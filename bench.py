@@ -1,0 +1,106 @@
+"""Benchmark driver — prints ONE JSON line.
+
+Headline metric: SimpleRNN training throughput (records/second), the
+only absolute number the reference publishes (models/rnn/README.md:119-122:
+2.43→4.85 records/s at batch 12 on a Xeon node — BASELINE.md).
+``vs_baseline`` is ours / 4.85.
+
+Also measured and reported as extra keys: ResNet-50 ImageNet-shape
+training images/sec/chip (the BASELINE.json north-star metric) and
+LeNet-5 MNIST-shape throughput.
+"""
+from __future__ import annotations
+
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+REFERENCE_SIMPLE_RNN_RPS = 4.85  # reference models/rnn/README.md:122
+
+
+def _train_step_fn(model, criterion, optim):
+    def step(params, buffers, slots, lr, rng, x, y):
+        def loss_fn(p):
+            out, nb = model.apply_fn(p, buffers, x, True, rng)
+            return criterion._loss(out, y), nb
+
+        (loss, nb), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
+        new_params, new_slots = optim.step(grads, params, slots, lr)
+        return loss, new_params, nb, new_slots
+
+    return jax.jit(step)
+
+
+def bench_model(model, criterion, x, y, iters=20, warmup=3, lr=0.01):
+    from bigdl_tpu.optim import SGD
+
+    optim = SGD(learning_rate=lr)
+    params = model.param_tree()
+    buffers = model.buffer_tree()
+    slots = optim.init_state(params)
+    step = _train_step_fn(model, criterion, optim)
+    rng = jax.random.PRNGKey(0)
+    lr_arr = jnp.float32(lr)
+    x, y = jnp.asarray(x), jnp.asarray(y)
+
+    for _ in range(warmup):
+        loss, params, buffers, slots = step(params, buffers, slots, lr_arr, rng, x, y)
+    jax.block_until_ready(loss)
+    t0 = time.time()
+    for _ in range(iters):
+        loss, params, buffers, slots = step(params, buffers, slots, lr_arr, rng, x, y)
+    jax.block_until_ready(loss)
+    dt = time.time() - t0
+    return x.shape[0] * iters / dt
+
+
+def main():
+    from bigdl_tpu import nn
+    from bigdl_tpu.models.lenet import LeNet5
+    from bigdl_tpu.models.resnet import ResNet50
+    from bigdl_tpu.models.rnn import SimpleRNN
+    from bigdl_tpu.utils.rng import set_global_seed
+
+    set_global_seed(42)
+    rng = np.random.RandomState(0)
+
+    # --- SimpleRNN: the reference's published workload (batch 12) -------
+    V, H, T, B = 4001, 40, 25, 12
+    seq = rng.randint(0, V, (B, T + 1))
+    x_rnn = np.eye(V, dtype=np.float32)[seq[:, :-1]]
+    y_rnn = (seq[:, 1:] + 1).astype(np.float32)
+    rnn = SimpleRNN(V, H, V)
+    rnn_crit = nn.TimeDistributedCriterion(nn.ClassNLLCriterion(), True)
+    rnn_rps = bench_model(rnn, rnn_crit, x_rnn, y_rnn, iters=20)
+
+    # --- ResNet-50 ImageNet shapes: north-star metric -------------------
+    B_r = 32
+    x_res = rng.rand(B_r, 3, 224, 224).astype(np.float32)
+    y_res = rng.randint(1, 1001, B_r).astype(np.float32)
+    resnet = ResNet50(1000)
+    res_ips = bench_model(resnet, nn.ClassNLLCriterion(), x_res, y_res,
+                          iters=10)
+
+    # --- LeNet-5 MNIST shapes ------------------------------------------
+    B_l = 256
+    x_len = rng.rand(B_l, 28, 28).astype(np.float32)
+    y_len = rng.randint(1, 11, B_l).astype(np.float32)
+    lenet_ips = bench_model(LeNet5(10), nn.ClassNLLCriterion(), x_len, y_len,
+                            iters=20)
+
+    print(json.dumps({
+        "metric": "SimpleRNN train throughput (batch 12)",
+        "value": round(rnn_rps, 2),
+        "unit": "records/second",
+        "vs_baseline": round(rnn_rps / REFERENCE_SIMPLE_RNN_RPS, 2),
+        "resnet50_images_per_sec_per_chip": round(res_ips, 2),
+        "lenet5_images_per_sec": round(lenet_ips, 2),
+        "device": str(jax.devices()[0]),
+    }))
+
+
+if __name__ == "__main__":
+    main()

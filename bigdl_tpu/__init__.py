@@ -1,0 +1,10 @@
+"""bigdl_tpu — a TPU-native deep learning framework.
+
+A ground-up JAX/XLA rebuild of the capabilities of the reference
+BigDL-era framework (Torch-style layers, Optimizer lifecycle,
+DataSet/Transformer pipeline, synchronous distributed SGD) designed
+TPU-first: one jitted train step, pjit/shard_map parallelism over a
+device mesh, XLA collectives instead of a block-manager all-reduce.
+"""
+
+__version__ = "0.1.0"

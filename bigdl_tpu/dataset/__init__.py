@@ -1,0 +1,9 @@
+from .dataset import (
+    AbstractDataSet, LocalArrayDataSet, ShardedDataSet, TransformedDataSet,
+    array, rdd, sort_data,
+)
+from .sample import (
+    MiniBatch, PaddingParam, Sample, SampleToBatch, SampleToMiniBatch,
+)
+from .transformer import ChainedTransformer, FnTransformer, Transformer, transformer
+from . import datasets, image

@@ -1,0 +1,107 @@
+"""DataSet abstractions (reference dataset/DataSet.scala:46-557).
+
+``LocalArrayDataSet`` mirrors the reference's in-memory dataset with
+index-array shuffling (CachedDistriDataSet.shuffle, DataSet.scala:292).
+``ShardedDataSet`` is the TPU-native replacement for
+``DistributedDataSet``: instead of one RDD partition per executor, one
+host iterator yields *global* batches that the distributed optimizer
+shards over the mesh's data axis (device_put with a NamedSharding — the
+infeed analogue of ZippedPartitionsWithLocalityRDD colocation).
+"""
+from __future__ import annotations
+
+from typing import Iterator, List, Optional, Sequence
+
+import numpy as np
+
+from ..utils.rng import RNG
+from .transformer import Transformer
+
+
+class AbstractDataSet:
+    """reference dataset/DataSet.scala:46"""
+
+    def data(self, train: bool) -> Iterator:
+        raise NotImplementedError
+
+    def size(self) -> int:
+        raise NotImplementedError
+
+    def shuffle(self):
+        raise NotImplementedError
+
+    def transform(self, transformer: Transformer) -> "TransformedDataSet":
+        return TransformedDataSet(self, transformer)
+
+    # `ds -> transformer` spelled `ds >> transformer`
+    def __rshift__(self, transformer: Transformer):
+        return self.transform(transformer)
+
+
+class LocalArrayDataSet(AbstractDataSet):
+    """In-memory dataset with index shuffling (reference DataSet.scala:128)."""
+
+    def __init__(self, data: Sequence):
+        self._data = list(data)
+        self._index = np.arange(len(self._data))
+
+    def size(self) -> int:
+        return len(self._data)
+
+    def shuffle(self):
+        RNG().shuffle(self._index)
+        return self
+
+    def data(self, train: bool) -> Iterator:
+        if train:
+            # infinite looping iterator (reference DataSet.scala:255-288)
+            def gen():
+                while True:
+                    for i in self._index:
+                        yield self._data[i]
+
+            return gen()
+        return (self._data[i] for i in range(len(self._data)))
+
+
+class TransformedDataSet(AbstractDataSet):
+    def __init__(self, base: AbstractDataSet, transformer: Transformer):
+        self.base = base
+        self.transformer = transformer
+
+    def size(self) -> int:
+        return self.base.size()
+
+    def shuffle(self):
+        self.base.shuffle()
+        return self
+
+    def data(self, train: bool) -> Iterator:
+        return self.transformer(self.base.data(train))
+
+
+class ShardedDataSet(LocalArrayDataSet):
+    """Distributed-dataset seam: batches from here are device_put with a
+    ``data``-axis sharding by the DistriOptimizer (P1 in SURVEY §2.2).
+    ``partition_num`` is kept for API parity; sharding happens at infeed.
+    """
+
+    def __init__(self, data: Sequence, partition_num: int = 1):
+        super().__init__(data)
+        self.partition_num = partition_num
+
+
+def array(data: Sequence) -> LocalArrayDataSet:
+    """reference DataSet.array (DataSet.scala:325)"""
+    return LocalArrayDataSet(data)
+
+
+def rdd(data: Sequence, partition_num: int = 1) -> ShardedDataSet:
+    """reference DataSet.rdd (DataSet.scala:348) — host-sharded stand-in."""
+    return ShardedDataSet(data, partition_num)
+
+
+def sort_data(samples, ascending: bool = True):
+    """Length-sorted batching helper (reference DataSet.sortData:372-400)."""
+    return sorted(samples, key=lambda s: np.asarray(s.feature).shape[0],
+                  reverse=not ascending)

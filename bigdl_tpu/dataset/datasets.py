@@ -1,0 +1,82 @@
+"""Dataset loaders (reference pyspark/bigdl/dataset/mnist.py & the
+Scala load helpers in models/*/Utils).
+
+Real IDX/CIFAR-binary files are parsed when present under ``data_dir``;
+otherwise a deterministic synthetic set with learnable structure is
+generated (class-dependent means) so examples/tests/benchmarks run
+hermetically in this zero-egress environment.
+"""
+from __future__ import annotations
+
+import gzip
+import os
+import struct
+from typing import Optional, Tuple
+
+import numpy as np
+
+TRAIN_MEAN = 0.13066047740239506 * 255
+TRAIN_STD = 0.3081078 * 255
+TEST_MEAN = 0.13251460696903547 * 255
+TEST_STD = 0.31048024 * 255
+
+CIFAR_MEAN = (125.3, 123.0, 113.9)
+CIFAR_STD = (63.0, 62.1, 66.7)
+
+
+def _read_idx(path: str) -> np.ndarray:
+    opener = gzip.open if path.endswith(".gz") else open
+    with opener(path, "rb") as f:
+        raw = f.read()
+    magic, = struct.unpack(">i", raw[:4])
+    ndim = magic % 256
+    dims = struct.unpack(">" + "i" * ndim, raw[4:4 + 4 * ndim])
+    return np.frombuffer(raw, np.uint8, offset=4 + 4 * ndim).reshape(dims)
+
+
+def _synthetic_images(n: int, shape, n_classes: int, seed: int,
+                      proto_seed: int = 1234):
+    """Class-conditional gaussian blobs — learnable by small nets.
+
+    ``proto_seed`` fixes the class prototypes across train/test splits
+    (only labels+noise vary with ``seed``) so a trained model generalizes.
+    """
+    rng = np.random.RandomState(seed)
+    labels = rng.randint(0, n_classes, n)
+    protos = np.random.RandomState(proto_seed).rand(n_classes, *shape) * 255
+    imgs = protos[labels] + rng.randn(n, *shape) * 25
+    return np.clip(imgs, 0, 255).astype(np.uint8), (labels + 1).astype(np.float32)
+
+
+def load_mnist(data_dir: Optional[str] = None, train: bool = True,
+               synthetic_size: int = 2048) -> Tuple[np.ndarray, np.ndarray]:
+    """Returns (images (N, 28, 28) uint8, labels (N,) float 1-based)."""
+    if data_dir:
+        prefix = "train" if train else "t10k"
+        for ext in ("", ".gz"):
+            ip = os.path.join(data_dir, f"{prefix}-images-idx3-ubyte{ext}")
+            lp = os.path.join(data_dir, f"{prefix}-labels-idx1-ubyte{ext}")
+            if os.path.exists(ip) and os.path.exists(lp):
+                return _read_idx(ip), _read_idx(lp).astype(np.float32) + 1
+    n = synthetic_size if train else synthetic_size // 4
+    return _synthetic_images(n, (28, 28), 10, seed=0 if train else 1)
+
+
+def load_cifar10(data_dir: Optional[str] = None, train: bool = True,
+                 synthetic_size: int = 2048) -> Tuple[np.ndarray, np.ndarray]:
+    """Returns (images (N, 32, 32, 3) uint8 BGR, labels 1-based float)."""
+    if data_dir:
+        files = ([f"data_batch_{i}.bin" for i in range(1, 6)] if train
+                 else ["test_batch.bin"])
+        paths = [os.path.join(data_dir, f) for f in files]
+        if all(os.path.exists(p) for p in paths):
+            imgs, labels = [], []
+            for p in paths:
+                raw = np.fromfile(p, np.uint8).reshape(-1, 3073)
+                labels.append(raw[:, 0])
+                chw = raw[:, 1:].reshape(-1, 3, 32, 32)
+                imgs.append(chw.transpose(0, 2, 3, 1)[..., ::-1])  # RGB→BGR HWC
+            return (np.concatenate(imgs),
+                    np.concatenate(labels).astype(np.float32) + 1)
+    n = synthetic_size if train else synthetic_size // 4
+    return _synthetic_images(n, (32, 32, 3), 10, seed=2 if train else 3)

@@ -1,0 +1,158 @@
+"""Image pipeline transformers (reference dataset/image/: GreyImg* for
+MNIST, BGRImg* for CIFAR/ImageNet, HFlip, ColorJitter, Lighting, crop).
+
+Images are numpy HWC float arrays on the host; all transforms are
+host-side (the reference's MTLabeledBGRImgToBatch multithreading is
+unnecessary — batching cost is trivial next to the jitted step)."""
+from __future__ import annotations
+
+from typing import Iterator, Optional, Tuple
+
+import numpy as np
+
+from ..utils.rng import RNG
+from .sample import Sample
+from .transformer import Transformer
+
+
+class GreyImgNormalizer(Transformer):
+    """reference dataset/image/GreyImgNormalizer.scala"""
+
+    def __init__(self, mean: float, std: float):
+        self.mean, self.std = mean, std
+
+    def apply(self, it):
+        for img, label in it:
+            yield (np.asarray(img, np.float32) - self.mean) / self.std, label
+
+
+class BGRImgNormalizer(Transformer):
+    """Per-channel normalize (reference dataset/image/BGRImgNormalizer.scala)."""
+
+    def __init__(self, mean: Tuple[float, float, float],
+                 std: Tuple[float, float, float]):
+        self.mean = np.asarray(mean, np.float32)
+        self.std = np.asarray(std, np.float32)
+
+    def apply(self, it):
+        for img, label in it:
+            yield (np.asarray(img, np.float32) - self.mean) / self.std, label
+
+
+class HFlip(Transformer):
+    """Random horizontal flip (reference dataset/image/HFlip.scala)."""
+
+    def __init__(self, threshold: float = 0.5):
+        self.threshold = threshold
+
+    def apply(self, it):
+        for img, label in it:
+            if RNG().uniform() < self.threshold:
+                img = np.ascontiguousarray(np.asarray(img)[:, ::-1])
+            yield img, label
+
+
+class BGRImgCropper(Transformer):
+    """Random crop (reference dataset/image/BGRImgCropper.scala)."""
+
+    def __init__(self, crop_width: int, crop_height: int):
+        self.cw, self.ch = crop_width, crop_height
+
+    def apply(self, it):
+        for img, label in it:
+            img = np.asarray(img)
+            h, w = img.shape[:2]
+            y = int(RNG().random_int(0, max(h - self.ch, 0) + 1))
+            x = int(RNG().random_int(0, max(w - self.cw, 0) + 1))
+            yield img[y:y + self.ch, x:x + self.cw], label
+
+
+class BGRImgRdmCropper(BGRImgCropper):
+    """Random crop with zero padding (reference BGRImgRdmCropper.scala)."""
+
+    def __init__(self, crop_width: int, crop_height: int, padding: int = 0):
+        super().__init__(crop_width, crop_height)
+        self.padding = padding
+
+    def apply(self, it):
+        def padded(src):
+            for img, label in src:
+                img = np.asarray(img)
+                p = self.padding
+                if p > 0:
+                    img = np.pad(img, [(p, p), (p, p)] + [(0, 0)] * (img.ndim - 2))
+                yield img, label
+
+        return super().apply(padded(it))
+
+
+class CenterCrop(Transformer):
+    def __init__(self, crop_width: int, crop_height: int):
+        self.cw, self.ch = crop_width, crop_height
+
+    def apply(self, it):
+        for img, label in it:
+            img = np.asarray(img)
+            h, w = img.shape[:2]
+            y, x = (h - self.ch) // 2, (w - self.cw) // 2
+            yield img[y:y + self.ch, x:x + self.cw], label
+
+
+class ColorJitter(Transformer):
+    """Random brightness/contrast/saturation (reference
+    dataset/image/ColorJitter.scala)."""
+
+    def __init__(self, delta: float = 0.4):
+        self.delta = delta
+
+    def apply(self, it):
+        for img, label in it:
+            img = np.asarray(img, np.float32)
+            order = RNG().permutation(3)
+            for o in order:
+                alpha = 1.0 + float(RNG().uniform(-self.delta, self.delta))
+                if o == 0:  # brightness
+                    img = img * alpha
+                elif o == 1:  # contrast
+                    img = img * alpha + (1 - alpha) * img.mean()
+                else:  # saturation
+                    grey = img.mean(axis=-1, keepdims=True)
+                    img = img * alpha + (1 - alpha) * grey
+            yield img, label
+
+
+class Lighting(Transformer):
+    """AlexNet PCA lighting noise (reference dataset/image/Lighting.scala)."""
+
+    EIGVAL = np.array([0.2175, 0.0188, 0.0045], np.float32)
+    EIGVEC = np.array([[-0.5675, 0.7192, 0.4009],
+                       [-0.5808, -0.0045, -0.8140],
+                       [-0.5836, -0.6948, 0.4203]], np.float32)
+
+    def __init__(self, alphastd: float = 0.1):
+        self.alphastd = alphastd
+
+    def apply(self, it):
+        for img, label in it:
+            alpha = RNG().normal(0, self.alphastd, (3,)).astype(np.float32)
+            shift = (self.EIGVEC * alpha * self.EIGVAL).sum(axis=1)
+            yield np.asarray(img, np.float32) + shift, label
+
+
+class GreyImgToSample(Transformer):
+    """(H, W) grey image + 1-based label → Sample with (1, H, W) feature
+    (reference GreyImgToSample.scala / GreyImgToBatch)."""
+
+    def apply(self, it):
+        for img, label in it:
+            feat = np.asarray(img, np.float32)[None, :, :]
+            yield Sample(feat, np.float32(label))
+
+
+class BGRImgToSample(Transformer):
+    """HWC BGR image → CHW Sample (reference BGRImgToSample.scala)."""
+
+    def apply(self, it):
+        for img, label in it:
+            feat = np.asarray(img, np.float32).transpose(2, 0, 1)
+            yield Sample(feat, np.float32(label))

@@ -1,0 +1,6 @@
+from .autoencoder import Autoencoder
+from .inception import Inception_v1, InceptionV1NoAuxClassifier
+from .lenet import LeNet5, lenet_graph
+from .resnet import ResNet50, ResNetCifar
+from .rnn import LSTMClassifier, SimpleRNN
+from .vgg import Vgg16, Vgg19, VggForCifar10

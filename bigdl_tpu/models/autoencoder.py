@@ -1,0 +1,17 @@
+"""MNIST Autoencoder (reference models/autoencoder/Autoencoder.scala)."""
+from __future__ import annotations
+
+from .. import nn
+
+
+def Autoencoder(class_num: int = 32) -> nn.Sequential:
+    """784 → classNum → 784 with sigmoid reconstruction, trained with
+    MSECriterion against the input (reference Train.scala uses
+    ``toAutoencoderBatch`` so target = input)."""
+    return nn.Sequential(
+        nn.Reshape([28 * 28]),
+        nn.Linear(28 * 28, class_num),
+        nn.ReLU(True),
+        nn.Linear(class_num, 28 * 28),
+        nn.Sigmoid(),
+    )

@@ -1,0 +1,70 @@
+"""Inception v1 / v2 ImageNet (reference models/inception/Inception_v1.scala,
+Inception_v2.scala) — the large-batch distributed workload (BASELINE.md).
+"""
+from __future__ import annotations
+
+from .. import nn
+
+
+def _inception_module(n_in: int, cfg, prefix: str) -> nn.Concat:
+    """cfg = ((1x1), (3x3reduce, 3x3), (5x5reduce, 5x5), (pool_proj))
+    (reference Inception_v1.scala inception())."""
+    concat = nn.Concat(2)
+    c1 = nn.Sequential(
+        nn.SpatialConvolution(n_in, cfg[0][0], 1, 1, 1, 1).set_name(prefix + "1x1"),
+        nn.ReLU(True))
+    concat.add(c1)
+    c3 = nn.Sequential(
+        nn.SpatialConvolution(n_in, cfg[1][0], 1, 1, 1, 1).set_name(prefix + "3x3_reduce"),
+        nn.ReLU(True),
+        nn.SpatialConvolution(cfg[1][0], cfg[1][1], 3, 3, 1, 1, 1, 1).set_name(prefix + "3x3"),
+        nn.ReLU(True))
+    concat.add(c3)
+    c5 = nn.Sequential(
+        nn.SpatialConvolution(n_in, cfg[2][0], 1, 1, 1, 1).set_name(prefix + "5x5_reduce"),
+        nn.ReLU(True),
+        nn.SpatialConvolution(cfg[2][0], cfg[2][1], 5, 5, 1, 1, 2, 2).set_name(prefix + "5x5"),
+        nn.ReLU(True))
+    concat.add(c5)
+    pool = nn.Sequential(
+        nn.SpatialMaxPooling(3, 3, 1, 1, 1, 1).ceil(),
+        nn.SpatialConvolution(n_in, cfg[3][0], 1, 1, 1, 1).set_name(prefix + "pool_proj"),
+        nn.ReLU(True))
+    concat.add(pool)
+    return concat
+
+
+def InceptionV1NoAuxClassifier(class_num: int = 1000) -> nn.Sequential:
+    """reference Inception_v1.scala (no-aux variant used by the perf
+    harness, DistriOptimizerPerf.scala:32)."""
+    model = nn.Sequential(
+        nn.SpatialConvolution(3, 64, 7, 7, 2, 2, 3, 3).set_name("conv1/7x7_s2"),
+        nn.ReLU(True),
+        nn.SpatialMaxPooling(3, 3, 2, 2).ceil(),
+        nn.SpatialCrossMapLRN(5, 0.0001, 0.75),
+        nn.SpatialConvolution(64, 64, 1, 1, 1, 1).set_name("conv2/3x3_reduce"),
+        nn.ReLU(True),
+        nn.SpatialConvolution(64, 192, 3, 3, 1, 1, 1, 1).set_name("conv2/3x3"),
+        nn.ReLU(True),
+        nn.SpatialCrossMapLRN(5, 0.0001, 0.75),
+        nn.SpatialMaxPooling(3, 3, 2, 2).ceil())
+    model.add(_inception_module(192, ((64,), (96, 128), (16, 32), (32,)), "inception_3a/"))
+    model.add(_inception_module(256, ((128,), (128, 192), (32, 96), (64,)), "inception_3b/"))
+    model.add(nn.SpatialMaxPooling(3, 3, 2, 2).ceil())
+    model.add(_inception_module(480, ((192,), (96, 208), (16, 48), (64,)), "inception_4a/"))
+    model.add(_inception_module(512, ((160,), (112, 224), (24, 64), (64,)), "inception_4b/"))
+    model.add(_inception_module(512, ((128,), (128, 256), (24, 64), (64,)), "inception_4c/"))
+    model.add(_inception_module(512, ((112,), (144, 288), (32, 64), (64,)), "inception_4d/"))
+    model.add(_inception_module(528, ((256,), (160, 320), (32, 128), (128,)), "inception_4e/"))
+    model.add(nn.SpatialMaxPooling(3, 3, 2, 2).ceil())
+    model.add(_inception_module(832, ((256,), (160, 320), (32, 128), (128,)), "inception_5a/"))
+    model.add(_inception_module(832, ((384,), (192, 384), (48, 128), (128,)), "inception_5b/"))
+    model.add(nn.SpatialAveragePooling(7, 7, 1, 1))
+    model.add(nn.Dropout(0.4))
+    model.add(nn.View(1024))
+    model.add(nn.Linear(1024, class_num).set_name("loss3/classifier"))
+    model.add(nn.LogSoftMax())
+    return model
+
+
+Inception_v1 = InceptionV1NoAuxClassifier

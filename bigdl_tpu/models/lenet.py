@@ -1,0 +1,44 @@
+"""LeNet-5 (reference models/lenet/LeNet5.scala + Train.scala).
+
+Same topology the reference builds: conv(1→6,5×5) → tanh → maxpool →
+conv(6→12,5×5) → tanh → maxpool → fc(12*4*4→100) → tanh → fc(100→10) →
+logsoftmax.
+"""
+from __future__ import annotations
+
+from .. import nn
+
+
+def LeNet5(class_num: int = 10) -> nn.Sequential:
+    return nn.Sequential(
+        nn.Reshape([1, 28, 28]),
+        nn.SpatialConvolution(1, 6, 5, 5).set_name("conv1_5x5"),
+        nn.Tanh(),
+        nn.SpatialMaxPooling(2, 2, 2, 2),
+        nn.SpatialConvolution(6, 12, 5, 5).set_name("conv2_5x5"),
+        nn.Tanh(),
+        nn.SpatialMaxPooling(2, 2, 2, 2),
+        nn.Reshape([12 * 4 * 4]),
+        nn.Linear(12 * 4 * 4, 100).set_name("fc_1"),
+        nn.Tanh(),
+        nn.Linear(100, class_num).set_name("fc_2"),
+        nn.LogSoftMax(),
+    )
+
+
+def lenet_graph(class_num: int = 10) -> nn.Graph:
+    """Graph-API variant (reference LeNet5.graph)."""
+    inp = nn.Input()
+    x = nn.Reshape([1, 28, 28])(inp)
+    x = nn.SpatialConvolution(1, 6, 5, 5)(x)
+    x = nn.Tanh()(x)
+    x = nn.SpatialMaxPooling(2, 2, 2, 2)(x)
+    x = nn.SpatialConvolution(6, 12, 5, 5)(x)
+    x = nn.Tanh()(x)
+    x = nn.SpatialMaxPooling(2, 2, 2, 2)(x)
+    x = nn.Reshape([12 * 4 * 4])(x)
+    x = nn.Linear(12 * 4 * 4, 100)(x)
+    x = nn.Tanh()(x)
+    x = nn.Linear(100, class_num)(x)
+    out = nn.LogSoftMax()(x)
+    return nn.Graph(inp, out)

@@ -1,0 +1,109 @@
+"""ResNet (reference models/resnet/ResNet.scala): CIFAR-10 basic-block
+variant (depth 20/32/.../110, shortcutType A/B) and ImageNet ResNet-50
+bottleneck variant.
+
+The reference's ``optnet``/``shareGradInput`` memory tricks
+(ResNet.scala) are XLA's job now — buffer sharing falls out of the
+compiler's liveness analysis, so those knobs vanish by design.
+"""
+from __future__ import annotations
+
+from .. import nn
+
+
+def _shortcut(n_in: int, n_out: int, stride: int, shortcut_type: str):
+    use_conv = (shortcut_type == "C"
+                or (shortcut_type == "B" and n_in != n_out))
+    if use_conv:
+        return nn.Sequential(
+            nn.SpatialConvolution(n_in, n_out, 1, 1, stride, stride),
+            nn.SpatialBatchNormalization(n_out))
+    if n_in != n_out:
+        # type A: identity with stride + zero-padded channels
+        return nn.Sequential(
+            nn.SpatialAveragePooling(1, 1, stride, stride),
+            nn.Concat(2,
+                      nn.Identity(),
+                      nn.MulConstant(0.0)))
+    return nn.Identity()
+
+
+def _basic_block(n_in: int, n_out: int, stride: int, shortcut_type: str):
+    s = nn.Sequential(
+        nn.SpatialConvolution(n_in, n_out, 3, 3, stride, stride, 1, 1),
+        nn.SpatialBatchNormalization(n_out),
+        nn.ReLU(True),
+        nn.SpatialConvolution(n_out, n_out, 3, 3, 1, 1, 1, 1),
+        nn.SpatialBatchNormalization(n_out))
+    return nn.Sequential(
+        nn.ConcatTable(s, _shortcut(n_in, n_out, stride, shortcut_type)),
+        nn.CAddTable(True),
+        nn.ReLU(True))
+
+
+def _bottleneck(n_in: int, n_mid: int, n_out: int, stride: int,
+                shortcut_type: str):
+    s = nn.Sequential(
+        nn.SpatialConvolution(n_in, n_mid, 1, 1, 1, 1),
+        nn.SpatialBatchNormalization(n_mid), nn.ReLU(True),
+        nn.SpatialConvolution(n_mid, n_mid, 3, 3, stride, stride, 1, 1),
+        nn.SpatialBatchNormalization(n_mid), nn.ReLU(True),
+        nn.SpatialConvolution(n_mid, n_out, 1, 1, 1, 1),
+        nn.SpatialBatchNormalization(n_out))
+    return nn.Sequential(
+        nn.ConcatTable(s, _shortcut(n_in, n_out, stride, shortcut_type)),
+        nn.CAddTable(True),
+        nn.ReLU(True))
+
+
+def ResNetCifar(depth: int = 20, class_num: int = 10,
+                shortcut_type: str = "A") -> nn.Sequential:
+    """reference models/resnet/ResNet.scala CIFAR-10 path (README: depth
+    20, batch 448, 156 epochs, shortcutType A)."""
+    assert (depth - 2) % 6 == 0, "depth must be 6n+2"
+    n = (depth - 2) // 6
+    model = nn.Sequential(
+        nn.SpatialConvolution(3, 16, 3, 3, 1, 1, 1, 1),
+        nn.SpatialBatchNormalization(16),
+        nn.ReLU(True))
+
+    def layer(n_in, n_out, count, stride):
+        seq = nn.Sequential()
+        seq.add(_basic_block(n_in, n_out, stride, shortcut_type))
+        for _ in range(1, count):
+            seq.add(_basic_block(n_out, n_out, 1, shortcut_type))
+        return seq
+
+    model.add(layer(16, 16, n, 1))
+    model.add(layer(16, 32, n, 2))
+    model.add(layer(32, 64, n, 2))
+    model.add(nn.SpatialAveragePooling(8, 8, 1, 1))
+    model.add(nn.View(64))
+    model.add(nn.Linear(64, class_num))
+    model.add(nn.LogSoftMax())
+    return model
+
+
+def ResNet50(class_num: int = 1000, shortcut_type: str = "B") -> nn.Sequential:
+    """ImageNet ResNet-50 (reference ResNet.scala imagenet path) — the
+    north-star benchmark model (BASELINE.md)."""
+    cfg = [(64, 256, 3, 1), (128, 512, 4, 2), (256, 1024, 6, 2),
+           (512, 2048, 3, 2)]
+    model = nn.Sequential(
+        nn.SpatialConvolution(3, 64, 7, 7, 2, 2, 3, 3),
+        nn.SpatialBatchNormalization(64),
+        nn.ReLU(True),
+        nn.SpatialMaxPooling(3, 3, 2, 2, 1, 1))
+    n_in = 64
+    for n_mid, n_out, count, stride in cfg:
+        seq = nn.Sequential()
+        seq.add(_bottleneck(n_in, n_mid, n_out, stride, shortcut_type))
+        for _ in range(1, count):
+            seq.add(_bottleneck(n_out, n_mid, n_out, 1, shortcut_type))
+        model.add(seq)
+        n_in = n_out
+    model.add(nn.SpatialAveragePooling(7, 7, 1, 1))
+    model.add(nn.View(2048))
+    model.add(nn.Linear(2048, class_num))
+    model.add(nn.LogSoftMax())
+    return model

@@ -1,0 +1,31 @@
+"""SimpleRNN language model (reference models/rnn/SimpleRNN.scala):
+lookup-free one-hot input → Recurrent(RnnCell) → TimeDistributed(Linear)
+→ LogSoftMax, trained with TimeDistributedCriterion(ClassNLL).
+"""
+from __future__ import annotations
+
+from .. import nn
+from ..nn.recurrent import Recurrent, RnnCell, TimeDistributed
+
+
+def SimpleRNN(input_size: int = 4001, hidden_size: int = 40,
+              output_size: int = 4001) -> nn.Sequential:
+    return nn.Sequential(
+        Recurrent(RnnCell(input_size, hidden_size)).set_name("rnn"),
+        TimeDistributed(nn.Linear(hidden_size, output_size)),
+        nn.LogSoftMax(),  # over the class dim of (N, T, C)
+    )
+
+
+def LSTMClassifier(vocab_size: int, embed_dim: int, hidden: int,
+                   class_num: int) -> nn.Sequential:
+    """LSTM/GRU text classification config (BASELINE.md workload 5)."""
+    from ..nn.recurrent import LSTM, Recurrent
+
+    return nn.Sequential(
+        nn.LookupTable(vocab_size, embed_dim),
+        Recurrent(LSTM(embed_dim, hidden)),
+        nn.Select(2, -1),  # last timestep
+        nn.Linear(hidden, class_num),
+        nn.LogSoftMax(),
+    )

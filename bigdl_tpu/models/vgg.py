@@ -1,0 +1,67 @@
+"""VGG for CIFAR-10 (reference models/vgg/VggForCifar10.scala) and
+VGG-16/19 (reference models/utils/DistriOptimizerPerf harness configs).
+"""
+from __future__ import annotations
+
+from .. import nn
+
+
+def _conv_bn_relu(seq, n_in, n_out):
+    seq.add(nn.SpatialConvolution(n_in, n_out, 3, 3, 1, 1, 1, 1))
+    seq.add(nn.SpatialBatchNormalization(n_out, 1e-3))
+    seq.add(nn.ReLU(True))
+    return n_out
+
+
+def VggForCifar10(class_num: int = 10) -> nn.Sequential:
+    """reference models/vgg/VggForCifar10.scala"""
+    model = nn.Sequential()
+    cfg = [64, 64, "M", 128, 128, "M", 256, 256, 256, "M",
+           512, 512, 512, "M", 512, 512, 512, "M"]
+    n_in = 3
+    for v in cfg:
+        if v == "M":
+            model.add(nn.SpatialMaxPooling(2, 2, 2, 2).ceil())
+        else:
+            n_in = _conv_bn_relu(model, n_in, v)
+    model.add(nn.View(512))
+    classifier = nn.Sequential(
+        nn.Dropout(0.5), nn.Linear(512, 512),
+        nn.BatchNormalization(512), nn.ReLU(True),
+        nn.Dropout(0.5), nn.Linear(512, class_num), nn.LogSoftMax())
+    model.add(classifier)
+    return model
+
+
+def _vgg_imagenet(cfg, class_num: int = 1000) -> nn.Sequential:
+    model = nn.Sequential()
+    n_in = 3
+    for v in cfg:
+        if v == "M":
+            model.add(nn.SpatialMaxPooling(2, 2, 2, 2))
+        else:
+            model.add(nn.SpatialConvolution(n_in, v, 3, 3, 1, 1, 1, 1))
+            model.add(nn.ReLU(True))
+            n_in = v
+    model.add(nn.View(512 * 7 * 7))
+    model.add(nn.Linear(512 * 7 * 7, 4096))
+    model.add(nn.Threshold(0, 1e-6))
+    model.add(nn.Dropout(0.5))
+    model.add(nn.Linear(4096, 4096))
+    model.add(nn.Threshold(0, 1e-6))
+    model.add(nn.Dropout(0.5))
+    model.add(nn.Linear(4096, class_num))
+    model.add(nn.LogSoftMax())
+    return model
+
+
+def Vgg16(class_num: int = 1000) -> nn.Sequential:
+    """reference models/utils/DistriOptimizerPerf vgg16"""
+    return _vgg_imagenet([64, 64, "M", 128, 128, "M", 256, 256, 256, "M",
+                          512, 512, 512, "M", 512, 512, 512, "M"], class_num)
+
+
+def Vgg19(class_num: int = 1000) -> nn.Sequential:
+    return _vgg_imagenet([64, 64, "M", 128, 128, "M", 256, 256, 256, 256, "M",
+                          512, 512, 512, 512, "M", 512, 512, 512, 512, "M"],
+                         class_num)

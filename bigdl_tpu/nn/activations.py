@@ -1,0 +1,270 @@
+"""Activation layers (~29, reference nn/ — SURVEY §2.4 'Activations').
+
+All pure elementwise maps: XLA fuses each into its producer, so unlike
+the reference (separate MKL VML calls per op, TensorNumeric.scala:239-334)
+these cost zero extra HBM round-trips inside a jitted step.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .initialization import ConstInitMethod
+from .module import TensorModule
+
+
+class ReLU(TensorModule):
+    """reference nn/ReLU.scala (ip = in-place is meaningless under XLA)"""
+
+    def __init__(self, ip: bool = False):
+        super().__init__()
+
+    def _apply(self, params, buffers, x, training, rng):
+        return jax.nn.relu(x), buffers
+
+
+class ReLU6(TensorModule):
+    def _apply(self, params, buffers, x, training, rng):
+        return jnp.clip(x, 0.0, 6.0), buffers
+
+
+class LeakyReLU(TensorModule):
+    def __init__(self, negval: float = 0.01, inplace: bool = False):
+        super().__init__()
+        self.negval = negval
+
+    def _apply(self, params, buffers, x, training, rng):
+        return jnp.where(x > 0, x, self.negval * x), buffers
+
+
+class PReLU(TensorModule):
+    """Learned negative slope (reference nn/PReLU.scala); n_output_plane=0
+    → one shared scalar."""
+
+    def __init__(self, n_output_plane: int = 0):
+        super().__init__()
+        self.n_output_plane = n_output_plane
+        self.reset()
+
+    def reset(self):
+        shape = (max(self.n_output_plane, 1),)
+        init = self._init_methods.get("weight", (ConstInitMethod(0.25), None))[0]
+        self._register_param("weight", init.init(shape))
+        return self
+
+    def _apply(self, params, buffers, x, training, rng):
+        w = params["weight"]
+        if self.n_output_plane > 0:
+            # reference PReLU.scala:86 — channel dim (1-based) is
+            # (nDim+1)%2+1: axis 1 for batched even-rank (NC, NCHW),
+            # axis 0 for unbatched odd-rank (C, CHW)
+            ch_axis = (x.ndim + 1) % 2
+            shape = [1] * x.ndim
+            shape[ch_axis] = self.n_output_plane
+            w = w.reshape(shape)
+        return jnp.where(x > 0, x, w * x), buffers
+
+
+class RReLU(TensorModule):
+    """Randomized leaky ReLU (reference nn/RReLU.scala): train = slope ~
+    U(lower, upper) per element; eval = fixed (lower+upper)/2."""
+
+    def __init__(self, lower: float = 1.0 / 8, upper: float = 1.0 / 3,
+                 inplace: bool = False):
+        super().__init__()
+        self.lower, self.upper = lower, upper
+
+    def _apply(self, params, buffers, x, training, rng):
+        if training and rng is not None:
+            a = jax.random.uniform(rng, x.shape, x.dtype, self.lower, self.upper)
+        else:
+            a = (self.lower + self.upper) / 2.0
+        return jnp.where(x >= 0, x, a * x), buffers
+
+
+class ELU(TensorModule):
+    def __init__(self, alpha: float = 1.0, inplace: bool = False):
+        super().__init__()
+        self.alpha = alpha
+
+    def _apply(self, params, buffers, x, training, rng):
+        return jnp.where(x > 0, x, self.alpha * jnp.expm1(x)), buffers
+
+
+class Tanh(TensorModule):
+    def _apply(self, params, buffers, x, training, rng):
+        return jnp.tanh(x), buffers
+
+
+class Sigmoid(TensorModule):
+    def _apply(self, params, buffers, x, training, rng):
+        return jax.nn.sigmoid(x), buffers
+
+
+class LogSigmoid(TensorModule):
+    def _apply(self, params, buffers, x, training, rng):
+        return jax.nn.log_sigmoid(x), buffers
+
+
+class LogSoftMax(TensorModule):
+    """reference nn/LogSoftMax.scala — over last dim for 1-D/2-D input"""
+
+    def _apply(self, params, buffers, x, training, rng):
+        return jax.nn.log_softmax(x, axis=-1), buffers
+
+
+class SoftMax(TensorModule):
+    def _apply(self, params, buffers, x, training, rng):
+        axis = 1 if x.ndim in (2, 4) else 0 if x.ndim in (1, 3) else -1
+        return jax.nn.softmax(x, axis=axis), buffers
+
+
+class SoftMin(TensorModule):
+    def _apply(self, params, buffers, x, training, rng):
+        axis = 1 if x.ndim in (2, 4) else 0 if x.ndim in (1, 3) else -1
+        return jax.nn.softmax(-x, axis=axis), buffers
+
+
+class SoftPlus(TensorModule):
+    def __init__(self, beta: float = 1.0):
+        super().__init__()
+        self.beta = beta
+
+    def _apply(self, params, buffers, x, training, rng):
+        # threshold at 20 like torch for numerical stability
+        bx = self.beta * x
+        return jnp.where(bx > 20.0, x, jnp.log1p(jnp.exp(bx)) / self.beta), buffers
+
+
+class SoftSign(TensorModule):
+    def _apply(self, params, buffers, x, training, rng):
+        return x / (1.0 + jnp.abs(x)), buffers
+
+
+class HardTanh(TensorModule):
+    def __init__(self, min_value: float = -1.0, max_value: float = 1.0,
+                 inplace: bool = False):
+        super().__init__()
+        assert max_value > min_value
+        self.min_value, self.max_value = min_value, max_value
+
+    def _apply(self, params, buffers, x, training, rng):
+        return jnp.clip(x, self.min_value, self.max_value), buffers
+
+
+class HardShrink(TensorModule):
+    def __init__(self, lambd: float = 0.5):
+        super().__init__()
+        self.lambd = lambd
+
+    def _apply(self, params, buffers, x, training, rng):
+        return jnp.where(jnp.abs(x) > self.lambd, x, 0.0), buffers
+
+
+class SoftShrink(TensorModule):
+    def __init__(self, lambd: float = 0.5):
+        super().__init__()
+        self.lambd = lambd
+
+    def _apply(self, params, buffers, x, training, rng):
+        return jnp.where(x > self.lambd, x - self.lambd,
+                         jnp.where(x < -self.lambd, x + self.lambd, 0.0)), buffers
+
+
+class TanhShrink(TensorModule):
+    def _apply(self, params, buffers, x, training, rng):
+        return x - jnp.tanh(x), buffers
+
+
+class Threshold(TensorModule):
+    def __init__(self, th: float = 1e-6, v: float = 0.0, ip: bool = False):
+        super().__init__()
+        self.th, self.v = th, v
+
+    def _apply(self, params, buffers, x, training, rng):
+        return jnp.where(x > self.th, x, self.v), buffers
+
+
+class Clamp(HardTanh):
+    def __init__(self, min_value: float, max_value: float):
+        super().__init__(float(min_value), float(max_value))
+
+
+class Abs(TensorModule):
+    def _apply(self, params, buffers, x, training, rng):
+        return jnp.abs(x), buffers
+
+
+class Power(TensorModule):
+    """(shift + scale*x)^power (reference nn/Power.scala)"""
+
+    def __init__(self, power: float, scale: float = 1.0, shift: float = 0.0):
+        super().__init__()
+        self.power, self.scale, self.shift = power, scale, shift
+
+    def _apply(self, params, buffers, x, training, rng):
+        return jnp.power(self.shift + self.scale * x, self.power), buffers
+
+
+class Square(TensorModule):
+    def _apply(self, params, buffers, x, training, rng):
+        return jnp.square(x), buffers
+
+
+class Sqrt(TensorModule):
+    def _apply(self, params, buffers, x, training, rng):
+        return jnp.sqrt(x), buffers
+
+
+class Log(TensorModule):
+    def _apply(self, params, buffers, x, training, rng):
+        return jnp.log(x), buffers
+
+
+class Exp(TensorModule):
+    def _apply(self, params, buffers, x, training, rng):
+        return jnp.exp(x), buffers
+
+
+class Mean(TensorModule):
+    """Mean over a (1-based) dim (reference nn/Mean.scala)."""
+
+    def __init__(self, dimension: int = 1, n_input_dims: int = -1,
+                 squeeze: bool = True):
+        super().__init__()
+        self.dimension = dimension
+        self.n_input_dims = n_input_dims
+        self.squeeze = squeeze
+
+    def _axis(self, x):
+        d = self.dimension - 1
+        if self.n_input_dims > 0 and x.ndim > self.n_input_dims:
+            d += 1  # batch mode
+        return d
+
+    def _apply(self, params, buffers, x, training, rng):
+        return jnp.mean(x, axis=self._axis(x), keepdims=not self.squeeze), buffers
+
+
+class Max(TensorModule):
+    def __init__(self, dim: int = 1, num_input_dims: int = -1):
+        super().__init__()
+        self.dim, self.num_input_dims = dim, num_input_dims
+
+    def _apply(self, params, buffers, x, training, rng):
+        d = self.dim - 1
+        if self.num_input_dims > 0 and x.ndim > self.num_input_dims:
+            d += 1
+        return jnp.max(x, axis=d), buffers
+
+
+class Min(TensorModule):
+    def __init__(self, dim: int = 1, num_input_dims: int = -1):
+        super().__init__()
+        self.dim, self.num_input_dims = dim, num_input_dims
+
+    def _apply(self, params, buffers, x, training, rng):
+        d = self.dim - 1
+        if self.num_input_dims > 0 and x.ndim > self.num_input_dims:
+            d += 1
+        return jnp.min(x, axis=d), buffers

@@ -1,0 +1,142 @@
+"""Container modules (reference nn/Sequential.scala:30, Concat.scala,
+ConcatTable.scala, ParallelTable.scala, Bottle.scala, MapTable.scala).
+
+Each container's ``apply_fn`` is pure composition of its children's pure
+applies — so any container tree traces into one XLA program.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..utils.table import Table
+from .module import AbstractModule, Container, TensorModule
+
+
+def _split_rng(rng, n):
+    import jax
+
+    if rng is None:
+        return [None] * n
+    return list(jax.random.split(rng, n))
+
+
+class Sequential(Container):
+    """Chain children (reference nn/Sequential.scala:30)."""
+
+    def apply_fn(self, params, buffers, inp, training=True, rng=None):
+        x = inp
+        new_buffers = {}
+        rngs = _split_rng(rng, max(len(self.modules), 1))
+        for i, m in enumerate(self.modules):
+            x, nb = m.apply_fn(params[str(i)], buffers[str(i)], x,
+                               training, rngs[i])
+            new_buffers[str(i)] = nb
+        return x, new_buffers
+
+
+class Concat(Container):
+    """Apply each child to the same input, concatenate outputs along
+    ``dimension`` (1-based) (reference nn/Concat.scala)."""
+
+    def __init__(self, dimension: int, *modules):
+        super().__init__(*modules)
+        self.dimension = dimension
+
+    def apply_fn(self, params, buffers, inp, training=True, rng=None):
+        outs, new_buffers = [], {}
+        rngs = _split_rng(rng, max(len(self.modules), 1))
+        for i, m in enumerate(self.modules):
+            o, nb = m.apply_fn(params[str(i)], buffers[str(i)], inp,
+                               training, rngs[i])
+            outs.append(o)
+            new_buffers[str(i)] = nb
+        return jnp.concatenate(outs, axis=self.dimension - 1), new_buffers
+
+
+class ConcatTable(Container):
+    """Apply each child to the same input, return a Table of outputs
+    (reference nn/ConcatTable.scala)."""
+
+    def apply_fn(self, params, buffers, inp, training=True, rng=None):
+        out, new_buffers = Table(), {}
+        rngs = _split_rng(rng, max(len(self.modules), 1))
+        for i, m in enumerate(self.modules):
+            o, nb = m.apply_fn(params[str(i)], buffers[str(i)], inp,
+                               training, rngs[i])
+            out[i + 1] = o
+            new_buffers[str(i)] = nb
+        return out, new_buffers
+
+
+class ParallelTable(Container):
+    """i-th child applied to i-th input table entry (reference
+    nn/ParallelTable.scala)."""
+
+    def apply_fn(self, params, buffers, inp, training=True, rng=None):
+        out, new_buffers = Table(), {}
+        rngs = _split_rng(rng, max(len(self.modules), 1))
+        for i, m in enumerate(self.modules):
+            o, nb = m.apply_fn(params[str(i)], buffers[str(i)], inp[i + 1],
+                               training, rngs[i])
+            out[i + 1] = o
+            new_buffers[str(i)] = nb
+        return out, new_buffers
+
+
+class MapTable(Container):
+    """Apply ONE shared child to every input entry (reference
+    nn/MapTable.scala) — weight sharing is free: same params subtree."""
+
+    def __init__(self, module: AbstractModule):
+        super().__init__(module)
+
+    def apply_fn(self, params, buffers, inp, training=True, rng=None):
+        m = self.modules[0]
+        out = Table()
+        nb = buffers["0"]
+        rngs = _split_rng(rng, max(len(inp), 1))
+        for j, key in enumerate(sorted(k for k in inp.keys())):
+            o, nb = m.apply_fn(params["0"], nb, inp[key], training, rngs[j])
+            out[key] = o
+        return out, {"0": nb}
+
+
+class Bottle(Container):
+    """Collapse leading dims, apply child, restore (reference nn/Bottle.scala)."""
+
+    def __init__(self, module: AbstractModule, n_input_dim: int = 2,
+                 n_output_dim: int = 2):
+        super().__init__(module)
+        self.n_input_dim = n_input_dim
+        self.n_output_dim = n_output_dim
+
+    def apply_fn(self, params, buffers, inp, training=True, rng=None):
+        in_shape = inp.shape
+        if len(in_shape) <= self.n_input_dim:
+            return self.modules[0].apply_fn(params["0"], buffers["0"], inp,
+                                            training, rng)
+        lead = in_shape[:len(in_shape) - self.n_input_dim + 1]
+        rest = in_shape[len(in_shape) - self.n_input_dim + 1:]
+        squashed = inp.reshape((-1,) + rest)
+        out, nb = self.modules[0].apply_fn(params["0"], buffers["0"], squashed,
+                                           training, rng)
+        out = out.reshape(lead + out.shape[1:])
+        return out, {"0": nb}
+
+
+class Identity(TensorModule):
+    """reference nn/Identity.scala"""
+
+    def _apply(self, params, buffers, inp, training, rng):
+        return inp, buffers
+
+
+class Echo(TensorModule):
+    """Print shape as activations flow past (reference nn/Echo.scala).
+    Uses jax.debug so it works under jit."""
+
+    def _apply(self, params, buffers, inp, training, rng):
+        import jax
+
+        jax.debug.print(self.get_name() + " shape: {}", inp.shape)
+        return inp, buffers

@@ -1,0 +1,303 @@
+"""Convolution family (reference SpatialConvolution.scala:42 et al.).
+
+The reference lowers conv to im2col+gemm with per-sample threads
+(SpatialConvolution.scala:199-227, NNPrimitive.scala).  On TPU the
+entire family is ``lax.conv_general_dilated`` — XLA tiles it straight
+onto the MXU, batched, with bias-add fused.  Layout is NCHW to match
+the reference's tensors.
+"""
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from .initialization import ONE_D, OUT_IN_KW_KH, RandomUniform
+from .module import TensorModule
+
+
+def _pair(v):
+    return v if isinstance(v, tuple) else (v, v)
+
+
+class SpatialConvolution(TensorModule):
+    """2-D conv, NCHW, group support, optional 'same'-ish explicit pads
+    (reference nn/SpatialConvolution.scala:42; im2col path replaced by
+    one XLA conv op)."""
+
+    def __init__(self, n_input_plane: int, n_output_plane: int,
+                 kernel_w: int, kernel_h: int, stride_w: int = 1,
+                 stride_h: int = 1, pad_w: int = 0, pad_h: int = 0,
+                 n_group: int = 1, propagate_back: bool = True,
+                 w_regularizer=None, b_regularizer=None,
+                 with_bias: bool = True):
+        super().__init__()
+        assert n_input_plane % n_group == 0
+        assert n_output_plane % n_group == 0
+        self.n_input_plane = n_input_plane
+        self.n_output_plane = n_output_plane
+        self.kernel_w, self.kernel_h = kernel_w, kernel_h
+        self.stride_w, self.stride_h = stride_w, stride_h
+        self.pad_w, self.pad_h = pad_w, pad_h
+        self.n_group = n_group
+        self.propagate_back = propagate_back
+        self.with_bias = with_bias
+        self.w_regularizer = w_regularizer
+        self.b_regularizer = b_regularizer
+        self.reset()
+
+    def reset(self):
+        shape = (self.n_output_plane, self.n_input_plane // self.n_group,
+                 self.kernel_h, self.kernel_w)
+        w_init = self._init_methods.get("weight", (RandomUniform(), None))[0]
+        self._register_param("weight", w_init.init(shape, OUT_IN_KW_KH))
+        if self.with_bias:
+            b_init = self._init_methods.get("bias", (RandomUniform(), None))[0]
+            self._register_param("bias",
+                                 b_init.init((self.n_output_plane,), ONE_D))
+        return self
+
+    def _conv(self, x, w):
+        # pad_w/pad_h = -1 means 'same' (reference uses -1 for same pad)
+        if self.pad_w == -1 or self.pad_h == -1:
+            padding = "SAME"
+        else:
+            padding = [(self.pad_h, self.pad_h), (self.pad_w, self.pad_w)]
+        return lax.conv_general_dilated(
+            x, w,
+            window_strides=(self.stride_h, self.stride_w),
+            padding=padding,
+            dimension_numbers=("NCHW", "OIHW", "NCHW"),
+            feature_group_count=self.n_group,
+            preferred_element_type=jnp.float32)
+
+    def _apply(self, params, buffers, x, training, rng):
+        squeeze = False
+        if x.ndim == 3:  # no-batch mode
+            x = x[None]
+            squeeze = True
+        y = self._conv(x, params["weight"])
+        if self.with_bias:
+            y = y + params["bias"][None, :, None, None]
+        if squeeze:
+            y = y[0]
+        return y, buffers
+
+
+class SpatialShareConvolution(SpatialConvolution):
+    """reference nn/SpatialShareConvolution.scala — im2col-buffer sharing
+    variant; under XLA there is no buffer to share, semantics identical."""
+
+
+class SpatialDilatedConvolution(SpatialConvolution):
+    """reference nn/SpatialDilatedConvolution.scala"""
+
+    def __init__(self, n_input_plane, n_output_plane, kw, kh, dw=1, dh=1,
+                 pad_w=0, pad_h=0, dilation_w=1, dilation_h=1,
+                 w_regularizer=None, b_regularizer=None):
+        self.dilation_w, self.dilation_h = dilation_w, dilation_h
+        super().__init__(n_input_plane, n_output_plane, kw, kh, dw, dh,
+                         pad_w, pad_h, 1, True, w_regularizer, b_regularizer)
+
+    def _conv(self, x, w):
+        padding = [(self.pad_h, self.pad_h), (self.pad_w, self.pad_w)]
+        return lax.conv_general_dilated(
+            x, w,
+            window_strides=(self.stride_h, self.stride_w),
+            padding=padding,
+            rhs_dilation=(self.dilation_h, self.dilation_w),
+            dimension_numbers=("NCHW", "OIHW", "NCHW"),
+            preferred_element_type=jnp.float32)
+
+
+class SpatialFullConvolution(TensorModule):
+    """Transposed conv / deconv (reference nn/SpatialFullConvolution.scala),
+    with output adjustment adj_w/adj_h."""
+
+    def __init__(self, n_input_plane: int, n_output_plane: int,
+                 kw: int, kh: int, dw: int = 1, dh: int = 1,
+                 pad_w: int = 0, pad_h: int = 0, adj_w: int = 0,
+                 adj_h: int = 0, n_group: int = 1, no_bias: bool = False,
+                 w_regularizer=None, b_regularizer=None):
+        super().__init__()
+        self.n_input_plane, self.n_output_plane = n_input_plane, n_output_plane
+        self.kw, self.kh, self.dw, self.dh = kw, kh, dw, dh
+        self.pad_w, self.pad_h = pad_w, pad_h
+        self.adj_w, self.adj_h = adj_w, adj_h
+        self.n_group = n_group
+        self.with_bias = not no_bias
+        self.reset()
+
+    def reset(self):
+        # reference layout: (in, out/group, kh, kw)
+        shape = (self.n_input_plane, self.n_output_plane // self.n_group,
+                 self.kh, self.kw)
+        w_init = self._init_methods.get("weight", (RandomUniform(), None))[0]
+        self._register_param("weight", w_init.init(shape, OUT_IN_KW_KH))
+        if getattr(self, "with_bias", True):
+            b_init = self._init_methods.get("bias", (RandomUniform(), None))[0]
+            self._register_param("bias",
+                                 b_init.init((self.n_output_plane,), ONE_D))
+        return self
+
+    def _apply(self, params, buffers, x, training, rng):
+        squeeze = False
+        if x.ndim == 3:
+            x = x[None]
+            squeeze = True
+        w = params["weight"]  # (I, O/g, kh, kw)
+        # Gradient-of-conv formulation: lhs-dilate input by stride.
+        pad_h = self.kh - 1 - self.pad_h
+        pad_w = self.kw - 1 - self.pad_w
+        w_flip = jnp.flip(w, axis=(-1, -2))
+        # to OIHW with O=n_output, I=n_input/g : transpose first two dims
+        if self.n_group > 1:
+            wg = w_flip.reshape(self.n_group, self.n_input_plane // self.n_group,
+                                self.n_output_plane // self.n_group, self.kh, self.kw)
+            wg = jnp.swapaxes(wg, 1, 2)
+            rhs = wg.reshape(self.n_output_plane,
+                             self.n_input_plane // self.n_group, self.kh, self.kw)
+        else:
+            rhs = jnp.swapaxes(w_flip, 0, 1)
+        y = lax.conv_general_dilated(
+            x, rhs, window_strides=(1, 1),
+            padding=[(pad_h, pad_h + self.adj_h), (pad_w, pad_w + self.adj_w)],
+            lhs_dilation=(self.dh, self.dw),
+            dimension_numbers=("NCHW", "OIHW", "NCHW"),
+            feature_group_count=self.n_group,
+            preferred_element_type=jnp.float32)
+        if self.with_bias:
+            y = y + params["bias"][None, :, None, None]
+        if squeeze:
+            y = y[0]
+        return y, buffers
+
+
+class SpatialConvolutionMap(TensorModule):
+    """Conv with an explicit input→output connection table
+    (reference nn/SpatialConvolutionMap.scala).  Implemented as a dense
+    conv with a fixed binary mask on the weight."""
+
+    def __init__(self, conn_table, kw: int, kh: int, dw: int = 1, dh: int = 1,
+                 pad_w: int = 0, pad_h: int = 0):
+        super().__init__()
+        conn = np.asarray(conn_table, dtype=np.int32)  # rows of (in, out), 1-based
+        self.conn = conn
+        self.n_input_plane = int(conn[:, 0].max())
+        self.n_output_plane = int(conn[:, 1].max())
+        self.kw, self.kh, self.dw, self.dh = kw, kh, dw, dh
+        self.pad_w, self.pad_h = pad_w, pad_h
+        mask = np.zeros((self.n_output_plane, self.n_input_plane, 1, 1), np.float32)
+        for i, o in conn:
+            mask[o - 1, i - 1, 0, 0] = 1.0
+        self._mask = jnp.asarray(mask)
+        self.reset()
+
+    def reset(self):
+        n_in_per_out = max(1, len(self.conn) // max(self.n_output_plane, 1))
+        stdv = 1.0 / math.sqrt(self.kw * self.kh * n_in_per_out)
+        init = RandomUniform(-stdv, stdv)
+        self._register_param("weight", init.init(
+            (self.n_output_plane, self.n_input_plane, self.kh, self.kw)))
+        self._register_param("bias", init.init((self.n_output_plane,)))
+        return self
+
+    def _apply(self, params, buffers, x, training, rng):
+        squeeze = False
+        if x.ndim == 3:
+            x = x[None]
+            squeeze = True
+        w = params["weight"] * self._mask
+        y = lax.conv_general_dilated(
+            x, w, (self.dh, self.dw),
+            [(self.pad_h, self.pad_h), (self.pad_w, self.pad_w)],
+            dimension_numbers=("NCHW", "OIHW", "NCHW"),
+            preferred_element_type=jnp.float32)
+        y = y + params["bias"][None, :, None, None]
+        if squeeze:
+            y = y[0]
+        return y, buffers
+
+
+class VolumetricConvolution(TensorModule):
+    """3-D conv, NCDHW (reference nn/VolumetricConvolution.scala)."""
+
+    def __init__(self, n_input_plane: int, n_output_plane: int,
+                 k_t: int, k_w: int, k_h: int, d_t: int = 1, d_w: int = 1,
+                 d_h: int = 1, pad_t: int = 0, pad_w: int = 0, pad_h: int = 0,
+                 with_bias: bool = True):
+        super().__init__()
+        self.n_input_plane, self.n_output_plane = n_input_plane, n_output_plane
+        self.k = (k_t, k_h, k_w)
+        self.d = (d_t, d_h, d_w)
+        self.pad = (pad_t, pad_h, pad_w)
+        self.with_bias = with_bias
+        self.reset()
+
+    def reset(self):
+        shape = (self.n_output_plane, self.n_input_plane) + self.k
+        w_init = self._init_methods.get("weight", (RandomUniform(), None))[0]
+        self._register_param("weight", w_init.init(shape, OUT_IN_KW_KH))
+        if self.with_bias:
+            b_init = self._init_methods.get("bias", (RandomUniform(), None))[0]
+            self._register_param("bias",
+                                 b_init.init((self.n_output_plane,), ONE_D))
+        return self
+
+    def _apply(self, params, buffers, x, training, rng):
+        squeeze = False
+        if x.ndim == 4:
+            x = x[None]
+            squeeze = True
+        y = lax.conv_general_dilated(
+            x, params["weight"], self.d,
+            [(p, p) for p in self.pad],
+            dimension_numbers=("NCDHW", "OIDHW", "NCDHW"),
+            preferred_element_type=jnp.float32)
+        if self.with_bias:
+            y = y + params["bias"][None, :, None, None, None]
+        if squeeze:
+            y = y[0]
+        return y, buffers
+
+
+class TemporalConvolution(TensorModule):
+    """1-D conv over (batch, nInputFrame, inputFrameSize)
+    (reference nn/TemporalConvolution.scala)."""
+
+    def __init__(self, input_frame_size: int, output_frame_size: int,
+                 kernel_w: int, stride_w: int = 1):
+        super().__init__()
+        self.input_frame_size = input_frame_size
+        self.output_frame_size = output_frame_size
+        self.kernel_w, self.stride_w = kernel_w, stride_w
+        self.reset()
+
+    def reset(self):
+        stdv = 1.0 / math.sqrt(self.kernel_w * self.input_frame_size)
+        init = self._init_methods.get("weight", (RandomUniform(-stdv, stdv), None))[0]
+        self._register_param("weight", init.init(
+            (self.output_frame_size, self.input_frame_size, self.kernel_w)))
+        b_init = self._init_methods.get("bias", (RandomUniform(-stdv, stdv), None))[0]
+        self._register_param("bias", b_init.init((self.output_frame_size,)))
+        return self
+
+    def _apply(self, params, buffers, x, training, rng):
+        squeeze = False
+        if x.ndim == 2:
+            x = x[None]
+            squeeze = True
+        # (N, T, C) -> (N, C, T)
+        xc = jnp.swapaxes(x, 1, 2)
+        y = lax.conv_general_dilated(
+            xc, params["weight"], (self.stride_w,), [(0, 0)],
+            dimension_numbers=("NCH", "OIH", "NCH"),
+            preferred_element_type=jnp.float32)
+        y = jnp.swapaxes(y, 1, 2) + params["bias"]
+        if squeeze:
+            y = y[0]
+        return y, buffers

@@ -1,0 +1,512 @@
+"""Criterions (reference nn/abstractnn/AbstractCriterion.scala:49 and the
+~28 criterion files, SURVEY §2.4).
+
+Each criterion defines ONE pure ``_loss(input, target) -> scalar``;
+``backward`` is ``jax.grad`` of it — no hand-written gradients.  Class
+weights / margins etc. are static attributes baked into the trace.
+
+Target index convention follows the reference: class labels are 1-based
+floats.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..utils.table import Table
+from .module import to_array
+
+
+class AbstractCriterion:
+    def __init__(self):
+        self.output = 0.0
+        self.grad_input = None
+        self.size_average = True
+
+    def _loss(self, inp, target):
+        raise NotImplementedError
+
+    def update_output(self, inp, target):
+        self.output = float(self._loss(to_array(inp), to_array(target)))
+        return self.output
+
+    def forward(self, inp, target):
+        return self.update_output(inp, target)
+
+    def update_grad_input(self, inp, target):
+        inp, target = to_array(inp), to_array(target)
+        self.grad_input = jax.grad(lambda x: self._loss(x, target))(inp)
+        return self.grad_input
+
+    def backward(self, inp, target):
+        return self.update_grad_input(inp, target)
+
+    def __call__(self, inp, target):
+        return self.forward(inp, target)
+
+    def clone_criterion(self):
+        import copy
+
+        return copy.deepcopy(self)
+
+
+def _batch_reduce(losses, size_average):
+    return jnp.mean(losses) if size_average else jnp.sum(losses)
+
+
+class ClassNLLCriterion(AbstractCriterion):
+    """NLL over log-probabilities, 1-based integer targets, optional class
+    weights (reference nn/ClassNLLCriterion.scala:60)."""
+
+    def __init__(self, weights=None, size_average: bool = True):
+        super().__init__()
+        self.weights = None if weights is None else jnp.asarray(to_array(weights))
+        self.size_average = size_average
+
+    def _loss(self, inp, target):
+        if inp.ndim == 1:
+            inp = inp[None]
+            target = jnp.reshape(target, (1,))
+        t = target.astype(jnp.int32).reshape(-1) - 1
+        picked = jnp.take_along_axis(inp, t[:, None], axis=1)[:, 0]
+        if self.weights is not None:
+            w = jnp.take(self.weights, t)
+            total = jnp.sum(w)
+            s = -jnp.sum(w * picked)
+            return s / total if self.size_average else s
+        return -( jnp.mean(picked) if self.size_average else jnp.sum(picked))
+
+
+class CrossEntropyCriterion(AbstractCriterion):
+    """LogSoftMax + ClassNLL fused (reference nn/CrossEntropyCriterion.scala)."""
+
+    def __init__(self, weights=None, size_average: bool = True):
+        super().__init__()
+        self.nll = ClassNLLCriterion(weights, size_average)
+        self.size_average = size_average
+
+    def _loss(self, inp, target):
+        return self.nll._loss(jax.nn.log_softmax(inp, axis=-1), target)
+
+
+class MSECriterion(AbstractCriterion):
+    """reference nn/MSECriterion.scala:32"""
+
+    def __init__(self, size_average: bool = True):
+        super().__init__()
+        self.size_average = size_average
+
+    def _loss(self, inp, target):
+        se = jnp.square(inp - target)
+        return jnp.mean(se) if self.size_average else jnp.sum(se)
+
+
+class AbsCriterion(AbstractCriterion):
+    def __init__(self, size_average: bool = True):
+        super().__init__()
+        self.size_average = size_average
+
+    def _loss(self, inp, target):
+        d = jnp.abs(inp - target)
+        return jnp.mean(d) if self.size_average else jnp.sum(d)
+
+
+class BCECriterion(AbstractCriterion):
+    """Binary cross entropy with optional per-element weights
+    (reference nn/BCECriterion.scala)."""
+
+    def __init__(self, weights=None, size_average: bool = True):
+        super().__init__()
+        self.weights = None if weights is None else jnp.asarray(to_array(weights))
+        self.size_average = size_average
+
+    def _loss(self, inp, target):
+        eps = 1e-12
+        l = -(target * jnp.log(inp + eps) + (1 - target) * jnp.log1p(-inp + eps))
+        if self.weights is not None:
+            l = l * self.weights
+        return jnp.mean(l) if self.size_average else jnp.sum(l)
+
+
+class SmoothL1Criterion(AbstractCriterion):
+    """Huber with delta 1 (reference nn/SmoothL1Criterion.scala)."""
+
+    def __init__(self, size_average: bool = True):
+        super().__init__()
+        self.size_average = size_average
+
+    def _loss(self, inp, target):
+        d = jnp.abs(inp - target)
+        l = jnp.where(d < 1.0, 0.5 * d * d, d - 0.5)
+        return jnp.mean(l) if self.size_average else jnp.sum(l)
+
+
+class SmoothL1CriterionWithWeights(AbstractCriterion):
+    """Fast-RCNN bbox loss with inside/outside weights (reference
+    nn/SmoothL1CriterionWithWeights.scala).  Input: tensor; target Table
+    (target, inside_w, outside_w)."""
+
+    def __init__(self, sigma: float = 1.0, num: int = 0):
+        super().__init__()
+        self.sigma2 = sigma * sigma
+        self.num = num
+
+    def _loss(self, inp, target):
+        t, w_in, w_out = target[1], target[2], target[3]
+        d = (inp - t) * w_in
+        ad = jnp.abs(d)
+        l = jnp.where(ad < 1.0 / self.sigma2,
+                      0.5 * self.sigma2 * d * d, ad - 0.5 / self.sigma2)
+        l = l * w_out
+        s = jnp.sum(l)
+        return s / self.num if self.num > 0 else s
+
+
+class MarginCriterion(AbstractCriterion):
+    """Hinge: max(0, margin - y*x) (reference nn/MarginCriterion.scala)."""
+
+    def __init__(self, margin: float = 1.0, size_average: bool = True):
+        super().__init__()
+        self.margin = margin
+        self.size_average = size_average
+
+    def _loss(self, inp, target):
+        l = jnp.maximum(0.0, self.margin - inp * target)
+        return jnp.mean(l) if self.size_average else jnp.sum(l)
+
+
+class MarginRankingCriterion(AbstractCriterion):
+    """Input Table(x1, x2), y=±1 (reference nn/MarginRankingCriterion.scala)."""
+
+    def __init__(self, margin: float = 1.0, size_average: bool = True):
+        super().__init__()
+        self.margin = margin
+        self.size_average = size_average
+
+    def _loss(self, inp, target):
+        x1, x2 = inp[1], inp[2]
+        y = target[1] if isinstance(target, Table) else target
+        l = jnp.maximum(0.0, -y * (x1 - x2) + self.margin)
+        return jnp.mean(l) if self.size_average else jnp.sum(l)
+
+
+class MultiMarginCriterion(AbstractCriterion):
+    """Multi-class hinge (reference nn/MultiMarginCriterion.scala)."""
+
+    def __init__(self, p: int = 1, weights=None, margin: float = 1.0,
+                 size_average: bool = True):
+        super().__init__()
+        self.p, self.margin = p, margin
+        self.weights = None if weights is None else jnp.asarray(to_array(weights))
+        self.size_average = size_average
+
+    def _loss(self, inp, target):
+        if inp.ndim == 1:
+            inp = inp[None]
+            target = jnp.reshape(target, (1,))
+        n, k = inp.shape
+        t = target.astype(jnp.int32).reshape(-1) - 1
+        x_y = jnp.take_along_axis(inp, t[:, None], axis=1)
+        margins = jnp.maximum(0.0, self.margin - x_y + inp) ** self.p
+        if self.weights is not None:
+            margins = margins * jnp.take(self.weights, t)[:, None]
+        mask = jax.nn.one_hot(t, k, dtype=inp.dtype)
+        per_sample = jnp.sum(margins * (1 - mask), axis=1) / k
+        return jnp.mean(per_sample) if self.size_average else jnp.sum(per_sample)
+
+
+class MultiLabelMarginCriterion(AbstractCriterion):
+    """Multi-label hinge; targets are 1-based label lists padded with 0
+    (reference nn/MultiLabelMarginCriterion.scala)."""
+
+    def __init__(self, size_average: bool = True):
+        super().__init__()
+        self.size_average = size_average
+
+    def _loss(self, inp, target):
+        if inp.ndim == 1:
+            inp = inp[None]
+            target = jnp.reshape(target, (1, -1))
+        n, k = inp.shape
+        t = target.astype(jnp.int32) - 1  # (n, k), -1 = padding
+        valid = (t >= 0).astype(inp.dtype)
+        t_safe = jnp.clip(t, 0, k - 1)
+        is_target = jnp.zeros((n, k), inp.dtype)
+        is_target = jax.vmap(
+            lambda row, idx, v: row.at[idx].add(v))(is_target, t_safe, valid)
+        is_target = jnp.minimum(is_target, 1.0)
+        x_y = jnp.take_along_axis(inp, t_safe, axis=1)  # (n, k)
+        # sum over target labels y and non-target j: max(0, 1 - (x_y - x_j))
+        diff = 1.0 - (x_y[:, :, None] - inp[:, None, :])  # (n, y, j)
+        hinge = jnp.maximum(0.0, diff)
+        mask = valid[:, :, None] * (1.0 - is_target)[:, None, :]
+        per_sample = jnp.sum(hinge * mask, axis=(1, 2)) / k
+        return jnp.mean(per_sample) if self.size_average else jnp.sum(per_sample)
+
+
+class MultiLabelSoftMarginCriterion(AbstractCriterion):
+    """Sigmoid + BCE per label (reference nn/MultiLabelSoftMarginCriterion.scala)."""
+
+    def __init__(self, weights=None, size_average: bool = True):
+        super().__init__()
+        self.weights = None if weights is None else jnp.asarray(to_array(weights))
+        self.size_average = size_average
+
+    def _loss(self, inp, target):
+        l = (jnp.logaddexp(0.0, -inp) * target
+             + jnp.logaddexp(0.0, inp) * (1 - target))
+        if self.weights is not None:
+            l = l * self.weights
+        per_sample = jnp.mean(l, axis=-1)
+        return jnp.mean(per_sample) if self.size_average else jnp.sum(per_sample)
+
+
+class HingeEmbeddingCriterion(AbstractCriterion):
+    """y=1 → x ; y=-1 → max(0, margin - x) (reference
+    nn/HingeEmbeddingCriterion.scala)."""
+
+    def __init__(self, margin: float = 1.0, size_average: bool = True):
+        super().__init__()
+        self.margin = margin
+        self.size_average = size_average
+
+    def _loss(self, inp, target):
+        l = jnp.where(target > 0, inp, jnp.maximum(0.0, self.margin - inp))
+        return jnp.mean(l) if self.size_average else jnp.sum(l)
+
+
+class L1HingeEmbeddingCriterion(AbstractCriterion):
+    """Pairwise L1 distance hinge over Table(x1, x2)
+    (reference nn/L1HingeEmbeddingCriterion.scala)."""
+
+    def __init__(self, margin: float = 1.0):
+        super().__init__()
+        self.margin = margin
+
+    def _loss(self, inp, target):
+        d = jnp.sum(jnp.abs(inp[1] - inp[2]))
+        y = target if not isinstance(target, Table) else target[1]
+        y = jnp.reshape(y, ())
+        return jnp.where(y > 0, d, jnp.maximum(0.0, self.margin - d))
+
+
+class CosineEmbeddingCriterion(AbstractCriterion):
+    """reference nn/CosineEmbeddingCriterion.scala"""
+
+    def __init__(self, margin: float = 0.0, size_average: bool = True):
+        super().__init__()
+        self.margin = margin
+        self.size_average = size_average
+
+    def _loss(self, inp, target):
+        x1, x2 = inp[1], inp[2]
+        if x1.ndim == 1:
+            x1, x2 = x1[None], x2[None]
+        y = target[1] if isinstance(target, Table) else target
+        y = jnp.reshape(y, (-1,))
+        cos = (jnp.sum(x1 * x2, -1)
+               / jnp.maximum(jnp.linalg.norm(x1, axis=-1)
+                             * jnp.linalg.norm(x2, axis=-1), 1e-12))
+        l = jnp.where(y > 0, 1.0 - cos, jnp.maximum(0.0, cos - self.margin))
+        return jnp.mean(l) if self.size_average else jnp.sum(l)
+
+
+class CosineDistanceCriterion(AbstractCriterion):
+    """1 - cos(input, target) (reference nn/CosineDistanceCriterion.scala)."""
+
+    def __init__(self, size_average: bool = True):
+        super().__init__()
+        self.size_average = size_average
+
+    def _loss(self, inp, target):
+        if inp.ndim == 1:
+            inp, target = inp[None], target[None]
+        cos = (jnp.sum(inp * target, -1)
+               / jnp.maximum(jnp.linalg.norm(inp, axis=-1)
+                             * jnp.linalg.norm(target, axis=-1), 1e-12))
+        l = 1.0 - cos
+        return jnp.mean(l) if self.size_average else jnp.sum(l)
+
+
+class DistKLDivCriterion(AbstractCriterion):
+    """KL divergence, input = log-probs (reference nn/DistKLDivCriterion.scala)."""
+
+    def __init__(self, size_average: bool = True):
+        super().__init__()
+        self.size_average = size_average
+
+    def _loss(self, inp, target):
+        l = jnp.where(target > 0, target * (jnp.log(jnp.maximum(target, 1e-12)) - inp), 0.0)
+        n = inp.shape[0] if inp.ndim > 1 else 1
+        return jnp.sum(l) / n if self.size_average else jnp.sum(l)
+
+
+class ClassSimplexCriterion(MSECriterion):
+    """MSE against simplex-embedded class targets (reference
+    nn/ClassSimplexCriterion.scala)."""
+
+    def __init__(self, n_classes: int):
+        super().__init__()
+        self.n_classes = n_classes
+        self.simplex = jnp.asarray(self._build_simplex(n_classes))
+
+    @staticmethod
+    def _build_simplex(n_classes):
+        """Regular (N-1)-simplex embedding, the reference's ``regsplex``
+        (ClassSimplexCriterion.scala:43-62): rows are unit vectors with
+        pairwise dot product -1/n, zero-padded to n_classes columns."""
+        n = n_classes - 1
+        a = np.zeros((n + 1, n), np.float64)
+        for k in range(1, n + 1):
+            i = k - 1
+            if k == 1:
+                a[i, i] = 1.0
+            else:
+                nrm = np.linalg.norm(a[i, :i])
+                a[i, i] = np.sqrt(1.0 - nrm * nrm)
+            c = (a[i, i] * a[i, i] - 1.0 - 1.0 / n) / a[i, i]
+            a[k:, i] = c
+        out = np.zeros((n + 1, n_classes), np.float32)
+        out[:, :n] = a
+        return out
+
+    def _loss(self, inp, target):
+        t = target.astype(jnp.int32).reshape(-1) - 1
+        goal = jnp.take(self.simplex, t, axis=0)
+        return super()._loss(inp, goal)
+
+
+class DiceCoefficientCriterion(AbstractCriterion):
+    """1 - dice overlap (reference nn/DiceCoefficientCriterion.scala)."""
+
+    def __init__(self, size_average: bool = True, epsilon: float = 1.0):
+        super().__init__()
+        self.epsilon = epsilon
+        self.size_average = size_average
+
+    def _loss(self, inp, target):
+        if inp.ndim == 1:
+            inp, target = inp[None], target[None]
+        inter = jnp.sum(inp * target, axis=-1)
+        union = jnp.sum(inp, axis=-1) + jnp.sum(target, axis=-1)
+        dice = (2.0 * inter + self.epsilon) / (union + self.epsilon)
+        l = 1.0 - dice
+        return jnp.mean(l) if self.size_average else jnp.sum(l)
+
+
+class L1Cost(AbstractCriterion):
+    """sum(|input|), target ignored (reference nn/L1Cost.scala)."""
+
+    def _loss(self, inp, target):
+        return jnp.sum(jnp.abs(inp))
+
+
+class SoftMarginCriterion(AbstractCriterion):
+    """log(1 + exp(-y*x)) (reference nn/SoftMarginCriterion.scala)."""
+
+    def __init__(self, size_average: bool = True):
+        super().__init__()
+        self.size_average = size_average
+
+    def _loss(self, inp, target):
+        l = jnp.logaddexp(0.0, -inp * target)
+        return jnp.mean(l) if self.size_average else jnp.sum(l)
+
+
+class SoftmaxWithCriterion(AbstractCriterion):
+    """Caffe-style fused softmax loss over NCHW with ignore_label
+    (reference nn/SoftmaxWithCriterion.scala)."""
+
+    def __init__(self, ignore_label: Optional[int] = None,
+                 normalize_mode: str = "VALID"):
+        super().__init__()
+        self.ignore_label = ignore_label
+        self.normalize_mode = normalize_mode
+
+    def _loss(self, inp, target):
+        # inp (N, C, H, W) or (N, C); target 1-based labels
+        logp = jax.nn.log_softmax(inp, axis=1)
+        t = target.astype(jnp.int32) - 1
+        if inp.ndim == 2:
+            picked = jnp.take_along_axis(logp, t.reshape(-1, 1), axis=1)[:, 0]
+        else:
+            picked = jnp.take_along_axis(
+                logp, t.reshape(t.shape[0], 1, *t.shape[1:]), axis=1)[:, 0]
+        if self.ignore_label is not None:
+            mask = (target != self.ignore_label).astype(inp.dtype)
+            mask = mask.reshape(picked.shape)
+            picked = picked * mask
+            count = jnp.maximum(jnp.sum(mask), 1.0)
+        else:
+            count = picked.size
+        if self.normalize_mode == "VALID":
+            return -jnp.sum(picked) / count
+        if self.normalize_mode == "FULL":
+            return -jnp.sum(picked) / picked.size
+        if self.normalize_mode == "BATCH_SIZE":
+            return -jnp.sum(picked) / inp.shape[0]
+        return -jnp.sum(picked)
+
+
+class TimeDistributedCriterion(AbstractCriterion):
+    """Apply a criterion at every timestep (reference
+    nn/TimeDistributedCriterion.scala)."""
+
+    def __init__(self, critrn: AbstractCriterion, size_average: bool = False):
+        super().__init__()
+        self.critrn = critrn
+        self.size_average = size_average
+
+    def _loss(self, inp, target):
+        steps = inp.shape[1]
+
+        def per_t(i):
+            return self.critrn._loss(inp[:, i], target[:, i])
+
+        total = sum(per_t(i) for i in range(steps))
+        return total / steps if self.size_average else total
+
+
+class ParallelCriterion(AbstractCriterion):
+    """Weighted sum of criterions over input/target Tables
+    (reference nn/ParallelCriterion.scala)."""
+
+    def __init__(self, repeat_target: bool = False):
+        super().__init__()
+        self.repeat_target = repeat_target
+        self.criterions = []
+        self.weights = []
+
+    def add(self, criterion: AbstractCriterion, weight: float = 1.0):
+        self.criterions.append(criterion)
+        self.weights.append(weight)
+        return self
+
+    def _loss(self, inp, target):
+        total = 0.0
+        for i, (c, w) in enumerate(zip(self.criterions, self.weights)):
+            t = target if self.repeat_target else target[i + 1]
+            total = total + w * c._loss(inp[i + 1], t)
+        return total
+
+
+class MultiCriterion(AbstractCriterion):
+    """Sum of criterions on the SAME input/target (reference
+    nn/MultiCriterion.scala)."""
+
+    def __init__(self):
+        super().__init__()
+        self.criterions = []
+        self.weights = []
+
+    def add(self, criterion: AbstractCriterion, weight: float = 1.0):
+        self.criterions.append(criterion)
+        self.weights.append(weight)
+        return self
+
+    def _loss(self, inp, target):
+        return sum(w * c._loss(inp, target)
+                   for c, w in zip(self.criterions, self.weights))

@@ -1,0 +1,36 @@
+"""Dropout (reference nn/Dropout.scala:44).
+
+The reference draws bernoulli masks with hand-threaded loops; here the
+mask is one ``jax.random.bernoulli`` fused into the step.  The forward
+rng is cached by the module shell so eager ``backward`` reuses the same
+mask (mirrors the reference caching ``noise``).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .module import TensorModule
+
+
+class Dropout(TensorModule):
+    def __init__(self, init_p: float = 0.5, inplace: bool = False,
+                 scale: bool = True):
+        super().__init__()
+        self.p = init_p
+        self.scale = scale
+
+    def set_p(self, p: float):
+        self.p = p
+        return self
+
+    def _apply(self, params, buffers, x, training, rng):
+        if not training or self.p <= 0.0:
+            return x, buffers
+        if rng is None:
+            rng = jax.random.PRNGKey(0)
+        keep = 1.0 - self.p
+        mask = jax.random.bernoulli(rng, keep, x.shape).astype(x.dtype)
+        if self.scale:
+            mask = mask / keep
+        return x * mask, buffers

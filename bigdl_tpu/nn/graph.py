@@ -1,0 +1,121 @@
+"""Graph container (reference nn/Graph.scala:58, utils/DirectedGraph.scala:34).
+
+``Graph`` topo-sorts its DAG once at construction (Graph.scala:180-198)
+and replays the sorted node list inside one pure ``apply_fn`` — so an
+arbitrary DAG still traces into a single XLA program and backward is the
+vjp of the whole graph (no per-node backward scheduling like the
+reference's Graph.backward, Graph.scala:64-120).
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+import jax
+
+from ..utils.table import Table
+from .containers import Identity
+from .module import AbstractModule, Container
+
+
+class ModuleNode:
+    """DAG node wrapping a module (reference ``Node[AbstractModule]``)."""
+
+    _counter = [0]
+
+    def __init__(self, element: AbstractModule):
+        self.element = element
+        self.prev_nodes: List["ModuleNode"] = []
+        self.next_nodes: List["ModuleNode"] = []
+        ModuleNode._counter[0] += 1
+        self.uid = ModuleNode._counter[0]
+
+    def add_edge(self, to: "ModuleNode"):
+        self.next_nodes.append(to)
+        to.prev_nodes.append(self)
+        return self
+
+    def inputs(self, *nodes):
+        for n in nodes:
+            n.add_edge(self)
+        return self
+
+    def __repr__(self):
+        return f"Node({self.element.get_name()})"
+
+
+def Input():
+    """Placeholder source node (reference nn/Graph.scala Input)."""
+    return ModuleNode(Identity())
+
+
+def topo_sort(outputs: Sequence[ModuleNode]) -> List[ModuleNode]:
+    """DFS post-order topological sort (reference DirectedGraph.topologySort:52)."""
+    visited, order, stack = set(), [], []
+
+    def visit(node):
+        if node.uid in visited:
+            return
+        visited.add(node.uid)
+        for p in node.prev_nodes:
+            visit(p)
+        order.append(node)
+
+    for out in outputs:
+        visit(out)
+    return order
+
+
+class Graph(Container):
+    """DAG of modules with explicit input/output nodes (reference nn/Graph.scala:58).
+
+    Multi-input graphs take a Table input (1-based, matching the order of
+    ``inputs``); multi-output graphs return a Table.
+    """
+
+    def __init__(self, inputs, outputs):
+        if isinstance(inputs, ModuleNode):
+            inputs = [inputs]
+        if isinstance(outputs, ModuleNode):
+            outputs = [outputs]
+        self.input_nodes = list(inputs)
+        self.output_nodes = list(outputs)
+        self.sorted_nodes = topo_sort(self.output_nodes)
+        # sanity: every input reachable
+        sorted_ids = {n.uid for n in self.sorted_nodes}
+        for i in self.input_nodes:
+            if i.uid not in sorted_ids:
+                raise ValueError("graph input not connected to any output")
+        super().__init__(*[n.element for n in self.sorted_nodes])
+
+    def apply_fn(self, params, buffers, inp, training=True, rng=None):
+        from .containers import _split_rng
+
+        activities: Dict[int, object] = {}
+        n_in = len(self.input_nodes)
+        if n_in == 1:
+            activities[self.input_nodes[0].uid] = inp
+        else:
+            for i, node in enumerate(self.input_nodes):
+                activities[node.uid] = inp[i + 1]
+        rngs = _split_rng(rng, max(len(self.sorted_nodes), 1))
+        new_buffers = {}
+        for i, node in enumerate(self.sorted_nodes):
+            if node.uid in activities:  # input node
+                x = activities[node.uid]
+            elif len(node.prev_nodes) == 1:
+                x = activities[node.prev_nodes[0].uid]
+            else:
+                x = Table(*[activities[p.uid] for p in node.prev_nodes])
+            out, nb = node.element.apply_fn(params[str(i)], buffers[str(i)],
+                                            x, training, rngs[i])
+            activities[node.uid] = out
+            new_buffers[str(i)] = nb
+        if len(self.output_nodes) == 1:
+            return activities[self.output_nodes[0].uid], new_buffers
+        return (Table(*[activities[o.uid] for o in self.output_nodes]),
+                new_buffers)
+
+
+def Model(inputs, outputs) -> Graph:
+    """pyspark-parity factory (pyspark/bigdl/nn/layer.py Model)."""
+    return Graph(inputs, outputs)

@@ -1,0 +1,134 @@
+"""Initialization methods (reference nn/InitializationMethod.scala).
+
+Host-side numpy draws from the seeded MT generator, converted to jax
+arrays — init happens once at construction, so it stays off-device.
+"""
+from __future__ import annotations
+
+import math
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..utils.rng import RNG
+
+
+class VariableFormat:
+    """Describes which dims are fan-in/fan-out (reference VariableFormat)."""
+
+    def __init__(self, name="Default"):
+        self.name = name
+
+    def fans(self, shape):
+        if self.name == "ONE_D":
+            return shape[0], shape[0]
+        if self.name == "IN_OUT":       # (out, in) linear weight
+            fan_out, fan_in = shape[0], int(np.prod(shape[1:]))
+            return fan_in, fan_out
+        if self.name == "OUT_IN":
+            fan_in, fan_out = shape[0], int(np.prod(shape[1:]))
+            return fan_out, fan_in
+        if self.name == "OUT_IN_KW_KH":  # conv weight (out, in, kh, kw)
+            receptive = int(np.prod(shape[2:]))
+            return shape[1] * receptive, shape[0] * receptive
+        if self.name == "IN_OUT_KW_KH":
+            receptive = int(np.prod(shape[2:]))
+            return shape[0] * receptive, shape[1] * receptive
+        n = int(np.prod(shape))
+        d0 = shape[0] if shape else 1
+        return n // d0 if d0 else 1, d0
+
+
+ONE_D = VariableFormat("ONE_D")
+IN_OUT = VariableFormat("IN_OUT")
+OUT_IN = VariableFormat("OUT_IN")
+OUT_IN_KW_KH = VariableFormat("OUT_IN_KW_KH")
+IN_OUT_KW_KH = VariableFormat("IN_OUT_KW_KH")
+DEFAULT_FORMAT = VariableFormat("Default")
+
+
+class InitializationMethod:
+    def init(self, shape, fmt: VariableFormat = DEFAULT_FORMAT):
+        raise NotImplementedError
+
+
+class Zeros(InitializationMethod):
+    def init(self, shape, fmt=DEFAULT_FORMAT):
+        return jnp.zeros(shape, jnp.float32)
+
+
+class Ones(InitializationMethod):
+    def init(self, shape, fmt=DEFAULT_FORMAT):
+        return jnp.ones(shape, jnp.float32)
+
+
+class ConstInitMethod(InitializationMethod):
+    def __init__(self, value):
+        self.value = value
+
+    def init(self, shape, fmt=DEFAULT_FORMAT):
+        return jnp.full(shape, self.value, jnp.float32)
+
+
+class RandomUniform(InitializationMethod):
+    """U(lower, upper); no-arg variant scales by 1/sqrt(fan_in) like the
+    reference's default torch init."""
+
+    def __init__(self, lower=None, upper=None):
+        self.lower, self.upper = lower, upper
+
+    def init(self, shape, fmt=DEFAULT_FORMAT):
+        if self.lower is None:
+            fan_in, _ = fmt.fans(shape)
+            stdv = 1.0 / math.sqrt(max(fan_in, 1))
+            lo, hi = -stdv, stdv
+        else:
+            lo, hi = self.lower, self.upper
+        return jnp.asarray(RNG().uniform(lo, hi, shape), jnp.float32)
+
+
+class RandomNormal(InitializationMethod):
+    def __init__(self, mean=0.0, stdv=1.0):
+        self.mean, self.stdv = mean, stdv
+
+    def init(self, shape, fmt=DEFAULT_FORMAT):
+        return jnp.asarray(RNG().normal(self.mean, self.stdv, shape), jnp.float32)
+
+
+class Xavier(InitializationMethod):
+    """Glorot uniform (reference InitializationMethod.scala Xavier)."""
+
+    def init(self, shape, fmt=DEFAULT_FORMAT):
+        fan_in, fan_out = fmt.fans(shape)
+        stdv = math.sqrt(6.0 / (fan_in + fan_out))
+        return jnp.asarray(RNG().uniform(-stdv, stdv, shape), jnp.float32)
+
+
+class MsraFiller(InitializationMethod):
+    """He init (reference MsraFiller)."""
+
+    def __init__(self, variance_norm_average=True):
+        self.avg = variance_norm_average
+
+    def init(self, shape, fmt=DEFAULT_FORMAT):
+        fan_in, fan_out = fmt.fans(shape)
+        n = (fan_in + fan_out) / 2.0 if self.avg else fan_in
+        std = math.sqrt(2.0 / max(n, 1))
+        return jnp.asarray(RNG().normal(0.0, std, shape), jnp.float32)
+
+
+class BilinearFiller(InitializationMethod):
+    """Bilinear upsampling kernel for deconv (reference BilinearFiller)."""
+
+    def init(self, shape, fmt=DEFAULT_FORMAT):
+        assert len(shape) >= 2
+        kh, kw = shape[-2], shape[-1]
+        f = math.ceil(kw / 2.0)
+        c = (2 * f - 1 - f % 2) / (2.0 * f)
+        w = np.zeros(shape, np.float32)
+        flat = w.reshape(-1, kh * kw)
+        for i in range(kh * kw):
+            x, y = i % kw, i // kw
+            val = (1 - abs(x / f - c)) * (1 - abs(y / f - c))
+            flat[:, i] = val
+        return jnp.asarray(flat.reshape(shape))

@@ -1,0 +1,276 @@
+"""Linear-algebra layers (reference: Linear.scala:44, Bilinear, CMul,
+CAdd, Mul, Add, MulConstant, AddConstant, MM, MV, Cosine, Euclidean,
+LookupTable).
+
+The reference lowers Linear onto MKL gemm with a ones-vector bias trick
+(Linear.scala:44); here it's one ``jnp.dot`` on the MXU with the bias
+add fused by XLA.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+
+from ..utils.table import Table
+from .initialization import IN_OUT, ONE_D, RandomUniform, Zeros
+from .module import TensorModule
+
+
+class Linear(TensorModule):
+    """y = x W^T + b (reference nn/Linear.scala:44)."""
+
+    def __init__(self, input_size: int, output_size: int,
+                 with_bias: bool = True, w_regularizer=None, b_regularizer=None):
+        super().__init__()
+        self.input_size = input_size
+        self.output_size = output_size
+        self.with_bias = with_bias
+        self.w_regularizer = w_regularizer
+        self.b_regularizer = b_regularizer
+        self.reset()
+
+    def reset(self):
+        w_init = self._init_methods.get("weight", (RandomUniform(), None))[0]
+        b_init = self._init_methods.get("bias", (RandomUniform(), None))[0]
+        self._register_param("weight",
+                             w_init.init((self.output_size, self.input_size), IN_OUT))
+        if self.with_bias:
+            self._register_param("bias",
+                                 b_init.init((self.output_size,), ONE_D))
+        return self
+
+    def _apply(self, params, buffers, x, training, rng):
+        y = jnp.dot(x, params["weight"].T)
+        if self.with_bias:
+            y = y + params["bias"]
+        return y, buffers
+
+
+class Bilinear(TensorModule):
+    """y_k = x1^T W_k x2 + b_k over a Table(x1, x2) (reference nn/Bilinear.scala)."""
+
+    def __init__(self, input_size1: int, input_size2: int, output_size: int,
+                 bias_res: bool = True):
+        super().__init__()
+        self.input_size1, self.input_size2 = input_size1, input_size2
+        self.output_size = output_size
+        self.bias_res = bias_res
+        self.reset()
+
+    def reset(self):
+        w_init = self._init_methods.get("weight", (RandomUniform(), None))[0]
+        shape = (self.output_size, self.input_size1, self.input_size2)
+        self._register_param("weight", w_init.init(shape, ONE_D))
+        if self.bias_res:
+            b_init = self._init_methods.get("bias", (RandomUniform(), None))[0]
+            self._register_param("bias", b_init.init((self.output_size,), ONE_D))
+        return self
+
+    def _apply(self, params, buffers, inp, training, rng):
+        x1, x2 = inp[1], inp[2]
+        # (N, I1) x (K, I1, I2) x (N, I2) -> (N, K)
+        y = jnp.einsum("ni,kij,nj->nk", x1, params["weight"], x2)
+        if self.bias_res:
+            y = y + params["bias"]
+        return y, buffers
+
+
+class CMul(TensorModule):
+    """Learned componentwise scale, broadcast by size (reference nn/CMul.scala)."""
+
+    def __init__(self, size):
+        super().__init__()
+        self.size = tuple(size)
+        self.reset()
+
+    def reset(self):
+        w_init = self._init_methods.get("weight", (RandomUniform(), None))[0]
+        self._register_param("weight", w_init.init(self.size, ONE_D))
+        return self
+
+    def _apply(self, params, buffers, x, training, rng):
+        w = params["weight"]
+        if w.ndim < x.ndim:
+            w = w.reshape((1,) * (x.ndim - w.ndim) + w.shape)
+        return x * w, buffers
+
+
+class CAdd(TensorModule):
+    """Learned componentwise bias (reference nn/CAdd.scala)."""
+
+    def __init__(self, size):
+        super().__init__()
+        self.size = tuple(size)
+        self.reset()
+
+    def reset(self):
+        b_init = self._init_methods.get("bias", (RandomUniform(), None))[0]
+        self._register_param("bias", b_init.init(self.size, ONE_D))
+        return self
+
+    def _apply(self, params, buffers, x, training, rng):
+        b = params["bias"]
+        if b.ndim < x.ndim:
+            b = b.reshape((1,) * (x.ndim - b.ndim) + b.shape)
+        return x + b, buffers
+
+
+class Mul(TensorModule):
+    """Single learned scalar multiplier (reference nn/Mul.scala)."""
+
+    def __init__(self):
+        super().__init__()
+        self.reset()
+
+    def reset(self):
+        self._register_param("weight", RandomUniform().init((1,), ONE_D))
+        return self
+
+    def _apply(self, params, buffers, x, training, rng):
+        return x * params["weight"][0], buffers
+
+
+class Add(TensorModule):
+    """Learned bias vector added to input (reference nn/Add.scala)."""
+
+    def __init__(self, input_size: int):
+        super().__init__()
+        self.input_size = input_size
+        self.reset()
+
+    def reset(self):
+        b_init = self._init_methods.get("bias", (RandomUniform(), None))[0]
+        self._register_param("bias", b_init.init((self.input_size,), ONE_D))
+        return self
+
+    def _apply(self, params, buffers, x, training, rng):
+        return x + params["bias"], buffers
+
+
+class MulConstant(TensorModule):
+    def __init__(self, constant_scalar: float, inplace: bool = False):
+        super().__init__()
+        self.constant_scalar = constant_scalar
+
+    def _apply(self, params, buffers, x, training, rng):
+        return x * self.constant_scalar, buffers
+
+
+class AddConstant(TensorModule):
+    def __init__(self, constant_scalar: float, inplace: bool = False):
+        super().__init__()
+        self.constant_scalar = constant_scalar
+
+    def _apply(self, params, buffers, x, training, rng):
+        return x + self.constant_scalar, buffers
+
+
+class MM(TensorModule):
+    """Matrix multiply of a Table(a, b) (reference nn/MM.scala)."""
+
+    def __init__(self, trans_a: bool = False, trans_b: bool = False):
+        super().__init__()
+        self.trans_a, self.trans_b = trans_a, trans_b
+
+    def _apply(self, params, buffers, inp, training, rng):
+        a, b = inp[1], inp[2]
+        if self.trans_a:
+            a = jnp.swapaxes(a, -1, -2)
+        if self.trans_b:
+            b = jnp.swapaxes(b, -1, -2)
+        return jnp.matmul(a, b), buffers
+
+
+class MV(TensorModule):
+    """Matrix-vector multiply of Table(mat, vec) (reference nn/MV.scala)."""
+
+    def __init__(self, trans: bool = False):
+        super().__init__()
+        self.trans = trans
+
+    def _apply(self, params, buffers, inp, training, rng):
+        m, v = inp[1], inp[2]
+        if self.trans:
+            m = jnp.swapaxes(m, -1, -2)
+        return jnp.einsum("...ij,...j->...i", m, v), buffers
+
+
+class Cosine(TensorModule):
+    """Cosine similarity against learned weight rows (reference nn/Cosine.scala)."""
+
+    def __init__(self, input_size: int, output_size: int):
+        super().__init__()
+        self.input_size, self.output_size = input_size, output_size
+        self.reset()
+
+    def reset(self):
+        w_init = self._init_methods.get("weight", (RandomUniform(), None))[0]
+        self._register_param("weight",
+                             w_init.init((self.output_size, self.input_size), IN_OUT))
+        return self
+
+    def _apply(self, params, buffers, x, training, rng):
+        w = params["weight"]
+        xn = x / (jnp.linalg.norm(x, axis=-1, keepdims=True) + 1e-12)
+        wn = w / (jnp.linalg.norm(w, axis=-1, keepdims=True) + 1e-12)
+        return jnp.dot(xn, wn.T), buffers
+
+
+class Euclidean(TensorModule):
+    """Output = ||x - w_j|| per row j (reference nn/Euclidean.scala)."""
+
+    def __init__(self, input_size: int, output_size: int, fast_backward=True):
+        super().__init__()
+        self.input_size, self.output_size = input_size, output_size
+        self.reset()
+
+    def reset(self):
+        w_init = self._init_methods.get("weight", (RandomUniform(), None))[0]
+        self._register_param("weight",
+                             w_init.init((self.input_size, self.output_size), ONE_D))
+        return self
+
+    def _apply(self, params, buffers, x, training, rng):
+        w = params["weight"]  # (in, out)
+        diff = x[..., :, None] - w[None, :, :]
+        return jnp.sqrt(jnp.sum(diff * diff, axis=-2) + 1e-12), buffers
+
+
+class LookupTable(TensorModule):
+    """Embedding with optional max-norm renorm (reference nn/LookupTable.scala).
+
+    Indices are 1-based floats (Torch convention); padding_value rows can
+    be zeroed.  maxNorm renorm of touched rows is applied functionally.
+    """
+
+    def __init__(self, n_index: int, n_output: int, padding_value: float = 0,
+                 max_norm: float = float("inf"), norm_type: float = 2.0,
+                 should_scale_grad_by_freq: bool = False, w_regularizer=None):
+        super().__init__()
+        self.n_index, self.n_output = n_index, n_output
+        self.padding_value = padding_value
+        self.max_norm = max_norm
+        self.norm_type = norm_type
+        self.w_regularizer = w_regularizer
+        self.reset()
+
+    def reset(self):
+        from .initialization import RandomNormal
+
+        w_init = self._init_methods.get("weight", (RandomNormal(0, 1), None))[0]
+        self._register_param("weight",
+                             w_init.init((self.n_index, self.n_output), ONE_D))
+        return self
+
+    def _apply(self, params, buffers, x, training, rng):
+        w = params["weight"]
+        if self.max_norm != float("inf"):
+            norms = jnp.linalg.norm(w, ord=self.norm_type, axis=1, keepdims=True)
+            w = jnp.where(norms > self.max_norm, w * self.max_norm / (norms + 1e-7), w)
+        idx = x.astype(jnp.int32) - 1
+        out = jnp.take(w, jnp.clip(idx, 0, self.n_index - 1), axis=0)
+        if self.padding_value != 0:
+            mask = (x.astype(jnp.int32) == int(self.padding_value))
+            out = jnp.where(mask[..., None], 0.0, out)
+        return out, buffers

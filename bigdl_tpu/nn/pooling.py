@@ -1,0 +1,189 @@
+"""Pooling layers → ``lax.reduce_window`` (reference SpatialMaxPooling.scala:43,
+SpatialAveragePooling.scala, VolumetricMaxPooling.scala, RoiPooling.scala;
+the hand-written NNPrimitive loops disappear into one XLA op)."""
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from ..utils.table import Table
+from .module import AbstractModule, TensorModule
+
+
+def _pool_out(size, k, s, pad, ceil_mode):
+    f = math.ceil if ceil_mode else math.floor
+    out = int(f((size + 2 * pad - k) / s)) + 1
+    if ceil_mode and pad > 0 and (out - 1) * s >= size + pad:
+        out -= 1
+    return out
+
+
+def _pool_pads(size, k, s, pad, ceil_mode):
+    """Torch-style padding: explicit pad both sides + extra right pad in
+    ceil mode so the window count matches."""
+    out = _pool_out(size, k, s, pad, ceil_mode)
+    needed = (out - 1) * s + k - size - pad
+    return (pad, max(needed, pad))
+
+
+class SpatialMaxPooling(TensorModule):
+    """NCHW max pool with ceil/floor modes (reference nn/SpatialMaxPooling.scala:43)."""
+
+    def __init__(self, kw: int, kh: int, dw: Optional[int] = None,
+                 dh: Optional[int] = None, pad_w: int = 0, pad_h: int = 0):
+        super().__init__()
+        self.kw, self.kh = kw, kh
+        self.dw = dw if dw is not None else kw
+        self.dh = dh if dh is not None else kh
+        self.pad_w, self.pad_h = pad_w, pad_h
+        self.ceil_mode = False
+
+    def ceil(self):
+        self.ceil_mode = True
+        return self
+
+    def floor(self):
+        self.ceil_mode = False
+        return self
+
+    def _apply(self, params, buffers, x, training, rng):
+        squeeze = False
+        if x.ndim == 3:
+            x = x[None]
+            squeeze = True
+        ph = _pool_pads(x.shape[2], self.kh, self.dh, self.pad_h, self.ceil_mode)
+        pw = _pool_pads(x.shape[3], self.kw, self.dw, self.pad_w, self.ceil_mode)
+        y = lax.reduce_window(
+            x, -jnp.inf, lax.max,
+            (1, 1, self.kh, self.kw), (1, 1, self.dh, self.dw),
+            [(0, 0), (0, 0), ph, pw])
+        if squeeze:
+            y = y[0]
+        return y, buffers
+
+
+class SpatialAveragePooling(TensorModule):
+    """NCHW average pool (reference nn/SpatialAveragePooling.scala).
+    ``count_include_pad`` follows the reference default (True)."""
+
+    def __init__(self, kw: int, kh: int, dw: int = 1, dh: int = 1,
+                 pad_w: int = 0, pad_h: int = 0, global_pooling: bool = False,
+                 ceil_mode: bool = False, count_include_pad: bool = True,
+                 divide: bool = True):
+        super().__init__()
+        self.kw, self.kh, self.dw, self.dh = kw, kh, dw, dh
+        self.pad_w, self.pad_h = pad_w, pad_h
+        self.global_pooling = global_pooling
+        self.ceil_mode = ceil_mode
+        self.count_include_pad = count_include_pad
+        self.divide = divide
+
+    def ceil(self):
+        self.ceil_mode = True
+        return self
+
+    def _apply(self, params, buffers, x, training, rng):
+        squeeze = False
+        if x.ndim == 3:
+            x = x[None]
+            squeeze = True
+        kh, kw = self.kh, self.kw
+        if self.global_pooling:
+            kh, kw = x.shape[2], x.shape[3]
+        ph = _pool_pads(x.shape[2], kh, self.dh, self.pad_h, self.ceil_mode)
+        pw = _pool_pads(x.shape[3], kw, self.dw, self.pad_w, self.ceil_mode)
+        sums = lax.reduce_window(
+            x, 0.0, lax.add, (1, 1, kh, kw), (1, 1, self.dh, self.dw),
+            [(0, 0), (0, 0), ph, pw])
+        if not self.divide:
+            y = sums
+        elif self.count_include_pad:
+            y = sums / (kh * kw)
+        else:
+            counts = lax.reduce_window(
+                jnp.ones_like(x), 0.0, lax.add, (1, 1, kh, kw),
+                (1, 1, self.dh, self.dw), [(0, 0), (0, 0), ph, pw])
+            y = sums / counts
+        if squeeze:
+            y = y[0]
+        return y, buffers
+
+
+class VolumetricMaxPooling(TensorModule):
+    """NCDHW max pool (reference nn/VolumetricMaxPooling.scala)."""
+
+    def __init__(self, k_t: int, k_w: int, k_h: int, d_t: Optional[int] = None,
+                 d_w: Optional[int] = None, d_h: Optional[int] = None,
+                 pad_t: int = 0, pad_w: int = 0, pad_h: int = 0):
+        super().__init__()
+        self.k = (k_t, k_h, k_w)
+        self.d = (d_t or k_t, d_h or k_h, d_w or k_w)
+        self.pad = (pad_t, pad_h, pad_w)
+
+    def _apply(self, params, buffers, x, training, rng):
+        squeeze = False
+        if x.ndim == 4:
+            x = x[None]
+            squeeze = True
+        y = lax.reduce_window(
+            x, -jnp.inf, lax.max,
+            (1, 1) + self.k, (1, 1) + self.d,
+            [(0, 0), (0, 0)] + [(p, p) for p in self.pad])
+        if squeeze:
+            y = y[0]
+        return y, buffers
+
+
+class RoiPooling(AbstractModule):
+    """ROI max pooling (reference nn/RoiPooling.scala).
+
+    Input: Table(features (N,C,H,W), rois (R,5) rows [batch_idx, x1, y1, x2, y2]).
+    Static-shape implementation: each output cell gathers a masked max —
+    jit-friendly, R fixed per trace.
+    """
+
+    def __init__(self, pooled_w: int, pooled_h: int, spatial_scale: float):
+        super().__init__()
+        self.pw, self.ph = pooled_w, pooled_h
+        self.spatial_scale = spatial_scale
+
+    def _apply(self, params, buffers, inp, training, rng):
+        data, rois = inp[1], inp[2]
+        N, C, H, W = data.shape
+
+        def one_roi(roi):
+            batch = roi[0].astype(jnp.int32) - 1
+            x1 = jnp.round(roi[1] * self.spatial_scale).astype(jnp.int32)
+            y1 = jnp.round(roi[2] * self.spatial_scale).astype(jnp.int32)
+            x2 = jnp.round(roi[3] * self.spatial_scale).astype(jnp.int32)
+            y2 = jnp.round(roi[4] * self.spatial_scale).astype(jnp.int32)
+            roi_h = jnp.maximum(y2 - y1 + 1, 1)
+            roi_w = jnp.maximum(x2 - x1 + 1, 1)
+            bin_h = roi_h / self.ph
+            bin_w = roi_w / self.pw
+            img = data[batch]  # (C, H, W)
+            ys = jnp.arange(H)[None, :]
+            xs = jnp.arange(W)[None, :]
+
+            def cell(py, px):
+                hstart = jnp.floor(py * bin_h).astype(jnp.int32) + y1
+                hend = jnp.ceil((py + 1) * bin_h).astype(jnp.int32) + y1
+                wstart = jnp.floor(px * bin_w).astype(jnp.int32) + x1
+                wend = jnp.ceil((px + 1) * bin_w).astype(jnp.int32) + x1
+                hmask = (ys >= jnp.clip(hstart, 0, H)) & (ys < jnp.clip(hend, 0, H))
+                wmask = (xs >= jnp.clip(wstart, 0, W)) & (xs < jnp.clip(wend, 0, W))
+                mask = (hmask.reshape(1, H, 1) & wmask.reshape(1, 1, W))
+                empty = ~jnp.any(mask)
+                masked = jnp.where(mask, img, -jnp.inf)
+                m = jnp.max(masked, axis=(1, 2))
+                return jnp.where(empty, 0.0, m)
+
+            grid = [[cell(py, px) for px in range(self.pw)] for py in range(self.ph)]
+            return jnp.stack([jnp.stack(row, -1) for row in grid], -2)  # (C, ph, pw)
+
+        return jax.vmap(one_roi)(rois), buffers

@@ -1,0 +1,430 @@
+"""Shape/structure layers (SURVEY §2.4 'Shape/structure ops').
+
+All are zero-FLOP layout ops — under XLA they compile to metadata
+changes or cheap gathers; none of the reference's copy loops survive.
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..utils.table import Table
+from .module import AbstractModule, TensorModule
+
+
+class Reshape(TensorModule):
+    """reference nn/Reshape.scala — ``batch_mode`` None = auto-detect
+    (leading dim preserved when it looks like a batch)."""
+
+    def __init__(self, size: Sequence[int], batch_mode: Optional[bool] = None):
+        super().__init__()
+        self.size = tuple(size)
+        self.batch_mode = batch_mode
+        self.n_element = int(np.prod(self.size))
+
+    def _apply(self, params, buffers, x, training, rng):
+        batch = self.batch_mode
+        if batch is None:
+            batch = (x.ndim > len(self.size)
+                     and int(np.prod(x.shape[1:])) == self.n_element)
+        if batch:
+            return x.reshape((x.shape[0],) + self.size), buffers
+        return x.reshape(self.size), buffers
+
+
+class View(TensorModule):
+    """reference nn/View.scala — -1 wildcard supported; num_input_dims
+    enables batch handling."""
+
+    def __init__(self, *sizes):
+        super().__init__()
+        if len(sizes) == 1 and isinstance(sizes[0], (tuple, list)):
+            sizes = tuple(sizes[0])
+        self.sizes = tuple(sizes)
+        self.num_input_dims = 0
+
+    def set_num_input_dims(self, n):
+        self.num_input_dims = n
+        return self
+
+    def _apply(self, params, buffers, x, training, rng):
+        known = int(np.prod([s for s in self.sizes if s != -1]))
+        total = int(np.prod(x.shape))
+        if -1 in self.sizes or total == known:
+            return x.reshape(self.sizes if -1 in self.sizes
+                             else ((-1,) + self.sizes if total != known else self.sizes)), buffers
+        return x.reshape((-1,) + self.sizes), buffers
+
+
+class InferReshape(TensorModule):
+    """reference nn/InferReshape.scala — 0 keeps the input dim, -1 infers."""
+
+    def __init__(self, size: Sequence[int], batch_mode: bool = False):
+        super().__init__()
+        self.size = tuple(size)
+        self.batch_mode = batch_mode
+
+    def _apply(self, params, buffers, x, training, rng):
+        in_shape = x.shape[1:] if self.batch_mode else x.shape
+        out = []
+        for i, s in enumerate(self.size):
+            out.append(in_shape[i] if s == 0 else s)
+        if self.batch_mode:
+            return x.reshape((x.shape[0],) + tuple(out)), buffers
+        return x.reshape(tuple(out)), buffers
+
+
+class Transpose(TensorModule):
+    """Sequence of (1-based) dim swaps (reference nn/Transpose.scala)."""
+
+    def __init__(self, permutations: Sequence):
+        super().__init__()
+        self.permutations = [tuple(p) for p in permutations]
+
+    def _apply(self, params, buffers, x, training, rng):
+        perm = list(range(x.ndim))
+        for d1, d2 in self.permutations:
+            perm[d1 - 1], perm[d2 - 1] = perm[d2 - 1], perm[d1 - 1]
+        return jnp.transpose(x, perm), buffers
+
+
+class Replicate(TensorModule):
+    """Insert + tile a new dim (reference nn/Replicate.scala)."""
+
+    def __init__(self, n_features: int, dim: int = 1, n_dim: int = np.iinfo(np.int32).max):
+        super().__init__()
+        self.n_features, self.dim, self.n_dim = n_features, dim, n_dim
+
+    def _apply(self, params, buffers, x, training, rng):
+        d = self.dim - 1
+        if x.ndim > self.n_dim:
+            d += 1  # batch mode
+        y = jnp.expand_dims(x, d)
+        reps = [1] * y.ndim
+        reps[d] = self.n_features
+        return jnp.tile(y, reps), buffers
+
+
+class Squeeze(TensorModule):
+    def __init__(self, dim: Optional[int] = None, num_input_dims: int = -1):
+        super().__init__()
+        self.dim, self.num_input_dims = dim, num_input_dims
+
+    def _apply(self, params, buffers, x, training, rng):
+        if self.dim is None:
+            return jnp.squeeze(x), buffers
+        d = self.dim - 1
+        if self.num_input_dims > 0 and x.ndim > self.num_input_dims:
+            d += 1
+        return jnp.squeeze(x, axis=d) if x.shape[d] == 1 else x, buffers
+
+
+class Unsqueeze(TensorModule):
+    def __init__(self, pos: int, num_input_dims: int = -1):
+        super().__init__()
+        self.pos, self.num_input_dims = pos, num_input_dims
+
+    def _apply(self, params, buffers, x, training, rng):
+        d = self.pos - 1
+        if self.num_input_dims > 0 and x.ndim > self.num_input_dims:
+            d += 1
+        return jnp.expand_dims(x, d), buffers
+
+
+class Select(TensorModule):
+    """1-based select along dim; negative counts from the end
+    (reference nn/Select.scala)."""
+
+    def __init__(self, dimension: int, index: int):
+        super().__init__()
+        self.dimension, self.index = dimension, index
+
+    def _apply(self, params, buffers, x, training, rng):
+        d = self.dimension - 1 if self.dimension > 0 else x.ndim + self.dimension
+        i = self.index - 1 if self.index > 0 else x.shape[d] + self.index
+        return jnp.take(x, i, axis=d), buffers
+
+
+class Narrow(TensorModule):
+    """1-based narrow (reference nn/Narrow.scala); negative length keeps
+    all but |length|-1 trailing entries."""
+
+    def __init__(self, dimension: int, offset: int, length: int = 1):
+        super().__init__()
+        self.dimension, self.offset, self.length = dimension, offset, length
+
+    def _apply(self, params, buffers, x, training, rng):
+        d = self.dimension - 1 if self.dimension > 0 else x.ndim + self.dimension
+        length = self.length
+        if length < 0:
+            length = x.shape[d] - self.offset + 2 + length
+        start = self.offset - 1
+        return jax.lax.slice_in_dim(x, start, start + length, axis=d), buffers
+
+
+class SelectTable(AbstractModule):
+    """Pick entry i from a Table (reference nn/SelectTable.scala)."""
+
+    def __init__(self, index: int):
+        super().__init__()
+        self.index = index
+
+    def _apply(self, params, buffers, inp, training, rng):
+        idx = self.index if self.index > 0 else len(inp) + self.index + 1
+        return inp[idx], buffers
+
+
+class NarrowTable(AbstractModule):
+    """Slice a Table (reference nn/NarrowTable.scala)."""
+
+    def __init__(self, offset: int, length: int = 1):
+        super().__init__()
+        self.offset, self.length = offset, length
+
+    def _apply(self, params, buffers, inp, training, rng):
+        length = self.length
+        if length < 0:
+            length = inp.length() - self.offset + 2 + length
+        out = Table()
+        for i in range(length):
+            out[i + 1] = inp[self.offset + i]
+        return out, buffers
+
+
+class FlattenTable(AbstractModule):
+    """reference nn/FlattenTable.scala"""
+
+    def _apply(self, params, buffers, inp, training, rng):
+        return inp.flatten(), buffers
+
+
+class SplitTable(AbstractModule):
+    """Split a tensor along dim into a Table (reference nn/SplitTable.scala)."""
+
+    def __init__(self, dimension: int, n_input_dims: int = -1):
+        super().__init__()
+        self.dimension, self.n_input_dims = dimension, n_input_dims
+
+    def _apply(self, params, buffers, x, training, rng):
+        d = self.dimension - 1 if self.dimension > 0 else x.ndim + self.dimension
+        if self.n_input_dims > 0 and x.ndim > self.n_input_dims:
+            d += 1
+        out = Table()
+        for i in range(x.shape[d]):
+            out[i + 1] = jnp.take(x, i, axis=d)
+        return out, buffers
+
+
+class JoinTable(AbstractModule):
+    """Concat a Table of tensors along dim (reference nn/JoinTable.scala)."""
+
+    def __init__(self, dimension: int, n_input_dims: int = -1):
+        super().__init__()
+        self.dimension, self.n_input_dims = dimension, n_input_dims
+
+    def _apply(self, params, buffers, inp, training, rng):
+        first = inp[1]
+        d = self.dimension - 1
+        if self.n_input_dims > 0 and first.ndim > self.n_input_dims:
+            d += 1
+        return jnp.concatenate([inp[i + 1] for i in range(inp.length())],
+                               axis=d), buffers
+
+
+class Pack(AbstractModule):
+    """Stack a Table of tensors along a new dim (reference nn/Pack.scala)."""
+
+    def __init__(self, dimension: int):
+        super().__init__()
+        self.dimension = dimension
+
+    def _apply(self, params, buffers, inp, training, rng):
+        if isinstance(inp, Table):
+            arrs = [inp[i + 1] for i in range(inp.length())]
+        else:
+            arrs = [inp]
+        return jnp.stack(arrs, axis=self.dimension - 1), buffers
+
+
+class Reverse(TensorModule):
+    """Reverse along a dim (reference nn/Reverse.scala)."""
+
+    def __init__(self, dimension: int = 1):
+        super().__init__()
+        self.dimension = dimension
+
+    def _apply(self, params, buffers, x, training, rng):
+        return jnp.flip(x, axis=self.dimension - 1), buffers
+
+
+class Contiguous(TensorModule):
+    """No-op under XLA (reference nn/Contiguous.scala)."""
+
+    def _apply(self, params, buffers, x, training, rng):
+        return x, buffers
+
+
+class Index(AbstractModule):
+    """Table(src, indices) → index_select (reference nn/Index.scala)."""
+
+    def __init__(self, dimension: int):
+        super().__init__()
+        self.dimension = dimension
+
+    def _apply(self, params, buffers, inp, training, rng):
+        src, idx = inp[1], inp[2]
+        return jnp.take(src, idx.astype(jnp.int32) - 1,
+                        axis=self.dimension - 1), buffers
+
+
+class MaskedSelect(AbstractModule):
+    """Table(src, mask) → masked flatten (reference nn/MaskedSelect.scala).
+
+    Note: output size is data-dependent; usable eagerly, not under jit.
+    """
+
+    def _apply(self, params, buffers, inp, training, rng):
+        src, mask = np.asarray(inp[1]), np.asarray(inp[2]).astype(bool)
+        return jnp.asarray(src[mask]), buffers
+
+
+class Padding(TensorModule):
+    """Pad ``pad`` entries (sign = side) along dim (reference nn/Padding.scala)."""
+
+    def __init__(self, dim: int, pad: int, n_input_dim: int,
+                 value: float = 0.0, n_index: int = 1):
+        super().__init__()
+        self.dim, self.pad, self.n_input_dim = dim, pad, n_input_dim
+        self.value = value
+
+    def _apply(self, params, buffers, x, training, rng):
+        d = self.dim - 1
+        if x.ndim > self.n_input_dim:
+            d += 1
+        widths = [(0, 0)] * x.ndim
+        widths[d] = (abs(self.pad), 0) if self.pad < 0 else (0, self.pad)
+        return jnp.pad(x, widths, constant_values=self.value), buffers
+
+
+class SpatialZeroPadding(TensorModule):
+    """reference nn/SpatialZeroPadding.scala — NCHW zero pad."""
+
+    def __init__(self, pad_left: int, pad_right: int, pad_top: int, pad_bottom: int):
+        super().__init__()
+        self.pads = (pad_left, pad_right, pad_top, pad_bottom)
+
+    def _apply(self, params, buffers, x, training, rng):
+        l, r, t, b = self.pads
+        widths = [(0, 0)] * (x.ndim - 2) + [(t, b), (l, r)]
+        return jnp.pad(x, widths), buffers
+
+
+class DotProduct(AbstractModule):
+    """Rowwise dot of Table(a, b) (reference nn/DotProduct.scala)."""
+
+    def _apply(self, params, buffers, inp, training, rng):
+        a, b = inp[1], inp[2]
+        return jnp.sum(a * b, axis=-1), buffers
+
+
+class CosineDistance(AbstractModule):
+    """Rowwise cosine of Table(a, b) (reference nn/CosineDistance.scala)."""
+
+    def _apply(self, params, buffers, inp, training, rng):
+        a, b = inp[1], inp[2]
+        na = jnp.maximum(jnp.linalg.norm(a, axis=-1), 1e-12)
+        nb = jnp.maximum(jnp.linalg.norm(b, axis=-1), 1e-12)
+        return jnp.sum(a * b, axis=-1) / (na * nb), buffers
+
+
+class PairwiseDistance(AbstractModule):
+    """Lp distance of Table(a, b) (reference nn/PairwiseDistance.scala)."""
+
+    def __init__(self, norm: int = 2):
+        super().__init__()
+        self.norm = norm
+
+    def _apply(self, params, buffers, inp, training, rng):
+        d = inp[1] - inp[2]
+        return jnp.sum(jnp.abs(d) ** self.norm, axis=-1) ** (1.0 / self.norm), buffers
+
+
+class MixtureTable(AbstractModule):
+    """Gater-weighted blend of expert outputs (reference nn/MixtureTable.scala).
+
+    Input: Table(gater (N,K), experts Table of K tensors (N,...)).
+    """
+
+    def __init__(self, dim: int = np.iinfo(np.int32).max):
+        super().__init__()
+        self.dim = dim
+
+    def _apply(self, params, buffers, inp, training, rng):
+        gater, experts = inp[1], inp[2]
+        if isinstance(experts, Table):
+            stacked = jnp.stack([experts[i + 1] for i in range(experts.length())],
+                                axis=1)  # (N, K, ...)
+        else:
+            stacked = experts
+        g = gater.reshape(gater.shape + (1,) * (stacked.ndim - gater.ndim))
+        return jnp.sum(stacked * g, axis=1), buffers
+
+
+class Scale(TensorModule):
+    """CMul then CAdd (reference nn/Scale.scala)."""
+
+    def __init__(self, size: Sequence[int]):
+        super().__init__()
+        from .linear import CAdd, CMul
+
+        self.cmul = CMul(size)
+        self.cadd = CAdd(size)
+
+    def param_tree(self):
+        return {"mul": self.cmul.param_tree(), "add": self.cadd.param_tree()}
+
+    def set_param_tree(self, tree):
+        self.cmul.set_param_tree(tree["mul"])
+        self.cadd.set_param_tree(tree["add"])
+
+    def gradient_scale_tree(self):
+        return {"mul": self.cmul.gradient_scale_tree(),
+                "add": self.cadd.gradient_scale_tree()}
+
+    def grad_tree(self):
+        return {"mul": self.cmul.grad_tree(), "add": self.cadd.grad_tree()}
+
+    def set_grad_tree(self, tree):
+        self.cmul.set_grad_tree(tree["mul"])
+        self.cadd.set_grad_tree(tree["add"])
+
+    def _apply(self, params, buffers, x, training, rng):
+        y, _ = self.cmul._apply(params["mul"], {}, x, training, rng)
+        y, _ = self.cadd._apply(params["add"], {}, y, training, rng)
+        return y, buffers
+
+
+class GradientReversal(TensorModule):
+    """Identity forward, negated+scaled gradient (reference
+    nn/GradientReversal.scala) — via jax.custom_vjp."""
+
+    def __init__(self, the_lambda: float = 1.0):
+        super().__init__()
+        self.the_lambda = the_lambda
+
+    def set_lambda(self, lam):
+        self.the_lambda = lam
+        return self
+
+    def _apply(self, params, buffers, x, training, rng):
+        lam = self.the_lambda
+
+        @jax.custom_vjp
+        def rev(v):
+            return v
+
+        rev.defvjp(lambda v: (v, None), lambda _, g: (-lam * g,))
+        return rev(x), buffers

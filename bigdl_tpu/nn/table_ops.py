@@ -1,0 +1,50 @@
+"""Elementwise table ops (reference nn/CAddTable.scala etc., SURVEY §2.4)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .module import AbstractModule
+
+
+class _TableReduce(AbstractModule):
+    def _reduce(self, a, b):
+        raise NotImplementedError
+
+    def _apply(self, params, buffers, inp, training, rng):
+        out = inp[1]
+        for i in range(2, inp.length() + 1):
+            out = self._reduce(out, inp[i])
+        return out, buffers
+
+
+class CAddTable(_TableReduce):
+    def __init__(self, inplace: bool = False):
+        super().__init__()
+
+    def _reduce(self, a, b):
+        return a + b
+
+
+class CSubTable(_TableReduce):
+    def _reduce(self, a, b):
+        return a - b
+
+
+class CMulTable(_TableReduce):
+    def _reduce(self, a, b):
+        return a * b
+
+
+class CDivTable(_TableReduce):
+    def _reduce(self, a, b):
+        return a / b
+
+
+class CMaxTable(_TableReduce):
+    def _reduce(self, a, b):
+        return jnp.maximum(a, b)
+
+
+class CMinTable(_TableReduce):
+    def _reduce(self, a, b):
+        return jnp.minimum(a, b)

@@ -1,0 +1,18 @@
+from .optim_method import (
+    SGD, Adadelta, Adagrad, Adam, Adamax, Default, EpochDecay, EpochSchedule,
+    EpochStep, Exponential, LBFGS, LearningRateSchedule, MultiStep, NaturalExp,
+    OptimMethod, Plateau, Poly, RMSprop, Step,
+)
+from .trigger import (
+    Trigger, every_epoch, max_epoch, max_iteration, max_score, min_loss,
+    several_iteration,
+)
+from .validation import (
+    AccuracyResult, Loss, LossResult, MAE, Top1Accuracy, Top5Accuracy,
+    ValidationMethod, ValidationResult,
+)
+from .regularizer import L1L2Regularizer, L1Regularizer, L2Regularizer, Regularizer
+from .metrics import Metrics
+from .optimizer import LocalOptimizer, Optimizer
+from .evaluator import DistriValidator, Evaluator, LocalValidator
+from .predictor import Predictor

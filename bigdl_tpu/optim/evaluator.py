@@ -1,0 +1,76 @@
+"""Evaluator (reference optim/Evaluator.scala:37, Validator.scala,
+LocalValidator.scala, DistriValidator.scala:35).
+
+Batches run through ONE jitted eval forward; ValidationResults reduce as
+monoids (the reference's driver-side reduce of per-partition results).
+"""
+from __future__ import annotations
+
+from typing import List, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from ..dataset.sample import MiniBatch, Sample, SampleToMiniBatch
+from .validation import ValidationMethod, ValidationResult
+
+
+def evaluate_dataset(model, dataset, v_methods: Sequence[ValidationMethod],
+                     batch_size: int = 128) -> List[ValidationResult]:
+    """Shared eval loop; dataset may yield Samples or MiniBatches."""
+    model.evaluate()
+    params = model.param_tree()
+    buffers = model.buffer_tree()
+
+    @jax.jit
+    def fwd(p, b, x):
+        out, _ = model.apply_fn(p, b, x, False, None)
+        return out
+
+    it = dataset.data(train=False)
+    results = [None] * len(v_methods)
+    batcher = SampleToMiniBatch(batch_size)
+
+    def batches():
+        pending = []
+        for item in it:
+            if isinstance(item, MiniBatch):
+                yield item
+            else:
+                pending.append(item)
+                if len(pending) == batch_size:
+                    yield batcher._make(pending)
+                    pending = []
+        if pending:
+            yield batcher._make(pending)
+
+    for batch in batches():
+        x = batch.get_input()
+        y = batch.get_target()
+        x = jnp.asarray(x) if not isinstance(x, (list, tuple)) else \
+            type(x)(jnp.asarray(v) for v in x)
+        out = fwd(params, buffers, x)
+        for i, m in enumerate(v_methods):
+            r = m(out, y)
+            results[i] = r if results[i] is None else results[i] + r
+    return [r for r in results if r is not None]
+
+
+class Evaluator:
+    """reference optim/Evaluator.scala:37 — model.evaluate(dataset, methods)."""
+
+    def __init__(self, model):
+        self.model = model
+
+    def test(self, dataset, v_methods, batch_size: int = 128):
+        results = evaluate_dataset(self.model, dataset, v_methods, batch_size)
+        return list(zip(results, [m.format() for m in v_methods]))
+
+
+class LocalValidator(Evaluator):
+    """reference optim/LocalValidator.scala:37"""
+
+
+class DistriValidator(Evaluator):
+    """reference optim/DistriValidator.scala:35 — same eval loop; batch
+    sharding over the mesh happens at infeed when a mesh is active."""

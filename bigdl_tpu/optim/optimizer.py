@@ -1,0 +1,292 @@
+"""Optimizer frontend + LocalOptimizer (reference optim/Optimizer.scala:42,
+LocalOptimizer.scala:41).
+
+The reference's LocalOptimizer clones the model per core and hand-merges
+gradients (LocalOptimizer.scala:66-142); on TPU the whole iteration is
+ONE jitted function — forward, loss, backward, optimizer update — and
+batch parallelism is XLA vectorization.  The host loop owns only what
+the reference driver owned: triggers, epochs, validation, checkpointing,
+summaries, metrics.
+"""
+from __future__ import annotations
+
+import logging
+import os
+import time
+from typing import List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..dataset.dataset import AbstractDataSet
+from ..dataset.sample import MiniBatch, SampleToMiniBatch
+from ..nn.module import AbstractModule, to_array
+from ..utils.rng import next_jax_key
+from ..utils.table import T, Table
+from .metrics import Metrics
+from .optim_method import SGD, OptimMethod
+from .regularizer import collect_regularizer_paths, regularizer_loss
+from .trigger import Trigger
+from .validation import ValidationMethod
+
+log = logging.getLogger("bigdl_tpu")
+logging.basicConfig(
+    level=logging.INFO,
+    format="%(asctime)s %(levelname)s %(name)s: %(message)s")
+
+
+class Optimizer:
+    """Fluent training config (reference Optimizer.scala fluent API +
+    factory ``Optimizer(model=..., dataset=..., criterion=...)``:324)."""
+
+    def __init__(self, model: AbstractModule, dataset, criterion,
+                 batch_size: Optional[int] = None, end_trigger: Optional[Trigger] = None):
+        from .trigger import max_epoch
+
+        # Samples → MiniBatch conversion at the factory, like
+        # Optimizer.apply (Optimizer.scala:330-335)
+        if batch_size is not None and not _yields_minibatch(dataset):
+            dataset = dataset.transform(SampleToMiniBatch(batch_size))
+        self.batch_size = batch_size
+        self.model = model
+        self.dataset = dataset
+        self.criterion = criterion
+        self.optim_method: OptimMethod = SGD(learning_rate=1e-3)
+        self.end_when: Trigger = end_trigger or max_epoch(1)
+        self.checkpoint_path: Optional[str] = None
+        self.checkpoint_trigger: Optional[Trigger] = None
+        self.is_overwrite = False
+        self.validation_trigger: Optional[Trigger] = None
+        self.validation_dataset = None
+        self.validation_methods: Sequence[ValidationMethod] = ()
+        self.train_summary = None
+        self.validation_summary = None
+        self.metrics = Metrics()
+        self.drop_percentage = 0.0  # reference straggler knob — no-op on TPU (SURVEY P6)
+        self.max_drop_percentage = 0.0
+        self.compute_threshold_batchsize = 100
+
+    # -- fluent config (Optimizer.scala:98-243) -------------------------
+    def set_optim_method(self, method: OptimMethod):
+        self.optim_method = method
+        return self
+
+    def set_end_when(self, trigger: Trigger):
+        self.end_when = trigger
+        return self
+
+    def set_validation(self, trigger: Trigger, dataset, v_methods,
+                       batch_size: Optional[int] = None):
+        if batch_size is not None and not _yields_minibatch(dataset):
+            dataset = dataset.transform(SampleToMiniBatch(batch_size))
+        self.validation_trigger = trigger
+        self.validation_dataset = dataset
+        self.validation_methods = list(v_methods)
+        return self
+
+    def set_checkpoint(self, path: str, trigger: Trigger):
+        self.checkpoint_path = path
+        self.checkpoint_trigger = trigger
+        return self
+
+    def overwrite_checkpoint(self):
+        self.is_overwrite = True
+        return self
+
+    def set_train_summary(self, summary):
+        self.train_summary = summary
+        return self
+
+    def set_validation_summary(self, summary):
+        self.validation_summary = summary
+        return self
+
+    def set_drop_module_property(self, drop_percentage, max_drop_percentage,
+                                 batch_size=100, warmup_iteration=200):
+        """Straggler-drop knobs (reference Optimizer.scala:229-243).
+        Kept for parity; a synchronous TPU step has no stragglers to drop
+        (SURVEY §2.2 P6) so these are recorded but unused."""
+        self.drop_percentage = drop_percentage
+        self.max_drop_percentage = max_drop_percentage
+        return self
+
+    def optimize(self) -> AbstractModule:
+        raise NotImplementedError
+
+
+def _yields_minibatch(dataset) -> bool:
+    try:
+        probe = next(iter(dataset.data(train=False)))
+    except StopIteration:
+        return False
+    return isinstance(probe, MiniBatch)
+
+
+def _resume_slots(optim, fresh_slots):
+    """Reuse checkpointed optimizer slots when their pytree structure and
+    leaf shapes match a fresh init; otherwise start clean."""
+    saved = optim._slots
+    if saved is None:
+        return fresh_slots
+    try:
+        ok = all(jax.tree_util.tree_leaves(jax.tree_util.tree_map(
+            lambda a, b: jnp.shape(a) == jnp.shape(b), saved, fresh_slots)))
+    except ValueError:
+        ok = False
+    return saved if ok else fresh_slots
+
+
+def _device_batch(batch: MiniBatch):
+    x = batch.get_input()
+    y = batch.get_target()
+    conv = lambda v: jnp.asarray(v) if not isinstance(v, (list, tuple)) \
+        else type(v)(jnp.asarray(e) for e in v)
+    return conv(x), conv(y)
+
+
+class LocalOptimizer(Optimizer):
+    """Single-host training driver (reference optim/LocalOptimizer.scala:41):
+    the whole iteration is one jitted step on one chip (or all local chips
+    via vectorized batch — the reference's per-core model clones collapse
+    into the batch dimension, SURVEY §2.2 P2)."""
+
+    def optimize(self) -> AbstractModule:
+        model, criterion, optim = self.model, self.criterion, self.optim_method
+        model.training()
+        reg_paths = list(collect_regularizer_paths(model))
+        scale_tree = model.gradient_scale_tree()
+        needs_scale = any(s != 1.0
+                          for s in jax.tree_util.tree_leaves(scale_tree))
+
+        def train_step(params, buffers, slots, lr, rng, x, y):
+            def loss_fn(p):
+                out, nb = model.apply_fn(p, buffers, x, True, rng)
+                loss = criterion._loss(out, y)
+                if reg_paths:
+                    loss = loss + regularizer_loss(p, reg_paths)
+                return loss, nb
+            (loss, new_buffers), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params)
+            if needs_scale:  # reference setScaleW/setScaleB semantics
+                grads = jax.tree_util.tree_map(lambda g, s: g * s,
+                                               grads, scale_tree)
+            new_params, new_slots = optim.step(grads, params, slots, lr)
+            return loss, new_params, new_buffers, new_slots
+
+        jitted = jax.jit(train_step)
+
+        params = model.param_tree()
+        buffers = model.buffer_tree()
+        # resume optimizer slots (Adam moments etc.) from a loaded
+        # checkpoint when their structure matches the parameters
+        # (reference OptimMethod state survives checkpoints,
+        # OptimMethod.scala:80-96)
+        slots = _resume_slots(optim, optim.init_state(params))
+
+        state = optim.state
+        state["epoch"] = state.get("epoch", 1)
+        state["neval"] = state.get("neval", 1)
+        state["epoch_finished"] = False
+
+        records_this_epoch = 0
+        epoch_size = self.dataset.size()
+        data_iter = self.dataset.data(train=True)
+        wall_start = time.time()
+
+        while not self.end_when(state):
+            state["epoch_finished"] = False
+            t_data0 = time.time()
+            batch = next(data_iter)
+            x, y = _device_batch(batch)
+            data_time = time.time() - t_data0
+
+            t0 = time.time()
+            lr = optim.get_current_lr()
+            rng = next_jax_key()
+            loss, params, buffers, slots = jitted(
+                params, buffers, slots, jnp.float32(lr), rng, x, y)
+            loss = float(loss)
+            n_records = batch.size()
+            train_time = time.time() - t0
+
+            self.metrics.add("computing time average", train_time)
+            self.metrics.add("data fetch time", data_time)
+            records_this_epoch += n_records
+            state["loss"] = loss
+            log.info(
+                "[Epoch %d %d/%d][Iteration %d][Wall Clock %.3fs] "
+                "Train %d in %.4f seconds. Throughput is %.1f records/second. "
+                "Loss is %.5f.",
+                state["epoch"], records_this_epoch, epoch_size, state["neval"],
+                time.time() - wall_start, n_records, train_time + data_time,
+                n_records / max(train_time + data_time, 1e-9), loss)
+
+            if self.train_summary is not None:
+                self.train_summary.add_scalar("Loss", loss, state["neval"])
+                self.train_summary.add_scalar(
+                    "Throughput", n_records / max(train_time + data_time, 1e-9),
+                    state["neval"])
+                if "LearningRate" in getattr(self.train_summary, "triggers", {}):
+                    self.train_summary.add_scalar("LearningRate", lr, state["neval"])
+
+            state["neval"] += 1
+            optim.state = state
+
+            if records_this_epoch >= epoch_size:
+                state["epoch"] += 1
+                state["epoch_finished"] = True
+                records_this_epoch = 0
+                self.dataset.shuffle()
+                data_iter = self.dataset.data(train=True)
+
+            # sync module state before validation/checkpoint consumers
+            if self._should(self.validation_trigger, state) or \
+               self._should(self.checkpoint_trigger, state):
+                model.set_param_tree(params)
+                model.set_buffer_tree(buffers)
+                optim._slots = slots
+            self._validate(state)
+            self._checkpoint(state)
+
+        model.set_param_tree(params)
+        model.set_buffer_tree(buffers)
+        optim._slots = slots
+        model.evaluate()
+        return model
+
+    @staticmethod
+    def _should(trigger, state) -> bool:
+        return trigger is not None and trigger(state)
+
+    def _validate(self, state):
+        if not self._should(self.validation_trigger, state):
+            return
+        if self.validation_dataset is None or not self.validation_methods:
+            return
+        from .evaluator import evaluate_dataset
+
+        results = evaluate_dataset(self.model, self.validation_dataset,
+                                   self.validation_methods)
+        for method, result in zip(self.validation_methods, results):
+            log.info("%s is %s", method.format(), result)
+            if self.validation_summary is not None:
+                value = result.result()[0]
+                self.validation_summary.add_scalar(
+                    method.format(), value, state["neval"] - 1)
+            if method.format() in ("Top1Accuracy", "Top5Accuracy"):
+                state["score"] = result.result()[0]
+        self.model.training()
+
+    def _checkpoint(self, state):
+        if not self._should(self.checkpoint_trigger, state):
+            return
+        if self.checkpoint_path is None:
+            return
+        n = state["neval"] - 1
+        suffix = "" if self.is_overwrite else f".{n}"
+        self.model.save(os.path.join(self.checkpoint_path, f"model{suffix}"),
+                        overwrite=True)
+        self.optim_method.save(
+            os.path.join(self.checkpoint_path, f"optimMethod{suffix}"),
+            overwrite=True)

@@ -1,0 +1,61 @@
+"""Predictor (reference optim/Predictor.scala:34, LocalPredictor.scala:37).
+
+Inference with the model's params broadcast once (jit constant-folds
+them — the TPU analogue of ModelBroadcast, SURVEY §2.2 P7)."""
+from __future__ import annotations
+
+from typing import Iterator, List
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..dataset.sample import MiniBatch, Sample, SampleToMiniBatch
+
+
+class Predictor:
+    def __init__(self, model):
+        self.model = model
+
+    def _fwd(self):
+        model = self.model
+        params = model.param_tree()
+        buffers = model.buffer_tree()
+
+        @jax.jit
+        def fwd(x):
+            out, _ = model.apply_fn(params, buffers, x, False, None)
+            return out
+
+        return fwd
+
+    def _batches(self, dataset, batch_size):
+        batcher = SampleToMiniBatch(batch_size)
+        pending = []
+        for item in dataset.data(train=False):
+            if isinstance(item, MiniBatch):
+                yield item
+            else:
+                pending.append(item)
+                if len(pending) == batch_size:
+                    yield batcher._make(pending)
+                    pending = []
+        if pending:
+            yield batcher._make(pending)
+
+    def predict(self, dataset, batch_size: int = 32) -> List[np.ndarray]:
+        """RDD[Activity] analogue: list of per-sample outputs."""
+        self.model.evaluate()
+        fwd = self._fwd()
+        outs = []
+        for batch in self._batches(dataset, batch_size):
+            x = batch.get_input()
+            x = jnp.asarray(x) if not isinstance(x, (list, tuple)) else \
+                type(x)(jnp.asarray(v) for v in x)
+            out = np.asarray(fwd(x))
+            outs.extend(out[i] for i in range(out.shape[0]))
+        return outs
+
+    def predict_class(self, dataset, batch_size: int = 32) -> List[int]:
+        """1-based argmax classes (reference predictClass)."""
+        return [int(np.argmax(o)) + 1 for o in self.predict(dataset, batch_size)]

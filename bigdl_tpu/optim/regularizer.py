@@ -1,0 +1,69 @@
+"""Weight regularizers (reference optim/Regularizer.scala:30/87/186).
+
+The reference adds ``l1*sign(w) + l2*w`` to the gradient inside each
+layer's accGradParameters; here regularizers contribute a penalty term
+to the (single, jitted) loss so their gradient falls out of autodiff:
+penalty = l1*|w|₁ + (l2/2)*|w|₂².  The train-step builder walks the
+module tree for ``w_regularizer``/``b_regularizer`` attributes.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+class Regularizer:
+    def loss(self, param) -> jnp.ndarray:
+        raise NotImplementedError
+
+
+class L1L2Regularizer(Regularizer):
+    def __init__(self, l1: float = 0.0, l2: float = 0.0):
+        self.l1, self.l2 = l1, l2
+
+    def loss(self, param):
+        out = 0.0
+        if self.l1:
+            out = out + self.l1 * jnp.sum(jnp.abs(param))
+        if self.l2:
+            out = out + 0.5 * self.l2 * jnp.sum(jnp.square(param))
+        return out
+
+
+class L1Regularizer(L1L2Regularizer):
+    def __init__(self, l1: float):
+        super().__init__(l1=l1)
+
+
+class L2Regularizer(L1L2Regularizer):
+    def __init__(self, l2: float):
+        super().__init__(l2=l2)
+
+
+def collect_regularizer_paths(module, prefix=()):
+    """Yield (param_tree_path, regularizer) pairs over a module tree.
+
+    Paths address the composed param pytree the way Container.param_tree
+    builds it (children keyed by str(index), leaf params by name).
+    """
+    from ..nn.module import Container
+
+    if isinstance(module, Container):
+        for i, child in enumerate(module.modules):
+            yield from collect_regularizer_paths(child, prefix + (str(i),))
+    else:
+        wr = getattr(module, "w_regularizer", None)
+        br = getattr(module, "b_regularizer", None)
+        if wr is not None and "weight" in module.params:
+            yield prefix + ("weight",), wr
+        if br is not None and "bias" in module.params:
+            yield prefix + ("bias",), br
+
+
+def regularizer_loss(param_tree, reg_paths):
+    total = 0.0
+    for path, reg in reg_paths:
+        node = param_tree
+        for key in path:
+            node = node[key]
+        total = total + reg.loss(node)
+    return total

@@ -1,0 +1,123 @@
+"""ValidationMethod + results (reference optim/ValidationMethod.scala:
+Top1Accuracy:170, Top5Accuracy:218, Loss:312, MAE:332) with monoid
+``ValidationResult``s that reduce across batches/devices."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+
+class ValidationResult:
+    def result(self):
+        raise NotImplementedError
+
+    def __add__(self, other):
+        raise NotImplementedError
+
+
+class AccuracyResult(ValidationResult):
+    def __init__(self, correct: int, count: int):
+        self.correct, self.count = int(correct), int(count)
+
+    def result(self):
+        return (self.correct / max(self.count, 1), self.count)
+
+    def __add__(self, other):
+        return AccuracyResult(self.correct + other.correct,
+                              self.count + other.count)
+
+    def __eq__(self, other):
+        return (isinstance(other, AccuracyResult)
+                and (self.correct, self.count) == (other.correct, other.count))
+
+    def __repr__(self):
+        acc, n = self.result()
+        return f"Accuracy(correct: {self.correct}, count: {n}, accuracy: {acc})"
+
+
+class LossResult(ValidationResult):
+    def __init__(self, loss: float, count: int):
+        self.loss, self.count = float(loss), int(count)
+
+    def result(self):
+        return (self.loss / max(self.count, 1), self.count)
+
+    def __add__(self, other):
+        return LossResult(self.loss + other.loss, self.count + other.count)
+
+    def __repr__(self):
+        avg, n = self.result()
+        return f"Loss(loss: {self.loss}, count: {n}, average: {avg})"
+
+
+class ValidationMethod:
+    def apply(self, output, target) -> ValidationResult:
+        raise NotImplementedError
+
+    def __call__(self, output, target):
+        return self.apply(output, target)
+
+    def format(self) -> str:
+        return type(self).__name__
+
+
+class Top1Accuracy(ValidationMethod):
+    """reference ValidationMethod.scala:170 — argmax vs 1-based labels."""
+
+    def apply(self, output, target):
+        out = np.asarray(output)
+        t = np.asarray(target).reshape(-1)
+        if out.ndim == 1:
+            out = out[None]
+        pred = out.argmax(axis=-1) + 1
+        correct = int((pred == t.astype(np.int64)).sum())
+        return AccuracyResult(correct, t.shape[0])
+
+    def format(self):
+        return "Top1Accuracy"
+
+
+class Top5Accuracy(ValidationMethod):
+    """reference ValidationMethod.scala:218"""
+
+    def apply(self, output, target):
+        out = np.asarray(output)
+        t = np.asarray(target).reshape(-1).astype(np.int64)
+        if out.ndim == 1:
+            out = out[None]
+        top5 = np.argsort(-out, axis=-1)[:, :5] + 1
+        correct = int((top5 == t[:, None]).any(axis=1).sum())
+        return AccuracyResult(correct, t.shape[0])
+
+    def format(self):
+        return "Top5Accuracy"
+
+
+class Loss(ValidationMethod):
+    """Criterion loss as a validation metric (reference :312)."""
+
+    def __init__(self, criterion=None):
+        from ..nn.criterion import ClassNLLCriterion
+
+        self.criterion = criterion or ClassNLLCriterion()
+
+    def apply(self, output, target):
+        l = self.criterion.forward(output, target)
+        n = np.asarray(output).shape[0]
+        return LossResult(l * n, n)
+
+    def format(self):
+        return "Loss"
+
+
+class MAE(ValidationMethod):
+    """Mean absolute error on argmax outputs (reference :332)."""
+
+    def apply(self, output, target):
+        out = np.asarray(output)
+        t = np.asarray(target).reshape(-1)
+        pred = out.argmax(axis=-1) + 1
+        return LossResult(float(np.abs(pred - t).sum()), t.shape[0])
+
+    def format(self):
+        return "MAE"

@@ -1,0 +1,1 @@
+from .all_reduce import AllReduceParameter, padded_size, shard_batch

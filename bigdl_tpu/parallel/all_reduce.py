@@ -1,0 +1,100 @@
+"""AllReduceParameter, rebuilt on XLA collectives (reference
+parameters/AllReduceParameter.scala:67-306, SURVEY §2.2 P3).
+
+The reference hand-rolls, over Spark's block manager:
+  (a) putGradients: fp16-compress the full local gradient, scatter slices
+  (b) aggregateGradientPartition: fetch + sum my slice   → reduce-scatter
+  (c) OptimMethod on my owned slice                      → sharded update
+  (d) sendWeightPartition / getWeights                   → all-gather
+
+Here the same dataflow is three ops inside ONE compiled step, riding ICI:
+``lax.psum_scatter`` → slice update → ``lax.all_gather``.  The fp16 wire
+codec becomes a bf16 cast on the scatter (native TPU dtype — SURVEY
+§2.1), kept behind the ``compress`` flag as the CompressedTensor seam.
+
+All functions run *inside* shard_map over the ``data`` mesh axis.
+"""
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.flatten_util import ravel_pytree
+
+
+def padded_size(n: int, num_shards: int) -> int:
+    return (n + num_shards - 1) // num_shards * num_shards
+
+
+class AllReduceParameter:
+    """Flat-parameter sharding bookkeeping (host side).
+
+    ``partition_num`` shards a flat fp32 parameter vector exactly like the
+    reference's per-node slices (AllReduceParameter.scala:136-154): shard
+    i owns [i*slice, (i+1)*slice).  The device-side collectives live in
+    the ``*_sharded`` methods, traced under shard_map.
+    """
+
+    def __init__(self, params_template, partition_num: int,
+                 axis_name: str = "data", compress: str = "bf16"):
+        flat, unravel = ravel_pytree(params_template)
+        self.size = int(flat.size)
+        self.partition_num = partition_num
+        self.axis_name = axis_name
+        self.compress = compress
+        self.padded = padded_size(self.size, partition_num)
+        self.slice_size = self.padded // partition_num
+        self.unravel = unravel
+
+    # -- host helpers ----------------------------------------------------
+    def flatten(self, params) -> jax.Array:
+        flat, _ = ravel_pytree(params)
+        return jnp.pad(flat, (0, self.padded - self.size))
+
+    def unflatten(self, flat: jax.Array):
+        return self.unravel(flat[:self.size])
+
+    def init_slices(self, optim_method, params):
+        """Optimizer slots for ONE owned slice per shard — the state the
+        reference keeps per-partition (slice-owned Adam moments etc.)."""
+        zero_slice = jnp.zeros((self.slice_size,), jnp.float32)
+        return optim_method.init_state(zero_slice)
+
+    # -- device (inside shard_map) ---------------------------------------
+    def reduce_scatter_gradients(self, grads_tree) -> jax.Array:
+        """(a)+(b): local grad pytree → my summed slice.  One
+        ``psum_scatter`` over ICI replaces N² block-manager fetches."""
+        flat, _ = ravel_pytree(grads_tree)
+        flat = jnp.pad(flat, (0, self.padded - self.size))
+        if self.compress == "bf16":
+            flat = flat.astype(jnp.bfloat16)
+        out = lax.psum_scatter(flat, self.axis_name, tiled=True)
+        return out.astype(jnp.float32)
+
+    def all_gather_weights(self, weight_slice: jax.Array):
+        """(d): my updated slice → full replicated param pytree."""
+        flat = lax.all_gather(weight_slice, self.axis_name, tiled=True)
+        return self.unflatten(flat)
+
+    def my_weight_slice(self, params_tree) -> jax.Array:
+        """Owned slice of the (replicated) flat parameter."""
+        flat = self.flatten(params_tree)
+        idx = lax.axis_index(self.axis_name)
+        return lax.dynamic_slice_in_dim(flat, idx * self.slice_size,
+                                        self.slice_size)
+
+
+def shard_batch(mesh, batch_arrays, axis_name: str = "data"):
+    """Host→device infeed with a data-axis sharding — the TPU replacement
+    for ZippedPartitionsWithLocalityRDD colocation (SURVEY §2.2 P4)."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    def put(x):
+        spec = P(axis_name, *([None] * (x.ndim - 1)))
+        return jax.device_put(jnp.asarray(x), NamedSharding(mesh, spec))
+
+    return jax.tree_util.tree_map(put, batch_arrays)

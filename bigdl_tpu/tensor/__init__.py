@@ -1,0 +1,1 @@
+from .tensor import Tensor, arange, ones, rand, randn, range_, tensor, zeros

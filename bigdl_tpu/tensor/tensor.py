@@ -1,0 +1,576 @@
+"""Torch-semantics Tensor facade over ``jax.Array``.
+
+Rebuild of the reference tensor layer (tensor/Tensor.scala:36,
+DenseTensor.scala, TensorMath.scala).  Design stance (SURVEY §7.1): the
+compute path of this framework is raw ``jax.Array`` pytrees flowing
+through jitted pure functions — XLA owns layout, striding and fusion, so
+the reference's storage/stride machinery (ArrayStorage, storageOffset,
+DenseTensorApply) is deliberately *not* rebuilt.  This class is the
+user-facing adapter that preserves Torch API semantics where the
+reference API demands them: 1-based ``select``/``narrow``/``index``,
+``view``/``reshape``, ``transpose(d1, d2)``, and the TensorMath surface
+(add/mul/addmm/addmv/max/sum/topk/...).
+
+Mutation semantics: the wrapper is mutable (in-place ops rebind the
+underlying immutable array), which is what the Torch-style API needs;
+under ``jit`` everything is functional because modules never see this
+class — they see the raw array via ``.data``.
+"""
+from __future__ import annotations
+
+import operator
+from typing import Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..utils.rng import RNG
+
+Number = Union[int, float]
+
+
+def _raw(x):
+    return x.data if isinstance(x, Tensor) else x
+
+
+class Tensor:
+    """Dense tensor with Torch-style (1-based) API over jax.numpy."""
+
+    def __init__(self, *sizes, data=None, dtype=None):
+        if data is not None:
+            self._a = jnp.asarray(_raw(data), dtype=dtype)
+        elif len(sizes) == 1 and isinstance(sizes[0], (tuple, list)):
+            self._a = jnp.zeros(tuple(sizes[0]), dtype=dtype or jnp.float32)
+        elif sizes:
+            self._a = jnp.zeros(sizes, dtype=dtype or jnp.float32)
+        else:
+            self._a = jnp.zeros((), dtype=dtype or jnp.float32)
+
+    # -- raw access ------------------------------------------------------
+    @property
+    def data(self) -> jax.Array:
+        return self._a
+
+    @data.setter
+    def data(self, value):
+        self._a = jnp.asarray(_raw(value))
+
+    def numpy(self) -> np.ndarray:
+        return np.asarray(self._a)
+
+    @property
+    def dtype(self):
+        return self._a.dtype
+
+    # -- shape surface (Tensor.scala:100-180) ----------------------------
+    def dim(self) -> int:
+        return self._a.ndim
+
+    def n_dimension(self) -> int:
+        return self._a.ndim
+
+    def size(self, dim: Optional[int] = None):
+        """1-based ``size(d)``; no arg returns the full shape tuple."""
+        if dim is None:
+            return tuple(self._a.shape)
+        return self._a.shape[dim - 1]
+
+    @property
+    def shape(self):
+        return tuple(self._a.shape)
+
+    def n_element(self) -> int:
+        return int(self._a.size)
+
+    def is_empty(self) -> bool:
+        return self._a.size == 0
+
+    def is_scalar(self) -> bool:
+        return self._a.ndim == 0
+
+    # -- shape ops (Tensor.scala:336-539) --------------------------------
+    def view(self, *sizes) -> "Tensor":
+        if len(sizes) == 1 and isinstance(sizes[0], (tuple, list)):
+            sizes = tuple(sizes[0])
+        return Tensor(data=self._a.reshape(sizes))
+
+    def reshape(self, *sizes) -> "Tensor":
+        return self.view(*sizes)
+
+    def resize(self, *sizes) -> "Tensor":
+        if len(sizes) == 1 and isinstance(sizes[0], (tuple, list)):
+            sizes = tuple(sizes[0])
+        n = int(np.prod(sizes)) if sizes else 1
+        flat = self._a.reshape(-1)
+        if flat.size < n:
+            flat = jnp.concatenate([flat, jnp.zeros(n - flat.size, flat.dtype)])
+        self._a = flat[:n].reshape(sizes)
+        return self
+
+    def resize_as(self, other: "Tensor") -> "Tensor":
+        return self.resize(*other.shape)
+
+    def select(self, dim: int, index: int) -> "Tensor":
+        """1-based select: drop dimension ``dim`` at slice ``index``."""
+        return Tensor(data=jnp.take(self._a, index - 1, axis=dim - 1))
+
+    def narrow(self, dim: int, index: int, size: int) -> "Tensor":
+        """1-based narrow along ``dim`` starting at ``index``, length ``size``."""
+        return Tensor(data=jax.lax.slice_in_dim(self._a, index - 1,
+                                                index - 1 + size, axis=dim - 1))
+
+    def t(self) -> "Tensor":
+        return self.transpose(1, 2)
+
+    def transpose(self, dim1: int, dim2: int) -> "Tensor":
+        perm = list(range(self._a.ndim))
+        perm[dim1 - 1], perm[dim2 - 1] = perm[dim2 - 1], perm[dim1 - 1]
+        return Tensor(data=jnp.transpose(self._a, perm))
+
+    def contiguous(self) -> "Tensor":
+        return self  # XLA owns layout; every array is logically contiguous
+
+    def clone(self) -> "Tensor":
+        return Tensor(data=self._a)
+
+    def squeeze(self, dim: Optional[int] = None) -> "Tensor":
+        if dim is None:
+            self._a = jnp.squeeze(self._a)
+        elif self._a.shape[dim - 1] == 1:
+            self._a = jnp.squeeze(self._a, axis=dim - 1)
+        return self
+
+    def unsqueeze(self, dim: int) -> "Tensor":
+        self._a = jnp.expand_dims(self._a, axis=dim - 1)
+        return self
+
+    def expand(self, *sizes) -> "Tensor":
+        if len(sizes) == 1 and isinstance(sizes[0], (tuple, list)):
+            sizes = tuple(sizes[0])
+        return Tensor(data=jnp.broadcast_to(self._a, sizes))
+
+    def expand_as(self, other: "Tensor") -> "Tensor":
+        return self.expand(*other.shape)
+
+    def repeat_tensor(self, *sizes) -> "Tensor":
+        return Tensor(data=jnp.tile(self._a, sizes))
+
+    def unfold(self, dim: int, size: int, step: int) -> "Tensor":
+        """Sliding windows along ``dim`` (Tensor.scala unfold)."""
+        d = dim - 1
+        n = (self._a.shape[d] - size) // step + 1
+        idx = np.arange(n)[:, None] * step + np.arange(size)[None, :]
+        win = jnp.take(self._a, jnp.asarray(idx.reshape(-1)), axis=d)
+        new_shape = (self._a.shape[:d] + (n, size) + self._a.shape[d + 1:])
+        win = win.reshape(new_shape)
+        # Torch puts the window dim last
+        perm = list(range(win.ndim))
+        wdim = perm.pop(d + 1)
+        perm.append(wdim)
+        return Tensor(data=jnp.transpose(win, perm))
+
+    def split(self, size: int, dim: int = 1):
+        d = dim - 1
+        n = self._a.shape[d]
+        return [Tensor(data=jax.lax.slice_in_dim(self._a, i, min(i + size, n), axis=d))
+                for i in range(0, n, size)]
+
+    def index_select(self, dim: int, indices) -> "Tensor":
+        idx = jnp.asarray(_raw(indices), dtype=jnp.int32) - 1
+        return Tensor(data=jnp.take(self._a, idx, axis=dim - 1))
+
+    def masked_select(self, mask) -> "Tensor":
+        m = np.asarray(_raw(mask)).astype(bool)
+        return Tensor(data=jnp.asarray(self.numpy()[m]))
+
+    def gather(self, dim: int, index) -> "Tensor":
+        """Torch gather: output shape == index shape (1-based indices)."""
+        idx = jnp.asarray(_raw(index), dtype=jnp.int32) - 1
+        d = dim - 1
+        src = self._a
+        # shrink non-gather dims to the index extent (Torch semantics)
+        for ax in range(src.ndim):
+            if ax != d and idx.shape[ax] < src.shape[ax]:
+                src = jax.lax.slice_in_dim(src, 0, idx.shape[ax], axis=ax)
+        return Tensor(data=jnp.take_along_axis(src, idx, axis=d))
+
+    def scatter(self, dim: int, index, src) -> "Tensor":
+        idx = jnp.asarray(_raw(index), dtype=jnp.int32) - 1
+        self._a = jnp.put_along_axis(self._a, idx, _raw(src), axis=dim - 1,
+                                     inplace=False)
+        return self
+
+    # -- element access (1-based) ----------------------------------------
+    def value_at(self, *indices) -> float:
+        idx = tuple(i - 1 for i in indices)
+        return float(self._a[idx])
+
+    def set_value(self, *args) -> "Tensor":
+        *indices, value = args
+        idx = tuple(i - 1 for i in indices)
+        self._a = self._a.at[idx].set(value)
+        return self
+
+    def __getitem__(self, key):
+        # python-style 0-based escape hatch on the raw array
+        return Tensor(data=self._a[key])
+
+    # -- fill / init -----------------------------------------------------
+    def fill(self, value: Number) -> "Tensor":
+        self._a = jnp.full_like(self._a, value)
+        return self
+
+    def zero(self) -> "Tensor":
+        return self.fill(0)
+
+    def rand(self, a=0.0, b=1.0) -> "Tensor":
+        self._a = jnp.asarray(RNG().uniform(a, b, self._a.shape), self._a.dtype)
+        return self
+
+    def randn(self, mean=0.0, stdv=1.0) -> "Tensor":
+        self._a = jnp.asarray(RNG().normal(mean, stdv, self._a.shape), self._a.dtype)
+        return self
+
+    def bernoulli(self, p: float) -> "Tensor":
+        self._a = jnp.asarray(RNG().bernoulli(p, self._a.shape), self._a.dtype)
+        return self
+
+    def copy(self, other: "Tensor") -> "Tensor":
+        self._a = jnp.asarray(_raw(other), self._a.dtype).reshape(self._a.shape)
+        return self
+
+    def apply1(self, fn) -> "Tensor":
+        """Elementwise host map (reference DenseTensorApply); test helper."""
+        self._a = jnp.asarray(np.vectorize(fn)(self.numpy()), self._a.dtype)
+        return self
+
+    # -- arithmetic (TensorMath.scala surface) ---------------------------
+    def _binop(self, other, op, inplace=False):
+        res = op(self._a, _raw(other))
+        if inplace:
+            self._a = res
+            return self
+        return Tensor(data=res)
+
+    def __add__(self, o):
+        return self._binop(o, operator.add)
+
+    __radd__ = __add__
+
+    def __sub__(self, o):
+        return self._binop(o, operator.sub)
+
+    def __rsub__(self, o):
+        return Tensor(data=_raw(o) - self._a)
+
+    def __mul__(self, o):
+        return self._binop(o, operator.mul)
+
+    __rmul__ = __mul__
+
+    def __truediv__(self, o):
+        return self._binop(o, operator.truediv)
+
+    def __neg__(self):
+        return Tensor(data=-self._a)
+
+    def add(self, *args) -> "Tensor":
+        """``add(value)``, ``add(other)``, or ``add(alpha, other)`` — in place."""
+        if len(args) == 1:
+            return self._binop(args[0], operator.add, inplace=True)
+        alpha, other = args
+        self._a = self._a + alpha * _raw(other)
+        return self
+
+    def sub(self, *args) -> "Tensor":
+        if len(args) == 1:
+            return self._binop(args[0], operator.sub, inplace=True)
+        alpha, other = args
+        self._a = self._a - alpha * _raw(other)
+        return self
+
+    def mul(self, other) -> "Tensor":
+        return self._binop(other, operator.mul, inplace=True)
+
+    def div(self, other) -> "Tensor":
+        return self._binop(other, operator.truediv, inplace=True)
+
+    def cmul(self, other) -> "Tensor":
+        return self.mul(other)
+
+    def cdiv(self, other) -> "Tensor":
+        return self.div(other)
+
+    def cmax(self, other) -> "Tensor":
+        self._a = jnp.maximum(self._a, _raw(other))
+        return self
+
+    def cmin(self, other) -> "Tensor":
+        self._a = jnp.minimum(self._a, _raw(other))
+        return self
+
+    def pow(self, n: Number) -> "Tensor":
+        self._a = jnp.power(self._a, n)
+        return self
+
+    def sqrt(self) -> "Tensor":
+        self._a = jnp.sqrt(self._a)
+        return self
+
+    def square(self) -> "Tensor":
+        self._a = jnp.square(self._a)
+        return self
+
+    def log(self) -> "Tensor":
+        self._a = jnp.log(self._a)
+        return self
+
+    def log1p(self) -> "Tensor":
+        self._a = jnp.log1p(self._a)
+        return self
+
+    def exp(self) -> "Tensor":
+        self._a = jnp.exp(self._a)
+        return self
+
+    def abs(self) -> "Tensor":
+        self._a = jnp.abs(self._a)
+        return self
+
+    def tanh(self) -> "Tensor":
+        self._a = jnp.tanh(self._a)
+        return self
+
+    def sigmoid(self) -> "Tensor":
+        self._a = jax.nn.sigmoid(self._a)
+        return self
+
+    def floor(self) -> "Tensor":
+        self._a = jnp.floor(self._a)
+        return self
+
+    def ceil(self) -> "Tensor":
+        self._a = jnp.ceil(self._a)
+        return self
+
+    def clamp(self, min_v, max_v) -> "Tensor":
+        self._a = jnp.clip(self._a, min_v, max_v)
+        return self
+
+    def sign(self) -> "Tensor":
+        self._a = jnp.sign(self._a)
+        return self
+
+    def negative(self) -> "Tensor":
+        self._a = -self._a
+        return self
+
+    def addcmul(self, value, t1, t2) -> "Tensor":
+        self._a = self._a + value * _raw(t1) * _raw(t2)
+        return self
+
+    def addcdiv(self, value, t1, t2) -> "Tensor":
+        self._a = self._a + value * _raw(t1) / _raw(t2)
+        return self
+
+    def axpy(self, alpha, x) -> "Tensor":
+        """BLAS axpy: self += alpha*x (reference TensorNumeric vsaxpy)."""
+        self._a = self._a + alpha * _raw(x)
+        return self
+
+    def scal(self, alpha) -> "Tensor":
+        self._a = self._a * alpha
+        return self
+
+    # -- BLAS-level (DenseTensorMath / DenseTensorBLAS → MXU) ------------
+    def dot(self, other) -> float:
+        return float(jnp.vdot(self._a, _raw(other)))
+
+    def addmm(self, *args) -> "Tensor":
+        """``addmm(beta, M, alpha, mat1, mat2)`` / ``addmm(mat1, mat2)``.
+
+        Reference DenseTensorMath.addmm:443 → MKL gemm; here one
+        ``jnp.matmul`` lowered onto the MXU.
+        """
+        if len(args) == 2:
+            beta, m, alpha, m1, m2 = 1.0, self, 1.0, *args
+        elif len(args) == 5:
+            beta, m, alpha, m1, m2 = args
+        else:
+            raise ValueError("addmm expects 2 or 5 args")
+        self._a = beta * _raw(m) + alpha * jnp.matmul(_raw(m1), _raw(m2))
+        return self
+
+    def mm(self, m1, m2) -> "Tensor":
+        self._a = jnp.matmul(_raw(m1), _raw(m2))
+        return self
+
+    def addmv(self, beta, alpha, mat, vec) -> "Tensor":
+        self._a = beta * self._a + alpha * jnp.matmul(_raw(mat), _raw(vec))
+        return self
+
+    def mv(self, mat, vec) -> "Tensor":
+        self._a = jnp.matmul(_raw(mat), _raw(vec))
+        return self
+
+    def addr(self, *args) -> "Tensor":
+        """outer-product update: ``addr(alpha, vec1, vec2)``."""
+        if len(args) == 2:
+            alpha, v1, v2 = 1.0, *args
+        else:
+            alpha, v1, v2 = args
+        self._a = self._a + alpha * jnp.outer(_raw(v1), _raw(v2))
+        return self
+
+    def baddbmm(self, beta, alpha, b1, b2) -> "Tensor":
+        self._a = beta * self._a + alpha * jnp.matmul(_raw(b1), _raw(b2))
+        return self
+
+    def bmm(self, b1, b2) -> "Tensor":
+        self._a = jnp.matmul(_raw(b1), _raw(b2))
+        return self
+
+    # -- reductions ------------------------------------------------------
+    def sum(self, dim: Optional[int] = None):
+        if dim is None:
+            return float(jnp.sum(self._a))
+        return Tensor(data=jnp.sum(self._a, axis=dim - 1, keepdims=True))
+
+    def mean(self, dim: Optional[int] = None):
+        if dim is None:
+            return float(jnp.mean(self._a))
+        return Tensor(data=jnp.mean(self._a, axis=dim - 1, keepdims=True))
+
+    def std(self) -> float:
+        return float(jnp.std(self._a, ddof=1))
+
+    def max(self, dim: Optional[int] = None):
+        """No-arg: scalar max.  With dim: (values, 1-based indices)."""
+        if dim is None:
+            return float(jnp.max(self._a))
+        d = dim - 1
+        vals = jnp.max(self._a, axis=d, keepdims=True)
+        idx = jnp.argmax(self._a, axis=d, keepdims=True) + 1
+        return Tensor(data=vals), Tensor(data=idx.astype(jnp.float32))
+
+    def min(self, dim: Optional[int] = None):
+        if dim is None:
+            return float(jnp.min(self._a))
+        d = dim - 1
+        vals = jnp.min(self._a, axis=d, keepdims=True)
+        idx = jnp.argmin(self._a, axis=d, keepdims=True) + 1
+        return Tensor(data=vals), Tensor(data=idx.astype(jnp.float32))
+
+    def topk(self, k: int, dim: Optional[int] = None, increase: bool = True):
+        """(values, 1-based indices).  ``increase=True`` (default) returns
+        the k SMALLEST elements ascending — Torch topk semantics the
+        reference follows (TensorMath.topk)."""
+        d = (dim - 1) if dim is not None else self._a.ndim - 1
+        a = jnp.moveaxis(self._a, d, -1)
+        if increase:
+            vals, idx = jax.lax.top_k(-a, k)
+            vals = -vals
+
+        else:
+            vals, idx = jax.lax.top_k(a, k)
+        vals = jnp.moveaxis(vals, -1, d)
+        idx = jnp.moveaxis(idx, -1, d) + 1
+        return Tensor(data=vals), Tensor(data=idx.astype(jnp.float32))
+
+    def norm(self, p: Number = 2) -> float:
+        if p == 1:
+            return float(jnp.sum(jnp.abs(self._a)))
+        return float(jnp.sum(jnp.abs(self._a) ** p) ** (1.0 / p))
+
+    def dist(self, other, p: Number = 2) -> float:
+        return (self - other).norm(p)
+
+    def prod(self) -> float:
+        return float(jnp.prod(self._a))
+
+    def argmax_1based(self, dim: int) -> "Tensor":
+        return Tensor(data=(jnp.argmax(self._a, axis=dim - 1) + 1).astype(jnp.float32))
+
+    # -- comparisons -----------------------------------------------------
+    def eq_tensor(self, other) -> "Tensor":
+        return Tensor(data=(self._a == _raw(other)).astype(self._a.dtype))
+
+    def gt(self, other) -> "Tensor":
+        return Tensor(data=(self._a > _raw(other)).astype(self._a.dtype))
+
+    def lt(self, other) -> "Tensor":
+        return Tensor(data=(self._a < _raw(other)).astype(self._a.dtype))
+
+    def ge(self, other) -> "Tensor":
+        return Tensor(data=(self._a >= _raw(other)).astype(self._a.dtype))
+
+    def le(self, other) -> "Tensor":
+        return Tensor(data=(self._a <= _raw(other)).astype(self._a.dtype))
+
+    def almost_equal(self, other, tolerance: float = 1e-5) -> bool:
+        return bool(jnp.allclose(self._a, _raw(other), atol=tolerance,
+                                 rtol=tolerance))
+
+    def __eq__(self, other):
+        if isinstance(other, Tensor):
+            return (self.shape == other.shape
+                    and bool(jnp.array_equal(self._a, other._a)))
+        return NotImplemented
+
+    def __hash__(self):
+        return id(self)
+
+    def __repr__(self):
+        return f"Tensor(shape={self.shape}, dtype={self._a.dtype})\n{np.asarray(self._a)}"
+
+    # -- dtype -----------------------------------------------------------
+    def to_bf16(self) -> "Tensor":
+        """bf16 cast — the TPU-native replacement for the reference's fp16
+        wire codec (parameters/FP16CompressedTensor.scala:26)."""
+        return Tensor(data=self._a.astype(jnp.bfloat16))
+
+    def to_f32(self) -> "Tensor":
+        return Tensor(data=self._a.astype(jnp.float32))
+
+    def astype(self, dtype) -> "Tensor":
+        return Tensor(data=self._a.astype(dtype))
+
+
+# ---------------------------------------------------------------------------
+# Factory surface (object Tensor, Tensor.scala:685-986)
+# ---------------------------------------------------------------------------
+def tensor(data, dtype=jnp.float32) -> Tensor:
+    return Tensor(data=jnp.asarray(_raw(data), dtype=dtype))
+
+
+def zeros(*sizes, dtype=jnp.float32) -> Tensor:
+    return Tensor(*sizes, dtype=dtype)
+
+
+def ones(*sizes, dtype=jnp.float32) -> Tensor:
+    return Tensor(*sizes, dtype=dtype).fill(1)
+
+
+def rand(*sizes, dtype=jnp.float32) -> Tensor:
+    return Tensor(*sizes, dtype=dtype).rand()
+
+
+def randn(*sizes, dtype=jnp.float32) -> Tensor:
+    return Tensor(*sizes, dtype=dtype).randn()
+
+
+def arange(start: Number, end: Number, step: Number = 1) -> Tensor:
+    """Inclusive range like Torch's ``torch.range`` (Tensor.scala range)."""
+    n = int(np.floor((end - start) / step)) + 1
+    return Tensor(data=start + jnp.arange(n, dtype=jnp.float32) * step)
+
+
+def range_(start, end, step=1):
+    return arange(start, end, step)
+
+
+# pytree registration: leaves through jit boundaries if users pass Tensor
+jax.tree_util.register_pytree_node(
+    Tensor, lambda t: ((t._a,), None),
+    lambda _, ch: Tensor(data=ch[0]))

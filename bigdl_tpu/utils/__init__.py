@@ -1,0 +1,3 @@
+from .engine import Engine, init_engine
+from .rng import RNG, RandomGenerator, set_global_seed
+from .table import T, Table

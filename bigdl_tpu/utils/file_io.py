@@ -1,0 +1,51 @@
+"""Save/load of modules, pytrees and optim methods (reference
+utils/File.scala:67-160 — Java serialization to local/HDFS/S3).
+
+Host-side pickle with jax arrays converted to numpy on the way out and
+back to jax on the way in.  The path seam accepts a scheme prefix the
+way the reference does (``hdfs://``/``s3://`` would plug in here);
+local files are what this environment supports.
+"""
+from __future__ import annotations
+
+import os
+import pickle
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _to_host(obj):
+    return jax.tree_util.tree_map(
+        lambda x: np.asarray(x) if isinstance(x, jax.Array) else x, obj)
+
+
+def _to_device(obj):
+    return jax.tree_util.tree_map(
+        lambda x: jnp.asarray(x) if isinstance(x, np.ndarray) else x, obj)
+
+
+def save(obj: Any, path: str, overwrite: bool = False):
+    if os.path.exists(path) and not overwrite:
+        raise FileExistsError(f"{path} exists and overwrite=False "
+                              "(reference File.save isOverwrite contract)")
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    # raw pytrees (save_weights, optimizer slots) go to portable numpy;
+    # module/optim objects additionally convert via their __getstate__
+    with open(path, "wb") as f:
+        pickle.dump(_to_host(obj), f)
+
+
+def load(path: str) -> Any:
+    with open(path, "rb") as f:
+        return _to_device(pickle.load(f))
+
+
+def load_module(path: str):
+    """Module.load parity: modules pickle whole (their pytrees go through
+    __reduce__ as numpy via __getstate__ below if defined)."""
+    return load(path)

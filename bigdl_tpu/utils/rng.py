@@ -1,0 +1,82 @@
+"""Seeded random generator for host-side init / shuffling.
+
+Rebuild of the reference's Mersenne-Twister ``RandomGenerator``
+(utils/RandomGenerator.scala:56).  We use numpy's MT19937 — the same
+algorithm family — for parameter initialisation and data shuffling on
+the host.  Device-side randomness (dropout masks, RReLU noise) uses
+``jax.random`` keys derived from this seed so that everything under
+``jit`` stays functional and reproducible.
+"""
+from __future__ import annotations
+
+import threading
+
+import numpy as np
+
+
+class RandomGenerator:
+    """Per-instance seeded generator (uniform/normal/bernoulli/shuffle)."""
+
+    def __init__(self, seed: int = 1):
+        self._seed = seed
+        self._rng = np.random.Generator(np.random.MT19937(seed))
+
+    def set_seed(self, seed: int):
+        self._seed = seed
+        self._rng = np.random.Generator(np.random.MT19937(seed))
+        return self
+
+    # camelCase alias for API parity with the reference
+    setSeed = set_seed
+
+    def get_seed(self) -> int:
+        return self._seed
+
+    def clone(self) -> "RandomGenerator":
+        c = RandomGenerator(self._seed)
+        c._rng.bit_generator.state = self._rng.bit_generator.state
+        return c
+
+    def uniform(self, a=0.0, b=1.0, size=None):
+        return self._rng.uniform(a, b, size=size)
+
+    def normal(self, mean=0.0, stdv=1.0, size=None):
+        return self._rng.normal(mean, stdv, size=size)
+
+    def bernoulli(self, p=0.5, size=None):
+        return (self._rng.random(size=size) < p).astype(np.float32)
+
+    def exponential(self, lam=1.0, size=None):
+        return self._rng.exponential(1.0 / lam, size=size)
+
+    def random_int(self, low, high, size=None):
+        return self._rng.integers(low, high, size=size)
+
+    def permutation(self, n: int):
+        return self._rng.permutation(n)
+
+    def shuffle(self, arr):
+        """In-place Fisher-Yates shuffle (reference RandomGenerator.scala:35)."""
+        self._rng.shuffle(arr)
+        return arr
+
+
+_local = threading.local()
+
+
+def RNG() -> RandomGenerator:
+    """Thread-local default generator (reference ``RandomGenerator.RNG``)."""
+    if not hasattr(_local, "rng"):
+        _local.rng = RandomGenerator(1)
+    return _local.rng
+
+
+def set_global_seed(seed: int):
+    RNG().set_seed(seed)
+
+
+def next_jax_key():
+    """Derive a fresh jax PRNG key from the host generator."""
+    import jax
+
+    return jax.random.PRNGKey(int(RNG().random_int(0, 2**31 - 1)))

@@ -1,0 +1,27 @@
+"""CRC32C (Castagnoli) + TFRecord masking (reference java/netty/Crc32c.java).
+
+Pure-python table implementation; fast enough for event-log volume
+(SURVEY §2.1 notes native only "if log volume demands").
+"""
+from __future__ import annotations
+
+_POLY = 0x82F63B78
+_TABLE = []
+for _i in range(256):
+    _c = _i
+    for _ in range(8):
+        _c = (_c >> 1) ^ _POLY if _c & 1 else _c >> 1
+    _TABLE.append(_c)
+
+
+def crc32c(data: bytes, crc: int = 0) -> int:
+    crc ^= 0xFFFFFFFF
+    for b in data:
+        crc = _TABLE[(crc ^ b) & 0xFF] ^ (crc >> 8)
+    return crc ^ 0xFFFFFFFF
+
+
+def masked_crc32c(data: bytes) -> int:
+    """TFRecord mask (same constant the reference RecordWriter uses)."""
+    crc = crc32c(data)
+    return ((crc >> 15) | (crc << 17)) + 0xA282EAD8 & 0xFFFFFFFF

@@ -1,0 +1,34 @@
+"""Test harness: force an 8-device virtual CPU platform.
+
+This is the analogue of the reference's Spark ``local[4]`` simulated
+topology (SURVEY §4.3): distributed code paths (mesh, psum_scatter,
+all_gather) run on 8 virtual CPU devices without TPU hardware.
+Must run before jax is imported anywhere.
+"""
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+# The image preloads jax at interpreter start (sitecustomize) with
+# JAX_PLATFORMS=axon already parsed into jax.config, so the env vars
+# above are too late on their own — override the live config before any
+# backend is initialized.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _seed_rng():
+    """Deterministic host RNG per test (reference tests fix seeds per spec)."""
+    from bigdl_tpu.utils.rng import RNG
+
+    RNG().set_seed(1)
+    np.random.seed(1)
+    yield

@@ -1,0 +1,146 @@
+"""Container + Graph specs (reference SequentialSpec, ConcatSpec,
+GraphSpec — nn/Graph.scala:58)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from bigdl_tpu import nn
+from bigdl_tpu.utils.table import T
+
+X = np.random.RandomState(5).randn(3, 4).astype(np.float32)
+
+
+def test_sequential_forward_backward():
+    m = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+    y = m.forward(jnp.asarray(X))
+    assert y.shape == (3, 2)
+    gi = m.backward(jnp.asarray(X), jnp.ones((3, 2)))
+    assert gi.shape == (3, 4)
+    _, grads = m.parameters()
+    assert any(bool((g != 0).any()) for g in grads)
+
+
+def test_concat_dim():
+    m = nn.Concat(2, nn.Linear(4, 3), nn.Linear(4, 5))
+    y = m.forward(jnp.asarray(X))
+    assert y.shape == (3, 8)
+
+
+def test_concattable_paralleltable():
+    ct = nn.ConcatTable(nn.Linear(4, 2), nn.Identity())
+    out = ct.forward(jnp.asarray(X))
+    assert out[1].shape == (3, 2) and out[2].shape == (3, 4)
+
+    pt = nn.ParallelTable(nn.Linear(4, 2), nn.Linear(4, 3))
+    out2 = pt.forward(T(jnp.asarray(X), jnp.asarray(X)))
+    assert out2[1].shape == (3, 2) and out2[2].shape == (3, 3)
+
+
+def test_maptable_shares_weights():
+    mt = nn.MapTable(nn.Linear(4, 2))
+    out = mt.forward(T(jnp.asarray(X), jnp.asarray(X * 2)))
+    np.testing.assert_allclose(np.asarray(out[2]) - np.asarray(out[1]),
+                               np.asarray(out[1])
+                               - np.asarray(mt[0].params["bias"]), atol=1e-5)
+
+
+def test_bottle():
+    m = nn.Bottle(nn.Linear(4, 3))
+    x3 = np.random.RandomState(6).randn(2, 5, 4).astype(np.float32)
+    y = m.forward(jnp.asarray(x3))
+    assert y.shape == (2, 5, 3)
+
+
+def test_table_ops():
+    a, b = jnp.asarray(X), jnp.asarray(X * 2)
+    assert np.allclose(nn.CAddTable().forward(T(a, b)), X * 3)
+    assert np.allclose(nn.CSubTable().forward(T(a, b)), -X)
+    assert np.allclose(nn.CMulTable().forward(T(a, b)), X * X * 2)
+    assert np.allclose(nn.CMaxTable().forward(T(a, b)), np.maximum(X, X * 2))
+
+
+def test_graph_diamond():
+    inp = nn.Input()
+    l1 = nn.Linear(4, 4)(inp)
+    b1 = nn.ReLU()(l1)
+    b2 = nn.Tanh()(l1)
+    add = nn.CAddTable()([b1, b2])
+    out = nn.Linear(4, 2)(add)
+    g = nn.Graph(inp, out)
+    y = g.forward(jnp.asarray(X))
+    assert y.shape == (3, 2)
+    gi = g.backward(jnp.asarray(X), jnp.ones((3, 2)))
+    assert gi.shape == (3, 4)
+
+
+def test_graph_multi_input_output():
+    in1, in2 = nn.Input(), nn.Input()
+    j = nn.JoinTable(2)([in1, in2])
+    h = nn.Linear(8, 4)(j)
+    o1 = nn.ReLU()(h)
+    o2 = nn.Tanh()(h)
+    g = nn.Graph([in1, in2], [o1, o2])
+    out = g.forward(T(jnp.asarray(X), jnp.asarray(X)))
+    assert out[1].shape == (3, 4) and out[2].shape == (3, 4)
+
+
+def test_graph_equals_sequential():
+    lin1, lin2 = nn.Linear(4, 8), nn.Linear(8, 2)
+    seq = nn.Sequential(lin1, nn.ReLU(), lin2)
+    inp = nn.Input()
+    g = nn.Graph(inp, lin2(nn.ReLU()(lin1(inp))))
+    np.testing.assert_allclose(np.asarray(seq.forward(jnp.asarray(X))),
+                               np.asarray(g.forward(jnp.asarray(X))), atol=1e-6)
+
+
+def test_shape_ops():
+    x = jnp.asarray(np.arange(24).reshape(2, 3, 4).astype(np.float32))
+    assert nn.Reshape([4, 3]).forward(x).shape == (2, 4, 3)
+    assert nn.View(12).forward(x).shape == (2, 12)
+    assert nn.Transpose([(2, 3)]).forward(x).shape == (2, 4, 3)
+    assert nn.Select(2, 2).forward(x).shape == (2, 4)
+    assert nn.Narrow(3, 2, 2).forward(x).shape == (2, 3, 2)
+    assert nn.Squeeze().forward(jnp.ones((2, 1, 3))).shape == (2, 3)
+    assert nn.Unsqueeze(2).forward(jnp.ones((2, 3))).shape == (2, 1, 3)
+    assert nn.Replicate(5, 1).forward(jnp.ones((3,))).shape == (5, 3)
+    assert nn.Reverse(1).forward(x)[0, 0, 0] == 12.0
+    st = nn.SplitTable(2).forward(x)
+    assert st.length() == 3 and st[1].shape == (2, 4)
+    assert nn.JoinTable(1).forward(st).shape == (6, 4)
+    assert nn.Pack(1).forward(st).shape == (3, 2, 4)
+    assert nn.SelectTable(2).forward(st).shape == (2, 4)
+    assert nn.FlattenTable().forward(T(x, T(x, x))).length() == 3
+    assert nn.Padding(2, 2, 2).forward(jnp.ones((2, 3))).shape == (2, 5)
+    assert nn.SpatialZeroPadding(1, 1, 2, 2).forward(
+        jnp.ones((1, 2, 4, 4))).shape == (1, 2, 8, 6)
+
+
+def test_infer_reshape():
+    x = jnp.ones((4, 6))
+    assert nn.InferReshape([-1, 3]).forward(x).shape == (8, 3)
+    assert nn.InferReshape([0, 2, 3]).forward(x).shape == (4, 2, 3)
+
+
+def test_gradient_reversal():
+    m = nn.GradientReversal(2.0)
+    x = jnp.asarray(X)
+    y = m.forward(x)
+    np.testing.assert_allclose(np.asarray(y), X)
+    gi = m.backward(x, jnp.ones_like(x))
+    np.testing.assert_allclose(np.asarray(gi), -2.0 * np.ones_like(X))
+
+
+def test_whole_tree_jits():
+    """The load-bearing property: an arbitrary container tree traces into
+    ONE jitted function."""
+    m = nn.Sequential(
+        nn.ConcatTable(nn.Linear(4, 4), nn.Sequential(nn.Linear(4, 4), nn.ReLU())),
+        nn.CAddTable(), nn.BatchNormalization(4), nn.Linear(4, 2))
+
+    @jax.jit
+    def step(params, buffers, x):
+        out, nb = m.apply_fn(params, buffers, x, True, jax.random.PRNGKey(0))
+        return out, nb
+
+    y, _ = step(m.param_tree(), m.buffer_tree(), jnp.asarray(X))
+    assert y.shape == (3, 2)

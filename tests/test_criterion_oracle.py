@@ -1,0 +1,173 @@
+"""Criterion specs vs PyTorch oracle (reference per-criterion Spec files)."""
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+
+from bigdl_tpu import nn
+from bigdl_tpu.utils.table import T
+
+X = np.random.RandomState(7).randn(5, 4).astype(np.float32)
+TGT = np.array([1.0, 2.0, 4.0, 3.0, 2.0])
+
+
+def _np(x):
+    return np.asarray(x)
+
+
+def check(crit, tcrit, inp, target, t_inp=None, t_target=None, atol=1e-5,
+          t_target_dtype=torch.float64):
+    loss = crit.forward(jnp.asarray(inp), target)
+    it = torch.tensor(inp if t_inp is None else t_inp, requires_grad=True,
+                      dtype=torch.float64)
+    tt = torch.tensor(target if t_target is None else t_target,
+                      dtype=t_target_dtype)
+    lt = tcrit(it, tt)
+    np.testing.assert_allclose(loss, lt.item(), atol=atol)
+    g = crit.backward(jnp.asarray(inp), target)
+    lt.backward()
+    np.testing.assert_allclose(_np(g), it.grad.numpy(), atol=atol)
+
+
+def test_classnll():
+    logp = np.log(np.abs(X) / np.abs(X).sum(1, keepdims=True))
+    check(nn.ClassNLLCriterion(), torch.nn.NLLLoss(),
+          logp, jnp.asarray(TGT), t_target=TGT - 1, t_target_dtype=torch.long)
+    # weighted
+    w = np.array([0.2, 0.5, 1.0, 2.0], np.float32)
+    check(nn.ClassNLLCriterion(weights=jnp.asarray(w)),
+          torch.nn.NLLLoss(weight=torch.tensor(w, dtype=torch.float64)),
+          logp, jnp.asarray(TGT), t_target=TGT - 1, t_target_dtype=torch.long)
+
+
+def test_crossentropy():
+    check(nn.CrossEntropyCriterion(), torch.nn.CrossEntropyLoss(),
+          X, jnp.asarray(TGT), t_target=TGT - 1, t_target_dtype=torch.long,
+          atol=1e-4)
+
+
+def test_mse_abs():
+    t = np.random.RandomState(8).randn(5, 4).astype(np.float32)
+    check(nn.MSECriterion(), torch.nn.MSELoss(), X, jnp.asarray(t), t_target=t)
+    check(nn.AbsCriterion(), torch.nn.L1Loss(), X, jnp.asarray(t), t_target=t)
+
+
+def test_bce():
+    p = 1.0 / (1.0 + np.exp(-X))
+    t = (np.random.RandomState(9).rand(5, 4) > 0.5).astype(np.float32)
+    check(nn.BCECriterion(), torch.nn.BCELoss(), p, jnp.asarray(t), t_target=t,
+          atol=1e-4)
+
+
+def test_smoothl1():
+    t = np.random.RandomState(10).randn(5, 4).astype(np.float32)
+    check(nn.SmoothL1Criterion(), torch.nn.SmoothL1Loss(), X, jnp.asarray(t),
+          t_target=t)
+
+
+def test_soft_margin():
+    y = np.sign(np.random.RandomState(11).randn(5, 4)).astype(np.float32)
+    check(nn.SoftMarginCriterion(), torch.nn.SoftMarginLoss(), X,
+          jnp.asarray(y), t_target=y)
+
+
+def test_multilabel_softmargin():
+    y = (np.random.RandomState(12).rand(5, 4) > 0.5).astype(np.float32)
+    check(nn.MultiLabelSoftMarginCriterion(),
+          torch.nn.MultiLabelSoftMarginLoss(), X, jnp.asarray(y), t_target=y)
+
+
+def test_multimargin():
+    check(nn.MultiMarginCriterion(), torch.nn.MultiMarginLoss(),
+          X, jnp.asarray(TGT), t_target=TGT - 1, t_target_dtype=torch.long)
+
+
+def test_hinge_embedding():
+    y = np.sign(np.random.RandomState(13).randn(5, 4)).astype(np.float32)
+    check(nn.HingeEmbeddingCriterion(0.7),
+          torch.nn.HingeEmbeddingLoss(margin=0.7),
+          np.abs(X), jnp.asarray(y), t_target=y)
+
+
+def test_kldiv():
+    logp = X - np.log(np.exp(X).sum(1, keepdims=True))
+    t = np.abs(np.random.RandomState(14).randn(5, 4)).astype(np.float32)
+    t = t / t.sum(1, keepdims=True)
+    check(nn.DistKLDivCriterion(), torch.nn.KLDivLoss(reduction="batchmean"),
+          logp, jnp.asarray(t), t_target=t)
+
+
+def test_margin_ranking():
+    x1 = np.random.RandomState(15).randn(6).astype(np.float32)
+    x2 = np.random.RandomState(16).randn(6).astype(np.float32)
+    y = np.sign(np.random.RandomState(17).randn(6)).astype(np.float32)
+    crit = nn.MarginRankingCriterion(0.5)
+    loss = crit.forward(T(jnp.asarray(x1), jnp.asarray(x2)), jnp.asarray(y))
+    tcrit = torch.nn.MarginRankingLoss(margin=0.5)
+    lt = tcrit(torch.tensor(x1), torch.tensor(x2), torch.tensor(y))
+    np.testing.assert_allclose(loss, lt.item(), atol=1e-5)
+
+
+def test_cosine_embedding():
+    x1 = np.random.RandomState(18).randn(5, 4).astype(np.float32)
+    x2 = np.random.RandomState(19).randn(5, 4).astype(np.float32)
+    y = np.sign(np.random.RandomState(20).randn(5)).astype(np.float32)
+    crit = nn.CosineEmbeddingCriterion(0.3)
+    loss = crit.forward(T(jnp.asarray(x1), jnp.asarray(x2)), jnp.asarray(y))
+    lt = torch.nn.CosineEmbeddingLoss(margin=0.3)(
+        torch.tensor(x1), torch.tensor(x2), torch.tensor(y))
+    np.testing.assert_allclose(loss, lt.item(), atol=1e-5)
+
+
+def test_parallel_and_multi():
+    t = np.random.RandomState(21).randn(5, 4).astype(np.float32)
+    pc = nn.ParallelCriterion()
+    pc.add(nn.MSECriterion(), 0.5).add(nn.AbsCriterion(), 2.0)
+    inp = T(jnp.asarray(X), jnp.asarray(X))
+    tgt = T(jnp.asarray(t), jnp.asarray(t))
+    expect = (0.5 * nn.MSECriterion().forward(jnp.asarray(X), jnp.asarray(t))
+              + 2.0 * nn.AbsCriterion().forward(jnp.asarray(X), jnp.asarray(t)))
+    np.testing.assert_allclose(pc.forward(inp, tgt), expect, rtol=1e-6)
+
+    mc = nn.MultiCriterion()
+    mc.add(nn.MSECriterion(), 1.0).add(nn.AbsCriterion(), 1.0)
+    expect2 = (nn.MSECriterion().forward(jnp.asarray(X), jnp.asarray(t))
+               + nn.AbsCriterion().forward(jnp.asarray(X), jnp.asarray(t)))
+    np.testing.assert_allclose(mc.forward(jnp.asarray(X), jnp.asarray(t)),
+                               expect2, rtol=1e-6)
+
+
+def test_timedistributed_criterion():
+    seq = np.random.RandomState(22).randn(3, 5, 4).astype(np.float32)
+    tgt = np.random.RandomState(23).randn(3, 5, 4).astype(np.float32)
+    crit = nn.TimeDistributedCriterion(nn.MSECriterion(), size_average=True)
+    loss = crit.forward(jnp.asarray(seq), jnp.asarray(tgt))
+    expect = np.mean([nn.MSECriterion().forward(jnp.asarray(seq[:, i]),
+                                                jnp.asarray(tgt[:, i]))
+                      for i in range(5)])
+    np.testing.assert_allclose(loss, expect, rtol=1e-5)
+
+
+def test_l1cost_dice():
+    assert abs(nn.L1Cost().forward(jnp.asarray(X), None)
+               - np.abs(X).sum()) < 1e-4
+    p = np.abs(X)
+    t = np.abs(np.random.RandomState(24).randn(5, 4)).astype(np.float32)
+    loss = nn.DiceCoefficientCriterion().forward(jnp.asarray(p), jnp.asarray(t))
+    assert 0.0 <= loss <= 2.0
+
+
+def test_class_simplex_embedding_geometry():
+    """regsplex rows are unit vectors with pairwise dot -1/n
+    (reference ClassSimplexCriterion.scala:43-62)."""
+    from bigdl_tpu.nn.criterion import ClassSimplexCriterion
+
+    k = 5
+    simp = np.asarray(ClassSimplexCriterion(k).simplex)
+    assert simp.shape == (k, k)
+    n = k - 1
+    for i in range(k):
+        np.testing.assert_allclose(np.linalg.norm(simp[i]), 1.0, atol=1e-5)
+        for j in range(i + 1, k):
+            np.testing.assert_allclose(simp[i] @ simp[j], -1.0 / n, atol=1e-5)

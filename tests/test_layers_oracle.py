@@ -1,0 +1,225 @@
+"""Per-layer specs checked against a PyTorch-CPU oracle — the rebuild of
+the reference's Torch7 oracle harness (SURVEY §4.2, test/.../torch/TH.scala).
+
+Weights are copied INTO the torch layer so forward AND backward must
+agree numerically.
+"""
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+
+from bigdl_tpu import nn
+from bigdl_tpu.utils.table import T
+
+
+def _np(x):
+    return np.asarray(x)
+
+
+def check_fwd_bwd(mod, tmod, x, atol=1e-4, param_map=None):
+    """Run forward+backward through both frameworks and compare."""
+    xt = torch.tensor(np.asarray(x), requires_grad=True, dtype=torch.float64)
+    tmod = tmod.double()
+    if param_map:
+        with torch.no_grad():
+            for ours, theirs in param_map.items():
+                getattr(tmod, theirs).copy_(
+                    torch.tensor(np.asarray(mod.params[ours]), dtype=torch.float64))
+    yt = tmod(xt)
+    y = mod.forward(jnp.asarray(x))
+    np.testing.assert_allclose(_np(y), yt.detach().numpy(), atol=atol)
+    go = np.random.RandomState(0).rand(*yt.shape).astype(np.float32)
+    yt.backward(torch.tensor(go, dtype=torch.float64))
+    gi = mod.backward(jnp.asarray(x), jnp.asarray(go))
+    np.testing.assert_allclose(_np(gi), xt.grad.numpy(), atol=atol)
+    return y
+
+
+X2 = np.random.RandomState(42).randn(4, 6).astype(np.float32)
+X4 = np.random.RandomState(43).randn(2, 3, 8, 8).astype(np.float32)
+
+
+def test_linear():
+    m = nn.Linear(6, 4)
+    check_fwd_bwd(m, torch.nn.Linear(6, 4), X2,
+                  param_map={"weight": "weight", "bias": "bias"})
+
+
+def test_relu():
+    check_fwd_bwd(nn.ReLU(), torch.nn.ReLU(), X2)
+
+
+def test_tanh_sigmoid():
+    check_fwd_bwd(nn.Tanh(), torch.nn.Tanh(), X2)
+    check_fwd_bwd(nn.Sigmoid(), torch.nn.Sigmoid(), X2)
+
+
+def test_elu_leaky_softplus_softsign():
+    check_fwd_bwd(nn.ELU(0.7), torch.nn.ELU(0.7), X2)
+    check_fwd_bwd(nn.LeakyReLU(0.02), torch.nn.LeakyReLU(0.02), X2)
+    check_fwd_bwd(nn.SoftPlus(), torch.nn.Softplus(), X2)
+    check_fwd_bwd(nn.SoftSign(), torch.nn.Softsign(), X2)
+
+
+def test_hardtanh_shrinks():
+    check_fwd_bwd(nn.HardTanh(-0.5, 0.5), torch.nn.Hardtanh(-0.5, 0.5), X2)
+    check_fwd_bwd(nn.HardShrink(0.3), torch.nn.Hardshrink(0.3), X2)
+    check_fwd_bwd(nn.SoftShrink(0.3), torch.nn.Softshrink(0.3), X2)
+
+
+def test_logsoftmax_softmax():
+    check_fwd_bwd(nn.LogSoftMax(), torch.nn.LogSoftmax(dim=-1), X2)
+    check_fwd_bwd(nn.SoftMax(), torch.nn.Softmax(dim=1), X2)
+
+
+def test_spatial_convolution():
+    m = nn.SpatialConvolution(3, 5, 3, 3, 2, 2, 1, 1)
+    t = torch.nn.Conv2d(3, 5, 3, stride=2, padding=1)
+    check_fwd_bwd(m, t, X4, param_map={"weight": "weight", "bias": "bias"})
+
+
+def test_spatial_convolution_groups():
+    m = nn.SpatialConvolution(4, 6, 3, 3, 1, 1, 0, 0, n_group=2)
+    t = torch.nn.Conv2d(4, 6, 3, groups=2)
+    x = np.random.RandomState(1).randn(2, 4, 7, 7).astype(np.float32)
+    check_fwd_bwd(m, t, x, param_map={"weight": "weight", "bias": "bias"})
+
+
+def test_dilated_convolution():
+    m = nn.SpatialDilatedConvolution(3, 4, 3, 3, 1, 1, 2, 2, 2, 2)
+    t = torch.nn.Conv2d(3, 4, 3, padding=2, dilation=2)
+    check_fwd_bwd(m, t, X4, param_map={"weight": "weight", "bias": "bias"})
+
+
+def test_full_convolution():
+    m = nn.SpatialFullConvolution(3, 4, 3, 3, 2, 2, 1, 1, adj_w=1, adj_h=1)
+    t = torch.nn.ConvTranspose2d(3, 4, 3, stride=2, padding=1, output_padding=1)
+    check_fwd_bwd(m, t, X4, param_map={"weight": "weight", "bias": "bias"})
+
+
+def test_volumetric_convolution():
+    m = nn.VolumetricConvolution(2, 3, 2, 3, 3, 1, 1, 1)
+    t = torch.nn.Conv3d(2, 3, (2, 3, 3))
+    x = np.random.RandomState(2).randn(2, 2, 4, 8, 8).astype(np.float32)
+    check_fwd_bwd(m, t, x, param_map={"weight": "weight", "bias": "bias"})
+
+
+def test_temporal_convolution():
+    m = nn.TemporalConvolution(5, 7, 3, 1)
+    x = np.random.RandomState(3).randn(2, 9, 5).astype(np.float32)
+    t = torch.nn.Conv1d(5, 7, 3)
+    xt = torch.tensor(x.transpose(0, 2, 1), requires_grad=True, dtype=torch.float64)
+    t = t.double()
+    with torch.no_grad():
+        t.weight.copy_(torch.tensor(np.asarray(m.params["weight"]), dtype=torch.float64))
+        t.bias.copy_(torch.tensor(np.asarray(m.params["bias"]), dtype=torch.float64))
+    yt = t(xt).transpose(1, 2)
+    y = m.forward(jnp.asarray(x))
+    np.testing.assert_allclose(_np(y), yt.detach().numpy(), atol=1e-4)
+
+
+def test_maxpool_ceil_floor():
+    m = nn.SpatialMaxPooling(3, 3, 2, 2)
+    t = torch.nn.MaxPool2d(3, 2)
+    check_fwd_bwd(m, t, X4)
+    m2 = nn.SpatialMaxPooling(3, 3, 2, 2).ceil()
+    t2 = torch.nn.MaxPool2d(3, 2, ceil_mode=True)
+    check_fwd_bwd(m2, t2, X4)
+
+
+def test_avgpool():
+    m = nn.SpatialAveragePooling(2, 2, 2, 2)
+    t = torch.nn.AvgPool2d(2, 2)
+    check_fwd_bwd(m, t, X4)
+
+
+def test_batchnorm_train_and_eval():
+    m = nn.BatchNormalization(6)
+    t = torch.nn.BatchNorm1d(6)
+    check_fwd_bwd(m, t, X2, param_map={"weight": "weight", "bias": "bias"})
+    # running stats must have been updated identically
+    np.testing.assert_allclose(_np(m.buffers["running_mean"]),
+                               t.running_mean.numpy(), atol=1e-5)
+    np.testing.assert_allclose(_np(m.buffers["running_var"]),
+                               t.running_var.numpy(), atol=1e-4)
+    # eval mode uses running stats
+    m.evaluate()
+    t.eval()
+    y = m.forward(jnp.asarray(X2))
+    yt = t(torch.tensor(X2, dtype=torch.float64))
+    np.testing.assert_allclose(_np(y), yt.detach().numpy(), atol=1e-4)
+
+
+def test_spatial_batchnorm():
+    m = nn.SpatialBatchNormalization(3)
+    t = torch.nn.BatchNorm2d(3)
+    check_fwd_bwd(m, t, X4, param_map={"weight": "weight", "bias": "bias"})
+
+
+def test_lrn():
+    m = nn.SpatialCrossMapLRN(5, 1.0, 0.75, 1.0)
+    t = torch.nn.LocalResponseNorm(5, 1.0, 0.75, 1.0)
+    check_fwd_bwd(m, t, X4)
+
+
+def test_lookup_table():
+    m = nn.LookupTable(10, 4)
+    idx = np.array([[1.0, 3.0, 5.0], [2.0, 9.0, 10.0]])
+    y = m.forward(jnp.asarray(idx))
+    emb = torch.nn.Embedding(10, 4)
+    with torch.no_grad():
+        emb.weight.copy_(torch.tensor(np.asarray(m.params["weight"])))
+    yt = emb(torch.tensor(idx).long() - 1)
+    np.testing.assert_allclose(_np(y), yt.detach().numpy(), atol=1e-5)
+
+
+def test_prelu():
+    m = nn.PReLU()
+    t = torch.nn.PReLU()
+    check_fwd_bwd(m, t, X2, param_map={"weight": "weight"})
+
+
+def test_dropout_mask_consistency():
+    m = nn.Dropout(0.5)
+    x = jnp.ones((8, 8))
+    y = m.forward(x)
+    zeros = float((np.asarray(y) == 0).mean())
+    assert 0.1 < zeros < 0.9
+    # backward must reuse the same mask
+    gi = m.backward(x, jnp.ones((8, 8)))
+    np.testing.assert_allclose((_np(y) == 0), (_np(gi) == 0))
+    m.evaluate()
+    np.testing.assert_allclose(_np(m.forward(x)), np.ones((8, 8)))
+
+
+def test_prelu_channel_axis():
+    """Channel axis follows reference PReLU.scala:86 — axis 1 for even
+    rank (NCHW), axis 0 for odd rank (CHW)."""
+    m = nn.PReLU(4)
+    neg = np.full((4, 8, 8), -1.0, np.float32)
+    out = _np(m.forward(jnp.asarray(neg)))
+    np.testing.assert_allclose(out, -0.25 * np.ones_like(neg))
+    neg4 = np.full((2, 4, 8, 8), -2.0, np.float32)
+    out4 = _np(m.forward(jnp.asarray(neg4)))
+    np.testing.assert_allclose(out4, -0.5 * np.ones_like(neg4))
+
+
+def test_gradient_scale():
+    """setScaleW/setScaleB semantics (reference AbstractModule.scala:70-101)."""
+    lin = nn.Linear(3, 2)
+    x = np.ones((4, 3), np.float32)
+    go = np.ones((4, 2), np.float32)
+    lin.zero_grad_parameters()
+    lin.forward(x)
+    lin.backward(x, go)
+    base_w = _np(lin.grads["weight"]).copy()
+    base_b = _np(lin.grads["bias"]).copy()
+
+    lin.set_scale_w(0.5).set_scale_b(2.0)
+    lin.zero_grad_parameters()
+    lin.forward(x)
+    lin.backward(x, go)
+    np.testing.assert_allclose(_np(lin.grads["weight"]), 0.5 * base_w, rtol=1e-6)
+    np.testing.assert_allclose(_np(lin.grads["bias"]), 2.0 * base_b, rtol=1e-6)

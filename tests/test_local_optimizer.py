@@ -1,0 +1,203 @@
+"""LocalOptimizer end-to-end specs — the analogue of the reference's
+LocalOptimizerSpec + RefLocalOptimizer fixtures (SURVEY §4.4): tiny nets
+on synthetic data must actually converge.
+"""
+import numpy as np
+import pytest
+
+from bigdl_tpu import nn, optim
+from bigdl_tpu.dataset import Sample, SampleToMiniBatch, array
+from bigdl_tpu.dataset.datasets import load_mnist
+from bigdl_tpu.dataset.image import GreyImgNormalizer, GreyImgToSample
+from bigdl_tpu.dataset.transformer import FnTransformer
+from bigdl_tpu.models.lenet import LeNet5
+from bigdl_tpu.optim import (
+    SGD, Adam, LocalOptimizer, Top1Accuracy, max_epoch, max_iteration,
+    several_iteration,
+)
+
+
+def xor_samples(n=256, seed=0):
+    rng = np.random.RandomState(seed)
+    x = rng.rand(n, 2).astype(np.float32)
+    y = ((x[:, 0] > 0.5) ^ (x[:, 1] > 0.5)).astype(np.float32) + 1  # 1-based
+    return [Sample(x[i], y[i]) for i in range(n)]
+
+
+def xor_model():
+    return nn.Sequential(nn.Linear(2, 32), nn.Tanh(), nn.Linear(32, 2),
+                         nn.LogSoftMax())
+
+
+def test_sgd_converges_on_xor():
+    ds = array(xor_samples())
+    model = xor_model()
+    opt = LocalOptimizer(model, ds, nn.ClassNLLCriterion(), batch_size=32)
+    opt.set_optim_method(SGD(learning_rate=1.0))
+    opt.set_end_when(max_epoch(150))
+    trained = opt.optimize()
+
+    results = trained.evaluate(array(xor_samples(seed=1)), [Top1Accuracy()])
+    acc = results[0][0].result()[0]
+    assert acc > 0.9, f"XOR accuracy {acc}"
+
+
+def test_adam_and_validation_and_checkpoint(tmp_path):
+    ds = array(xor_samples())
+    model = xor_model()
+    opt = LocalOptimizer(model, ds, nn.ClassNLLCriterion(), batch_size=32)
+    opt.set_optim_method(Adam(learning_rate=0.05))
+    opt.set_end_when(max_iteration(60))
+    opt.set_validation(several_iteration(20), array(xor_samples(seed=2)),
+                       [Top1Accuracy()], batch_size=64)
+    opt.set_checkpoint(str(tmp_path), several_iteration(25))
+    trained = opt.optimize()
+
+    # checkpoint files written (reference DistriOptimizer.scala:394-416 naming)
+    files = {p.name for p in tmp_path.iterdir()}
+    assert any(f.startswith("model.") for f in files)
+    assert any(f.startswith("optimMethod.") for f in files)
+
+    # checkpointed model loads and predicts
+    from bigdl_tpu.utils.file_io import load
+
+    model_file = sorted(f for f in files if f.startswith("model."))[-1]
+    restored = load(str(tmp_path / model_file))
+    res = restored.evaluate(array(xor_samples(seed=3)), [Top1Accuracy()])
+    assert res[0][0].result()[0] > 0.6
+
+
+def test_regularizer_shrinks_weights():
+    ds = array(xor_samples())
+    m1 = nn.Sequential(
+        nn.Linear(2, 8, w_regularizer=optim.L2Regularizer(5e-1)),
+        nn.Tanh(), nn.Linear(8, 2), nn.LogSoftMax())
+    opt = LocalOptimizer(m1, ds, nn.ClassNLLCriterion(), batch_size=32)
+    opt.set_optim_method(SGD(learning_rate=0.5))
+    opt.set_end_when(max_iteration(50))
+    opt.optimize()
+    w_reg = float(np.abs(np.asarray(m1[0].params["weight"])).mean())
+
+    m2 = xor_model()
+    opt2 = LocalOptimizer(m2, ds, nn.ClassNLLCriterion(), batch_size=32)
+    opt2.set_optim_method(SGD(learning_rate=0.5))
+    opt2.set_end_when(max_iteration(50))
+    opt2.optimize()
+    w_noreg = float(np.abs(np.asarray(m2[0].params["weight"])).mean())
+    assert w_reg < w_noreg
+
+
+def test_lenet_mnist_smoke():
+    """Milestone 1 slice: LeNet-5 on (synthetic) MNIST through the full
+    DataSet→Transformer→Optimizer stack (SURVEY §7.5)."""
+    from bigdl_tpu.dataset.datasets import TRAIN_MEAN, TRAIN_STD
+
+    imgs, labels = load_mnist(train=True, synthetic_size=512)
+    data = list(zip(imgs, labels))
+    ds = (array(data)
+          >> GreyImgNormalizer(TRAIN_MEAN, TRAIN_STD)
+          >> GreyImgToSample())
+    model = LeNet5(10)
+    opt = LocalOptimizer(model, ds, nn.ClassNLLCriterion(), batch_size=64)
+    opt.set_optim_method(SGD(learning_rate=0.1))
+    opt.set_end_when(max_epoch(3))
+    trained = opt.optimize()
+
+    test_imgs, test_labels = load_mnist(train=False, synthetic_size=512)
+    tds = (array(list(zip(test_imgs, test_labels)))
+           >> GreyImgNormalizer(TRAIN_MEAN, TRAIN_STD)
+           >> GreyImgToSample())
+    res = trained.evaluate(tds, [Top1Accuracy()])
+    acc = res[0][0].result()[0]
+    # synthetic blobs are easy — anything trained should beat chance hard
+    assert acc > 0.5, f"LeNet synthetic-MNIST accuracy {acc}"
+
+
+def test_lr_schedules():
+    sgd = SGD(learning_rate=1.0, learning_rate_schedule=optim.Step(10, 0.5))
+    sgd.state["neval"] = 1
+    assert sgd.get_current_lr() == 1.0
+    sgd.state["neval"] = 11
+    assert sgd.get_current_lr() == 0.5
+    sgd.state["neval"] = 25
+    assert sgd.get_current_lr() == 0.25
+
+    poly = SGD(learning_rate=1.0, learning_rate_schedule=optim.Poly(2.0, 100))
+    poly.state["neval"] = 51
+    assert abs(poly.get_current_lr() - 0.25) < 1e-6
+
+    ms = SGD(learning_rate=1.0,
+             learning_rate_schedule=optim.MultiStep([10, 20], 0.1))
+    ms.state["neval"] = 15
+    assert abs(ms.get_current_lr() - 0.1) < 1e-9
+    ms.state["neval"] = 25
+    assert abs(ms.get_current_lr() - 0.01) < 1e-9
+
+
+def test_optim_methods_reduce_quadratic():
+    """Every OptimMethod minimizes a quadratic via the Torch-parity
+    optimize(feval, x) API (reference per-method Spec files)."""
+    import jax.numpy as jnp
+
+    target = jnp.asarray(np.array([1.0, -2.0, 3.0], np.float32))
+
+    def feval(x):
+        d = x - target
+        return float(jnp.sum(d * d)), 2 * d
+
+    # Adadelta keeps the reference's default epsilon=1e-10 (Adadelta.scala:33),
+    # which crawls on small problems — test it with a workable epsilon.
+    for method in [SGD(learning_rate=0.1), Adam(learning_rate=0.3),
+                   optim.Adagrad(learning_rate=1.0),
+                   optim.Adadelta(epsilon=1e-2),
+                   optim.Adamax(learning_rate=0.5),
+                   optim.RMSprop(learning_rate=0.3)]:
+        x = jnp.zeros(3)
+        for _ in range(200):
+            x, _ = method.optimize(feval, x)
+        assert float(jnp.sum((x - target) ** 2)) < 1e-2, type(method).__name__
+
+
+def test_lbfgs_rosenbrock():
+    import jax
+    import jax.numpy as jnp
+
+    def rosen(x):
+        return jnp.sum(100.0 * (x[1:] - x[:-1] ** 2) ** 2 + (1 - x[:-1]) ** 2)
+
+    g = jax.grad(rosen)
+
+    def feval(x):
+        return float(rosen(x)), g(x)
+
+    lbfgs = optim.LBFGS(max_iter=100, learning_rate=0.5, line_search=True)
+    x = jnp.zeros(4)
+    for _ in range(20):
+        x, hist = lbfgs.optimize(feval, x)
+    assert float(rosen(x)) < 1e-2
+
+
+def test_optimizer_slots_survive_checkpoint(tmp_path):
+    """Adam moments checkpoint and resume (reference OptimMethod state
+    survives checkpoints, OptimMethod.scala:80-96)."""
+    from bigdl_tpu.optim.optim_method import OptimMethod
+
+    ds = array(xor_samples())
+    model = xor_model()
+    opt = LocalOptimizer(model, ds, nn.ClassNLLCriterion(), batch_size=32)
+    opt.set_optim_method(Adam(learning_rate=0.05))
+    opt.set_end_when(max_iteration(10))
+    opt.set_checkpoint(str(tmp_path), several_iteration(10))
+    opt.optimize()
+
+    om = OptimMethod.load(str(tmp_path / "optimMethod.10"))
+    assert om._slots is not None
+    leaves = __import__("jax").tree_util.tree_leaves(om._slots)
+    assert any(float(np.abs(np.asarray(l)).max()) > 0 for l in leaves)
+
+    # resuming with the restored method reuses the slots (structure match)
+    model2 = xor_model()
+    opt2 = LocalOptimizer(model2, ds, nn.ClassNLLCriterion(), batch_size=32)
+    opt2.set_optim_method(om)
+    opt2.set_end_when(max_iteration(12))
+    opt2.optimize()  # no crash; moments carried forward

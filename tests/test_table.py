@@ -1,0 +1,42 @@
+"""Table spec (reference test utils/TableSpec)."""
+import jax.numpy as jnp
+
+from bigdl_tpu.utils.table import T, Table
+
+
+def test_positional_and_named():
+    t = T(1, 2, 3, foo="bar")
+    assert t[1] == 1 and t[3] == 3 and t["foo"] == "bar"
+    assert t.length() == 3
+    assert len(t) == 4
+
+
+def test_insert_remove():
+    t = T(1, 2, 3)
+    t.insert(2, 99)
+    assert [t[i] for i in range(1, 5)] == [1, 99, 2, 3]
+    assert t.remove(2) == 99
+    assert [t[i] for i in range(1, 4)] == [1, 2, 3]
+
+
+def test_flatten_inverse():
+    nested = T(1, T(2, 3), T(T(4), 5))
+    flat = nested.flatten()
+    assert [flat[i] for i in range(1, 6)] == [1, 2, 3, 4, 5]
+    rebuilt = nested.inverse_flatten(flat)
+    assert rebuilt == nested
+
+
+def test_pytree():
+    import jax
+
+    t = T(jnp.ones(3), jnp.zeros(2))
+    doubled = jax.tree_util.tree_map(lambda x: x * 2, t)
+    assert float(doubled[1][0]) == 2.0
+    leaves = jax.tree_util.tree_leaves(t)
+    assert len(leaves) == 2
+
+
+def test_equality():
+    assert T(1, 2) == T(1, 2)
+    assert not (T(1, 2) == T(1, 3))

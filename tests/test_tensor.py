@@ -1,0 +1,98 @@
+"""Tensor facade spec (reference tensor/DenseTensorSpec.scala subset —
+Torch 1-based semantics over jax arrays)."""
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from bigdl_tpu.tensor import Tensor, arange, ones, randn, tensor, zeros
+
+
+def test_construction_and_shape():
+    t = Tensor(2, 3)
+    assert t.size() == (2, 3)
+    assert t.size(1) == 2 and t.size(2) == 3
+    assert t.dim() == 2
+    assert t.n_element() == 6
+
+
+def test_select_narrow_1based():
+    t = tensor(np.arange(12).reshape(3, 4))
+    row2 = t.select(1, 2)
+    assert row2.numpy().tolist() == [4, 5, 6, 7]
+    nar = t.narrow(2, 2, 2)
+    assert nar.shape == (3, 2)
+    assert nar.numpy()[0].tolist() == [1, 2]
+
+
+def test_transpose_view():
+    t = tensor(np.arange(6).reshape(2, 3))
+    tt = t.transpose(1, 2)
+    assert tt.shape == (3, 2)
+    v = t.view(3, 2)
+    assert v.shape == (3, 2)
+
+
+def test_math_inplace_semantics():
+    t = ones(2, 2)
+    t.add(1.0)
+    assert t.numpy().tolist() == [[2, 2], [2, 2]]
+    t.mul(tensor(np.full((2, 2), 3.0)))
+    assert float(t.sum()) == 24.0
+    t2 = ones(2, 2).axpy(2.0, ones(2, 2))
+    assert float(t2.max()) == 3.0
+
+
+def test_addmm_matches_numpy():
+    a = np.random.rand(3, 4).astype(np.float32)
+    b = np.random.rand(4, 5).astype(np.float32)
+    c = np.random.rand(3, 5).astype(np.float32)
+    t = tensor(c.copy()).addmm(0.5, tensor(c), 2.0, tensor(a), tensor(b))
+    np.testing.assert_allclose(t.numpy(), 0.5 * c + 2.0 * a @ b, rtol=1e-5)
+
+
+def test_max_with_dim_returns_1based_indices():
+    t = tensor(np.array([[1.0, 5.0, 2.0], [7.0, 0.0, 3.0]]))
+    vals, idx = t.max(2)
+    assert vals.numpy().flatten().tolist() == [5.0, 7.0]
+    assert idx.numpy().flatten().tolist() == [2.0, 1.0]
+
+
+def test_topk_ascending():
+    t = tensor(np.array([3.0, 1.0, 2.0, 5.0]))
+    vals, idx = t.topk(2)
+    assert vals.numpy().tolist() == [1.0, 2.0]
+    assert idx.numpy().tolist() == [2.0, 3.0]
+
+
+def test_arange_inclusive():
+    t = arange(1, 5)
+    assert t.numpy().tolist() == [1, 2, 3, 4, 5]
+
+
+def test_unfold():
+    t = tensor(np.arange(7).astype(np.float32))
+    u = t.unfold(1, 3, 2)
+    assert u.shape == (3, 3)
+    assert u.numpy()[1].tolist() == [2, 3, 4]
+
+
+def test_fill_zero_copy():
+    t = ones(2, 2)
+    t.zero()
+    assert float(t.sum()) == 0.0
+    t.copy(ones(2, 2))
+    assert float(t.sum()) == 4.0
+
+
+def test_gather_scatter():
+    t = tensor(np.arange(6).reshape(2, 3).astype(np.float32))
+    idx = tensor(np.array([[1.0, 3.0]]))
+    g = t.gather(2, idx)
+    assert g.numpy().tolist() == [[0.0, 2.0]]
+
+
+def test_bf16_roundtrip():
+    t = randn(4, 4)
+    b = t.to_bf16()
+    assert b.dtype == jnp.bfloat16
+    assert t.almost_equal(b.to_f32(), 0.05)
