@@ -1,0 +1,165 @@
+"""TransformerLM — the TPU rebuild's flagship long-context model.
+
+The reference's sequence models stop at LSTM/GRU (SURVEY §5.7); this is
+the forward-looking model family that exercises every parallel axis the
+framework makes first-class:
+
+* data parallelism   — batch dim over the ``data`` mesh axis
+* sequence/context   — ring (or Ulysses) attention over a ``seq`` axis
+* tensor parallelism — Megatron column/row split of the MLP over a
+  ``model`` axis (one psum per block)
+
+Built entirely from framework layers (LookupTable, LayerNorm,
+MultiHeadAttention, Column/RowParallelLinear), so the same model object
+runs eagerly on one chip or inside shard_map over a 3-D mesh.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+from jax import lax
+
+from .. import nn
+from ..nn.module import Container
+from ..parallel.tensor_parallel import ColumnParallelLinear, RowParallelLinear
+from ..utils.rng import next_jax_key
+
+
+def _thread_children(modules, params, buffers, x, training, rng, start=0):
+    """Run children sequentially, threading buffers and splitting rng per
+    child (same convention as the Sequential container)."""
+    new_buffers = dict(buffers)
+    for i, m in enumerate(modules, start=start):
+        sub = jax.random.fold_in(rng, i) if rng is not None else None
+        x, nb = m.apply_fn(params[str(i)], buffers[str(i)], x, training, sub)
+        new_buffers[str(i)] = nb
+    return x, new_buffers
+
+
+class TransformerBlock(Container):
+    """Pre-norm residual block: x + MHA(LN(x)); x + MLP(LN(x))."""
+
+    def __init__(self, embed_dim: int, num_heads: int, mlp_dim: int,
+                 causal: bool = True, seq_strategy: str = "dense",
+                 seq_axis: str = "seq", model_axis: Optional[str] = None):
+        super().__init__(
+            nn.LayerNorm(embed_dim),
+            nn.MultiHeadAttention(embed_dim, num_heads, causal=causal,
+                                  seq_strategy=seq_strategy,
+                                  seq_axis=seq_axis),
+            nn.LayerNorm(embed_dim),
+            ColumnParallelLinear(embed_dim, mlp_dim, axis_name=model_axis),
+            RowParallelLinear(mlp_dim, embed_dim, axis_name=model_axis),
+        )
+
+    def apply_fn(self, params, buffers, x, training, rng):
+        def sub(i):
+            return jax.random.fold_in(rng, i) if rng is not None else None
+
+        nb = dict(buffers)
+        h, nb["0"] = self.modules[0].apply_fn(
+            params["0"], buffers["0"], x, training, sub(0))
+        h, nb["1"] = self.modules[1].apply_fn(
+            params["1"], buffers["1"], h, training, sub(1))
+        x = x + h
+        h, nb["2"] = self.modules[2].apply_fn(
+            params["2"], buffers["2"], x, training, sub(2))
+        h, nb["3"] = self.modules[3].apply_fn(
+            params["3"], buffers["3"], h, training, sub(3))
+        h = jax.nn.gelu(h)
+        h, nb["4"] = self.modules[4].apply_fn(
+            params["4"], buffers["4"], h, training, sub(4))
+        return x + h, nb
+
+
+class TransformerLM(Container):
+    """Decoder-only causal LM over 1-based token ids [batch, seq].
+
+    Output is log-probs [batch, seq, vocab] — feed
+    ``TimeDistributedCriterion(ClassNLLCriterion())`` like SimpleRNN.
+    Under sequence parallelism the learned positional table is sliced at
+    each device's global offset (``lax.axis_index(seq_axis)``).
+    """
+
+    def __init__(self, vocab_size: int, embed_dim: int = 256,
+                 num_heads: int = 8, mlp_dim: Optional[int] = None,
+                 num_layers: int = 4, max_len: int = 2048,
+                 causal: bool = True, seq_strategy: str = "dense",
+                 seq_axis: str = "seq", model_axis: Optional[str] = None):
+        mlp_dim = mlp_dim or 4 * embed_dim
+        self.vocab_size = vocab_size
+        self.embed_dim = embed_dim
+        self.max_len = max_len
+        self.seq_axis = seq_axis
+        self.seq_strategy = seq_strategy
+        blocks = [TransformerBlock(embed_dim, num_heads, mlp_dim, causal,
+                                   seq_strategy, seq_axis, model_axis)
+                  for _ in range(num_layers)]
+        super().__init__(
+            nn.LookupTable(vocab_size, embed_dim),
+            *blocks,
+            nn.LayerNorm(embed_dim),
+            nn.Linear(embed_dim, vocab_size),
+        )
+        self._reset_pos()
+
+    def _reset_pos(self):
+        self._register_param(
+            "pos", 0.02 * jax.random.normal(
+                next_jax_key(), (self.max_len, self.embed_dim)))
+
+    def reset(self):
+        super().reset()
+        self._reset_pos()
+        return self
+
+    # own params ("pos") + children keyed by index, like Container
+    def param_tree(self):
+        tree = super().param_tree()
+        tree["pos"] = self.params["pos"]
+        return tree
+
+    def set_param_tree(self, tree):
+        tree = dict(tree)
+        self.params["pos"] = tree.pop("pos")
+        super().set_param_tree(tree)
+
+    def grad_tree(self):
+        tree = super().grad_tree()
+        tree["pos"] = self.grads["pos"]
+        return tree
+
+    def set_grad_tree(self, tree):
+        tree = dict(tree)
+        self.grads["pos"] = tree.pop("pos")
+        super().set_grad_tree(tree)
+
+    def gradient_scale_tree(self):
+        tree = super().gradient_scale_tree()
+        tree["pos"] = self.scale_w
+        return tree
+
+    def _positions(self, pos_table, T):
+        if self.seq_strategy in ("ring", "ulysses"):
+            n = lax.psum(1, self.seq_axis)  # concrete under shard_map
+            total = n * T if isinstance(n, int) else T
+            off = lax.axis_index(self.seq_axis) * T
+        else:
+            total, off = T, 0
+        if total > self.max_len:
+            # dynamic_slice would silently clamp → duplicated rows
+            raise ValueError(f"sequence length {total} exceeds "
+                             f"max_len {self.max_len}")
+        return lax.dynamic_slice_in_dim(pos_table, off, T)
+
+    def apply_fn(self, params, buffers, x, training, rng):
+        embed = self.modules[0]
+        h, eb = embed.apply_fn(params["0"], buffers["0"], x, training,
+                               jax.random.fold_in(rng, 0)
+                               if rng is not None else None)
+        h = h + self._positions(params["pos"], h.shape[1])
+        logits, nb = _thread_children(self.modules[1:], params, buffers, h,
+                                      training, rng, start=1)
+        nb["0"] = eb
+        return jax.nn.log_softmax(logits, axis=-1), nb

@@ -33,6 +33,7 @@ from .pooling import (
     RoiPooling, SpatialAveragePooling, SpatialMaxPooling, VolumetricMaxPooling,
 )
 from .normalization import (
+    LayerNorm,
     BatchNormalization, L1Penalty, Normalize, SpatialBatchNormalization,
     SpatialContrastiveNormalization, SpatialCrossMapLRN,
     SpatialDivisiveNormalization, SpatialSubtractiveNormalization,
@@ -59,3 +60,4 @@ from .criterion import (
     SmoothL1CriterionWithWeights, SoftMarginCriterion, SoftmaxWithCriterion,
     TimeDistributedCriterion,
 )
+from .attention import MultiHeadAttention

@@ -1,0 +1,91 @@
+"""Attention layers — the TPU rebuild's first-class long-context stack.
+
+The reference has no attention (it predates transformers; SURVEY §5.7),
+so these layers have no reference counterpart to cite — they exist
+because the TPU framework makes long-context and sequence parallelism
+first-class.  The compute lives in ``parallel/ring_attention.py``; these
+modules wrap it in the standard layer protocol.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..parallel.ring_attention import (attention, blockwise_attention,
+                                       ring_attention, ulysses_attention)
+from .initialization import IN_OUT, ONE_D, Xavier, Zeros
+from .module import TensorModule
+
+SEQ_STRATEGIES = ("dense", "block", "ring", "ulysses")
+
+
+class MultiHeadAttention(TensorModule):
+    """Multi-head self-attention over [batch, seq, embed].
+
+    ``seq_strategy`` picks how the sequence dimension is handled:
+      * ``"dense"``  — one [T, T] matmul (short sequences)
+      * ``"block"``  — single-device flash-style blockwise attention
+      * ``"ring"``   — ring context parallelism; REQUIRES running inside
+        shard_map with the sequence sharded over ``seq_axis``
+      * ``"ulysses"`` — all-to-all sequence parallelism (same requirement)
+    """
+
+    def __init__(self, embed_dim: int, num_heads: int,
+                 causal: bool = False, with_bias: bool = True,
+                 seq_strategy: str = "dense", seq_axis: str = "seq",
+                 block_size: int = 512):
+        super().__init__()
+        assert embed_dim % num_heads == 0, "embed_dim % num_heads != 0"
+        if seq_strategy not in SEQ_STRATEGIES:
+            raise ValueError(f"seq_strategy {seq_strategy!r} not in "
+                             f"{SEQ_STRATEGIES}")
+        self.embed_dim = embed_dim
+        self.num_heads = num_heads
+        self.head_dim = embed_dim // num_heads
+        self.causal = causal
+        self.with_bias = with_bias
+        self.seq_strategy = seq_strategy
+        self.seq_axis = seq_axis
+        self.block_size = block_size
+        self.reset()
+
+    def reset(self):
+        w_init = self._init_methods.get("weight", (Xavier(), None))[0]
+        b_init = self._init_methods.get("bias", (Zeros(), None))[0]
+        E = self.embed_dim
+        for name in ("wq", "wk", "wv", "wo"):
+            self._register_param(name, w_init.init((E, E), IN_OUT))
+        if self.with_bias:
+            for name in ("bq", "bk", "bv", "bo"):
+                self._register_param(name, b_init.init((E,), ONE_D))
+        return self
+
+    def _split(self, x):
+        B, T, _ = x.shape
+        return x.reshape(B, T, self.num_heads, self.head_dim).transpose(
+            0, 2, 1, 3)
+
+    def _attend(self, q, k, v):
+        if self.seq_strategy == "ring":
+            return ring_attention(q, k, v, axis_name=self.seq_axis,
+                                  causal=self.causal)
+        if self.seq_strategy == "ulysses":
+            return ulysses_attention(q, k, v, axis_name=self.seq_axis,
+                                     causal=self.causal,
+                                     block_size=self.block_size)
+        if self.seq_strategy == "block":
+            return blockwise_attention(q, k, v, block_size=self.block_size,
+                                       causal=self.causal)
+        return attention(q, k, v, causal=self.causal)
+
+    def _apply(self, params, buffers, x, training, rng):
+        def proj(x, w, b):
+            y = jnp.dot(x, w.T)
+            return y + params[b] if self.with_bias else y
+
+        q = self._split(proj(x, params["wq"], "bq"))
+        k = self._split(proj(x, params["wk"], "bk"))
+        v = self._split(proj(x, params["wv"], "bv"))
+        o = self._attend(q, k, v)
+        B, H, T, D = o.shape
+        o = o.transpose(0, 2, 1, 3).reshape(B, T, H * D)
+        return proj(o, params["wo"], "bo"), buffers

@@ -228,3 +228,37 @@ class SpatialContrastiveNormalization(TensorModule):
         y, _ = self.sub._apply({}, {}, x, training, rng)
         y, _ = self.div._apply({}, {}, y, training, rng)
         return y, buffers
+
+
+class LayerNorm(TensorModule):
+    """Layer normalization over the last dimension.
+
+    No reference counterpart (the reference predates transformers) —
+    required by the TPU rebuild's attention/transformer stack.  Unlike
+    BatchNormalization it keeps no running statistics, so it is fully
+    shard-oblivious: under sequence/tensor parallelism each device
+    normalises its local activations independently.
+    """
+
+    def __init__(self, n_output: int, eps: float = 1e-5, affine: bool = True):
+        super().__init__()
+        self.n_output = n_output
+        self.eps = eps
+        self.affine = affine
+        self.reset()
+
+    def reset(self):
+        if self.affine:
+            w_init = self._init_methods.get("weight", (Ones(), None))[0]
+            b_init = self._init_methods.get("bias", (Zeros(), None))[0]
+            self._register_param("weight", w_init.init((self.n_output,), ONE_D))
+            self._register_param("bias", b_init.init((self.n_output,), ONE_D))
+        return self
+
+    def _apply(self, params, buffers, x, training, rng):
+        mean = x.mean(axis=-1, keepdims=True)
+        var = ((x - mean) ** 2).mean(axis=-1, keepdims=True)
+        y = (x - mean) * lax.rsqrt(var + self.eps)
+        if self.affine:
+            y = y * params["weight"] + params["bias"]
+        return y, buffers

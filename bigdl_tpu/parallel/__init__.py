@@ -1,1 +1,4 @@
 from .all_reduce import AllReduceParameter, padded_size, shard_batch
+from .ring_attention import (attention, blockwise_attention,
+                             make_ring_attention_sharded, ring_attention,
+                             ulysses_attention)

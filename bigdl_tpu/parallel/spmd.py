@@ -1,0 +1,157 @@
+"""SPMD train-step builder over a multi-axis device mesh.
+
+Composes the framework's parallel axes into ONE compiled program
+(SURVEY §7.6 — the whole reference iteration, two Spark jobs + block
+manager traffic, becomes a single XLA executable):
+
+* ``data``  axis — batch sharding; gradients pmean'd across it (the
+  rebuild of AllReduceParameter's reduce-scatter/all-gather, here left
+  to XLA's collective scheduling)
+* ``seq``   axis — sequence/context parallelism; models whose attention
+  uses ``seq_strategy="ring"|"ulysses"`` compute across it with
+  ppermute/all_to_all (parallel/ring_attention.py)
+* ``model`` axis — Megatron tensor parallelism; Column/RowParallelLinear
+  weights are sharded by ``param_specs`` and the row psum closes each
+  block
+
+``make_train_step`` returns a jitted function
+``(params, slots, lr, x, y) -> (loss, params, slots)`` whose arrays stay
+device-resident and sharded between steps.
+"""
+from __future__ import annotations
+
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax, shard_map
+from jax.sharding import PartitionSpec as P
+
+
+def param_specs(module, model_axis: str = "model"):
+    """PartitionSpec pytree matching ``module.param_tree()``.
+
+    Column/RowParallelLinear weights shard over ``model_axis``; every
+    other parameter is replicated.
+    """
+    from ..nn.module import Container
+    from .tensor_parallel import ColumnParallelLinear, RowParallelLinear
+
+    tree = module.param_tree()
+    if isinstance(module, ColumnParallelLinear) and module.axis_name:
+        specs = {"weight": P(model_axis, None)}
+        if "bias" in tree:
+            specs["bias"] = P(model_axis)
+        return specs
+    if isinstance(module, RowParallelLinear) and module.axis_name:
+        specs = {"weight": P(None, model_axis)}
+        if "bias" in tree:
+            specs["bias"] = P()
+        return specs
+    if isinstance(module, Container):
+        specs = {str(i): param_specs(m, model_axis)
+                 for i, m in enumerate(module.modules)}
+        for k in tree:  # module-own params (e.g. TransformerLM "pos")
+            if k not in specs:
+                specs[k] = P()
+        return specs
+    return jax.tree_util.tree_map(lambda _: P(), tree)
+
+
+def slot_specs(slots, pspecs):
+    """Optimizer-state specs: subtrees shaped like the param tree inherit
+    the param specs (momentum/Adam moments shard with their params);
+    scalar leaves (step counters) replicate."""
+    ptreedef = jax.tree_util.tree_structure(pspecs)
+
+    def rec(s):
+        if jax.tree_util.tree_structure(s) == ptreedef:
+            return pspecs
+        if isinstance(s, dict):
+            return {k: rec(v) for k, v in s.items()}
+        return P()
+
+    return rec(slots)
+
+
+def make_train_step(model, criterion, optim, mesh,
+                    data_axis: Optional[str] = "data",
+                    seq_axis: Optional[str] = "seq",
+                    model_axis: Optional[str] = "model",
+                    input_seq_dim: Optional[int] = 1):
+    """Build the jitted SPMD train step over ``mesh``.
+
+    ``input_seq_dim`` — which dim of x/y is the sequence (None: inputs
+    are not sequence-sharded).  Axes not present in the mesh are ignored.
+    """
+    axes = set(mesh.axis_names)
+    data_axis = data_axis if data_axis in axes else None
+    seq_axis = seq_axis if seq_axis in axes else None
+    model_axis = model_axis if model_axis in axes else None
+    batch_axes = tuple(a for a in (data_axis, seq_axis) if a)
+
+    pspecs = param_specs(model, model_axis or "model")
+    buffers = model.buffer_tree()
+    sslots = slot_specs(optim.init_state(model.param_tree()), pspecs)
+    bspecs = jax.tree_util.tree_map(lambda _: P(), buffers)
+
+    def in_spec(ndim):
+        parts = [data_axis]
+        if input_seq_dim is not None and seq_axis:
+            parts += [None] * (input_seq_dim - 1) + [seq_axis]
+        parts += [None] * (ndim - len(parts))
+        return P(*parts)
+
+    x_spec, y_spec = in_spec(2), in_spec(2)
+
+    all_axes = tuple(a for a in (data_axis, seq_axis, model_axis) if a)
+    n_model = mesh.shape[model_axis] if model_axis else 1
+
+    def _reduce_grad(g, spec):
+        """Tied-parameter chain rule over the mesh.
+
+        A replicated param has one copy per device; the gradient of the
+        global (pmean) objective w.r.t. the tied value is the pmean over
+        ALL axes of the per-copy AD grads (cross-shard paths through
+        ppermute/psum are already inside each copy's AD grad).  A
+        model-sharded param has copies over (data, seq) only, but its AD
+        grad double-counts the model-axis' redundant loss copies — so:
+        pmean over (data, seq), divided by the model-axis size.
+        """
+        sharded = model_axis is not None and any(
+            model_axis == ax or (isinstance(ax, tuple) and model_axis in ax)
+            for ax in spec if ax is not None)
+        if sharded:
+            if batch_axes:
+                g = lax.pmean(g, batch_axes)
+            return g / n_model
+        return lax.pmean(g, all_axes) if all_axes else g
+
+    def local_step(params, slots, buf, lr, x, y):
+        def loss_fn(p):
+            out, nb = model.apply_fn(p, buf, x, True, None)
+            return criterion._loss(out, y), nb
+
+        (loss, nb), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
+        grads = jax.tree_util.tree_map(_reduce_grad, grads, pspecs)
+        if batch_axes:
+            loss = lax.pmean(loss, batch_axes)
+        new_params, new_slots = optim.step(grads, params, slots, lr)
+        return loss, new_params, new_slots, nb
+
+    sharded = shard_map(
+        local_step, mesh=mesh,
+        in_specs=(pspecs, sslots, bspecs, P(), x_spec, y_spec),
+        out_specs=(P(), pspecs, sslots, bspecs),
+        check_vma=False)
+
+    jitted = jax.jit(sharded)
+
+    def step(params, slots, buf, lr, x, y):
+        return jitted(params, slots, buf, jnp.float32(lr),
+                      jnp.asarray(x), jnp.asarray(y))
+
+    step.param_specs = pspecs
+    step.input_spec = x_spec
+    return step

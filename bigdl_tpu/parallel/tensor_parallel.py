@@ -1,0 +1,63 @@
+"""Tensor (model) parallel layers — Megatron-style column/row linears.
+
+No reference counterpart (SURVEY §2.2: the reference's only axis is data
+parallelism); this is the TPU rebuild's model-parallel extension.  The
+layers store FULL weights on the host; sharding happens at trace time:
+under ``shard_map`` the caller passes param in_specs that split
+``ColumnParallelLinear.weight`` on its output dim and
+``RowParallelLinear.weight`` on its input dim over the model axis (see
+``parallel.spmd.param_specs``).  The layer code itself is
+shape-oblivious — the only collective is the ``psum`` closing a
+row-parallel matmul.
+
+Canonical MLP block:  y = RowParallel(act(ColumnParallel(x)))
+→ one all-reduce per block, activations between the two stay sharded.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+from jax import lax
+
+from ..nn.linear import Linear
+
+
+class ColumnParallelLinear(Linear):
+    """y = x W^T + b with W split on the OUTPUT dim over ``axis_name``.
+
+    Output activations come out sharded on their last dim; no collective
+    is needed — the compute is exactly ``nn.Linear``.  ``axis_name=None``
+    degrades to a plain Linear (eager / single-device use).
+    """
+
+    def __init__(self, input_size: int, output_size: int,
+                 with_bias: bool = True, axis_name: Optional[str] = "model"):
+        self.axis_name = axis_name
+        super().__init__(input_size, output_size, with_bias)
+
+
+class RowParallelLinear(Linear):
+    """y = psum(x W^T) + b with W split on the INPUT dim over ``axis_name``.
+
+    Takes output-sharded activations from a ColumnParallelLinear; each
+    device computes a partial product and one ``psum`` over the model
+    axis completes the contraction.  The bias is added AFTER the psum so
+    it is applied exactly once.
+    """
+
+    def __init__(self, input_size: int, output_size: int,
+                 with_bias: bool = True, axis_name: Optional[str] = "model"):
+        self.axis_name = axis_name
+        super().__init__(input_size, output_size, with_bias)
+
+    def _apply(self, params, buffers, x, training, rng):
+        y = jnp.dot(x, params["weight"].T)
+        if self.axis_name is not None:
+            try:
+                y = lax.psum(y, self.axis_name)
+            except NameError:  # axis not bound: eager/unsharded call
+                pass
+        if self.with_bias:
+            y = y + params["bias"]
+        return y, buffers
