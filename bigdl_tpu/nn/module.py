@@ -332,6 +332,14 @@ class AbstractModule:
         _save(self, path, overwrite)
         return self
 
+    def save_torch(self, path: str, overwrite: bool = False):
+        """Write this module as a Torch7 ``.t7`` file (reference
+        AbstractModule.saveTorch:390 → TorchFile.save)."""
+        from ..utils import torch_file
+
+        torch_file.save(self, path, overwrite)
+        return self
+
     def save_weights(self, path: str, overwrite: bool = False):
         from ..utils.file_io import save as _save
 

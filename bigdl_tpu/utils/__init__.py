@@ -1,3 +1,4 @@
 from .engine import Engine, init_engine
 from .rng import RNG, RandomGenerator, set_global_seed
 from .table import T, Table
+from . import torch_file as TorchFile
