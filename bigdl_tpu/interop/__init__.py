@@ -1,0 +1,4 @@
+"""Model interop: Caffe / TensorFlow GraphDef / Torch .t7 loaders and
+savers (reference utils/caffe/*, utils/tf/*, utils/TorchFile.scala)."""
+from .caffe import CaffeLoader, CaffePersister
+from .tensorflow import TensorflowLoader, TensorflowSaver

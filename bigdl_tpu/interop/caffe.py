@@ -1,0 +1,571 @@
+"""Caffe model interop (reference utils/caffe/CaffeLoader.scala:47,
+Converter.scala, LayerConverter.scala, V1LayerConverter.scala,
+CaffePersister.scala).
+
+``CaffeLoader`` parses a deploy prototxt (protobuf text format) plus a
+binary ``.caffemodel`` and either (a) builds a :class:`~bigdl_tpu.nn.graph.Graph`
+of bigdl_tpu modules (``create_caffe_model``, CaffeLoader.scala:213-316)
+or (b) copies weights by layer name into an existing model (``load``,
+CaffeLoader.scala:380).  ``CaffePersister`` writes a module back out as
+prototxt + caffemodel.
+
+The protobuf schema is an in-tree subset of the public BVLC caffe.proto
+(bigdl_tpu/interop/protos/caffe.proto) with upstream field numbers, so
+real Caffe artifacts parse bit-compatibly.
+"""
+from __future__ import annotations
+
+import logging
+import os
+import sys
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+_PROTO_DIR = os.path.join(os.path.dirname(__file__), "protos")
+if _PROTO_DIR not in sys.path:
+    sys.path.insert(0, _PROTO_DIR)
+
+import caffe_pb2  # noqa: E402  (generated from protos/caffe.proto)
+from google.protobuf import text_format  # noqa: E402
+
+log = logging.getLogger(__name__)
+
+
+def _blob_array(blob) -> np.ndarray:
+    if blob.double_data:
+        data = np.asarray(blob.double_data, dtype=np.float64)
+    else:
+        data = np.asarray(blob.data, dtype=np.float32)
+    if blob.HasField("shape") and blob.shape.dim:
+        return data.reshape(tuple(blob.shape.dim))
+    legacy = [d for d in (blob.num, blob.channels, blob.height, blob.width)]
+    if any(d > 1 for d in legacy) or data.size == int(np.prod([max(d, 1) for d in legacy])):
+        shape = tuple(d for d in legacy if d != 0) or (data.size,)
+        try:
+            return data.reshape(shape)
+        except ValueError:
+            return data
+    return data
+
+
+def _fill_blob(blob, arr: np.ndarray):
+    blob.shape.dim.extend(int(d) for d in arr.shape)
+    blob.data.extend(np.asarray(arr, dtype=np.float32).ravel().tolist())
+
+
+def _v1_type_name(t) -> str:
+    """Map V1 LayerType enum to the V2 string type (V1LayerConverter parity)."""
+    name = caffe_pb2.V1LayerParameter.LayerType.Name(t)
+    special = {
+        "CONVOLUTION": "Convolution", "INNER_PRODUCT": "InnerProduct",
+        "RELU": "ReLU", "TANH": "TanH", "SIGMOID": "Sigmoid",
+        "SOFTMAX": "Softmax", "SOFTMAX_LOSS": "SoftmaxWithLoss",
+        "POOLING": "Pooling", "LRN": "LRN", "DROPOUT": "Dropout",
+        "CONCAT": "Concat", "ELTWISE": "Eltwise", "ABSVAL": "AbsVal",
+        "POWER": "Power", "EXP": "Exp", "THRESHOLD": "Threshold",
+        "FLATTEN": "Flatten", "SLICE": "Slice", "SPLIT": "Split",
+        "DECONVOLUTION": "Deconvolution", "DATA": "Data",
+        "DUMMY_DATA": "DummyData", "MEMORY_DATA": "MemoryData",
+        "EUCLIDEAN_LOSS": "EuclideanLoss", "ACCURACY": "Accuracy",
+    }
+    return special.get(name, name.title())
+
+
+_SKIP_TYPES = {
+    "Data", "DummyData", "MemoryData", "ImageData", "HDF5Data", "Accuracy",
+    "Silence", "Input",
+}
+_LOSS_TO_MODULE = {"SoftmaxWithLoss": "SoftMax", "Softmax": "SoftMax"}
+
+
+class CaffeConverter:
+    """Caffe layer → bigdl_tpu module (reference Converter.scala)."""
+
+    def convert(self, layer) -> Optional[object]:
+        from .. import nn
+
+        t = layer.type
+        if t in _SKIP_TYPES:
+            return None
+        if t == "Convolution" or t == "Deconvolution":
+            p = layer.convolution_param
+            nout = int(p.num_output)
+            # caffe repeated spatial fields are (h, w) ordered
+            kh = int(p.kernel_h or (p.kernel_size[0] if p.kernel_size else 1))
+            kw = int(p.kernel_w or (p.kernel_size[-1] if p.kernel_size else 1))
+            dh = int(p.stride_h or (p.stride[0] if p.stride else 1))
+            dw = int(p.stride_w or (p.stride[-1] if p.stride else 1))
+            ph = int(p.pad_h or (p.pad[0] if p.pad else 0))
+            pw = int(p.pad_w or (p.pad[-1] if p.pad else 0))
+            group = int(p.group) or 1
+            if t == "Deconvolution":
+                # deconv weight blob layout is (in, out/group, kH, kW)
+                w = _blob_array(layer.blobs[0]) if layer.blobs else None
+                nin = int(w.shape[0]) if w is not None and w.ndim == 4 else nout
+                return nn.SpatialFullConvolution(
+                    nin, nout, kw, kh, dw, dh, pw, ph, n_group=group,
+                    no_bias=not p.bias_term)
+            nin = self._conv_nin(layer, group)
+            return nn.SpatialConvolution(
+                nin, nout, kw, kh, dw, dh, pw, ph, n_group=group,
+                with_bias=p.bias_term)
+        if t == "InnerProduct":
+            p = layer.inner_product_param
+            nout = int(p.num_output)
+            nin = self._linear_nin(layer)
+            seq = nn.Sequential(
+                nn.Reshape([nin]),  # batch auto-detect → flatten trailing dims
+                nn.Linear(nin, nout, with_bias=p.bias_term))
+            return seq
+        if t == "ReLU":
+            slope = layer.relu_param.negative_slope
+            return nn.LeakyReLU(slope) if slope else nn.ReLU()
+        if t == "TanH":
+            return nn.Tanh()
+        if t == "Sigmoid":
+            return nn.Sigmoid()
+        if t == "AbsVal":
+            return nn.Abs()
+        if t == "ELU":
+            return nn.ELU(layer.elu_param.alpha or 1.0)
+        if t == "PReLU":
+            return nn.PReLU()
+        if t == "Power":
+            p = layer.power_param
+            return nn.Power(p.power or 1.0, p.scale or 1.0, p.shift or 0.0)
+        if t == "Exp":
+            return nn.Exp()
+        if t == "Log":
+            return nn.Log()
+        if t == "Threshold":
+            return nn.Threshold(layer.threshold_param.threshold, 0.0)
+        if t == "Pooling":
+            p = layer.pooling_param
+            kw = int(p.kernel_w or p.kernel_size or 1)
+            kh = int(p.kernel_h or p.kernel_size or 1)
+            dw = int(p.stride_w or p.stride or 1)
+            dh = int(p.stride_h or p.stride or 1)
+            pw = int(p.pad_w or p.pad or 0)
+            ph = int(p.pad_h or p.pad or 0)
+            if p.pool == caffe_pb2.PoolingParameter.MAX:
+                return nn.SpatialMaxPooling(
+                    kw, kh, dw, dh, pw, ph,
+                    global_pooling=p.global_pooling).ceil()
+            if p.pool == caffe_pb2.PoolingParameter.AVE:
+                return nn.SpatialAveragePooling(
+                    kw, kh, dw, dh, pw, ph, ceil_mode=True,
+                    global_pooling=p.global_pooling)
+            raise NotImplementedError("STOCHASTIC pooling not supported "
+                                      "(reference Converter.scala:120 → null)")
+        if t == "LRN":
+            p = layer.lrn_param
+            if p.norm_region != caffe_pb2.LRNParameter.ACROSS_CHANNELS:
+                raise NotImplementedError("WITHIN_CHANNEL LRN not supported")
+            return nn.SpatialCrossMapLRN(int(p.local_size) or 5, p.alpha or 1.0,
+                                         p.beta or 0.75, p.k or 1.0)
+        if t == "Dropout":
+            return nn.Dropout(layer.dropout_param.dropout_ratio or 0.5)
+        if t in _LOSS_TO_MODULE:
+            return nn.SoftMax()
+        if t == "Concat":
+            axis = layer.concat_param.axis if layer.HasField("concat_param") else 1
+            return nn.JoinTable(int(axis) + 1)  # caffe axis 0-based → 1-based
+        if t == "Eltwise":
+            p = layer.eltwise_param
+            op = p.operation
+            if op == caffe_pb2.EltwiseParameter.PROD:
+                return nn.CMulTable()
+            if op == caffe_pb2.EltwiseParameter.MAX:
+                return nn.CMaxTable()
+            coeffs = list(p.coeff)
+            if coeffs == [1.0, -1.0]:
+                return nn.CSubTable()
+            return nn.CAddTable()
+        if t == "Flatten":
+            return nn.InferReshape([0, -1])
+        if t == "Slice":
+            axis = layer.slice_param.axis if layer.HasField("slice_param") else 1
+            return nn.SplitTable(int(axis) + 1)
+        if t == "Tile":
+            p = layer.tile_param
+            return nn.Replicate(int(p.tiles), int(p.axis) + 1)
+        if t == "BatchNorm":
+            p = layer.batch_norm_param
+            n = self._bn_channels(layer)
+            return nn.SpatialBatchNormalization(n, eps=p.eps or 1e-5,
+                                                momentum=1.0 - (p.moving_average_fraction or 0.999),
+                                                affine=False)
+        if t == "Scale":
+            p = layer.scale_param
+            shape = self._scale_shape(layer)
+            if p.bias_term:
+                return nn.Sequential(nn.CMul(shape), nn.CAdd(shape))
+            return nn.CMul(shape)
+        if t == "Reshape":
+            dims = list(layer.reshape_param.shape.dim)
+            return nn.InferReshape([int(d) for d in dims])
+        raise NotImplementedError(
+            f"unsupported caffe layer type {t} "
+            "(reference Converter.scala:305 throws the same)")
+
+    # -- helpers that need weight blobs for shape inference ---------------
+    def _conv_nin(self, layer, group) -> int:
+        if layer.blobs:
+            w = _blob_array(layer.blobs[0])
+            return int(w.shape[1]) * group if w.ndim == 4 else int(w.shape[-1])
+        raise ValueError(f"conv layer {layer.name} has no weight blob; "
+                         "cannot infer input planes")
+
+    def _linear_nin(self, layer) -> int:
+        if layer.blobs:
+            w = _blob_array(layer.blobs[0])
+            return int(w.shape[-1])
+        raise ValueError(f"ip layer {layer.name} has no weight blob")
+
+    def _bn_channels(self, layer) -> int:
+        if layer.blobs:
+            return int(_blob_array(layer.blobs[0]).size)
+        raise ValueError(f"bn layer {layer.name} has no blobs")
+
+    def _scale_shape(self, layer) -> Tuple[int, ...]:
+        if layer.blobs:
+            s = _blob_array(layer.blobs[0])
+            return (1, int(s.size), 1, 1)
+        raise ValueError(f"scale layer {layer.name} has no blobs")
+
+    # -- weight copy ------------------------------------------------------
+    def copy_weights(self, module, layer):
+        from .. import nn
+
+        blobs = [_blob_array(b) for b in layer.blobs]
+        if not blobs:
+            return
+        if isinstance(module, nn.Sequential):  # InnerProduct / Scale wrappers
+            for m in module.modules:
+                self.copy_weights(m, layer)
+            return
+        if isinstance(module, nn.SpatialConvolution):
+            w = blobs[0].reshape(module.params["weight"].shape)
+            module.params["weight"] = jnp.asarray(w, jnp.float32)
+            if len(blobs) > 1 and "bias" in module.params:
+                module.params["bias"] = jnp.asarray(blobs[1].ravel(), jnp.float32)
+        elif isinstance(module, nn.Linear):
+            module.params["weight"] = jnp.asarray(
+                blobs[0].reshape(module.params["weight"].shape), jnp.float32)
+            if len(blobs) > 1 and "bias" in module.params:
+                module.params["bias"] = jnp.asarray(blobs[1].ravel(), jnp.float32)
+        elif isinstance(module, nn.SpatialBatchNormalization):
+            scale = float(blobs[2].ravel()[0]) if len(blobs) > 2 else 1.0
+            scale = scale if scale != 0 else 1.0
+            module.buffers["running_mean"] = jnp.asarray(
+                blobs[0].ravel() / scale, jnp.float32)
+            module.buffers["running_var"] = jnp.asarray(
+                blobs[1].ravel() / scale, jnp.float32)
+        elif isinstance(module, nn.CMul):
+            module.params["weight"] = jnp.asarray(
+                blobs[0].reshape(module.params["weight"].shape), jnp.float32)
+        elif isinstance(module, nn.CAdd):
+            if len(blobs) > 1:
+                module.params["bias"] = jnp.asarray(
+                    blobs[1].reshape(module.params["bias"].shape), jnp.float32)
+        elif isinstance(module, nn.PReLU):
+            module.params["weight"] = jnp.asarray(
+                blobs[0].ravel(), jnp.float32)
+
+
+class CaffeLoader:
+    """Parse prototxt + caffemodel and build / fill a model
+    (reference CaffeLoader.scala:47)."""
+
+    def __init__(self, def_path: str, model_path: str, match_all: bool = True):
+        self.def_path = def_path
+        self.model_path = model_path
+        self.match_all = match_all
+        self.converter = CaffeConverter()
+        self._net_def = None
+        self._weights = None
+
+    # -- parsing ----------------------------------------------------------
+    def _load_def(self):
+        if self._net_def is None:
+            net = caffe_pb2.NetParameter()
+            with open(self.def_path) as f:
+                text_format.Merge(f.read(), net)
+            self._net_def = net
+        return self._net_def
+
+    def _load_weights(self):
+        if self._weights is None:
+            net = caffe_pb2.NetParameter()
+            with open(self.model_path, "rb") as f:
+                net.ParseFromString(f.read())
+            self._weights = net
+        return self._weights
+
+    def _layers(self, net) -> List:
+        """V2 ``layer`` or legacy V1 ``layers``, normalized to V2 messages."""
+        if net.layer:
+            return list(net.layer)
+        out = []
+        for v1 in net.layers:
+            l2 = caffe_pb2.LayerParameter()
+            l2.name = v1.name
+            l2.type = _v1_type_name(v1.type)
+            l2.bottom.extend(v1.bottom)
+            l2.top.extend(v1.top)
+            l2.blobs.extend(v1.blobs)
+            for f in ("convolution_param", "inner_product_param", "lrn_param",
+                      "pooling_param", "dropout_param", "relu_param",
+                      "power_param", "threshold_param", "concat_param",
+                      "eltwise_param", "slice_param", "softmax_param"):
+                if v1.HasField(f):
+                    getattr(l2, f).CopyFrom(getattr(v1, f))
+            out.append(l2)
+        return out
+
+    def _merged_layers(self) -> List:
+        """Prototxt structure + caffemodel blobs merged by layer name.
+        Works on copies so repeated calls don't re-extend blobs onto the
+        cached net def."""
+        weights = {l.name: l for l in self._layers(self._load_weights())}
+        merged = []
+        for l in self._layers(self._load_def()):
+            copy = caffe_pb2.LayerParameter()
+            copy.CopyFrom(l)
+            if copy.name in weights and weights[copy.name].blobs:
+                del copy.blobs[:]
+                copy.blobs.extend(weights[copy.name].blobs)
+            merged.append(copy)
+        return merged
+
+    def _is_train_only(self, layer) -> bool:
+        return any(rule.HasField("phase") and rule.phase == caffe_pb2.TRAIN
+                   for rule in layer.include)
+
+    # -- graph building (CaffeLoader.createCaffeModel:213-316) -------------
+    def create_caffe_model(self):
+        from ..nn.graph import Graph, Input
+
+        net = self._load_def()
+        blob_to_node: Dict[str, object] = {}
+        input_nodes = []
+
+        input_names = list(net.input)
+        for l in self._layers(net):
+            if l.type == "Input":
+                input_names.extend(l.top)
+        if not input_names:  # fall back: first layer's bottoms
+            for l in self._layers(net):
+                if not self._is_train_only(l):
+                    input_names.extend(b for b in l.bottom)
+                    break
+        for name in dict.fromkeys(input_names):
+            node = Input()
+            node.element.set_name(name)
+            blob_to_node[name] = node
+            input_nodes.append(node)
+
+        for layer in self._merged_layers():
+            if self._is_train_only(layer):
+                continue
+            try:
+                module = self.converter.convert(layer)
+            except NotImplementedError:
+                log.warning("skipping unsupported caffe layer %s (%s) — kept "
+                            "as identity", layer.name, layer.type)
+                from .. import nn
+                module = nn.Identity()
+            if module is None:
+                continue
+            module.set_name(layer.name)
+            self.converter.copy_weights(module, layer)
+            bottoms = [blob_to_node[b] for b in layer.bottom
+                       if b in blob_to_node]
+            node = module.inputs(*bottoms)
+            for top in layer.top:
+                blob_to_node[top] = node
+
+        consumed = set()
+        for node in blob_to_node.values():
+            for p in node.prev_nodes:
+                consumed.add(p.uid)
+        outputs = [n for name, n in blob_to_node.items()
+                   if n.uid not in consumed and n not in input_nodes]
+        # preserve insertion order, dedupe
+        seen, uniq = set(), []
+        for n in outputs:
+            if n.uid not in seen:
+                seen.add(n.uid)
+                uniq.append(n)
+        return Graph(input_nodes, uniq)
+
+    # -- weight copy into an existing model (CaffeLoader.load:380) ---------
+    @staticmethod
+    def load(model, def_path: str, model_path: str, match_all: bool = True):
+        loader = CaffeLoader(def_path, model_path, match_all)
+        by_name = {l.name: l for l in loader._merged_layers()}
+        copied = set()
+        for m in model.modules_iter():
+            name = m.get_name()
+            if name in by_name and by_name[name].blobs:
+                loader.converter.copy_weights(m, by_name[name])
+                copied.add(name)
+        missing = {n for n, l in by_name.items() if l.blobs} - copied
+        if match_all and missing:
+            raise ValueError(
+                f"match_all=True but caffe layers {sorted(missing)} have no "
+                "named counterpart in the model (reference CaffeLoader "
+                "copyParameter require)")
+        return model
+
+
+class CaffePersister:
+    """Write a module out as prototxt + caffemodel
+    (reference utils/caffe/CaffePersister.scala)."""
+
+    @staticmethod
+    def persist(prototxt_path: str, model_path: str, module,
+                use_v2: bool = True, overwrite: bool = False):
+        from .. import nn
+
+        if not overwrite:
+            for p in (prototxt_path, model_path):
+                if os.path.exists(p):
+                    raise FileExistsError(p)
+        net = caffe_pb2.NetParameter()
+        net.name = module.get_name()
+        net.input.append("data")
+
+        if hasattr(module, "sorted_nodes"):  # Graph: preserve real topology
+            if len(module.input_nodes) != 1:
+                raise NotImplementedError(
+                    "caffe persist supports single-input graphs")
+            tops = {module.input_nodes[0].uid: "data"}
+            for i, node in enumerate(module.sorted_nodes):
+                if node.uid in tops:
+                    continue
+                m = node.element
+                layer = net.layer.add()
+                layer.name = m.get_name() if m.name else f"layer{i}"
+                for p in node.prev_nodes:
+                    layer.bottom.append(tops[p.uid])
+                top = f"{layer.name}_out"
+                layer.top.append(top)
+                tops[node.uid] = top
+                CaffePersister._fill_layer(layer, m)
+        else:
+            mods = (list(module.modules) if isinstance(module, nn.Sequential)
+                    else [module])
+            prev_top = "data"
+            for i, m in enumerate(mods):
+                layer = net.layer.add()
+                layer.name = m.get_name() if m.name else f"layer{i}"
+                layer.bottom.append(prev_top)
+                top = f"{layer.name}_out"
+                layer.top.append(top)
+                prev_top = top
+                CaffePersister._fill_layer(layer, m)
+        with open(prototxt_path, "w") as f:
+            stripped = caffe_pb2.NetParameter()
+            stripped.CopyFrom(net)
+            for l in stripped.layer:
+                del l.blobs[:]
+            f.write(text_format.MessageToString(stripped))
+        with open(model_path, "wb") as f:
+            f.write(net.SerializeToString())
+
+    @staticmethod
+    def _fill_layer(layer, m):
+        from .. import nn
+
+        p = {k: np.asarray(v) for k, v in m.params.items()}
+        if isinstance(m, nn.SpatialConvolution):
+            layer.type = "Convolution"
+            cp = layer.convolution_param
+            cp.num_output = m.n_output_plane
+            cp.kernel_w, cp.kernel_h = m.kernel_w, m.kernel_h
+            cp.stride_w, cp.stride_h = m.stride_w, m.stride_h
+            cp.pad_w, cp.pad_h = max(m.pad_w, 0), max(m.pad_h, 0)
+            cp.group = m.n_group
+            cp.bias_term = m.with_bias
+            _fill_blob(layer.blobs.add(), p["weight"])
+            if m.with_bias:
+                _fill_blob(layer.blobs.add(), p["bias"])
+        elif isinstance(m, nn.Linear):
+            layer.type = "InnerProduct"
+            ip = layer.inner_product_param
+            ip.num_output = m.output_size
+            ip.bias_term = m.with_bias
+            _fill_blob(layer.blobs.add(), p["weight"])
+            if m.with_bias:
+                _fill_blob(layer.blobs.add(), p["bias"])
+        elif isinstance(m, nn.SpatialMaxPooling):
+            layer.type = "Pooling"
+            pp = layer.pooling_param
+            pp.pool = caffe_pb2.PoolingParameter.MAX
+            pp.kernel_w, pp.kernel_h = m.kw, m.kh
+            pp.stride_w, pp.stride_h = m.dw, m.dh
+            pp.pad_w, pp.pad_h = m.pad_w, m.pad_h
+        elif isinstance(m, nn.SpatialAveragePooling):
+            layer.type = "Pooling"
+            pp = layer.pooling_param
+            pp.pool = caffe_pb2.PoolingParameter.AVE
+            pp.kernel_w, pp.kernel_h = m.kw, m.kh
+            pp.stride_w, pp.stride_h = m.dw, m.dh
+            pp.pad_w, pp.pad_h = m.pad_w, m.pad_h
+        elif isinstance(m, nn.SpatialCrossMapLRN):
+            layer.type = "LRN"
+            lp = layer.lrn_param
+            lp.local_size = m.size
+            lp.alpha, lp.beta, lp.k = m.alpha, m.beta, m.k
+        elif isinstance(m, (nn.SpatialBatchNormalization, nn.BatchNormalization)):
+            layer.type = "BatchNorm"
+            layer.batch_norm_param.eps = m.eps
+            b = {k: np.asarray(v) for k, v in m.buffers.items()}
+            _fill_blob(layer.blobs.add(), b["running_mean"])
+            _fill_blob(layer.blobs.add(), b["running_var"])
+            _fill_blob(layer.blobs.add(), np.ones((1,), np.float32))
+        elif isinstance(m, nn.ReLU):
+            layer.type = "ReLU"
+        elif isinstance(m, nn.LeakyReLU):
+            layer.type = "ReLU"
+            layer.relu_param.negative_slope = m.negval
+        elif isinstance(m, nn.Tanh):
+            layer.type = "TanH"
+        elif isinstance(m, nn.Sigmoid):
+            layer.type = "Sigmoid"
+        elif isinstance(m, nn.Abs):
+            layer.type = "AbsVal"
+        elif isinstance(m, (nn.SoftMax, nn.LogSoftMax)):
+            layer.type = "Softmax"
+        elif isinstance(m, nn.Dropout):
+            layer.type = "Dropout"
+            layer.dropout_param.dropout_ratio = m.p
+        elif isinstance(m, nn.JoinTable):
+            layer.type = "Concat"
+            layer.concat_param.axis = m.dimension - 1
+        elif isinstance(m, nn.CAddTable):
+            layer.type = "Eltwise"
+            layer.eltwise_param.operation = caffe_pb2.EltwiseParameter.SUM
+        elif isinstance(m, nn.CMulTable):
+            layer.type = "Eltwise"
+            layer.eltwise_param.operation = caffe_pb2.EltwiseParameter.PROD
+        elif isinstance(m, nn.CMaxTable):
+            layer.type = "Eltwise"
+            layer.eltwise_param.operation = caffe_pb2.EltwiseParameter.MAX
+        elif isinstance(m, (nn.Reshape, nn.InferReshape, nn.View)):
+            layer.type = "Reshape"
+            sizes = list(getattr(m, "size", None) or getattr(m, "sizes", ()))
+            if isinstance(m, nn.InferReshape) and not m.batch_mode:
+                dims = [int(d) for d in sizes]
+            else:  # caffe convention: leading 0 copies the batch dim
+                dims = [0] + [int(d) for d in sizes]
+            layer.reshape_param.shape.dim.extend(dims)
+        elif isinstance(m, nn.Identity):
+            layer.type = "Split"
+        else:
+            raise NotImplementedError(
+                f"caffe persist of {type(m).__name__} not supported "
+                "(reference Converter.scala:305 parity)")

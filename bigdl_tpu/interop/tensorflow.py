@@ -1,0 +1,544 @@
+"""TensorFlow GraphDef interop (reference utils/tf/TensorflowLoader.scala:38,
+TensorflowToBigDL.scala pattern table, TensorflowSaver.scala,
+BigDLToTensorflow.scala).
+
+``TensorflowLoader.load`` parses a binary GraphDef, builds the node DAG
+(buildTFGraph parity, TensorflowLoader.scala:85), fuses the standard
+``{Conv2D,MatMul} + BiasAdd`` / ``FusedBatchNorm`` subgraph patterns and
+emits a :class:`~bigdl_tpu.nn.graph.Graph` (buildBigDLModel:126).
+
+Layout: TF spatial ops default to NHWC; bigdl_tpu spatial modules are
+NCHW (the TPU-friendly conv layout under XLA's dimension-number
+flexibility is handled inside the modules).  The loader inserts
+transpose adapters at NHWC boundaries — XLA cancels back-to-back
+transposes at compile time, so the adapters are free after fusion.
+
+``TensorflowSaver.save`` walks a Sequential/Graph module and emits a
+GraphDef with Const weight nodes (AbstractModule.saveTF parity,
+AbstractModule.scala:405).
+"""
+from __future__ import annotations
+
+import logging
+import os
+import sys
+from typing import Dict, List, Optional, Sequence
+
+import jax.numpy as jnp
+import numpy as np
+
+_PROTO_DIR = os.path.join(os.path.dirname(__file__), "protos")
+if _PROTO_DIR not in sys.path:
+    sys.path.insert(0, _PROTO_DIR)
+
+import tf_graph_pb2 as tfpb  # noqa: E402
+
+log = logging.getLogger(__name__)
+
+_NP_TO_DT = {
+    np.dtype(np.float32): tfpb.DT_FLOAT,
+    np.dtype(np.float64): tfpb.DT_DOUBLE,
+    np.dtype(np.int32): tfpb.DT_INT32,
+    np.dtype(np.int64): tfpb.DT_INT64,
+    np.dtype(np.bool_): tfpb.DT_BOOL,
+}
+_DT_TO_NP = {v: k for k, v in _NP_TO_DT.items()}
+
+
+def tensor_to_proto(arr: np.ndarray) -> tfpb.TensorProto:
+    arr = np.asarray(arr)
+    t = tfpb.TensorProto()
+    t.dtype = _NP_TO_DT[arr.dtype]
+    for d in arr.shape:
+        t.tensor_shape.dim.add().size = int(d)
+    t.tensor_content = arr.tobytes()
+    return t
+
+
+def proto_to_tensor(t: tfpb.TensorProto) -> np.ndarray:
+    dtype = _DT_TO_NP.get(t.dtype, np.dtype(np.float32))
+    shape = tuple(d.size for d in t.tensor_shape.dim)
+    n = int(np.prod(shape)) if shape else 1
+    if t.tensor_content:
+        arr = np.frombuffer(t.tensor_content, dtype=dtype)
+    elif t.float_val:
+        arr = np.asarray(t.float_val, dtype)
+    elif t.double_val:
+        arr = np.asarray(t.double_val, dtype)
+    elif t.int_val:
+        arr = np.asarray(t.int_val, dtype)
+    elif t.int64_val:
+        arr = np.asarray(t.int64_val, dtype)
+    elif t.bool_val:
+        arr = np.asarray(t.bool_val, dtype)
+    else:
+        arr = np.zeros(n, dtype)
+    if arr.size == 1 and n > 1:  # scalar broadcast encoding
+        arr = np.full(n, arr.ravel()[0], dtype)
+    return arr.reshape(shape)
+
+
+def _canon(name: str) -> str:
+    """Strip the output-slot suffix and control-dep marker from an input ref."""
+    name = name.lstrip("^")
+    return name.split(":")[0]
+
+
+class TensorflowLoader:
+    """GraphDef → bigdl_tpu Graph (reference TensorflowLoader.scala:38)."""
+
+    @staticmethod
+    def parse(graph_path: str) -> tfpb.GraphDef:
+        g = tfpb.GraphDef()
+        with open(graph_path, "rb") as f:
+            g.ParseFromString(f.read())
+        return g
+
+    @staticmethod
+    def load(graph_path: str, inputs: Sequence[str], outputs: Sequence[str]):
+        return TensorflowLoader.build(TensorflowLoader.parse(graph_path),
+                                      inputs, outputs)
+
+    # -- graph building ---------------------------------------------------
+    @staticmethod
+    def build(graph_def: tfpb.GraphDef, inputs: Sequence[str],
+              outputs: Sequence[str]):
+        from .. import nn
+        from ..nn.graph import Graph, Input
+
+        nodes: Dict[str, tfpb.NodeDef] = {n.name: n for n in graph_def.node}
+        consts: Dict[str, np.ndarray] = {
+            n.name: proto_to_tensor(n.attr["value"].tensor)
+            for n in graph_def.node if n.op == "Const"}
+
+        def const_of(name: str) -> Optional[np.ndarray]:
+            name = _canon(name)
+            if name in consts:
+                return consts[name]
+            n = nodes.get(name)
+            if n is not None and n.op == "Identity":
+                return const_of(n.input[0])
+            return None
+
+        built: Dict[str, object] = {}  # tf node name -> ModuleNode
+        input_nodes = []
+        for name in inputs:
+            node = Input()
+            node.element.set_name(name)
+            built[_canon(name)] = node
+            input_nodes.append(node)
+
+        # consumers map for the BiasAdd fusion
+        consumers: Dict[str, List[tfpb.NodeDef]] = {}
+        for n in graph_def.node:
+            for i in n.input:
+                consumers.setdefault(_canon(i), []).append(n)
+
+        fused_into: Dict[str, str] = {}  # BiasAdd name -> producing op name
+
+        def data_inputs(tf_node) -> List[str]:
+            return [_canon(i) for i in tf_node.input if not i.startswith("^")]
+
+        def visit(name: str):
+            name = _canon(name)
+            if name in built:
+                return built[name]
+            if name in fused_into:
+                built[name] = visit(fused_into[name])
+                return built[name]
+            tf_node = nodes[name]
+            module, dep_names = _convert_node(
+                tf_node, const_of, consumers, fused_into, nn, nodes)
+            if module is None:  # passthrough (Identity, Const feeding, etc.)
+                deps = dep_names if dep_names else data_inputs(tf_node)
+                if not deps:
+                    raise ValueError(
+                        f"node {name} ({tf_node.op}) has no data inputs and "
+                        "is not convertible")
+                built[name] = visit(deps[0])
+                return built[name]
+            module.set_name(name)
+            parents = [visit(d) for d in dep_names]
+            node = module.inputs(*parents)
+            built[name] = node
+            return node
+
+        output_nodes = [visit(o) for o in outputs]
+        return Graph(input_nodes, output_nodes)
+
+
+def _attr_list_i(tf_node, key) -> List[int]:
+    return list(tf_node.attr[key].list.i)
+
+
+def _nhwc(tf_node) -> bool:
+    fmt = tf_node.attr["data_format"].s.decode() if tf_node.attr[
+        "data_format"].s else "NHWC"
+    return fmt == "NHWC"
+
+
+def _convert_node(tf_node, const_of, consumers, fused_into, nn, nodes):
+    """Return (module, dep tf-node names) or (None, …) for passthrough.
+
+    The module may be a small Sequential when a TF op maps to a fused
+    pattern (conv+bias) or needs layout adapters (NHWC→NCHW)
+    (reference TensorflowToBigDL.scala pattern table).
+    """
+    op = tf_node.op
+    name = tf_node.name
+    ins = [i for i in tf_node.input if not i.startswith("^")]
+
+    def bias_consumer():
+        """If our SOLE consumer is BiasAdd/Add with a const bias, fuse it.
+        With more than one consumer the pre-bias tensor is observable
+        elsewhere, so fusion would be wrong — leave the add unfused."""
+        my_consumers = consumers.get(name, [])
+        if len(my_consumers) != 1:
+            return None, None
+        c = my_consumers[0]
+        if c.op in ("BiasAdd", "Add", "AddV2") and len(c.input) == 2:
+            other = [i for i in c.input if _canon(i) != name]
+            if other and const_of(other[0]) is not None:
+                return c, const_of(other[0])
+        return None, None
+
+    if op in ("Placeholder", "PlaceholderV2"):
+        return None, None
+    if op == "Const":
+        return None, None
+    if op in ("Identity", "StopGradient", "CheckNumerics", "NoOp"):
+        return None, None
+
+    if op == "MatMul":
+        w = const_of(ins[1])
+        x_dep = _canon(ins[0])
+        if w is None:
+            w = const_of(ins[0])
+            x_dep = _canon(ins[1])
+        if w is None:
+            raise NotImplementedError("MatMul with two non-const operands")
+        if tf_node.attr["transpose_a"].b:
+            raise NotImplementedError("MatMul transpose_a=true")
+        if not tf_node.attr["transpose_b"].b:
+            w = w.T  # tf stores (in, out); Linear wants (out, in)
+        bias_node, bias = bias_consumer()
+        lin = nn.Linear(int(w.shape[1]), int(w.shape[0]),
+                        with_bias=bias is not None)
+        lin.params["weight"] = jnp.asarray(w, jnp.float32)
+        if bias is not None:
+            lin.params["bias"] = jnp.asarray(bias.ravel(), jnp.float32)
+            fused_into[bias_node.name] = name
+        return lin, [x_dep]
+
+    if op == "Conv2D":
+        w = const_of(ins[1])
+        if w is None:
+            raise NotImplementedError("Conv2D with non-const filter")
+        # tf filter layout: (kH, kW, inC, outC) -> OIHW
+        w_oihw = np.transpose(w, (3, 2, 0, 1))
+        strides = _attr_list_i(tf_node, "strides")
+        dilations = _attr_list_i(tf_node, "dilations")
+        if dilations and any(d != 1 for d in dilations):
+            raise NotImplementedError(
+                f"dilated Conv2D (dilations={dilations}) not supported")
+        nhwc = _nhwc(tf_node)
+        sh, sw = (strides[1], strides[2]) if nhwc else (strides[2], strides[3])
+        padding = tf_node.attr["padding"].s.decode() or "SAME"
+        if padding == "EXPLICIT":
+            ep = _attr_list_i(tf_node, "explicit_paddings")
+            # attr order follows data_format
+            ph0, ph1, pw0, pw1 = ((ep[2], ep[3], ep[4], ep[5]) if nhwc
+                                  else (ep[4], ep[5], ep[6], ep[7]))
+            if ph0 != ph1 or pw0 != pw1:
+                raise NotImplementedError("asymmetric explicit conv padding")
+            pad_h, pad_w = int(ph0), int(pw0)
+        else:
+            pad_h = pad_w = -1 if padding == "SAME" else 0
+        bias_node, bias = bias_consumer()
+        conv = nn.SpatialConvolution(
+            int(w_oihw.shape[1]), int(w_oihw.shape[0]),
+            int(w_oihw.shape[3]), int(w_oihw.shape[2]), sw, sh,
+            pad_w, pad_h, with_bias=bias is not None)
+        conv.params["weight"] = jnp.asarray(w_oihw, jnp.float32)
+        if bias is not None:
+            conv.params["bias"] = jnp.asarray(bias.ravel(), jnp.float32)
+            fused_into[bias_node.name] = name
+        mod = _wrap_nhwc(conv, nhwc, nn)
+        return mod, [_canon(ins[0])]
+
+    if op in ("MaxPool", "AvgPool"):
+        ksize = _attr_list_i(tf_node, "ksize")
+        strides = _attr_list_i(tf_node, "strides")
+        nhwc = _nhwc(tf_node)
+        kh, kw = (ksize[1], ksize[2]) if nhwc else (ksize[2], ksize[3])
+        sh, sw = (strides[1], strides[2]) if nhwc else (strides[2], strides[3])
+        padding = tf_node.attr["padding"].s.decode() or "VALID"
+        pad = -1 if padding == "SAME" else 0
+        if op == "MaxPool":
+            pool = nn.SpatialMaxPooling(kw, kh, sw, sh, pad, pad)
+        else:
+            pool = nn.SpatialAveragePooling(kw, kh, sw, sh, pad, pad)
+        return _wrap_nhwc(pool, nhwc, nn), [_canon(ins[0])]
+
+    if op == "FusedBatchNorm" or op == "FusedBatchNormV2" or op == "FusedBatchNormV3":
+        scale = const_of(ins[1])
+        offset = const_of(ins[2])
+        mean = const_of(ins[3])
+        var = const_of(ins[4])
+        eps = tf_node.attr["epsilon"].f or 1e-4
+        n = int(scale.size)
+        bn = nn.SpatialBatchNormalization(n, eps=float(eps), affine=True)
+        bn.params["weight"] = jnp.asarray(scale.ravel(), jnp.float32)
+        bn.params["bias"] = jnp.asarray(offset.ravel(), jnp.float32)
+        if mean is not None and mean.size:
+            bn.buffers["running_mean"] = jnp.asarray(mean.ravel(), jnp.float32)
+            bn.buffers["running_var"] = jnp.asarray(var.ravel(), jnp.float32)
+        return _wrap_nhwc(bn, _nhwc(tf_node), nn), [_canon(ins[0])]
+
+    unary = {
+        "Relu": nn.ReLU, "Relu6": nn.ReLU6, "Elu": nn.ELU,
+        "Sigmoid": nn.Sigmoid, "Tanh": nn.Tanh, "Softplus": nn.SoftPlus,
+        "Softsign": nn.SoftSign, "Abs": nn.Abs, "Exp": nn.Exp, "Log": nn.Log,
+        "Softmax": nn.SoftMax, "LogSoftmax": nn.LogSoftMax,
+        "Square": nn.Square, "Sqrt": nn.Sqrt, "Sign": None,
+    }
+    if op in unary and unary[op] is not None:
+        return unary[op](), [_canon(ins[0])]
+
+    if op in ("BiasAdd", "Add", "AddV2") and len(ins) == 2:
+        # bias fused into a preceding MatMul/Conv2D? then this node is a
+        # passthrough — the producer's converter picks the bias up via
+        # bias_consumer() (TensorflowToBigDL fused-pattern parity).
+        # Either operand order; producer must have no other consumers.
+        for data_in, const_in in ((ins[0], ins[1]), (ins[1], ins[0])):
+            producer = nodes.get(_canon(data_in))
+            if (producer is not None and producer.op in ("MatMul", "Conv2D")
+                    and const_of(const_in) is not None
+                    and const_of(data_in) is None
+                    and len(consumers.get(producer.name, [])) == 1):
+                return None, [_canon(data_in)]  # passthrough to the producer
+
+    if op == "BiasAdd":  # unfused: add const bias on the channel dim
+        bias = const_of(ins[1])
+        if bias is None:
+            raise NotImplementedError("BiasAdd with non-const bias")
+        add = nn.CAdd((int(bias.size),))
+        add.params["bias"] = jnp.asarray(bias.ravel(), jnp.float32)
+        return add, [_canon(ins[0])]
+
+    binary = {"Add": nn.CAddTable, "AddV2": nn.CAddTable, "Sub": nn.CSubTable,
+              "Mul": nn.CMulTable, "Maximum": nn.CMaxTable,
+              "Minimum": nn.CMinTable}
+    if op in binary:
+        return binary[op](), [_canon(i) for i in ins]
+
+    if op in ("ConcatV2", "Concat"):
+        if op == "ConcatV2":
+            axis = int(const_of(ins[-1]).ravel()[0])
+            deps = [_canon(i) for i in ins[:-1]]
+        else:
+            axis = int(const_of(ins[0]).ravel()[0])
+            deps = [_canon(i) for i in ins[1:]]
+        return nn.JoinTable(axis + 1), deps
+
+    if op == "Reshape":
+        shape = const_of(ins[1])
+        if shape is None:
+            raise NotImplementedError("Reshape with dynamic shape")
+        dims = [int(d) for d in shape.ravel()]
+        return nn.InferReshape(dims), [_canon(ins[0])]
+
+    if op == "Squeeze":
+        dims = _attr_list_i(tf_node, "squeeze_dims")
+        if not dims:
+            return nn.Squeeze(), [_canon(ins[0])]
+        seq = nn.Sequential(*[nn.Squeeze(d + 1)
+                              for d in sorted(dims, reverse=True)])
+        return seq, [_canon(ins[0])]
+
+    if op == "LRN":
+        size = 2 * int(tf_node.attr["depth_radius"].i or 5) + 1
+        alpha = (tf_node.attr["alpha"].f or 1.0) * size
+        beta = tf_node.attr["beta"].f or 0.5
+        k = tf_node.attr["bias"].f or 1.0
+        return _wrap_nhwc(nn.SpatialCrossMapLRN(size, alpha, beta, k),
+                          True, nn), [_canon(ins[0])]
+
+    if op == "Pad":
+        pads = const_of(ins[1])
+        if pads is None:
+            raise NotImplementedError("Pad with dynamic paddings")
+        mod = nn.Identity() if not np.any(pads) else _PadModule(pads)
+        return mod, [_canon(ins[0])]
+
+    raise NotImplementedError(
+        f"unsupported TF op {op} at node {name} "
+        "(reference TensorflowLoader throws for unmatched patterns too)")
+
+
+def _wrap_nhwc(module, nhwc: bool, nn):
+    """NHWC input adapter around an NCHW spatial module.  XLA cancels the
+    back-to-back transposes between consecutive wrapped ops at compile
+    time, so this costs one layout change at the graph edges only."""
+    if not nhwc:
+        return module
+    return nn.Sequential(
+        nn.Transpose([(2, 4), (3, 4)]),   # NHWC -> NCHW (1-based swaps)
+        module,
+        nn.Transpose([(2, 4), (2, 3)]))   # NCHW -> NHWC
+
+
+def _PadModule(pads):
+    """Generic N-D zero pad from a TF paddings matrix."""
+    from ..nn.module import TensorModule
+
+    class _Pad(TensorModule):
+        def __init__(self, p):
+            super().__init__()
+            self.pad_cfg = [(int(a), int(b)) for a, b in np.asarray(p)]
+
+        def _apply(self, params, buffers, x, training, rng):
+            return jnp.pad(x, self.pad_cfg), buffers
+
+    return _Pad(pads)
+
+
+class TensorflowSaver:
+    """Module → GraphDef (reference TensorflowSaver.scala,
+    AbstractModule.saveTF:405)."""
+
+    @staticmethod
+    def save(module, input_shape: Sequence[int], path: str,
+             input_name: str = "input", data_format: str = "NCHW"):
+        from .. import nn
+
+        g = tfpb.GraphDef()
+        g.versions.producer = 26
+
+        def add_node(op, name, inputs=(), **attrs):
+            n = g.node.add()
+            n.op = op
+            n.name = name
+            n.input.extend(inputs)
+            for k, v in attrs.items():
+                if isinstance(v, np.ndarray):
+                    n.attr[k].tensor.CopyFrom(tensor_to_proto(v))
+                elif isinstance(v, bool):
+                    n.attr[k].b = v
+                elif k in ("dtype", "T", "type"):
+                    n.attr[k].type = v
+                elif isinstance(v, int):
+                    n.attr[k].i = v
+                elif isinstance(v, float):
+                    n.attr[k].f = v
+                elif isinstance(v, bytes):
+                    n.attr[k].s = v
+                elif isinstance(v, str):
+                    n.attr[k].s = v.encode()
+            return name
+
+        ph = g.node.add()
+        ph.op = "Placeholder"
+        ph.name = input_name
+        ph.attr["dtype"].type = tfpb.DT_FLOAT
+        for d in input_shape:
+            ph.attr["shape"].shape.dim.add().size = int(d)
+
+        if isinstance(module, nn.Sequential):
+            mods = list(module.modules)
+        else:
+            mods = [module]
+
+        prev = input_name
+        idx = [0]
+
+        def emit(m, prev):
+            nm = (m.get_name() or type(m).__name__) + f"_{idx[0]}"
+            idx[0] += 1
+            p = {k: np.asarray(v, np.float32) for k, v in m.params.items()}
+            if isinstance(m, nn.Linear):
+                wname = add_node("Const", nm + "/weight",
+                                 value=np.ascontiguousarray(p["weight"].T),
+                                 dtype=tfpb.DT_FLOAT)
+                out = add_node("MatMul", nm, [prev, wname],
+                               transpose_a=False, transpose_b=False)
+                if m.with_bias:
+                    bname = add_node("Const", nm + "/bias", value=p["bias"],
+                                     dtype=tfpb.DT_FLOAT)
+                    out = add_node("BiasAdd", nm + "/biasadd", [out, bname])
+                return out
+            if isinstance(m, nn.SpatialConvolution):
+                # OIHW -> tf HWIO
+                w = np.transpose(p["weight"], (2, 3, 1, 0))
+                wname = add_node("Const", nm + "/filter",
+                                 value=np.ascontiguousarray(w),
+                                 dtype=tfpb.DT_FLOAT)
+                n = g.node.add()
+                n.op = "Conv2D"
+                n.name = nm
+                n.input.extend([prev, wname])
+                n.attr["strides"].list.i.extend(
+                    [1, 1, m.stride_h, m.stride_w])
+                if m.pad_w == -1 or m.pad_h == -1:
+                    n.attr["padding"].s = b"SAME"
+                elif (m.pad_w, m.pad_h) == (0, 0):
+                    n.attr["padding"].s = b"VALID"
+                else:
+                    n.attr["padding"].s = b"EXPLICIT"
+                    n.attr["explicit_paddings"].list.i.extend(
+                        [0, 0, 0, 0, m.pad_h, m.pad_h, m.pad_w, m.pad_w])
+                n.attr["data_format"].s = b"NCHW"
+                out = nm
+                if m.with_bias:
+                    bname = add_node("Const", nm + "/bias", value=p["bias"],
+                                     dtype=tfpb.DT_FLOAT)
+                    bn = g.node.add()
+                    bn.op = "BiasAdd"
+                    bn.name = nm + "/biasadd"
+                    bn.input.extend([out, bname])
+                    bn.attr["data_format"].s = b"NCHW"
+                    out = bn.name
+                return out
+            if isinstance(m, nn.SpatialMaxPooling) or isinstance(
+                    m, nn.SpatialAveragePooling):
+                n = g.node.add()
+                n.op = ("MaxPool" if isinstance(m, nn.SpatialMaxPooling)
+                        else "AvgPool")
+                n.name = nm
+                n.input.append(prev)
+                n.attr["ksize"].list.i.extend([1, 1, m.kh, m.kw])
+                n.attr["strides"].list.i.extend([1, 1, m.dh, m.dw])
+                n.attr["padding"].s = b"VALID" if (m.pad_w, m.pad_h) == (0, 0) else b"SAME"
+                n.attr["data_format"].s = b"NCHW"
+                return nm
+            simple = {nn.ReLU: "Relu", nn.ReLU6: "Relu6", nn.Tanh: "Tanh",
+                      nn.Sigmoid: "Sigmoid", nn.SoftMax: "Softmax",
+                      nn.LogSoftMax: "LogSoftmax", nn.Abs: "Abs",
+                      nn.Exp: "Exp", nn.Log: "Log", nn.Square: "Square",
+                      nn.Sqrt: "Sqrt", nn.SoftPlus: "Softplus",
+                      nn.SoftSign: "Softsign", nn.ELU: "Elu"}
+            for cls, opname in simple.items():
+                if type(m) is cls:
+                    return add_node(opname, nm, [prev])
+            if isinstance(m, (nn.Reshape, nn.View, nn.InferReshape)):
+                sizes = list(getattr(m, "size", ()) or getattr(m, "sizes", ()))
+                shape = np.asarray([-1] + [int(s) for s in sizes], np.int32)
+                sname = add_node("Const", nm + "/shape", value=shape,
+                                 dtype=tfpb.DT_INT32)
+                return add_node("Reshape", nm, [prev, sname])
+            if isinstance(m, nn.Dropout):
+                return prev  # inference graph: dropout is identity
+            if isinstance(m, nn.Identity):
+                return prev
+            raise NotImplementedError(
+                f"saveTF of {type(m).__name__} not supported")
+
+        for m in mods:
+            prev = emit(m, prev)
+
+        d = os.path.dirname(path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        with open(path, "wb") as f:
+            f.write(g.SerializeToString())
+        return prev  # name of the output node

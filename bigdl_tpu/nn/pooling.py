@@ -35,13 +35,15 @@ class SpatialMaxPooling(TensorModule):
     """NCHW max pool with ceil/floor modes (reference nn/SpatialMaxPooling.scala:43)."""
 
     def __init__(self, kw: int, kh: int, dw: Optional[int] = None,
-                 dh: Optional[int] = None, pad_w: int = 0, pad_h: int = 0):
+                 dh: Optional[int] = None, pad_w: int = 0, pad_h: int = 0,
+                 global_pooling: bool = False):
         super().__init__()
         self.kw, self.kh = kw, kh
         self.dw = dw if dw is not None else kw
         self.dh = dh if dh is not None else kh
         self.pad_w, self.pad_h = pad_w, pad_h
         self.ceil_mode = False
+        self.global_pooling = global_pooling
 
     def ceil(self):
         self.ceil_mode = True
@@ -56,11 +58,14 @@ class SpatialMaxPooling(TensorModule):
         if x.ndim == 3:
             x = x[None]
             squeeze = True
-        ph = _pool_pads(x.shape[2], self.kh, self.dh, self.pad_h, self.ceil_mode)
-        pw = _pool_pads(x.shape[3], self.kw, self.dw, self.pad_w, self.ceil_mode)
+        kh, kw = self.kh, self.kw
+        if self.global_pooling:
+            kh, kw = x.shape[2], x.shape[3]
+        ph = _pool_pads(x.shape[2], kh, self.dh, self.pad_h, self.ceil_mode)
+        pw = _pool_pads(x.shape[3], kw, self.dw, self.pad_w, self.ceil_mode)
         y = lax.reduce_window(
             x, -jnp.inf, lax.max,
-            (1, 1, self.kh, self.kw), (1, 1, self.dh, self.dw),
+            (1, 1, kh, kw), (1, 1, self.dh, self.dw),
             [(0, 0), (0, 0), ph, pw])
         if squeeze:
             y = y[0]

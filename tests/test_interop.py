@@ -1,0 +1,265 @@
+"""Caffe + TensorFlow interop tests (reference test strategy §4 —
+load_caffe_test.py, TensorflowLoaderSpec/TensorflowSaverSpec analogues).
+
+Fixtures are generated in-test: the persister/saver writes an artifact,
+the loader reads it back, forward outputs must match.  Field-number
+compatibility with real Caffe artifacts is covered by a prototxt
+text-format fixture mirroring the upstream schema.
+"""
+import numpy as np
+import pytest
+
+from bigdl_tpu import nn
+from bigdl_tpu.interop import (CaffeLoader, CaffePersister, TensorflowLoader,
+                               TensorflowSaver)
+
+RNG = np.random.RandomState(7)
+
+
+def _small_cnn():
+    return nn.Sequential(
+        nn.SpatialConvolution(3, 4, 3, 3, 1, 1, 1, 1).set_name("conv1"),
+        nn.ReLU().set_name("relu1"),
+        nn.SpatialMaxPooling(2, 2, 2, 2).set_name("pool1"),
+        nn.SpatialConvolution(4, 2, 1, 1).set_name("conv2"),
+        nn.Tanh().set_name("tanh1"))
+
+
+# ---------------------------------------------------------------------------
+# Caffe
+# ---------------------------------------------------------------------------
+
+def test_caffe_persist_and_load_graph(tmp_path):
+    model = _small_cnn().evaluate()
+    proto = str(tmp_path / "net.prototxt")
+    weights = str(tmp_path / "net.caffemodel")
+    CaffePersister.persist(proto, weights, model)
+
+    loaded = CaffeLoader(proto, weights).create_caffe_model().evaluate()
+    x = RNG.rand(2, 3, 8, 8).astype(np.float32)
+    np.testing.assert_allclose(np.asarray(loaded.forward(x)),
+                               np.asarray(model.forward(x)),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_caffe_weight_copy_into_existing_model(tmp_path):
+    model = _small_cnn()
+    proto = str(tmp_path / "net.prototxt")
+    weights = str(tmp_path / "net.caffemodel")
+    CaffePersister.persist(proto, weights, model)
+
+    target = _small_cnn()  # fresh random weights, same layer names
+    CaffeLoader.load(target, proto, weights, match_all=True)
+    np.testing.assert_allclose(
+        np.asarray(target.modules[0].params["weight"]),
+        np.asarray(model.modules[0].params["weight"]), rtol=1e-6)
+    np.testing.assert_allclose(
+        np.asarray(target.modules[3].params["bias"]),
+        np.asarray(model.modules[3].params["bias"]), rtol=1e-6)
+
+
+def test_caffe_match_all_flags_missing_layer(tmp_path):
+    model = _small_cnn()
+    proto = str(tmp_path / "net.prototxt")
+    weights = str(tmp_path / "net.caffemodel")
+    CaffePersister.persist(proto, weights, model)
+
+    target = nn.Sequential(nn.SpatialConvolution(3, 4, 3, 3).set_name("other"))
+    with pytest.raises(ValueError):
+        CaffeLoader.load(target, proto, weights, match_all=True)
+    CaffeLoader.load(target, proto, weights, match_all=False)  # tolerated
+
+
+def test_caffe_prototxt_text_format_parse(tmp_path):
+    """A hand-written upstream-style prototxt parses through our schema
+    subset (InnerProduct with bias_term=false, fillers, loss layer)."""
+    prototxt = tmp_path / "deploy.prototxt"
+    prototxt.write_text("""
+name: "tiny"
+input: "data"
+input_dim: 1
+input_dim: 3
+input_dim: 4
+input_dim: 4
+layer {
+  name: "conv" type: "Convolution" bottom: "data" top: "conv"
+  convolution_param { num_output: 2 kernel_size: 3 stride: 1
+    weight_filler { type: "xavier" } }
+}
+layer { name: "relu" type: "ReLU" bottom: "conv" top: "conv" }
+layer {
+  name: "ip" type: "InnerProduct" bottom: "conv" top: "out"
+  inner_product_param { num_output: 5 bias_term: false }
+}
+layer { name: "loss" type: "SoftmaxWithLoss" bottom: "out" top: "loss" }
+""")
+    # weights: build the matching caffemodel via protobuf directly
+    import sys, os
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        __import__("bigdl_tpu.interop.caffe", fromlist=["x"]).__file__),
+        "protos"))
+    import caffe_pb2
+    net = caffe_pb2.NetParameter()
+    net.name = "tiny"
+    l1 = net.layer.add(); l1.name = "conv"; l1.type = "Convolution"
+    w = RNG.rand(2, 3, 3, 3).astype(np.float32)
+    b = RNG.rand(2).astype(np.float32)
+    for arr in (w, b):
+        blob = l1.blobs.add()
+        blob.shape.dim.extend(arr.shape)
+        blob.data.extend(arr.ravel().tolist())
+    l2 = net.layer.add(); l2.name = "ip"; l2.type = "InnerProduct"
+    ipw = RNG.rand(5, 8).astype(np.float32)
+    blob = l2.blobs.add()
+    blob.shape.dim.extend(ipw.shape)
+    blob.data.extend(ipw.ravel().tolist())
+    model_path = tmp_path / "tiny.caffemodel"
+    model_path.write_bytes(net.SerializeToString())
+
+    g = CaffeLoader(str(prototxt), str(model_path)).create_caffe_model()
+    x = RNG.rand(1, 3, 4, 4).astype(np.float32)
+    out = np.asarray(g.evaluate().forward(x))
+    assert out.shape == (1, 5)
+    # conv(3x3,no pad) -> (1,2,2,2) -> flatten 8 -> 5, then softmax head
+    np.testing.assert_allclose(out.sum(), 1.0, rtol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# TensorFlow
+# ---------------------------------------------------------------------------
+
+def test_tf_save_load_mlp(tmp_path):
+    model = nn.Sequential(
+        nn.Linear(6, 10).set_name("fc1"), nn.ReLU(),
+        nn.Linear(10, 3).set_name("fc2"), nn.SoftMax()).evaluate()
+    path = str(tmp_path / "mlp.pb")
+    out_name = TensorflowSaver.save(model, (1, 6), path)
+
+    loaded = TensorflowLoader.load(path, ["input"], [out_name]).evaluate()
+    x = RNG.rand(4, 6).astype(np.float32)
+    np.testing.assert_allclose(np.asarray(loaded.forward(x)),
+                               np.asarray(model.forward(x)),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_tf_save_load_cnn_nchw(tmp_path):
+    model = nn.Sequential(
+        nn.SpatialConvolution(1, 4, 3, 3).set_name("c1"),
+        nn.ReLU(),
+        nn.SpatialMaxPooling(2, 2, 2, 2),
+        nn.Reshape([4 * 3 * 3]),
+        nn.Linear(36, 5)).evaluate()
+    path = str(tmp_path / "cnn.pb")
+    out_name = TensorflowSaver.save(model, (1, 1, 8, 8), path)
+
+    loaded = TensorflowLoader.load(path, ["input"], [out_name]).evaluate()
+    x = RNG.rand(2, 1, 8, 8).astype(np.float32)
+    np.testing.assert_allclose(np.asarray(loaded.forward(x)),
+                               np.asarray(model.forward(x)),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_tf_save_load_padded_conv(tmp_path):
+    """Explicit conv padding survives the GraphDef round-trip
+    (EXPLICIT padding + explicit_paddings attr)."""
+    model = nn.Sequential(
+        nn.SpatialConvolution(2, 3, 3, 3, 1, 1, 1, 1).set_name("c")).evaluate()
+    path = str(tmp_path / "pad.pb")
+    out_name = TensorflowSaver.save(model, (1, 2, 6, 6), path)
+    loaded = TensorflowLoader.load(path, ["input"], [out_name]).evaluate()
+    x = RNG.rand(2, 2, 6, 6).astype(np.float32)
+    np.testing.assert_allclose(np.asarray(loaded.forward(x)),
+                               np.asarray(model.forward(x)),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_caffe_nonsquare_kernel_hw_order(tmp_path):
+    """caffe repeated kernel_size is (h, w) ordered — a 3x5 kernel maps
+    to kh=3, kw=5."""
+    prototxt = tmp_path / "k.prototxt"
+    prototxt.write_text("""
+name: "k"
+input: "data"
+input_dim: 1
+input_dim: 1
+input_dim: 7
+input_dim: 9
+layer {
+  name: "conv" type: "Convolution" bottom: "data" top: "conv"
+  convolution_param { num_output: 2 kernel_size: 3 kernel_size: 5 }
+}
+""")
+    import sys, os
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        __import__("bigdl_tpu.interop.caffe", fromlist=["x"]).__file__),
+        "protos"))
+    import caffe_pb2
+    net = caffe_pb2.NetParameter()
+    l1 = net.layer.add(); l1.name = "conv"; l1.type = "Convolution"
+    w = RNG.rand(2, 1, 3, 5).astype(np.float32)  # (O, I, kH, kW)
+    blob = l1.blobs.add()
+    blob.shape.dim.extend(w.shape)
+    blob.data.extend(w.ravel().tolist())
+    model_path = tmp_path / "k.caffemodel"
+    model_path.write_bytes(net.SerializeToString())
+
+    g = CaffeLoader(str(prototxt), str(model_path)).create_caffe_model()
+    x = RNG.rand(1, 1, 7, 9).astype(np.float32)
+    out = np.asarray(g.evaluate().forward(x))
+    assert out.shape == (1, 2, 5, 5)  # (7-3+1, 9-5+1)
+
+
+def test_tf_nhwc_conv_graph():
+    """A hand-built NHWC GraphDef (the TF default layout) loads with
+    transpose adapters and matches a manual conv."""
+    from bigdl_tpu.interop.tensorflow import tfpb, tensor_to_proto
+
+    g = tfpb.GraphDef()
+    ph = g.node.add(); ph.op = "Placeholder"; ph.name = "x"
+    w = RNG.rand(3, 3, 2, 4).astype(np.float32)  # HWIO
+    c = g.node.add(); c.op = "Const"; c.name = "w"
+    c.attr["value"].tensor.CopyFrom(tensor_to_proto(w))
+    conv = g.node.add(); conv.op = "Conv2D"; conv.name = "conv"
+    conv.input.extend(["x", "w"])
+    conv.attr["strides"].list.i.extend([1, 1, 1, 1])
+    conv.attr["padding"].s = b"SAME"
+    conv.attr["data_format"].s = b"NHWC"
+    relu = g.node.add(); relu.op = "Relu"; relu.name = "relu"
+    relu.input.append("conv")
+
+    model = TensorflowLoader.build(g, ["x"], ["relu"]).evaluate()
+    x = RNG.rand(2, 5, 5, 2).astype(np.float32)  # NHWC
+    out = np.asarray(model.forward(x))
+    assert out.shape == (2, 5, 5, 4)
+
+    import jax
+    from jax import lax
+    ref = lax.conv_general_dilated(
+        x, w, (1, 1), "SAME", dimension_numbers=("NHWC", "HWIO", "NHWC"))
+    np.testing.assert_allclose(out, np.maximum(np.asarray(ref), 0),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_tf_bias_fusion():
+    """MatMul + BiasAdd fuses into one Linear (reference
+    TensorflowToBigDL pattern table)."""
+    from bigdl_tpu.interop.tensorflow import tfpb, tensor_to_proto
+
+    g = tfpb.GraphDef()
+    ph = g.node.add(); ph.op = "Placeholder"; ph.name = "x"
+    w = RNG.rand(6, 3).astype(np.float32)
+    b = RNG.rand(3).astype(np.float32)
+    for nm, arr in (("w", w), ("b", b)):
+        c = g.node.add(); c.op = "Const"; c.name = nm
+        c.attr["value"].tensor.CopyFrom(tensor_to_proto(arr))
+    mm = g.node.add(); mm.op = "MatMul"; mm.name = "mm"
+    mm.input.extend(["x", "w"])
+    ba = g.node.add(); ba.op = "BiasAdd"; ba.name = "ba"
+    ba.input.extend(["mm", "b"])
+
+    model = TensorflowLoader.build(g, ["x"], ["ba"]).evaluate()
+    linears = [m for m in model.modules_iter() if isinstance(m, nn.Linear)]
+    assert len(linears) == 1 and linears[0].with_bias
+    x = RNG.rand(5, 6).astype(np.float32)
+    np.testing.assert_allclose(np.asarray(model.forward(x)), x @ w + b,
+                               rtol=1e-5, atol=1e-6)
