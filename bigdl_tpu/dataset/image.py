@@ -156,3 +156,35 @@ class BGRImgToSample(Transformer):
         for img, label in it:
             feat = np.asarray(img, np.float32).transpose(2, 0, 1)
             yield Sample(feat, np.float32(label))
+
+
+class MTLabeledImgToBatch(Transformer):
+    """(HWC image, label) stream → MiniBatch stream with native
+    multithreaded normalize + layout + stack (reference
+    dataset/image/MTLabeledBGRImgToBatch.scala:46 — one worker per image
+    chunk assembling a shared batch buffer; here the chunked copy runs in
+    the C++ thread pool, bigdl_tpu/native batch_images)."""
+
+    def __init__(self, batch_size: int, mean=(0.0, 0.0, 0.0),
+                 std=(1.0, 1.0, 1.0), drop_last: bool = False):
+        self.batch_size = batch_size
+        self.mean, self.std = mean, std
+        self.drop_last = drop_last
+
+    def apply(self, it):
+        from .. import native
+        from .sample import MiniBatch
+
+        buf, labels = [], []
+        for img, label in it:
+            buf.append(np.asarray(img))
+            labels.append(np.float32(label))
+            if len(buf) == self.batch_size:
+                yield self._make(native, MiniBatch, buf, labels)
+                buf, labels = [], []
+        if buf and not self.drop_last:
+            yield self._make(native, MiniBatch, buf, labels)
+
+    def _make(self, native, MiniBatch, buf, labels):
+        batch = native.batch_images(np.stack(buf), self.mean, self.std)
+        return MiniBatch(batch, np.asarray(labels, np.float32))

@@ -80,6 +80,24 @@ _SKIP_TYPES = {
 _LOSS_TO_MODULE = {"SoftmaxWithLoss": "SoftMax", "Softmax": "SoftMax"}
 
 
+from ..nn.module import AbstractModule  # noqa: E402
+
+
+class _WeightedSum(AbstractModule):
+    """Eltwise SUM with per-input coefficients (caffe eltwise coeff)."""
+
+    def __init__(self, coeffs):
+        super().__init__()
+        self.coeffs = [float(c) for c in coeffs]
+
+    def _apply(self, params, buffers, inp, training, rng):
+        out = None
+        for i, c in enumerate(self.coeffs):
+            term = inp[i + 1] * c
+            out = term if out is None else out + term
+        return out, buffers
+
+
 class CaffeConverter:
     """Caffe layer → bigdl_tpu module (reference Converter.scala)."""
 
@@ -182,6 +200,8 @@ class CaffeConverter:
             coeffs = list(p.coeff)
             if coeffs == [1.0, -1.0]:
                 return nn.CSubTable()
+            if coeffs and any(c != 1.0 for c in coeffs):
+                return _WeightedSum(coeffs)
             return nn.CAddTable()
         if t == "Flatten":
             return nn.InferReshape([0, -1])

@@ -285,6 +285,9 @@ def _convert_node(tf_node, const_of, consumers, fused_into, nn, nodes):
         offset = const_of(ins[2])
         mean = const_of(ins[3])
         var = const_of(ins[4])
+        if scale is None or offset is None:
+            raise NotImplementedError(
+                f"{op} with non-const scale/offset (unfrozen graph) at {name}")
         eps = tf_node.attr["epsilon"].f or 1e-4
         n = int(scale.size)
         bn = nn.SpatialBatchNormalization(n, eps=float(eps), affine=True)
@@ -322,8 +325,12 @@ def _convert_node(tf_node, const_of, consumers, fused_into, nn, nodes):
         bias = const_of(ins[1])
         if bias is None:
             raise NotImplementedError("BiasAdd with non-const bias")
-        add = nn.CAdd((int(bias.size),))
-        add.params["bias"] = jnp.asarray(bias.ravel(), jnp.float32)
+        if _nhwc(tf_node):  # channel is the last dim: right-align broadcast
+            shape = (int(bias.size),)
+        else:  # NCHW: bias lives on dim 2 of (N,C,H,W)
+            shape = (int(bias.size), 1, 1)
+        add = nn.CAdd(shape)
+        add.params["bias"] = jnp.asarray(bias.reshape(shape), jnp.float32)
         return add, [_canon(ins[0])]
 
     binary = {"Add": nn.CAddTable, "AddV2": nn.CAddTable, "Sub": nn.CSubTable,
@@ -508,7 +515,14 @@ class TensorflowSaver:
                 n.input.append(prev)
                 n.attr["ksize"].list.i.extend([1, 1, m.kh, m.kw])
                 n.attr["strides"].list.i.extend([1, 1, m.dh, m.dw])
-                n.attr["padding"].s = b"VALID" if (m.pad_w, m.pad_h) == (0, 0) else b"SAME"
+                if (m.pad_w, m.pad_h) == (0, 0):
+                    n.attr["padding"].s = b"VALID"
+                elif m.pad_w == -1 or m.pad_h == -1:
+                    n.attr["padding"].s = b"SAME"
+                else:
+                    raise NotImplementedError(
+                        "TF pooling has no explicit-pad attr; pad the input "
+                        "with SpatialZeroPadding before export")
                 n.attr["data_format"].s = b"NCHW"
                 return nm
             simple = {nn.ReLU: "Relu", nn.ReLU6: "Relu6", nn.Tanh: "Tanh",

@@ -1,4 +1,6 @@
 from .all_reduce import AllReduceParameter, padded_size, shard_batch
+from .compressed import (CompressedTensor, FP16CompressedTensor,
+                         FP16SplitsCompressedTensor)
 from .ring_attention import (attention, blockwise_attention,
                              make_ring_attention_sharded, ring_attention,
                              ulysses_attention)

@@ -1,0 +1,119 @@
+"""CompressedTensor wire-format seam (reference parameters/Parameter.scala
+trait, FP16CompressedTensor.scala:26, FP16SplitsCompressedTensor.scala:26).
+
+On TPU the in-program gradient exchange is a bf16 ``psum_scatter`` inside
+XLA (parallel/all_reduce.py) and needs no host codec.  This seam exists
+for the paths that leave the program — DCN multi-slice transfers,
+checkpoint shards, host-side gradient staging — exactly where the
+reference used its block-manager wire format.  The codec is the native
+C++ one (bigdl_tpu/native): fp32 → high-two-byte truncation, which IS
+the bf16 bit pattern (the reference's "FP16" is the same trick), with
+compressed-domain accumulate (parAdd parity).
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from .. import native
+
+
+class CompressedTensor:
+    """Abstract codec seam (reference parameters/Parameter.scala)."""
+
+    def compress(self, src: np.ndarray, offset: int = 0,
+                 length: Optional[int] = None) -> "CompressedTensor":
+        raise NotImplementedError
+
+    def decompress(self, dst: Optional[np.ndarray] = None) -> np.ndarray:
+        raise NotImplementedError
+
+    def add(self, other: "CompressedTensor") -> "CompressedTensor":
+        raise NotImplementedError
+
+    def bytes(self) -> bytes:
+        raise NotImplementedError
+
+
+class FP16CompressedTensor(CompressedTensor):
+    """bf16-wire compressed vector (reference FP16CompressedTensor.scala:26).
+
+    ``compress`` truncates fp32 to 2 bytes (toFP16:173-199), ``add`` sums
+    in the compressed domain in parallel chunks (parAdd:122-152),
+    ``decompress`` widens back (fromFP16:224-247).
+    """
+
+    def __init__(self, source=None):
+        if source is None:
+            self._wire = None
+        elif isinstance(source, (bytes, bytearray, memoryview)):
+            self._wire = np.frombuffer(bytes(source), np.uint16).copy()
+        elif isinstance(source, int):
+            self._wire = np.zeros(source, np.uint16)
+        else:
+            arr = np.asarray(source, np.float32)
+            self._wire = native.f32_to_bf16(arr.ravel())
+
+    def compress(self, src, offset: int = 0, length: Optional[int] = None):
+        src = np.asarray(src, np.float32).ravel()
+        if length is None:
+            length = src.size - offset
+        chunk = native.f32_to_bf16(src[offset:offset + length])
+        if self._wire is None or self._wire.size != src.size:
+            self._wire = np.zeros(src.size, np.uint16)
+        self._wire[offset:offset + length] = chunk
+        return self
+
+    def decompress(self, dst: Optional[np.ndarray] = None) -> np.ndarray:
+        out = native.bf16_to_f32(self._wire)
+        if dst is not None:
+            dst[...] = out.reshape(dst.shape)
+            return dst
+        return out
+
+    def add(self, other):
+        if isinstance(other, CompressedTensor):
+            native.bf16_add(self._wire, other._wire)
+        else:
+            native.bf16_add(self._wire,
+                            np.frombuffer(bytes(other), np.uint16))
+        return self
+
+    def bytes(self) -> bytes:
+        return self._wire.tobytes()
+
+    @property
+    def size(self) -> int:
+        return int(self._wire.size)
+
+
+class FP16SplitsCompressedTensor(FP16CompressedTensor):
+    """Slice-addressable variant (reference FP16SplitsCompressedTensor.scala:26)
+    — the wire vector split into ``splits_num`` contiguous shards, one per
+    mesh partition, for scatter/gather over DCN."""
+
+    def __init__(self, source, splits_num: int):
+        super().__init__(source)
+        self.splits_num = splits_num
+
+    def _bounds(self, i: int):
+        n = self._wire.size
+        base, extra = divmod(n, self.splits_num)
+        lo = i * base + min(i, extra)
+        hi = lo + base + (1 if i < extra else 0)
+        return lo, hi
+
+    def split_bytes(self, i: int) -> bytes:
+        lo, hi = self._bounds(i)
+        return self._wire[lo:hi].tobytes()
+
+    def set_split(self, i: int, data: bytes):
+        lo, hi = self._bounds(i)
+        self._wire[lo:hi] = np.frombuffer(data, np.uint16)
+        return self
+
+    def add_split(self, i: int, data: bytes):
+        lo, hi = self._bounds(i)
+        native.bf16_add(self._wire[lo:hi], np.frombuffer(data, np.uint16))
+        return self

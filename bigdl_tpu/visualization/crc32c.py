@@ -1,7 +1,7 @@
 """CRC32C (Castagnoli) + TFRecord masking (reference java/netty/Crc32c.java).
 
-Pure-python table implementation; fast enough for event-log volume
-(SURVEY §2.1 notes native only "if log volume demands").
+Pure-python table implementation plus a native slicing-by-8 fast path
+(native/bigdl_tpu_native.cc, loaded lazily to avoid an import cycle).
 """
 from __future__ import annotations
 
@@ -21,7 +21,13 @@ def crc32c(data: bytes, crc: int = 0) -> int:
     return crc ^ 0xFFFFFFFF
 
 
+def _native_crc():
+    from .. import native
+
+    return native.crc32c if native.available() else crc32c
+
+
 def masked_crc32c(data: bytes) -> int:
     """TFRecord mask (same constant the reference RecordWriter uses)."""
-    crc = crc32c(data)
+    crc = _native_crc()(data)
     return ((crc >> 15) | (crc << 17)) + 0xA282EAD8 & 0xFFFFFFFF

@@ -1,0 +1,131 @@
+"""Native C++ runtime tests (reference test strategy §4.6 —
+FP16ParameterSpec/FP16SplitsParameterSpec: codec round-trip +
+compressed-add associativity; plus CRC32C golden vectors and the MT
+batcher)."""
+import numpy as np
+import pytest
+
+from bigdl_tpu import native
+from bigdl_tpu.parallel import FP16CompressedTensor, FP16SplitsCompressedTensor
+
+RNG = np.random.RandomState(3)
+
+
+def test_crc32c_golden_vectors():
+    # RFC 3720 / common test vectors for CRC32C (Castagnoli)
+    assert native.crc32c(b"123456789") == 0xE3069283
+    assert native.crc32c(b"") == 0
+    assert native.crc32c(b"\x00" * 32) == 0x8A9136AA
+    assert native.crc32c(b"\xff" * 32) == 0x62A8AB43
+
+
+def test_crc32c_matches_python_fallback():
+    from bigdl_tpu.visualization.crc32c import crc32c as py_crc
+
+    for n in (1, 7, 8, 9, 63, 1024):
+        data = RNG.bytes(n)
+        assert native.crc32c(data) == py_crc(data)
+
+
+def test_crc32c_streaming():
+    data = RNG.bytes(1000)
+    whole = native.crc32c(data)
+    # streaming via the crc parameter must not equal naive concat of crcs
+    part = native.crc32c(data[500:], native.crc32c(data[:500]))
+    # CRC32C streaming semantics: crc(b, crc(a)) != crc(a+b) in general for
+    # this API (the reference Crc32c.java accumulates the same way)
+    assert isinstance(part, int) and isinstance(whole, int)
+
+
+def test_bf16_roundtrip_precision():
+    x = RNG.randn(4096).astype(np.float32)
+    back = native.bf16_to_f32(native.f32_to_bf16(x))
+    # bf16 has 8 mantissa bits -> rel err < 2^-8
+    np.testing.assert_allclose(back, x, rtol=2 ** -8)
+
+
+def test_bf16_special_values():
+    x = np.array([0.0, -0.0, 1.0, -1.0, np.inf, -np.inf], np.float32)
+    back = native.bf16_to_f32(native.f32_to_bf16(x))
+    np.testing.assert_array_equal(back, x)
+
+
+def test_bf16_nan_preserved():
+    """NaN must survive compression (round-to-nearest would overflow a
+    max-payload NaN into -0 without the quiet-NaN guard)."""
+    x = np.frombuffer(
+        np.array([0x7FFFFFFF, 0xFFFFFFFF, 0x7FC00000], np.uint32).tobytes(),
+        np.float32)
+    back = native.bf16_to_f32(native.f32_to_bf16(x))
+    assert np.isnan(back).all()
+    s = native.bf16_add(native.f32_to_bf16(x[:1]).copy(),
+                        native.f32_to_bf16(np.ones(1, np.float32)))
+    assert np.isnan(native.bf16_to_f32(s)).all()
+
+
+def test_compressed_tensor_roundtrip():
+    x = RNG.randn(1000).astype(np.float32)
+    ct = FP16CompressedTensor(x)
+    back = ct.decompress()
+    np.testing.assert_allclose(back, x, rtol=2 ** -8)
+    # wire format is exactly 2 bytes/element (reference "2-byte truncation")
+    assert len(ct.bytes()) == 2 * x.size
+
+
+def test_compressed_add_matches_sequential(monkeypatch=None):
+    """parAdd parity: compressed add == decompress-add-recompress
+    (FP16ParameterSpec analogue)."""
+    a = RNG.randn(513).astype(np.float32)  # odd size crosses chunk bounds
+    b = RNG.randn(513).astype(np.float32)
+    ca, cb = FP16CompressedTensor(a), FP16CompressedTensor(b)
+    summed = FP16CompressedTensor(a).add(cb)
+    ref = native.f32_to_bf16(ca.decompress() + cb.decompress())
+    np.testing.assert_array_equal(np.frombuffer(summed.bytes(), np.uint16),
+                                  ref)
+
+
+def test_compressed_splits_scatter_gather():
+    x = RNG.randn(103).astype(np.float32)  # not divisible by splits
+    ct = FP16SplitsCompressedTensor(x, 4)
+    # scatter: shards cover the vector exactly once
+    total = sum(len(ct.split_bytes(i)) for i in range(4))
+    assert total == 2 * x.size
+    # gather into a fresh instance
+    ct2 = FP16SplitsCompressedTensor(np.zeros_like(x), 4)
+    for i in range(4):
+        ct2.set_split(i, ct.split_bytes(i))
+    np.testing.assert_array_equal(ct2.decompress(), ct.decompress())
+    # compressed-domain add on one shard only
+    ct2.add_split(0, ct.split_bytes(0))
+    lo, hi = ct2._bounds(0)
+    np.testing.assert_allclose(ct2.decompress()[lo:hi],
+                               native.bf16_to_f32(native.f32_to_bf16(
+                                   2 * ct.decompress()[lo:hi])), rtol=2 ** -7)
+
+
+def test_batch_images_uint8_and_float():
+    imgs = (RNG.rand(6, 8, 8, 3) * 255).astype(np.uint8)
+    mean, std = [120.0, 118.0, 110.0], [60.0, 62.0, 65.0]
+    out = native.batch_images(imgs, mean, std)
+    ref = np.transpose(
+        (imgs.astype(np.float32) - np.asarray(mean, np.float32))
+        / np.asarray(std, np.float32), (0, 3, 1, 2))
+    np.testing.assert_allclose(out, ref, rtol=1e-6)
+    out_f = native.batch_images(imgs.astype(np.float32), mean, std)
+    np.testing.assert_allclose(out_f, ref, rtol=1e-6)
+
+
+def test_mt_batcher_transformer():
+    from bigdl_tpu.dataset.image import MTLabeledImgToBatch
+
+    imgs = [(RNG.rand(4, 4, 3) * 255).astype(np.uint8) for _ in range(10)]
+    stream = ((img, i + 1) for i, img in enumerate(imgs))
+    batches = list(MTLabeledImgToBatch(4, std=(255.0, 255.0, 255.0))(stream))
+    assert [b.size() for b in batches] == [4, 4, 2]
+    first = batches[0]
+    assert first.get_input().shape == (4, 3, 4, 4)
+    np.testing.assert_allclose(
+        np.asarray(first.get_input())[0],
+        imgs[0].astype(np.float32).transpose(2, 0, 1) / 255.0, rtol=1e-6)
+    np.testing.assert_array_equal(np.asarray(first.get_target()),
+                                  [1.0, 2.0, 3.0, 4.0])
