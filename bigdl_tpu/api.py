@@ -1,0 +1,191 @@
+"""Flat API facade (reference python/api/PythonBigDL.scala:80 and the
+pyspark reflection bridge pyspark/bigdl/util/common.py:79-90).
+
+The reference's Python API reaches the JVM through one facade object
+exposing ``create<LayerName>`` per layer plus model-level verbs
+(``modelForward``, ``modelTest``, ``loadBigDL``…).  This framework IS
+Python, so no socket bridge survives — but the flat factory registry is
+kept so code written against the ``create*`` contract (and the
+documented layer names) ports directly: ``api.create_linear(...)``,
+``api.createLinear(...)`` and ``api.create("Linear", ...)`` all work.
+"""
+from __future__ import annotations
+
+import re
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from . import nn
+from .dataset import Sample
+from .dataset.dataset import array
+from .utils import Engine, init_engine, set_global_seed  # noqa: F401
+
+
+_BASES = ("AbstractModule", "AbstractCriterion", "Container", "TensorModule",
+          "Cell", "ModuleNode")
+
+
+def _registry() -> Dict[str, type]:
+    from .nn.criterion import AbstractCriterion
+    from .nn.module import AbstractModule
+    from .nn.initialization import InitializationMethod
+
+    reg = {}
+    for name in dir(nn):
+        obj = getattr(nn, name)
+        if (isinstance(obj, type) and not name.startswith("_")
+                and name not in _BASES
+                and issubclass(obj, (AbstractModule, AbstractCriterion,
+                                     InitializationMethod))):
+            reg[name] = obj
+    return reg
+
+
+_REGISTRY = _registry()
+_SNAKE = re.compile(r"(?<!^)(?=[A-Z])")
+
+
+def layer_names() -> List[str]:
+    return sorted(_REGISTRY)
+
+
+def create(name: str, *args, **kwargs):
+    """Factory by reference layer name (PythonBigDL.scala create* methods)."""
+    if name not in _REGISTRY:
+        raise ValueError(f"unknown layer/criterion: {name!r}")
+    return _REGISTRY[name](*args, **kwargs)
+
+
+def __getattr__(attr: str):
+    """PEP-562 reflection mirroring JavaValue.jvm_class_constructor:
+    ``create_linear`` / ``createLinear`` / ``createSpatialConvolution``."""
+    if attr.startswith("create"):
+        raw = attr[len("create"):].lstrip("_")
+        # exact CamelCase match first, then case-insensitive snake match
+        if raw in _REGISTRY:
+            return lambda *a, **k: create(raw, *a, **k)
+        flat = raw.replace("_", "").lower()
+        for name in _REGISTRY:
+            if name.lower() == flat:
+                return lambda *a, **k: create(name, *a, **k)
+        raise AttributeError(f"no layer matches {attr!r}")
+    raise AttributeError(attr)
+
+
+# ----------------------------------------------------------------- model verbs
+def model_forward(model, inp):
+    """PythonBigDL.modelForward (:1421)."""
+    return np.asarray(model.forward(inp))
+
+
+def model_backward(model, inp, grad_output):
+    """PythonBigDL.modelBackward."""
+    out = model.backward(inp, grad_output)
+    return np.asarray(out) if not isinstance(out, (list, tuple)) else out
+
+
+def model_get_parameters(model):
+    """Flattened (weights, gradients) like getParameters (:1460)."""
+    w, g = model.get_parameters()
+    return np.asarray(w), np.asarray(g)
+
+
+def model_test(model, features, labels, batch_size: int, val_methods):
+    """PythonBigDL.modelTest (:1341): evaluate arrays with validation
+    methods, returning [(result, name)] pairs."""
+    from .optim.evaluator import Evaluator
+
+    samples = to_sample_rdd(features, labels)
+    return Evaluator(model).test(array(samples), val_methods,
+                                 batch_size=batch_size)
+
+
+def model_predict(model, features, batch_size: int = 32):
+    """PythonBigDL.modelPredictRDD."""
+    from .optim.predictor import Predictor
+
+    samples = [Sample(np.asarray(f, np.float32), np.float32(0)) for f in features]
+    return Predictor(model).predict(array(samples), batch_size=batch_size)
+
+
+def model_predict_class(model, features, batch_size: int = 32):
+    out = model_predict(model, features, batch_size)
+    return [int(np.asarray(o).argmax()) + 1 for o in out]
+
+
+def to_sample_rdd(features, labels) -> List[Sample]:
+    """numpy arrays → Sample list (PythonBigDL.toJSample :141-176)."""
+    return [Sample(np.asarray(f, np.float32), np.asarray(l, np.float32))
+            for f, l in zip(features, labels)]
+
+
+# ----------------------------------------------------------------- optimizer
+def create_optimizer(model, training_set, criterion, optim_method,
+                     end_trigger, batch_size: int, mesh=None):
+    """PythonBigDL.createOptimizer (:1595)."""
+    from .optim.optimizer import LocalOptimizer
+    from .optim.distri_optimizer import DistriOptimizer
+
+    if not hasattr(training_set, "data"):
+        training_set = array(list(training_set))
+    if mesh is not None:
+        opt = DistriOptimizer(model, training_set, criterion,
+                              batch_size=batch_size, mesh=mesh)
+    else:
+        opt = LocalOptimizer(model, training_set, criterion,
+                             batch_size=batch_size)
+    opt.set_optim_method(optim_method)
+    opt.set_end_when(end_trigger)
+    return opt
+
+
+# ----------------------------------------------------------------- load/save
+def load_bigdl(path: str):
+    """PythonBigDL.loadBigDL (:1355)."""
+    from .utils import file_io
+
+    return file_io.load_module(path)
+
+
+def load_torch(path: str):
+    """PythonBigDL.loadTorch (:1361) — Torch7 .t7 codec."""
+    from .utils import torch_file
+
+    return torch_file.load(path)
+
+
+def load_caffe(model, def_path: str, model_path: str,
+               match_all: bool = True):
+    """PythonBigDL.loadCaffe (:1367)."""
+    from .interop.caffe import CaffeLoader
+
+    return CaffeLoader.load(model, def_path, model_path, match_all=match_all)
+
+
+def load_caffe_model(def_path: str, model_path: str):
+    from .interop.caffe import CaffeLoader
+
+    return CaffeLoader(def_path, model_path).create_caffe_model()
+
+
+def load_tf(path: str, inputs: Optional[List[str]] = None,
+            outputs: Optional[List[str]] = None):
+    """PythonBigDL.loadTF (:1374)."""
+    from .interop.tensorflow import TensorflowLoader
+
+    return TensorflowLoader.load(path, inputs=inputs or [],
+                                 outputs=outputs or [])
+
+
+# ----------------------------------------------------------------- summaries
+def summary_read_scalar(log_dir: str, tag: str):
+    """PythonBigDL.summaryReadScalar (:1656)."""
+    from .visualization.summary import read_scalars
+
+    return read_scalars(log_dir, tag)
+
+
+def summary_set_trigger(summary, name: str, trigger):
+    summary.set_summary_trigger(name, trigger)
+    return summary

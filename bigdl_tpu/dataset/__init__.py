@@ -6,4 +6,4 @@ from .sample import (
     MiniBatch, PaddingParam, Sample, SampleToBatch, SampleToMiniBatch,
 )
 from .transformer import ChainedTransformer, FnTransformer, Transformer, transformer
-from . import datasets, image
+from . import datasets, image, text

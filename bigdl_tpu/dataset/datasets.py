@@ -80,3 +80,63 @@ def load_cifar10(data_dir: Optional[str] = None, train: bool = True,
                     np.concatenate(labels).astype(np.float32) + 1)
     n = synthetic_size if train else synthetic_size // 4
     return _synthetic_images(n, (32, 32, 3), 10, seed=2 if train else 3)
+
+
+def load_news20(data_dir: Optional[str] = None, train: bool = True,
+                synthetic_size: int = 512, n_classes: int = 20):
+    """20-newsgroups-style corpus: list of (text, label 1-based float)
+    (reference pyspark/bigdl/dataset/news20.py get_news20).
+
+    When ``data_dir`` holds the extracted ``20_newsgroup/<group>/<file>``
+    tree it is read; otherwise a synthetic corpus with class-specific
+    keyword distributions (learnable by a bag-of-words classifier) is
+    generated.
+    """
+    if data_dir and os.path.isdir(data_dir):
+        texts = []
+        groups = sorted(d for d in os.listdir(data_dir)
+                        if os.path.isdir(os.path.join(data_dir, d)))
+        for label, group in enumerate(groups, start=1):
+            gdir = os.path.join(data_dir, group)
+            for fname in sorted(os.listdir(gdir)):
+                with open(os.path.join(gdir, fname), "rb") as f:
+                    texts.append((f.read().decode("latin1"),
+                                  np.float32(label)))
+        if texts:
+            return texts
+    rng = np.random.RandomState(10 if train else 11)
+    # 8 keywords per class + shared filler vocabulary
+    filler = [f"word{i}" for i in range(100)]
+    out = []
+    for _ in range(synthetic_size if train else synthetic_size // 4):
+        label = rng.randint(1, n_classes + 1)
+        keywords = [f"topic{label}kw{k}" for k in range(8)]
+        n_words = rng.randint(20, 60)
+        words = [keywords[rng.randint(8)] if rng.rand() < 0.4
+                 else filler[rng.randint(100)] for _ in range(n_words)]
+        out.append((" ".join(words), np.float32(label)))
+    return out
+
+
+def get_glove_w2v(data_dir: Optional[str] = None, dim: int = 50,
+                  vocab: Optional[list] = None):
+    """word → vector map (reference pyspark/bigdl/dataset/news20.py
+    get_glove_w2v).  Reads ``glove.6B.<dim>d.txt`` when present; otherwise
+    deterministic random vectors per word (hash-seeded, stable across
+    runs) for the given ``vocab``.
+    """
+    if data_dir:
+        path = os.path.join(data_dir, f"glove.6B.{dim}d.txt")
+        if os.path.exists(path):
+            w2v = {}
+            with open(path, encoding="utf8") as f:
+                for line in f:
+                    parts = line.rstrip().split(" ")
+                    w2v[parts[0]] = np.asarray(parts[1:], np.float32)
+            return w2v
+    import zlib
+    w2v = {}
+    for word in vocab or []:
+        seed = zlib.crc32(word.encode("utf8")) % (2 ** 31)
+        w2v[word] = np.random.RandomState(seed).randn(dim).astype(np.float32)
+    return w2v

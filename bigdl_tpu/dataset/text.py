@@ -1,0 +1,223 @@
+"""Text pipeline (reference dataset/text/ — SURVEY §2.5).
+
+Host-side tokenize → index → sample stages feeding the ``Transformer``
+chain, rebuilt without the OpenNLP/Hadoop dependencies:
+
+- ``SentenceSplitter``   (SentenceSplitter.scala:33)  document → sentences
+- ``SentenceTokenizer``  (SentenceTokenizer.scala:34) sentence → tokens
+- ``SentenceBiPadding``  (SentenceBiPadding.scala:27) wraps with start/end
+- ``Dictionary``         (Dictionary.scala:32)        top-k vocab by freq
+- ``TextToLabeledSentence`` (TextToLabeledSentence.scala:43) next-word LM pairs
+- ``LabeledSentenceToSample`` (LabeledSentenceToSample.scala:55) one-hot Samples
+
+TPU notes: everything here is host preprocessing; static shapes for XLA
+come from ``fix_data_length``/``fix_label_length`` (the reference's
+padding contract) or from ``SampleToMiniBatch``'s ``PaddingParam``.
+"""
+from __future__ import annotations
+
+import os
+import re
+from collections import Counter
+from typing import Iterable, List, Optional, Sequence
+
+import numpy as np
+
+from .sample import Sample
+from .transformer import Transformer
+
+SENTENCE_START = "SENTENCESTART"  # reference utils/SentenceToken.scala
+SENTENCE_END = "SENTENCEEND"
+
+
+class SentenceSplitter(Transformer):
+    """Document string → list of sentence strings.
+
+    The reference uses OpenNLP when a model file is given and splits on
+    periods otherwise (SentenceSplitter.scala:70-73); only the
+    dependency-free default survives here.
+    """
+
+    def apply(self, it):
+        return (sent for doc in it for sent in doc.split(".")
+                if sent.strip())
+
+
+class SentenceTokenizer(Transformer):
+    """Sentence string → token array (SentenceTokenizer.scala:51-66).
+
+    The OpenNLP ``SimpleTokenizer`` default splits on whitespace and
+    separates punctuation classes; a regex reproduces that behavior.
+    """
+
+    _TOKEN = re.compile(r"\w+|[^\w\s]+")
+
+    def apply(self, it):
+        return (self._TOKEN.findall(sentence) for sentence in it)
+
+
+class SentenceBiPadding(Transformer):
+    """x → "start x end" (SentenceBiPadding.scala:35-40)."""
+
+    def __init__(self, start: Optional[str] = None, end: Optional[str] = None):
+        self.start = start or SENTENCE_START
+        self.end = end or SENTENCE_END
+
+    def apply(self, it):
+        return (f"{self.start} {x} {self.end}" for x in it)
+
+
+class Dictionary:
+    """Top-``vocab_size`` words by frequency; the rest are "discarded"
+    (Dictionary.scala:192-200 ``update``).
+
+    ``get_index`` maps unknown words to ``vocab_size`` (the out-of-vocab
+    bucket, Dictionary.scala:68-70); ``get_word`` of an unknown index
+    draws from the discard list (Dictionary.scala:87-91).
+    """
+
+    def __init__(self, sentences: Optional[Iterable[Sequence[str]]] = None,
+                 vocab_size: int = 10000, directory: Optional[str] = None):
+        if directory is not None:
+            self._load(directory)
+            return
+        freq = Counter()
+        n_sentences = 0
+        for sentence in sentences or []:
+            n_sentences += 1
+            freq.update(sentence)
+        # ascending by count, keep the top `length` tail — ties resolve
+        # the same way for a stable word->index assignment
+        ordered = sorted(freq.items(), key=lambda kv: (kv[1], kv[0]))
+        length = min(vocab_size, len(ordered))
+        kept = ordered[len(ordered) - length:]
+        self._vocabulary = [w for w, _ in kept]
+        self._word2index = {w: i for i, w in enumerate(self._vocabulary)}
+        self._index2word = {i: w for w, i in self._word2index.items()}
+        self._discard = [w for w, _ in ordered[:len(ordered) - length]]
+
+    def vocab_size(self) -> int:
+        return len(self._vocabulary)
+
+    def discard_size(self) -> int:
+        return len(self._discard)
+
+    def vocabulary(self) -> List[str]:
+        return list(self._vocabulary)
+
+    def discard_vocab(self) -> List[str]:
+        return list(self._discard)
+
+    def word2index(self):
+        return dict(self._word2index)
+
+    def index2word(self):
+        return dict(self._index2word)
+
+    def get_index(self, word: str) -> int:
+        return self._word2index.get(word, len(self._vocabulary))
+
+    def get_word(self, index) -> str:
+        index = int(index)
+        if index in self._index2word:
+            return self._index2word[index]
+        from ..utils.rng import RNG
+        if self._discard:
+            return self._discard[int(RNG().random_int(0, len(self._discard)))]
+        return self._index2word[int(RNG().random_int(0, len(self._vocabulary)))]
+
+    def save(self, folder: str):
+        """dictionary.txt ("word -> idx" lines) + discard.txt
+        (Dictionary.scala:113-129)."""
+        os.makedirs(folder, exist_ok=True)
+        with open(os.path.join(folder, "dictionary.txt"), "w") as f:
+            f.write("\n".join(f"{w} -> {i}"
+                              for w, i in self._word2index.items()))
+        with open(os.path.join(folder, "discard.txt"), "w") as f:
+            f.write("\n".join(self._discard))
+
+    def _load(self, directory: str):
+        path = os.path.join(directory, "dictionary.txt")
+        self._word2index = {}
+        with open(path) as f:
+            for line in f:
+                if not line.strip():
+                    continue
+                word, idx = line.rstrip("\n").rsplit("->", 1)
+                self._word2index[word.rstrip(" ")] = int(idx.lstrip(" "))
+        self._index2word = {i: w for w, i in self._word2index.items()}
+        self._vocabulary = list(self._word2index)
+        discard_path = os.path.join(directory, "discard.txt")
+        self._discard = []
+        if os.path.exists(discard_path):
+            with open(discard_path) as f:
+                self._discard = [ln.rstrip("\n") for ln in f if ln.strip()]
+
+
+class LabeledSentence:
+    """Token-index sequence + its label sequence (text/Types.scala:37)."""
+
+    def __init__(self, data, label):
+        self.data = np.asarray(data, np.float32)
+        self.label = np.asarray(label, np.float32)
+
+    def data_length(self) -> int:
+        return int(self.data.shape[0])
+
+    def label_length(self) -> int:
+        return int(self.label.shape[0])
+
+
+class TextToLabeledSentence(Transformer):
+    """Tokens → next-word-prediction pair: data = idx[:-1], label = idx[1:]
+    (TextToLabeledSentence.scala:47-57)."""
+
+    def __init__(self, dictionary: Dictionary):
+        self.dictionary = dictionary
+
+    def apply(self, it):
+        def convert(sentence):
+            idx = np.array([self.dictionary.get_index(w) for w in sentence],
+                           np.float32)
+            return LabeledSentence(idx[:-1], idx[1:])
+        return (convert(s) for s in it)
+
+
+class LabeledSentenceToSample(Transformer):
+    """One-hot features + 1-based label targets
+    (LabeledSentenceToSample.scala:68-118).
+
+    Padding semantics match the reference exactly: feature positions past
+    the sentence repeat the END token's one-hot; label positions past the
+    sentence repeat the START token index (+1 for the 1-based
+    ClassNLLCriterion target convention).
+    """
+
+    def __init__(self, vocab_length: int,
+                 fix_data_length: Optional[int] = None,
+                 fix_label_length: Optional[int] = None):
+        self.vocab_length = vocab_length
+        self.fix_data_length = fix_data_length
+        self.fix_label_length = fix_label_length
+
+    def apply(self, it):
+        return (self._convert(s) for s in it)
+
+    def _convert(self, sentence: LabeledSentence) -> Sample:
+        data_length = self.fix_data_length or sentence.data_length()
+        label_length = self.fix_label_length or sentence.label_length()
+        feature = np.zeros((data_length, self.vocab_length), np.float32)
+        label = np.zeros((label_length,), np.float32)
+
+        start_token = float(sentence.data[0])
+        end_token = (0 if label_length == 1
+                     else int(sentence.label[sentence.label_length() - 1]))
+
+        n = min(sentence.data_length(), data_length)
+        feature[np.arange(n), sentence.data[:n].astype(np.int64)] = 1.0
+        feature[n:, end_token] = 1.0
+
+        m = min(sentence.label_length(), label_length)
+        label[:m] = sentence.label[:m] + 1.0
+        label[m:] = start_token + 1.0
+        return Sample(feature, label)

@@ -1,0 +1,112 @@
+"""ML-pipeline estimators (reference org/apache/spark/ml/DLEstimator.scala:53,
+DLClassifier.scala:36 over the DLEstimatorBase version shim — SURVEY §1.7).
+
+The reference plugs training into Spark ML's Estimator/Transformer
+pipeline contract (fit(DataFrame) → Model, Model.transform(DataFrame)).
+TPU-native equivalent: the same fit/transform lifecycle over host arrays
+(or any iterable of rows) — scikit-learn-shaped, no Spark session.
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import numpy as np
+
+from .dataset import Sample
+from .dataset.dataset import array
+from .optim.optimizer import LocalOptimizer
+from .optim.trigger import max_epoch
+
+
+class DLEstimator:
+    """Trains ``model`` against ``criterion`` on (features, labels) arrays
+    and yields a :class:`DLModel` (reference DLEstimator.scala:53 —
+    featureSize/labelSize fix the per-row tensor shapes).
+    """
+
+    def __init__(self, model, criterion, feature_size: Sequence[int],
+                 label_size: Sequence[int]):
+        self.model = model
+        self.criterion = criterion
+        self.feature_size = tuple(feature_size)
+        self.label_size = tuple(label_size)
+        self.batch_size = 32
+        self.max_epoch = 10
+        self.learning_rate = 0.1
+        self.optim_method = None
+
+    # fluent setters follow the reference's Params (DLEstimator.scala:60-90)
+    def set_batch_size(self, v: int):
+        self.batch_size = v
+        return self
+
+    def set_max_epoch(self, v: int):
+        self.max_epoch = v
+        return self
+
+    def set_learning_rate(self, v: float):
+        self.learning_rate = v
+        return self
+
+    def set_optim_method(self, method):
+        self.optim_method = method
+        return self
+
+    def _make_samples(self, features, labels):
+        return [Sample(np.asarray(f, np.float32).reshape(self.feature_size),
+                       np.asarray(l, np.float32).reshape(self.label_size))
+                for f, l in zip(features, labels)]
+
+    def fit(self, features, labels) -> "DLModel":
+        from .optim.optim_method import SGD
+
+        samples = self._make_samples(features, labels)
+        opt = LocalOptimizer(self.model, array(samples), self.criterion,
+                             batch_size=self.batch_size)
+        opt.set_optim_method(self.optim_method
+                             or SGD(learning_rate=self.learning_rate))
+        opt.set_end_when(max_epoch(self.max_epoch))
+        trained = opt.optimize()
+        return DLModel(trained, self.feature_size,
+                       batch_size=self.batch_size)
+
+
+class DLModel:
+    """Inference transformer (reference DLEstimator.scala:155 DLModel)."""
+
+    def __init__(self, model, feature_size: Sequence[int],
+                 batch_size: int = 32):
+        self.model = model
+        self.feature_size = tuple(feature_size)
+        self.batch_size = batch_size
+
+    def transform(self, features) -> np.ndarray:
+        """Row-wise forward; returns stacked predictions."""
+        from .optim.predictor import Predictor
+
+        samples = [Sample(np.asarray(f, np.float32).reshape(self.feature_size),
+                          np.float32(0)) for f in features]
+        outs = Predictor(self.model).predict(array(samples),
+                                             batch_size=self.batch_size)
+        return np.stack([np.asarray(o) for o in outs])
+
+
+class DLClassifier(DLEstimator):
+    """Classification specialization (reference DLClassifier.scala:36):
+    scalar 1-based class labels, argmax predictions."""
+
+    def __init__(self, model, criterion, feature_size: Sequence[int]):
+        super().__init__(model, criterion, feature_size, (1,))
+
+    def fit(self, features, labels) -> "DLClassifierModel":
+        base = super().fit(features, labels)
+        return DLClassifierModel(base.model, self.feature_size,
+                                 batch_size=self.batch_size)
+
+
+class DLClassifierModel(DLModel):
+    """reference DLClassifier.scala:63 — transform emits class ids."""
+
+    def transform(self, features) -> np.ndarray:
+        probs = super().transform(features)
+        return probs.reshape(probs.shape[0], -1).argmax(axis=1) + 1

@@ -68,3 +68,75 @@ def InceptionV1NoAuxClassifier(class_num: int = 1000) -> nn.Sequential:
 
 
 Inception_v1 = InceptionV1NoAuxClassifier
+
+
+def _conv_bn_relu(seq: nn.Sequential, n_in: int, n_out: int, k: int, s: int,
+                  p: int, name: str):
+    seq.add(nn.SpatialConvolution(n_in, n_out, k, k, s, s, p, p).set_name(name))
+    seq.add(nn.SpatialBatchNormalization(n_out, 1e-3).set_name(name + "/bn"))
+    seq.add(nn.ReLU(True))
+    return seq
+
+
+def _inception_module_v2(n_in: int, cfg, prefix: str) -> nn.Concat:
+    """Inception-BN module (reference Inception_v2.scala Inception_Layer_v2):
+    cfg = ((1x1,), (3x3reduce, 3x3), (d3x3reduce, d3x3), (pool_kind, proj)).
+    5x5 becomes a double-3x3 tower; a cfg with 1x1==0 and proj==0 is the
+    stride-2 grid-reduction variant."""
+    reduce_grid = cfg[3][0] == "max" and cfg[3][1] == 0
+    concat = nn.Concat(2)
+    if cfg[0][0] != 0:
+        concat.add(_conv_bn_relu(nn.Sequential(), n_in, cfg[0][0], 1, 1, 0,
+                                 prefix + "1x1"))
+    c3 = _conv_bn_relu(nn.Sequential(), n_in, cfg[1][0], 1, 1, 0,
+                       prefix + "3x3_reduce")
+    _conv_bn_relu(c3, cfg[1][0], cfg[1][1], 3, 2 if reduce_grid else 1, 1,
+                  prefix + "3x3")
+    concat.add(c3)
+    c33 = _conv_bn_relu(nn.Sequential(), n_in, cfg[2][0], 1, 1, 0,
+                        prefix + "double3x3_reduce")
+    _conv_bn_relu(c33, cfg[2][0], cfg[2][1], 3, 1, 1, prefix + "double3x3a")
+    _conv_bn_relu(c33, cfg[2][1], cfg[2][1], 3, 2 if reduce_grid else 1, 1,
+                  prefix + "double3x3b")
+    concat.add(c33)
+    pool = nn.Sequential()
+    if cfg[3][0] == "max":
+        if cfg[3][1] != 0:
+            pool.add(nn.SpatialMaxPooling(3, 3, 1, 1, 1, 1).ceil())
+        else:
+            pool.add(nn.SpatialMaxPooling(3, 3, 2, 2).ceil())
+    else:
+        pool.add(nn.SpatialAveragePooling(3, 3, 1, 1, 1, 1, ceil_mode=True))
+    if cfg[3][1] != 0:
+        _conv_bn_relu(pool, n_in, cfg[3][1], 1, 1, 0, prefix + "pool_proj")
+    concat.add(pool)
+    return concat
+
+
+def InceptionV2NoAuxClassifier(class_num: int = 1000) -> nn.Sequential:
+    """Inception-BN (reference Inception_v2.scala
+    Inception_v2_NoAuxClassifier:107-150)."""
+    model = nn.Sequential()
+    _conv_bn_relu(model, 3, 64, 7, 2, 3, "conv1/7x7_s2")
+    model.add(nn.SpatialMaxPooling(3, 3, 2, 2).ceil())
+    _conv_bn_relu(model, 64, 64, 1, 1, 0, "conv2/3x3_reduce")
+    _conv_bn_relu(model, 64, 192, 3, 1, 1, "conv2/3x3")
+    model.add(nn.SpatialMaxPooling(3, 3, 2, 2).ceil())
+    model.add(_inception_module_v2(192, ((64,), (64, 64), (64, 96), ("avg", 32)), "inception_3a/"))
+    model.add(_inception_module_v2(256, ((64,), (64, 96), (64, 96), ("avg", 64)), "inception_3b/"))
+    model.add(_inception_module_v2(320, ((0,), (128, 160), (64, 96), ("max", 0)), "inception_3c/"))
+    model.add(_inception_module_v2(576, ((224,), (64, 96), (96, 128), ("avg", 128)), "inception_4a/"))
+    model.add(_inception_module_v2(576, ((192,), (96, 128), (96, 128), ("avg", 128)), "inception_4b/"))
+    model.add(_inception_module_v2(576, ((160,), (128, 160), (128, 160), ("avg", 96)), "inception_4c/"))
+    model.add(_inception_module_v2(576, ((96,), (128, 192), (160, 192), ("avg", 96)), "inception_4d/"))
+    model.add(_inception_module_v2(576, ((0,), (128, 192), (192, 256), ("max", 0)), "inception_4e/"))
+    model.add(_inception_module_v2(1024, ((352,), (192, 320), (160, 224), ("avg", 128)), "inception_5a/"))
+    model.add(_inception_module_v2(1024, ((352,), (192, 320), (192, 224), ("max", 128)), "inception_5b/"))
+    model.add(nn.SpatialAveragePooling(7, 7, 1, 1, ceil_mode=True))
+    model.add(nn.View(1024).set_num_input_dims(3))
+    model.add(nn.Linear(1024, class_num).set_name("loss3/classifier"))
+    model.add(nn.LogSoftMax())
+    return model
+
+
+Inception_v2 = InceptionV2NoAuxClassifier

@@ -61,3 +61,9 @@ from .criterion import (
     TimeDistributedCriterion,
 )
 from .attention import MultiHeadAttention
+from .recurrent import (
+    BiRecurrent, Cell, ConvLSTMPeephole, GRU, LSTM, LSTMPeephole, Recurrent,
+    RnnCell, TimeDistributed,
+)
+from .tree import BinaryTreeLSTM, TensorTree, TreeLSTM
+from .tf_ops import Const, Fill, Nms, Shape, SplitAndSelect, StrideSlice

@@ -1,0 +1,124 @@
+"""TF-compat ops (reference nn/tf/: Const.scala, Fill.scala, Shape.scala,
+SplitAndSelect.scala, StrideSlice.scala — SURVEY §2.4) and Nms
+(nn/Nms.scala).
+
+These exist so TensorFlow GraphDefs map onto framework layers
+(utils/tf/TensorflowToBigDL pattern table); they are thin jnp ops here.
+"""
+from __future__ import annotations
+
+from typing import Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .module import AbstractModule, TensorModule
+
+
+class Const(AbstractModule):
+    """Emit a constant regardless of input (reference nn/tf/Const.scala)."""
+
+    def __init__(self, value):
+        super().__init__()
+        self.value = jnp.asarray(value)
+
+    def _apply(self, params, buffers, inp, training, rng):
+        return self.value, buffers
+
+
+class Fill(TensorModule):
+    """Input holds the target shape; output is that shape filled with
+    ``value`` (reference nn/tf/Fill.scala)."""
+
+    def __init__(self, value):
+        super().__init__()
+        self.value = value
+
+    def _apply(self, params, buffers, x, training, rng):
+        shape = tuple(int(v) for v in np.asarray(x).reshape(-1))
+        return jnp.full(shape, self.value, jnp.float32), buffers
+
+
+class Shape(TensorModule):
+    """Output the input's shape as a 1-D tensor (reference nn/tf/Shape.scala)."""
+
+    def _apply(self, params, buffers, x, training, rng):
+        return jnp.asarray(x.shape, jnp.float32), buffers
+
+
+class SplitAndSelect(TensorModule):
+    """Split dim into ``num_split`` equal chunks, emit chunk ``index``
+    (1-based, reference nn/tf/SplitAndSelect.scala)."""
+
+    def __init__(self, dimension: int, index: int, num_split: int):
+        super().__init__()
+        self.dimension, self.index, self.num_split = dimension, index, num_split
+
+    def _apply(self, params, buffers, x, training, rng):
+        d = (self.dimension - 1 if self.dimension > 0
+             else x.ndim + self.dimension)
+        size = x.shape[d]
+        assert size % self.num_split == 0, (
+            f"num_split must evenly divide dim size {size}")
+        length = size // self.num_split
+        start = (self.index - 1) * length
+        return jax.lax.slice_in_dim(x, start, start + length, axis=d), buffers
+
+
+class StrideSlice(TensorModule):
+    """Chained 1-based narrows: specs of (dim, startIdx, endIdx, stride)
+    with endIdx exclusive, stride must be 1 (reference nn/tf/StrideSlice.scala)."""
+
+    def __init__(self, slice_specs: Sequence[Tuple[int, int, int, int]]):
+        super().__init__()
+        assert all(s[3] == 1 for s in slice_specs), "only stride 1 supported"
+        self.slice_specs = list(slice_specs)
+
+    def _apply(self, params, buffers, x, training, rng):
+        for dim, start, end, _ in self.slice_specs:
+            d = dim - 1 if dim > 0 else x.ndim + dim
+            x = jax.lax.slice_in_dim(x, start - 1, end - 1, axis=d)
+        return x, buffers
+
+
+class Nms:
+    """Greedy non-maximum suppression for detection (reference
+    nn/Nms.scala:26): sort by score descending, keep the top box, drop
+    boxes whose IoU with it exceeds ``thresh``, repeat.  Box areas use
+    the reference's +1 pixel convention ((x2-x1+1)*(y2-y1+1)).
+
+    Host-side helper like the reference (not a Module); the greedy
+    data-dependent loop stays on CPU where it belongs — candidate counts
+    are tiny post-RPN.
+    """
+
+    def nms(self, scores, boxes, thresh: float, indices) -> int:
+        scores = np.asarray(scores, np.float32).reshape(-1)
+        boxes = np.asarray(boxes, np.float32).reshape(-1, 4)
+        n = scores.shape[0]
+        if n == 0:
+            return 0
+        assert len(indices) >= n and boxes.shape[1] == 4
+        x1, y1, x2, y2 = boxes[:, 0], boxes[:, 1], boxes[:, 2], boxes[:, 3]
+        areas = (x2 - x1 + 1) * (y2 - y1 + 1)
+        order = np.argsort(-scores, kind="stable")
+        suppressed = np.zeros(n, bool)
+        count = 0
+        for i in range(n):
+            cur = order[i]
+            if suppressed[cur]:
+                continue
+            indices[count] = cur + 1  # 1-based like the reference
+            count += 1
+            rest = order[i + 1:]
+            rest = rest[~suppressed[rest]]
+            if rest.size == 0:
+                continue
+            w = np.minimum(x2[cur], x2[rest]) - np.maximum(x1[cur], x1[rest]) + 1
+            h = np.minimum(y2[cur], y2[rest]) - np.maximum(y1[cur], y1[rest]) + 1
+            inter = np.clip(w, 0, None) * np.clip(h, 0, None)
+            inter = np.where((w < 0) | (h < 0), 0.0, inter)
+            iou = inter / (areas[cur] + areas[rest] - inter)
+            suppressed[rest[iou > thresh]] = True
+        return count

@@ -1,0 +1,197 @@
+"""Tree-structured LSTM (reference nn/TreeLSTM.scala:26,
+nn/BinaryTreeLSTM.scala:37 — Constituency Tree LSTM).
+
+Tree encoding (reference TensorTree, BinaryTreeLSTM.scala:454-512): a
+``(node_number, width)`` tensor per sample; columns ``0..width-2`` hold
+1-based child node indices (0 = no child, -1 in column 0 = padding row)
+and the LAST column holds ``-1`` for the root or the 1-based leaf index
+into the token sequence for leaves.
+
+TPU-first redesign: the reference walks each tree with host recursion,
+cloning leaf/composer modules per node with shared weights
+(BinaryTreeLSTM.scala:214-276).  Recursion over data-dependent structure
+doesn't trace, so here the whole batch of trees is evaluated by a masked
+fixed-point iteration: every step computes the composer for ALL nodes as
+one batched (B·N, H) matmul and commits only nodes whose children are
+both ready.  ``node_number`` iterations guarantee convergence (tree
+depth ≤ node count); weight sharing is automatic — one parameter set,
+no clones.
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..utils.table import Table
+from .initialization import ONE_D, RandomUniform
+from .module import AbstractModule
+
+
+class TensorTree:
+    """Host-side helper for building/reading the tree tensor
+    (reference BinaryTreeLSTM.scala:454-512)."""
+
+    def __init__(self, content: np.ndarray):
+        content = np.asarray(content, np.float32)
+        assert content.ndim == 2, "TensorTree content must be 2-D"
+        self.content = content
+
+    @property
+    def node_number(self) -> int:
+        return self.content.shape[0]
+
+    def children(self, index: int):
+        return self.content[index - 1].astype(np.int64)
+
+    def add_child(self, parent: int, child: int):
+        row = self.content[parent - 1]
+        for i in range(self.content.shape[1] - 1):
+            if row[i] == 0:
+                row[i] = child
+                return
+
+    def mark_as_root(self, index: int):
+        self.content[index - 1, -1] = -1
+
+    def get_root(self) -> int:
+        for i in range(self.node_number):
+            if int(self.content[i, -1]) == -1:
+                return i + 1
+        raise RuntimeError("There is no root in the tensor tree")
+
+    def mark_as_leaf(self, index: int, leaf_index: int):
+        self.content[index - 1, -1] = leaf_index
+
+    def leaf_index(self, index: int) -> int:
+        return int(self.content[index - 1, -1])
+
+    def has_child(self, index: int) -> bool:
+        return int(self.content[index - 1, 0]) > 0
+
+    def no_child(self, index: int) -> bool:
+        return int(self.content[index - 1, 0]) == 0
+
+    def exists(self, index: int) -> bool:
+        return 1 <= index <= self.node_number
+
+    def is_padding(self, index: int) -> bool:
+        return int(self.content[index - 1, 0]) == -1
+
+
+class BinaryTreeLSTM(AbstractModule):
+    """Binary (constituency) TreeLSTM (reference BinaryTreeLSTM.scala:37).
+
+    Input: ``Table(embeddings (B, L, input_size), trees (B, N, W))``.
+    Output: ``(B, N, hidden_size)`` — the hidden state of every node
+    (padding rows zero), matching the reference's ``updateOutput``
+    layout (BinaryTreeLSTM.scala:214-259).
+
+    Leaf cell  (createLeafModuleWithGraph, :59-76):
+        c = W_c x + b;  h = sigmoid(W_o x + b_o) * tanh(c)   [gate_output]
+    Composer  (createComposerWithGraph, :78-110), each gate g:
+        g = act(W_l lh + b_l + W_r rh + b_r)
+        c = i*u + lf*lc + rf*rc;  h = o * tanh(c)
+    """
+
+    def __init__(self, input_size: int, hidden_size: int,
+                 gate_output: bool = True):
+        super().__init__()
+        self.input_size, self.hidden_size = input_size, hidden_size
+        self.gate_output = gate_output
+        self.reset()
+
+    def reset(self):
+        H, I = self.hidden_size, self.input_size
+        n_gates = 5 if self.gate_output else 4  # i, lf, rf, u [, o]
+
+        def uni(name, shape, stdv):
+            init = self._init_methods.get(
+                name, (RandomUniform(-stdv, stdv), None))[0]
+            self._register_param(name, init.init(shape, ONE_D))
+
+        uni("leaf_c_w", (H, I), 1.0 / math.sqrt(I))
+        uni("leaf_c_b", (H,), 1.0 / math.sqrt(I))
+        if self.gate_output:
+            uni("leaf_o_w", (H, I), 1.0 / math.sqrt(I))
+            uni("leaf_o_b", (H,), 1.0 / math.sqrt(I))
+        stdv = 1.0 / math.sqrt(H)
+        uni("comp_l_w", (n_gates * H, H), stdv)
+        uni("comp_l_b", (n_gates * H,), stdv)
+        uni("comp_r_w", (n_gates * H, H), stdv)
+        uni("comp_r_b", (n_gates * H,), stdv)
+        return self
+
+    def _apply(self, params, buffers, inp, training, rng):
+        x, trees = inp[1], inp[2]
+        x = jnp.asarray(x)
+        trees = jnp.asarray(trees)
+        B, N = trees.shape[0], trees.shape[1]
+        H = self.hidden_size
+
+        left = trees[:, :, 0].astype(jnp.int32)    # 1-based; 0 none, -1 pad
+        right = trees[:, :, 1].astype(jnp.int32)
+        marker = trees[:, :, -1].astype(jnp.int32)  # -1 root / leaf index
+        is_leaf = left == 0
+        is_pad = left == -1
+        is_comp = left > 0
+
+        # --- all leaves at once: one (B, N, I) gather + (B·N, H) matmul
+        leaf_pos = jnp.clip(marker - 1, 0, x.shape[1] - 1)
+        leaf_in = jnp.take_along_axis(
+            x, leaf_pos[:, :, None].astype(jnp.int32), axis=1)  # (B, N, I)
+        leaf_c = jnp.einsum("bni,hi->bnh", leaf_in, params["leaf_c_w"]) \
+            + params["leaf_c_b"]
+        if self.gate_output:
+            o = jax.nn.sigmoid(
+                jnp.einsum("bni,hi->bnh", leaf_in, params["leaf_o_w"])
+                + params["leaf_o_b"])
+            leaf_h = o * jnp.tanh(leaf_c)
+        else:
+            leaf_h = jnp.tanh(leaf_c)
+
+        mask = is_leaf[:, :, None]
+        c0 = jnp.where(mask, leaf_c, 0.0)
+        h0 = jnp.where(mask, leaf_h, 0.0)
+        ready0 = is_leaf | is_pad
+
+        li = jnp.clip(left - 1, 0, N - 1)
+        ri = jnp.clip(right - 1, 0, N - 1)
+
+        def gather_nodes(states, idx):
+            return jnp.take_along_axis(states, idx[:, :, None], axis=1)
+
+        def body(_, carry):
+            c, h, ready = carry
+            lc, lh = gather_nodes(c, li), gather_nodes(h, li)
+            rc, rh = gather_nodes(c, ri), gather_nodes(h, ri)
+            pre = (jnp.einsum("bnh,gh->bng", lh, params["comp_l_w"])
+                   + params["comp_l_b"]
+                   + jnp.einsum("bnh,gh->bng", rh, params["comp_r_w"])
+                   + params["comp_r_b"])
+            i_g = jax.nn.sigmoid(pre[..., 0:H])
+            lf = jax.nn.sigmoid(pre[..., H:2 * H])
+            rf = jax.nn.sigmoid(pre[..., 2 * H:3 * H])
+            u = jnp.tanh(pre[..., 3 * H:4 * H])
+            cc = i_g * u + lf * lc + rf * rc
+            if self.gate_output:
+                o_g = jax.nn.sigmoid(pre[..., 4 * H:5 * H])
+                hh = o_g * jnp.tanh(cc)
+            else:
+                hh = jnp.tanh(cc)
+            l_ready = jnp.take_along_axis(ready, li, axis=1)
+            r_ready = jnp.take_along_axis(ready, ri, axis=1)
+            commit = is_comp & l_ready & r_ready & ~ready
+            cm = commit[:, :, None]
+            return (jnp.where(cm, cc, c), jnp.where(cm, hh, h),
+                    ready | commit)
+
+        c, h, _ = jax.lax.fori_loop(0, N, body, (c0, h0, ready0))
+        return jnp.where(is_pad[:, :, None], 0.0, h), buffers
+
+
+class TreeLSTM(BinaryTreeLSTM):
+    """Alias base name kept for API parity (reference TreeLSTM.scala:26
+    is the abstract parent of BinaryTreeLSTM)."""

@@ -9,7 +9,7 @@ from .trigger import (
 )
 from .validation import (
     AccuracyResult, Loss, LossResult, MAE, Top1Accuracy, Top5Accuracy,
-    ValidationMethod, ValidationResult,
+    TreeNNAccuracy, ValidationMethod, ValidationResult,
 )
 from .regularizer import L1L2Regularizer, L1Regularizer, L2Regularizer, Regularizer
 from .metrics import Metrics

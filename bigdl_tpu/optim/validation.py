@@ -61,6 +61,34 @@ class ValidationMethod:
         return type(self).__name__
 
 
+class TreeNNAccuracy(ValidationMethod):
+    """Root-node accuracy for tree models (reference
+    ValidationMethod.scala:118): score the FIRST node's output (the
+    sentiment-treebank root) against the first label; binary outputs
+    threshold at 0.5, multi-class take argmax."""
+
+    def apply(self, output, target):
+        out = np.asarray(output)
+        t = np.asarray(target)
+        if out.ndim == 3:                       # (B, N, C) → root node
+            out = out[:, 0, :]
+            t = t.reshape(t.shape[0], -1)[:, 0]
+        elif out.ndim == 2:                     # single sample (N, C)
+            out = out[0:1, :]
+            t = t.reshape(-1)[:1]
+        else:
+            raise ValueError("TreeNNAccuracy expects 2-D or 3-D output")
+        if out.shape[-1] == 1:
+            pred = (out[:, 0] >= 0.5).astype(np.int64)
+        else:
+            pred = out.argmax(axis=-1) + 1
+        correct = int((pred == t.astype(np.int64)).sum())
+        return AccuracyResult(correct, out.shape[0])
+
+    def format(self):
+        return "TreeNNAccuracy()"
+
+
 class Top1Accuracy(ValidationMethod):
     """reference ValidationMethod.scala:170 — argmax vs 1-based labels."""
 

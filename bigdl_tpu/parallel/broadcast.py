@@ -1,0 +1,63 @@
+"""ModelBroadcast (reference models/utils/ModelBroadcast.scala:33).
+
+The reference strips a model's weights, broadcasts skeleton and weight
+arrays separately (cheaper Spark broadcast), and re-attaches per
+partition (:46-103).  On TPU the analogue is: keep ONE host skeleton,
+``device_put_replicated`` the weight pytree across local devices, and
+hand each consumer a view bound to its device — inference then runs the
+pure apply with params already resident, nothing is re-shipped per batch.
+"""
+from __future__ import annotations
+
+import copy
+from typing import Optional
+
+import jax
+
+
+class ModelBroadcast:
+    def __init__(self):
+        self._skeleton = None
+        self._params = None
+        self._buffers = None
+
+    def broadcast(self, model) -> "ModelBroadcast":
+        """Keep a host skeleton and stage the weight pytree on every
+        local device (reference broadcast(sc, model) ships skeleton and
+        weights separately; here "shipping" is one device_put)."""
+        params = model.param_tree()
+        buffers = model.buffer_tree()
+        # strip the arrays before copying — the skeleton carries only
+        # structure (the reference ships skeleton and weights separately)
+        stripped_p = jax.tree_util.tree_map(lambda a: None, params)
+        stripped_b = jax.tree_util.tree_map(lambda a: None, buffers)
+        model.set_param_tree(stripped_p)
+        model.set_buffer_tree(stripped_b)
+        try:
+            self._skeleton = copy.deepcopy(model)
+        finally:
+            model.set_param_tree(params)
+            model.set_buffer_tree(buffers)
+        devices = jax.local_devices()
+        if len(devices) > 1:
+            import numpy as np
+            from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+            replicated = NamedSharding(
+                Mesh(np.array(devices), ("d",)), PartitionSpec())
+            self._params = jax.device_put(params, replicated)
+            self._buffers = jax.device_put(buffers, replicated)
+        else:
+            self._params = jax.device_put(params, devices[0])
+            self._buffers = jax.device_put(buffers, devices[0])
+        return self
+
+    def value(self, device_index: Optional[int] = None):
+        """Model bound to the staged weights (reference value() per
+        partition).  The weights are one logical replicated array —
+        every device reads its local copy, so ``device_index`` is
+        unused (kept for signature parity)."""
+        model = copy.deepcopy(self._skeleton)
+        model.set_param_tree(self._params)
+        model.set_buffer_tree(self._buffers)
+        return model

@@ -1,4 +1,6 @@
 from .engine import Engine, init_engine
 from .rng import RNG, RandomGenerator, set_global_seed
 from .table import T, Table
+from .util import LoggerFilter, kth_largest
+from .gradient_checker import GradientChecker
 from . import torch_file as TorchFile

@@ -1,0 +1,128 @@
+"""API facade, ML estimators, ModelBroadcast, perf harness tests."""
+import numpy as np
+import pytest
+
+from bigdl_tpu import api, nn
+from bigdl_tpu.ml import DLClassifier, DLEstimator
+from bigdl_tpu.parallel.broadcast import ModelBroadcast
+
+
+class TestApiFacade:
+    def test_create_by_name(self):
+        lin = api.create("Linear", 4, 3)
+        assert isinstance(lin, nn.Linear)
+
+    def test_create_reflection_camel_and_snake(self):
+        assert isinstance(api.createLinear(4, 3), nn.Linear)
+        assert isinstance(api.create_linear(4, 3), nn.Linear)
+        assert isinstance(api.createSpatialConvolution(3, 8, 3, 3),
+                          nn.SpatialConvolution)
+        assert isinstance(api.create_class_nll_criterion(),
+                          nn.ClassNLLCriterion)
+
+    def test_unknown_layer_raises(self):
+        with pytest.raises(ValueError):
+            api.create("NopeLayer")
+        with pytest.raises(AttributeError):
+            api.createNopeLayer
+
+    def test_layer_names_cover_survey_inventory(self):
+        names = api.layer_names()
+        for required in ["Linear", "SpatialConvolution", "LSTM", "GRU",
+                         "BatchNormalization", "Dropout", "Sequential",
+                         "Graph", "ClassNLLCriterion", "MSECriterion",
+                         "BinaryTreeLSTM", "Const", "StrideSlice"]:
+            assert required in names, required
+
+    def test_model_verbs(self):
+        model = nn.Sequential(nn.Linear(4, 2), nn.LogSoftMax())
+        x = np.random.RandomState(0).rand(3, 4).astype(np.float32)
+        out = api.model_forward(model, x)
+        assert out.shape == (3, 2)
+        grad = api.model_backward(model, x, np.ones((3, 2), np.float32))
+        assert np.asarray(grad).shape == (3, 4)
+        w, g = api.model_get_parameters(model)
+        assert w.shape == g.shape and w.ndim == 1
+
+    def test_model_test_and_predict(self):
+        from bigdl_tpu.optim import Top1Accuracy
+
+        model = nn.Sequential(nn.Linear(4, 2), nn.LogSoftMax())
+        feats = np.random.RandomState(1).rand(8, 4).astype(np.float32)
+        labels = np.ones(8, np.float32)
+        res = api.model_test(model, feats, labels, batch_size=4,
+                             val_methods=[Top1Accuracy()])
+        assert res[0][0].count == 8
+        preds = api.model_predict_class(model, feats, batch_size=4)
+        assert len(preds) == 8 and all(p in (1, 2) for p in preds)
+
+    def test_create_optimizer_runs(self):
+        from bigdl_tpu.optim import SGD, max_iteration
+
+        model = nn.Sequential(nn.Linear(4, 2), nn.LogSoftMax())
+        feats = np.random.RandomState(2).rand(16, 4).astype(np.float32)
+        labels = (np.random.RandomState(3).randint(0, 2, 16) + 1).astype(np.float32)
+        opt = api.create_optimizer(
+            model, api.to_sample_rdd(feats, labels), nn.ClassNLLCriterion(),
+            SGD(learning_rate=0.1), max_iteration(3), batch_size=8)
+        trained = opt.optimize()
+        assert trained is model
+
+
+class TestMLPipeline:
+    def _data(self, n=64):
+        rng = np.random.RandomState(5)
+        x = rng.rand(n, 4).astype(np.float32)
+        y = (x.sum(axis=1) > 2).astype(np.float32) + 1  # classes 1/2
+        return x, y
+
+    def test_dl_classifier_fit_transform(self):
+        x, y = self._data()
+        clf = DLClassifier(
+            nn.Sequential(nn.Linear(4, 8), nn.Tanh(), nn.Linear(8, 2),
+                          nn.LogSoftMax()),
+            nn.ClassNLLCriterion(), [4])
+        model = (clf.set_batch_size(16).set_max_epoch(30)
+                 .set_learning_rate(0.5).fit(x, y))
+        preds = model.transform(x)
+        assert preds.shape == (64,)
+        assert (preds == y).mean() > 0.8
+
+    def test_dl_estimator_regression(self):
+        rng = np.random.RandomState(6)
+        x = rng.rand(32, 3).astype(np.float32)
+        y = x @ np.array([1.0, -2.0, 0.5], np.float32)
+        est = DLEstimator(nn.Linear(3, 1), nn.MSECriterion(), [3], [1])
+        model = est.set_batch_size(8).set_max_epoch(50).set_learning_rate(0.3)\
+                   .fit(x, y[:, None])
+        preds = model.transform(x).reshape(-1)
+        assert np.abs(preds - y).mean() < 0.2
+
+
+class TestModelBroadcast:
+    def test_broadcast_value_matches(self):
+        model = nn.Sequential(nn.Linear(4, 3), nn.Tanh())
+        x = np.random.RandomState(7).rand(2, 4).astype(np.float32)
+        expected = np.asarray(model.forward(x))
+        mb = ModelBroadcast().broadcast(model)
+        replica = mb.value()
+        np.testing.assert_allclose(np.asarray(replica.forward(x)), expected,
+                                   rtol=1e-6)
+        # the replica is an independent module object
+        assert replica is not model
+
+
+class TestPerfHarness:
+    def test_lenet_perf_runs(self, capsys):
+        from bigdl_tpu.models.perf import performance
+
+        rps = performance("lenet5", batch_size=8, iterations=2, warmup=1)
+        assert rps > 0
+        out = capsys.readouterr().out
+        assert "records/second" in out
+
+    def test_unknown_model_rejected(self):
+        from bigdl_tpu.models.perf import build_model
+
+        with pytest.raises(ValueError):
+            build_model("alexnet")

@@ -1,0 +1,265 @@
+"""Text pipeline, TreeLSTM, TF-compat ops, Nms, GradientChecker tests
+(reference test strategy SURVEY §4.1 — per-feature specs with oracles)."""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from bigdl_tpu import nn
+from bigdl_tpu.dataset.text import (
+    Dictionary, LabeledSentence, LabeledSentenceToSample, SentenceBiPadding,
+    SentenceSplitter, SentenceTokenizer, TextToLabeledSentence,
+    SENTENCE_START, SENTENCE_END,
+)
+from bigdl_tpu.optim import TreeNNAccuracy
+from bigdl_tpu.utils import GradientChecker, kth_largest
+
+
+# ---------------------------------------------------------------- text
+class TestTextPipeline:
+    def test_tokenizer_and_padding(self):
+        toks = list(SentenceTokenizer()(iter(["I love TPUs, truly."])))
+        assert toks[0] == ["I", "love", "TPUs", ",", "truly", "."]
+        padded = list(SentenceBiPadding()(iter(["a b"])))
+        assert padded[0] == f"{SENTENCE_START} a b {SENTENCE_END}"
+
+    def test_splitter(self):
+        sents = list(SentenceSplitter()(iter(["one. two. three"])))
+        assert sents == ["one", " two", " three"]
+
+    def test_dictionary_topk_and_oov(self):
+        sentences = [["a", "a", "a", "b", "b", "c"]]
+        d = Dictionary(iter(sentences), vocab_size=2)
+        assert d.vocab_size() == 2
+        # top-2 by frequency: a, b; c discarded
+        assert set(d.vocabulary()) == {"a", "b"}
+        assert d.discard_vocab() == ["c"]
+        assert d.get_index("zzz") == 2  # OOV bucket = vocab_size
+        assert d.get_word(d.get_index("a")) == "a"
+
+    def test_dictionary_save_load(self, tmp_path):
+        d = Dictionary(iter([["x", "y", "x"]]), vocab_size=10)
+        d.save(str(tmp_path))
+        d2 = Dictionary(directory=str(tmp_path))
+        assert d2.word2index() == d.word2index()
+        assert d2.vocab_size() == d.vocab_size()
+
+    def test_text_to_labeled_sentence(self):
+        d = Dictionary(iter([["I", "love", "Intel"]]), vocab_size=10)
+        ls = next(iter(TextToLabeledSentence(d)(iter([["I", "love", "Intel"]]))))
+        idx = [d.get_index(w) for w in ["I", "love", "Intel"]]
+        assert ls.data.tolist() == [float(i) for i in idx[:2]]
+        assert ls.label.tolist() == [float(i) for i in idx[1:]]
+
+    def test_labeled_sentence_to_sample_reference_example(self):
+        # LabeledSentenceToSample.scala:41-48 documented example:
+        # data [0,2,3], label [2,3,1], vocab 4 →
+        # one-hot rows for 0,2,3; target = label+1 = [3,4,2]
+        s = next(iter(LabeledSentenceToSample(4)(
+            iter([LabeledSentence([0, 2, 3], [2, 3, 1])]))))
+        np.testing.assert_array_equal(
+            s.feature,
+            [[1, 0, 0, 0], [0, 0, 1, 0], [0, 0, 0, 1]])
+        np.testing.assert_array_equal(s.label, [3, 4, 2])
+
+    def test_labeled_sentence_fixed_length_padding(self):
+        s = next(iter(LabeledSentenceToSample(
+            4, fix_data_length=5, fix_label_length=5)(
+            iter([LabeledSentence([0, 2, 3], [2, 3, 1])]))))
+        assert s.feature.shape == (5, 4)
+        end_token = 1  # last label
+        np.testing.assert_array_equal(s.feature[3], np.eye(4)[end_token])
+        np.testing.assert_array_equal(s.feature[4], np.eye(4)[end_token])
+        # label padding repeats start token (+1)
+        np.testing.assert_array_equal(s.label, [3, 4, 2, 1, 1])
+
+    def test_news20_loader(self):
+        from bigdl_tpu.dataset.datasets import get_glove_w2v, load_news20
+
+        data = load_news20(train=True, synthetic_size=32)
+        assert len(data) == 32
+        text, label = data[0]
+        assert isinstance(text, str) and 1 <= label <= 20
+        w2v = get_glove_w2v(vocab=["hello", "world"], dim=16)
+        assert w2v["hello"].shape == (16,)
+        w2v2 = get_glove_w2v(vocab=["hello"], dim=16)
+        np.testing.assert_array_equal(w2v["hello"], w2v2["hello"])
+
+
+# ---------------------------------------------------------------- tree
+def _tree_oracle(params, x, tree, hidden, gate_output=True):
+    """Host recursion oracle mirroring BinaryTreeLSTM.scala recursiveForward."""
+    H = hidden
+
+    def leaf(vec):
+        c = params["leaf_c_w"] @ vec + params["leaf_c_b"]
+        if gate_output:
+            o = 1 / (1 + np.exp(-(params["leaf_o_w"] @ vec + params["leaf_o_b"])))
+            return c, o * np.tanh(c)
+        return c, np.tanh(c)
+
+    def compose(lc, lh, rc, rh):
+        pre = (params["comp_l_w"] @ lh + params["comp_l_b"]
+               + params["comp_r_w"] @ rh + params["comp_r_b"])
+        sig = lambda v: 1 / (1 + np.exp(-v))
+        i, lf, rf = sig(pre[0:H]), sig(pre[H:2*H]), sig(pre[2*H:3*H])
+        u = np.tanh(pre[3*H:4*H])
+        c = i * u + lf * lc + rf * rc
+        if gate_output:
+            o = sig(pre[4*H:5*H])
+            return c, o * np.tanh(c)
+        return c, np.tanh(c)
+
+    n = tree.shape[0]
+    states = [None] * n
+
+    def rec(node):
+        left, right, marker = int(tree[node-1, 0]), int(tree[node-1, 1]), int(tree[node-1, -1])
+        if left == 0:
+            states[node-1] = leaf(x[marker - 1])
+        else:
+            rec(left), rec(right)
+            states[node-1] = compose(*states[left-1], *states[right-1])
+        return states[node-1]
+
+    root = next(i+1 for i in range(n) if int(tree[i, -1]) == -1)
+    rec(root)
+    out = np.zeros((n, H), np.float32)
+    for i, st in enumerate(states):
+        if st is not None:
+            out[i] = st[1]
+    return out
+
+
+class TestBinaryTreeLSTM:
+    def _make(self, gate_output=True):
+        m = nn.BinaryTreeLSTM(4, 3, gate_output=gate_output)
+        x = np.random.RandomState(0).randn(2, 3, 4).astype(np.float32)
+        # tree: root 1 = (2, 3); 3 = (4, 5); leaves 2,4,5 → tokens 1,2,3
+        tree = np.array([[2, 3, -1],
+                         [0, 0, 1],
+                         [4, 5, 0],
+                         [0, 0, 2],
+                         [0, 0, 3],
+                         [-1, -1, 0]], np.float32)  # last row padding
+        trees = np.stack([tree, tree])
+        return m, x, trees
+
+    @pytest.mark.parametrize("gate_output", [True, False])
+    def test_matches_recursive_oracle(self, gate_output):
+        m, x, trees = self._make(gate_output)
+        params = {k: np.asarray(v) for k, v in m.param_tree().items()}
+        out, _ = m.apply_fn(m.param_tree(), {},
+                            __import__("bigdl_tpu").utils.Table(
+                                jnp.asarray(x), jnp.asarray(trees)))
+        for b in range(2):
+            oracle = _tree_oracle(params, x[b], trees[b], 3, gate_output)
+            np.testing.assert_allclose(np.asarray(out)[b], oracle,
+                                       rtol=1e-4, atol=1e-5)
+
+    def test_jit_and_grad(self):
+        m, x, trees = self._make()
+        from bigdl_tpu.utils.table import Table
+
+        def loss(p):
+            out, _ = m.apply_fn(p, {}, Table(jnp.asarray(x),
+                                             jnp.asarray(trees)))
+            return jnp.sum(out ** 2)
+
+        g = jax.jit(jax.grad(loss))(m.param_tree())
+        assert float(jnp.abs(g["comp_l_w"]).sum()) > 0
+        assert float(jnp.abs(g["leaf_c_w"]).sum()) > 0
+
+    def test_tensor_tree_helpers(self):
+        t = nn.TensorTree(np.zeros((3, 3), np.float32))
+        t.add_child(1, 2)
+        t.add_child(1, 3)
+        t.mark_as_root(1)
+        t.mark_as_leaf(2, 1)
+        t.mark_as_leaf(3, 2)
+        assert t.get_root() == 1
+        assert t.has_child(1) and t.no_child(2)
+        assert t.leaf_index(3) == 2
+        assert t.children(1).tolist()[:2] == [2, 3]
+
+    def test_tree_nn_accuracy(self):
+        # (B, N, C) — node 1 is scored vs label 1
+        out = np.zeros((2, 3, 4))
+        out[0, 0, 2] = 5.0   # pred class 3
+        out[1, 0, 0] = 5.0   # pred class 1
+        target = np.array([[3.0, 1, 1], [2.0, 1, 1]])
+        res = TreeNNAccuracy()(out, target)
+        assert res.correct == 1 and res.count == 2
+
+
+# ---------------------------------------------------------------- tf ops
+class TestTFOps:
+    def test_const_fill_shape(self):
+        c = nn.Const(np.arange(3.0))
+        np.testing.assert_array_equal(np.asarray(c.forward(np.zeros(5))),
+                                      [0, 1, 2])
+        f = nn.Fill(7.0)
+        out = f.forward(np.array([2.0, 3.0]))
+        assert out.shape == (2, 3) and float(out[0, 0]) == 7.0
+        s = nn.Shape()
+        np.testing.assert_array_equal(np.asarray(s.forward(np.zeros((4, 5)))),
+                                      [4, 5])
+
+    def test_split_and_select(self):
+        x = np.arange(12.0).reshape(2, 6)
+        m = nn.SplitAndSelect(2, 2, 3)  # dim 2, chunk 2 of 3
+        np.testing.assert_array_equal(np.asarray(m.forward(x)),
+                                      x[:, 2:4])
+
+    def test_stride_slice(self):
+        x = np.arange(24.0).reshape(2, 3, 4)
+        m = nn.StrideSlice([(1, 1, 2, 1), (3, 2, 4, 1)])
+        np.testing.assert_array_equal(np.asarray(m.forward(x)),
+                                      x[0:1, :, 1:3])
+
+    def test_nms_matches_naive(self):
+        rng = np.random.RandomState(3)
+        n = 40
+        x1y1 = rng.rand(n, 2) * 50
+        wh = rng.rand(n, 2) * 30 + 1
+        boxes = np.concatenate([x1y1, x1y1 + wh], axis=1).astype(np.float32)
+        scores = rng.rand(n).astype(np.float32)
+        idx = np.zeros(n, np.int64)
+        count = nn.Nms().nms(scores, boxes, 0.5, idx)
+        kept = idx[:count] - 1
+
+        # naive reference
+        areas = ((boxes[:, 2] - boxes[:, 0] + 1)
+                 * (boxes[:, 3] - boxes[:, 1] + 1))
+        order = np.argsort(-scores, kind="stable").tolist()
+        keep = []
+        while order:
+            i = order.pop(0)
+            keep.append(i)
+            rest = []
+            for j in order:
+                w = min(boxes[i, 2], boxes[j, 2]) - max(boxes[i, 0], boxes[j, 0]) + 1
+                h = min(boxes[i, 3], boxes[j, 3]) - max(boxes[i, 1], boxes[j, 1]) + 1
+                inter = max(w, 0) * max(h, 0) if (w >= 0 and h >= 0) else 0
+                if inter / (areas[i] + areas[j] - inter) <= 0.5:
+                    rest.append(j)
+            order = rest
+        assert kept.tolist() == keep
+
+
+# ---------------------------------------------------------------- utils
+class TestUtils:
+    def test_kth_largest(self):
+        assert kth_largest([5, 1, 9, 3], 1) == 9
+        assert kth_largest([5, 1, 9, 3], 3) == 3
+
+    def test_gradient_checker_layer(self):
+        m = nn.Sequential(nn.Linear(4, 3), nn.Tanh())
+        x = np.random.RandomState(1).randn(2, 4).astype(np.float32)
+        assert GradientChecker(1e-2, 1e-2).check_layer(m, x)
+
+    def test_gradient_checker_weight(self):
+        m = nn.Linear(3, 2)
+        x = np.random.RandomState(2).randn(2, 3).astype(np.float32)
+        assert GradientChecker(1e-2, 1e-2).check_weight(m, x)
