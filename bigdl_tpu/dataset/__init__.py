@@ -6,4 +6,8 @@ from .sample import (
     MiniBatch, PaddingParam, Sample, SampleToBatch, SampleToMiniBatch,
 )
 from .transformer import ChainedTransformer, FnTransformer, Transformer, transformer
-from . import datasets, image, text
+from .ingest import (
+    RecordFileWriter, SeqFileFolder, image_folder, read_records,
+    write_seq_files,
+)
+from . import datasets, image, ingest, text

@@ -118,6 +118,37 @@ def load_news20(data_dir: Optional[str] = None, train: bool = True,
     return out
 
 
+def load_movielens(data_dir: Optional[str] = None,
+                   synthetic_size: int = 1000) -> np.ndarray:
+    """MovieLens-1M style (user, item, rating) int triplets (reference
+    pyspark/bigdl/dataset/movielens.py get_id_pairs/read_data_sets).
+    Parses ``ratings.dat`` (``uid::mid::rating::ts``) when present,
+    synthetic low-rank preference structure otherwise."""
+    if data_dir:
+        path = os.path.join(data_dir, "ratings.dat")
+        if os.path.exists(path):
+            rows = []
+            with open(path) as f:
+                for line in f:
+                    parts = line.strip().split("::")
+                    if len(parts) >= 3:
+                        rows.append([int(parts[0]), int(parts[1]),
+                                     int(float(parts[2]))])
+            return np.asarray(rows, np.int64)
+    rng = np.random.RandomState(12)
+    n_users, n_items, rank = 100, 200, 4
+    u = rng.randn(n_users, rank)
+    v = rng.randn(n_items, rank)
+    rows = []
+    for _ in range(synthetic_size):
+        uid = rng.randint(n_users)
+        mid = rng.randint(n_items)
+        score = u[uid] @ v[mid] + rng.randn() * 0.3
+        rating = int(np.clip(np.round(3 + score), 1, 5))
+        rows.append([uid + 1, mid + 1, rating])
+    return np.asarray(rows, np.int64)
+
+
 def get_glove_w2v(data_dir: Optional[str] = None, dim: int = 50,
                   vocab: Optional[list] = None):
     """word → vector map (reference pyspark/bigdl/dataset/news20.py

@@ -1,0 +1,187 @@
+"""Bulk ingest (reference DataSet.ImageFolder/SeqFileFolder,
+DataSet.scala:441-557, and the seqfile writer
+dataset/image/BGRImgToLocalSeqFile.scala — SURVEY §2.5).
+
+The reference stages ImageNet as Hadoop SequenceFiles of encoded BGR
+images and reads them as a DistributedDataSet.  TPU-native equivalent:
+TFRecord-framed shard files (same len|crc|data|crc framing as the
+tensorboard writer, via the native CRC32C when built) — sharded so a
+multi-host input pipeline can assign shards per host, read
+sequentially (HBM-friendly large sequential IO), and shuffle by shard
+order + in-shard index without loading everything.
+"""
+from __future__ import annotations
+
+import os
+import struct
+from typing import Iterator, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..visualization.crc32c import masked_crc32c
+from .dataset import AbstractDataSet
+from .sample import Sample
+
+_DTYPES = {0: np.uint8, 1: np.float32, 2: np.float64, 3: np.int32,
+           4: np.int64}
+_DTYPE_CODES = {np.dtype(v): k for k, v in _DTYPES.items()}
+
+
+# ----------------------------------------------------------------- records
+def _encode_sample(sample: Sample) -> bytes:
+    """feature dtype|ndim|dims|raw + label dtype|ndim|dims|raw."""
+    out = bytearray()
+    for arr in (np.asarray(sample.feature), np.asarray(sample.label)):
+        # NOT ascontiguousarray — it promotes 0-d to (1,), breaking the
+        # scalar-label shape round-trip
+        if not arr.flags["C_CONTIGUOUS"]:
+            arr = arr.copy()
+        code = _DTYPE_CODES.get(arr.dtype)
+        if code is None:
+            arr = arr.astype(np.float32)
+            code = _DTYPE_CODES[arr.dtype]
+        out += struct.pack("<BB", code, arr.ndim)
+        out += struct.pack(f"<{arr.ndim}i", *arr.shape)
+        out += arr.tobytes()
+    return bytes(out)
+
+
+def _decode_sample(data: bytes) -> Sample:
+    pos = 0
+    arrays = []
+    for _ in range(2):
+        code, ndim = struct.unpack_from("<BB", data, pos)
+        pos += 2
+        shape = struct.unpack_from(f"<{ndim}i", data, pos)
+        pos += 4 * ndim
+        dtype = _DTYPES[code]
+        n = int(np.prod(shape)) if ndim else 1
+        arr = np.frombuffer(data, dtype, n, pos).reshape(shape)
+        pos += arr.nbytes
+        arrays.append(arr)
+    return Sample(arrays[0], arrays[1])
+
+
+class RecordFileWriter:
+    """TFRecord framing: len | crc(len) | data | crc(data) — one shard."""
+
+    def __init__(self, path: str):
+        self._f = open(path, "wb")
+        self.count = 0
+
+    def write(self, data: bytes):
+        header = struct.pack("<Q", len(data))
+        self._f.write(header)
+        self._f.write(struct.pack("<I", masked_crc32c(header)))
+        self._f.write(data)
+        self._f.write(struct.pack("<I", masked_crc32c(data)))
+        self.count += 1
+
+    def close(self):
+        self._f.close()
+
+
+def read_records(path: str, verify: bool = True) -> Iterator[bytes]:
+    with open(path, "rb") as f:
+        while True:
+            header = f.read(8)
+            if len(header) < 8:
+                return
+            (length,) = struct.unpack("<Q", header)
+            (hcrc,) = struct.unpack("<I", f.read(4))
+            data = f.read(length)
+            (dcrc,) = struct.unpack("<I", f.read(4))
+            if verify and (masked_crc32c(header) != hcrc
+                           or masked_crc32c(data) != dcrc):
+                raise IOError(f"corrupt record in {path}")
+            yield data
+
+
+def write_seq_files(samples: Sequence[Sample], folder: str,
+                    shard_size: int = 1024,
+                    prefix: str = "shard") -> List[str]:
+    """Stage samples into sharded record files (reference
+    BGRImgToLocalSeqFile.scala — blockSize images per SequenceFile)."""
+    os.makedirs(folder, exist_ok=True)
+    paths = []
+    writer = None
+    for i, s in enumerate(samples):
+        if i % shard_size == 0:
+            if writer:
+                writer.close()
+            path = os.path.join(folder,
+                                f"{prefix}-{i // shard_size:05d}.records")
+            paths.append(path)
+            writer = RecordFileWriter(path)
+        writer.write(_encode_sample(s))
+    if writer:
+        writer.close()
+    return paths
+
+
+class SeqFileFolder(AbstractDataSet):
+    """DataSet over sharded record files (reference
+    DataSet.SeqFileFolder:470-557).  ``shuffle()`` permutes shard order
+    (in-shard order rides the shard — large sequential reads stay
+    sequential); multi-host pipelines pass ``shard_index/shard_count``
+    to read a disjoint shard subset per host.
+    """
+
+    def __init__(self, folder: str, shard_index: int = 0,
+                 shard_count: int = 1):
+        all_paths = sorted(
+            os.path.join(folder, f) for f in os.listdir(folder)
+            if f.endswith(".records"))
+        self.paths = all_paths[shard_index::shard_count]
+        self._order = list(range(len(self.paths)))
+        self._size: Optional[int] = None
+
+    def size(self) -> int:
+        if self._size is None:
+            self._size = sum(1 for p in self.paths for _ in read_records(p))
+        return self._size
+
+    def shuffle(self):
+        from ..utils.rng import RNG
+
+        perm = RNG().permutation(len(self._order))
+        self._order = [self._order[int(i)] for i in perm]
+
+    def data(self, train: bool) -> Iterator[Sample]:
+        # train iterators loop forever (AbstractDataSet contract —
+        # reference CachedDistriDataSet train iterator, DataSet.scala:255)
+        while True:
+            for shard in self._order:
+                for rec in read_records(self.paths[shard]):
+                    yield _decode_sample(rec)
+            if not train:
+                return
+
+
+# ----------------------------------------------------------------- images
+def image_folder(path: str, scale_to: Optional[int] = None
+                 ) -> List[Tuple[np.ndarray, float]]:
+    """Read a <path>/<class>/<image> tree into (BGR HWC uint8, 1-based
+    label) pairs (reference DataSet.ImageFolder:441-470, LocalImgReader
+    scaleTo).  Class ids are assigned by sorted directory name, matching
+    the reference's consistent label mapping."""
+    from PIL import Image
+
+    classes = sorted(d for d in os.listdir(path)
+                     if os.path.isdir(os.path.join(path, d)))
+    out = []
+    for label, cls in enumerate(classes, start=1):
+        cdir = os.path.join(path, cls)
+        for fname in sorted(os.listdir(cdir)):
+            try:
+                img = Image.open(os.path.join(cdir, fname)).convert("RGB")
+            except Exception:
+                continue
+            if scale_to:
+                w, h = img.size
+                ratio = scale_to / min(w, h)
+                img = img.resize((max(scale_to, int(w * ratio)),
+                                  max(scale_to, int(h * ratio))))
+            rgb = np.asarray(img, np.uint8)
+            out.append((rgb[:, :, ::-1], float(label)))  # RGB→BGR
+    return out

@@ -1,0 +1,2 @@
+"""Example programs (reference example/ — SURVEY §1.8): pretrained-model
+validation, GloVe-CNN text classification, and UDF-style serving."""

@@ -1,0 +1,70 @@
+"""Pretrained-model validation (reference
+example/loadmodel/ModelValidator.scala: model sources BigDL | Caffe |
+Torch; evaluates Top1/Top5 on a labeled image folder).
+
+Usage:
+    python -m bigdl_tpu.examples.model_validator \
+        --model-type bigdl --model lenet.bin --folder val_images/
+"""
+from __future__ import annotations
+
+import argparse
+
+import numpy as np
+
+
+def load_model(model_type: str, model_path: str,
+               def_path: str = None):
+    """Dispatch on source format (ModelValidator.scala BigDlModel /
+    CaffeModel / TorchModel cases)."""
+    from .. import api
+
+    t = model_type.lower()
+    if t == "bigdl":
+        return api.load_bigdl(model_path)
+    if t == "caffe":
+        return api.load_caffe_model(def_path, model_path)
+    if t == "torch":
+        return api.load_torch(model_path)
+    raise ValueError("model-type must be bigdl | caffe | torch")
+
+
+def validate(model, samples, batch_size: int = 32):
+    from ..dataset.dataset import array
+    from ..optim import Top1Accuracy, Top5Accuracy
+    from ..optim.evaluator import Evaluator
+
+    return Evaluator(model).test(array(samples),
+                                 [Top1Accuracy(), Top5Accuracy()],
+                                 batch_size=batch_size)
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--model-type", required=True,
+                        choices=("bigdl", "caffe", "torch"))
+    parser.add_argument("--model", required=True)
+    parser.add_argument("--def-path", default=None,
+                        help="caffe prototxt (caffe source only)")
+    parser.add_argument("--folder", required=True,
+                        help="<folder>/<class>/<img> validation tree")
+    parser.add_argument("-b", "--batch-size", type=int, default=32)
+    args = parser.parse_args(argv)
+
+    from ..dataset import Sample
+    from ..dataset.image import CenterCrop
+    from ..dataset.ingest import image_folder
+
+    model = load_model(args.model_type, args.model, args.def_path)
+    # scale short side to 256 then center-crop 224 — the reference
+    # ModelValidator's BGRImgCropper pipeline (fixed input shape)
+    pairs = image_folder(args.folder, scale_to=256)
+    samples = [Sample(np.asarray(img).transpose(2, 0, 1).astype(np.float32),
+                      lbl)
+               for img, lbl in CenterCrop(224, 224)(iter(pairs))]
+    for result, name in validate(model, samples, args.batch_size):
+        print(f"{name} is {result}")
+
+
+if __name__ == "__main__":
+    main()

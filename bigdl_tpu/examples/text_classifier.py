@@ -1,0 +1,77 @@
+"""Text classification: CNN over pretrained word vectors (reference
+example/textclassification/TextClassifier.scala — GloVe embeddings +
+TemporalConvolution + max-pool over time + MLP, trained on news20).
+
+The embedding lookup happens host-side as a Transformer stage (the
+reference also materializes GloVe vectors per token before batching);
+the model consumes dense (T, D) tensors — static shapes, MXU matmuls.
+"""
+from __future__ import annotations
+
+import argparse
+
+import numpy as np
+
+
+def build_model(class_num: int, embed_dim: int = 50):
+    """reference TextClassifier.buildModel shape: temporal conv bank over
+    the embedded sequence, pooled over time, then an MLP head."""
+    from .. import nn
+
+    return nn.Sequential(
+        nn.TemporalConvolution(embed_dim, 128, 5),  # (N, T, D) → (N, T', 128)
+        nn.ReLU(True),
+        nn.Max(2),                                  # global max over time
+        nn.Linear(128, 128),
+        nn.ReLU(True),
+        nn.Linear(128, class_num),
+        nn.LogSoftMax())
+
+
+def make_samples(seq_len: int = 64, embed_dim: int = 50, train: bool = True):
+    from ..dataset import Sample
+    from ..dataset.datasets import get_glove_w2v, load_news20
+    from ..dataset.text import SentenceTokenizer
+
+    corpus = load_news20(train=train)
+    tok = SentenceTokenizer()
+    tokens = list(tok(iter(text for text, _ in corpus)))
+    vocab = sorted({w for toks in tokens for w in toks})
+    w2v = get_glove_w2v(vocab=vocab, dim=embed_dim)
+    zero = np.zeros(embed_dim, np.float32)
+    samples = []
+    for toks, (_, label) in zip(tokens, corpus):
+        vecs = [w2v.get(w, zero) for w in toks[:seq_len]]
+        vecs += [zero] * (seq_len - len(vecs))
+        samples.append(Sample(np.stack(vecs), np.float32(label)))
+    return samples
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser()
+    parser.add_argument("-b", "--batch-size", type=int, default=32)
+    parser.add_argument("-e", "--max-epoch", type=int, default=5)
+    parser.add_argument("--learning-rate", type=float, default=0.05)
+    parser.add_argument("--classes", type=int, default=20)
+    args = parser.parse_args(argv)
+
+    from .. import nn
+    from ..dataset.dataset import array
+    from ..optim import SGD, Top1Accuracy, every_epoch, max_epoch
+    from ..optim.optimizer import LocalOptimizer
+
+    model = build_model(args.classes)
+    train_s = make_samples(train=True)
+    val_s = make_samples(train=False)
+    opt = LocalOptimizer(model, array(train_s), nn.ClassNLLCriterion(),
+                         batch_size=args.batch_size)
+    opt.set_optim_method(SGD(learning_rate=args.learning_rate))
+    opt.set_end_when(max_epoch(args.max_epoch))
+    opt.set_validation(every_epoch(), array(val_s), [Top1Accuracy()],
+                       batch_size=args.batch_size)
+    opt.optimize()
+    return model
+
+
+if __name__ == "__main__":
+    main()

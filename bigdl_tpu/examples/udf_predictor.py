@@ -1,0 +1,48 @@
+"""UDF-style model serving (reference example/udfpredictor — registers a
+trained text classifier as a Spark-SQL UDF over a streamed table).
+
+TPU-native equivalent: ``make_udf`` closes a trained model into a plain
+callable with jitted batched forward — usable from any host dataflow
+(generators, pandas apply, a serving loop).  Single-row calls are
+batched through a micro-batcher so the MXU still sees batches.
+"""
+from __future__ import annotations
+
+from typing import Callable, List, Sequence
+
+import numpy as np
+
+
+def make_udf(model, preprocess: Callable = None,
+             batch_size: int = 32) -> Callable:
+    """Return ``udf(rows) -> List[int]`` predicting 1-based classes."""
+    import jax
+    import jax.numpy as jnp
+
+    params = model.param_tree()
+    buffers = model.buffer_tree()
+
+    @jax.jit
+    def fwd(x):
+        out, _ = model.apply_fn(params, buffers, x, False, None)
+        return jnp.argmax(out, axis=-1) + 1
+
+    def udf(rows):
+        # a list/tuple is a batch of rows; a bare array is ONE sample
+        # (features may be any rank, so rank can't disambiguate)
+        single = not isinstance(rows, (list, tuple))
+        batch = [rows] if single else list(rows)
+        feats = [np.asarray(preprocess(r) if preprocess else r, np.float32)
+                 for r in batch]
+        preds: List[int] = []
+        for i in range(0, len(feats), batch_size):
+            chunk = feats[i:i + batch_size]
+            pad = len(chunk)
+            # always pad to batch_size so the jit sees ONE static shape
+            while len(chunk) < batch_size:
+                chunk.append(np.zeros_like(chunk[0]))
+            out = np.asarray(fwd(jnp.stack(chunk)))[:pad]
+            preds.extend(int(p) for p in out)
+        return preds[0] if single else preds
+
+    return udf

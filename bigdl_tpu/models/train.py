@@ -1,0 +1,185 @@
+"""End-to-end zoo trainers (reference models/{lenet,vgg,resnet,inception,
+rnn,autoencoder}/Train.scala + Options — SURVEY §1.8).
+
+One argparse CLI replaces the per-model scopt parsers; per-model
+defaults (batch size, schedule, epochs) follow the reference Train
+configs.  Data comes from the hermetic loaders (real files when
+``--folder`` points at MNIST/CIFAR binaries, synthetic otherwise).
+
+Usage:
+    python -m bigdl_tpu.models.train --model lenet5 --max-epoch 5
+    python -m bigdl_tpu.models.train --model vgg --batch-size 128 --distributed
+"""
+from __future__ import annotations
+
+import argparse
+from typing import Optional
+
+import numpy as np
+
+
+def _mnist_samples(folder: Optional[str], train: bool):
+    from ..dataset import Sample
+    from ..dataset.datasets import (TEST_MEAN, TEST_STD, TRAIN_MEAN,
+                                    TRAIN_STD, load_mnist)
+
+    x, y = load_mnist(folder, train)
+    mean, std = (TRAIN_MEAN, TRAIN_STD) if train else (TEST_MEAN, TEST_STD)
+    x = (x.astype(np.float32) - mean) / std
+    return [Sample(xi[None], np.float32(yi)) for xi, yi in zip(x, y)]
+
+
+def _cifar_samples(folder: Optional[str], train: bool):
+    from ..dataset import Sample
+    from ..dataset.datasets import CIFAR_MEAN, CIFAR_STD, load_cifar10
+
+    x, y = load_cifar10(folder, train)
+    x = (x.astype(np.float32) - CIFAR_MEAN) / CIFAR_STD
+    x = x.transpose(0, 3, 1, 2)  # HWC→CHW
+    return [Sample(xi, np.float32(yi)) for xi, yi in zip(x, y)]
+
+
+def _text_samples(vocab_size: int, seq_len: int, train: bool):
+    from ..dataset import Sample
+    from ..dataset.datasets import load_news20
+    from ..dataset.text import Dictionary, SentenceTokenizer
+
+    corpus = load_news20(train=train)
+    tok = SentenceTokenizer()
+    tokens = list(tok(iter(text for text, _ in corpus)))
+    d = Dictionary(iter(tokens), vocab_size=vocab_size - 1)
+    samples = []
+    for toks, (_, label) in zip(tokens, corpus):
+        idx = np.array([d.get_index(w) + 1 for w in toks[:seq_len]],
+                       np.float32)
+        if len(idx) < seq_len:
+            idx = np.pad(idx, (0, seq_len - len(idx)))
+        samples.append(Sample(idx, np.float32(label)))
+    return samples
+
+
+def build(model_name: str, args):
+    """→ (model, criterion, train_samples, val_samples, val_methods)."""
+    from .. import nn
+    from ..optim import Loss, Top1Accuracy
+
+    name = model_name.lower()
+    if name == "lenet5":
+        from .lenet import LeNet5
+
+        return (LeNet5(10), nn.ClassNLLCriterion(),
+                _mnist_samples(args.folder, True),
+                _mnist_samples(args.folder, False), [Top1Accuracy()])
+    if name == "autoencoder":
+        from ..dataset import Sample
+        from .autoencoder import Autoencoder
+
+        base = _mnist_samples(args.folder, True)
+        flat = [Sample(np.asarray(s.feature).reshape(-1),
+                       np.asarray(s.feature).reshape(-1)) for s in base]
+        vflat = flat[:max(1, len(flat) // 10)]
+        return (Autoencoder(32), nn.MSECriterion(), flat, vflat,
+                [Loss(nn.MSECriterion())])
+    if name == "vgg":
+        from .vgg import VggForCifar10
+
+        return (VggForCifar10(10), nn.ClassNLLCriterion(),
+                _cifar_samples(args.folder, True),
+                _cifar_samples(args.folder, False), [Top1Accuracy()])
+    if name == "resnet":
+        from .resnet import ResNetCifar
+
+        return (ResNetCifar(depth=20, class_num=10),
+                nn.ClassNLLCriterion(),
+                _cifar_samples(args.folder, True),
+                _cifar_samples(args.folder, False), [Top1Accuracy()])
+    if name in ("inception_v1", "inception_v2"):
+        from ..dataset import Sample
+        from .inception import Inception_v1, Inception_v2
+
+        rng = np.random.RandomState(0)
+        mk = lambda n: [Sample(rng.rand(3, 224, 224).astype(np.float32),
+                               np.float32(rng.randint(1, 1001)))
+                        for _ in range(n)]
+        model = (Inception_v1 if name == "inception_v1"
+                 else Inception_v2)(1000)
+        return (model, nn.ClassNLLCriterion(), mk(args.batch_size * 4),
+                mk(args.batch_size), [Top1Accuracy()])
+    if name == "rnn":
+        from .rnn import LSTMClassifier
+
+        V, T = 2000, 64
+        return (LSTMClassifier(V + 1, 64, 64, 20),
+                nn.ClassNLLCriterion(),
+                _text_samples(V, T, True), _text_samples(V, T, False),
+                [Top1Accuracy()])
+    raise ValueError(f"unknown model {model_name!r}")
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(
+        description="bigdl_tpu zoo trainer (reference models/*/Train.scala)")
+    parser.add_argument("--model", default="lenet5",
+                        choices=("lenet5", "vgg", "resnet", "inception_v1",
+                                 "inception_v2", "rnn", "autoencoder"))
+    parser.add_argument("-f", "--folder", default=None,
+                        help="dataset folder (synthetic data when absent)")
+    parser.add_argument("-b", "--batch-size", type=int, default=None)
+    parser.add_argument("-e", "--max-epoch", type=int, default=None)
+    parser.add_argument("--learning-rate", type=float, default=None)
+    parser.add_argument("--checkpoint", default=None)
+    parser.add_argument("--summary-dir", default=None)
+    parser.add_argument("--distributed", action="store_true",
+                        help="DistriOptimizer over all visible devices")
+    args = parser.parse_args(argv)
+
+    # per-model defaults from the reference Train configs
+    defaults = {
+        "lenet5": (128, 5, 0.05),        # models/lenet/Train.scala
+        "vgg": (128, 10, 0.01),          # models/vgg/Train.scala
+        "resnet": (128, 10, 0.1),        # models/resnet/Train.scala batch 448
+        "inception_v1": (32, 1, 0.01),
+        "inception_v2": (32, 1, 0.01),
+        "rnn": (32, 5, 0.1),             # models/rnn/Train.scala
+        "autoencoder": (128, 5, 0.01),
+    }[args.model]
+    batch = args.batch_size or defaults[0]
+    epochs = args.max_epoch or defaults[1]
+    lr = args.learning_rate or defaults[2]
+
+    from .. import nn  # noqa: F401 — force registry
+    from ..dataset.dataset import array
+    from ..optim import SGD, Top1Accuracy, every_epoch, max_epoch
+    from ..optim.optimizer import LocalOptimizer
+    from ..utils.engine import Engine
+
+    Engine.init()
+    model, criterion, train_s, val_s, v_methods = build(args.model, args)
+
+    if args.distributed:
+        import jax
+        from jax.sharding import Mesh
+        from ..optim.distri_optimizer import DistriOptimizer
+
+        mesh = Mesh(np.array(jax.devices()), ("data",))
+        opt = DistriOptimizer(model, array(train_s), criterion,
+                              batch_size=batch, mesh=mesh)
+    else:
+        opt = LocalOptimizer(model, array(train_s), criterion,
+                             batch_size=batch)
+    opt.set_optim_method(SGD(learning_rate=lr))
+    opt.set_end_when(max_epoch(epochs))
+    opt.set_validation(every_epoch(), array(val_s), v_methods,
+                       batch_size=batch)
+    if args.checkpoint:
+        opt.set_checkpoint(args.checkpoint, every_epoch())
+    if args.summary_dir:
+        from ..visualization.summary import TrainSummary
+
+        opt.set_train_summary(TrainSummary(args.summary_dir, args.model))
+    opt.optimize()
+    return model
+
+
+if __name__ == "__main__":
+    main()
