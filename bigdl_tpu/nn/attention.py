@@ -15,7 +15,7 @@ from ..parallel.ring_attention import (attention, blockwise_attention,
 from .initialization import IN_OUT, ONE_D, Xavier, Zeros
 from .module import TensorModule
 
-SEQ_STRATEGIES = ("dense", "block", "ring", "ulysses")
+SEQ_STRATEGIES = ("dense", "flash", "block", "ring", "ulysses")
 
 
 class MultiHeadAttention(TensorModule):
@@ -23,6 +23,8 @@ class MultiHeadAttention(TensorModule):
 
     ``seq_strategy`` picks how the sequence dimension is handled:
       * ``"dense"``  — one [T, T] matmul (short sequences)
+      * ``"flash"``  — Pallas online-softmax kernel (ops/flash_attention;
+        jnp fallback off-TPU), scores never materialized
       * ``"block"``  — single-device flash-style blockwise attention
       * ``"ring"``   — ring context parallelism; REQUIRES running inside
         shard_map with the sequence sharded over ``seq_axis``
@@ -75,6 +77,10 @@ class MultiHeadAttention(TensorModule):
         if self.seq_strategy == "block":
             return blockwise_attention(q, k, v, block_size=self.block_size,
                                        causal=self.causal)
+        if self.seq_strategy == "flash":
+            from ..ops import flash_attention
+
+            return flash_attention(q, k, v, causal=self.causal)
         return attention(q, k, v, causal=self.causal)
 
     def _apply(self, params, buffers, x, training, rng):

@@ -256,9 +256,12 @@ class LayerNorm(TensorModule):
         return self
 
     def _apply(self, params, buffers, x, training, rng):
+        if self.affine:
+            # Pallas single-pass kernel on TPU, jnp fallback elsewhere
+            from ..ops import fused_layer_norm
+
+            return fused_layer_norm(x, params["weight"], params["bias"],
+                                    self.eps), buffers
         mean = x.mean(axis=-1, keepdims=True)
         var = ((x - mean) ** 2).mean(axis=-1, keepdims=True)
-        y = (x - mean) * lax.rsqrt(var + self.eps)
-        if self.affine:
-            y = y * params["weight"] + params["bias"]
-        return y, buffers
+        return (x - mean) * lax.rsqrt(var + self.eps), buffers

@@ -1,0 +1,11 @@
+"""bigdl_tpu.ops — Pallas TPU kernels for the hot ops.
+
+XLA fuses most of this framework automatically (SURVEY §7 architecture
+stance); these kernels cover the cases where hand-tiling pays:
+attention's O(T²) score matrix (never materialized — online softmax in
+VMEM) and single-pass LayerNorm.  Everything degrades gracefully: on
+non-TPU backends the public wrappers fall back to reference jnp
+implementations, so tests and CPU development need no TPU.
+"""
+from .flash_attention import flash_attention
+from .layer_norm import fused_layer_norm

@@ -1,0 +1,24 @@
+"""Shared Pallas availability/gating for the ops package."""
+from __future__ import annotations
+
+import jax
+
+try:
+    from jax.experimental import pallas as pl  # noqa: F401
+    from jax.experimental.pallas import tpu as pltpu  # noqa: F401
+
+    HAS_PALLAS = True
+except Exception:  # pragma: no cover
+    pl = None
+    pltpu = None
+    HAS_PALLAS = False
+
+
+def use_kernel(interpret: bool) -> bool:
+    """Kernel path on TPU or when explicitly interpreting; jnp fallback
+    elsewhere (CPU tests exercise kernels with interpret=True)."""
+    if not HAS_PALLAS:
+        return False
+    if interpret:
+        return True
+    return jax.default_backend() == "tpu"

@@ -1,0 +1,163 @@
+"""Flash attention — tiled online-softmax attention as a Pallas TPU
+kernel (the hot op the reference era lacked; replaces materializing the
+(T, T) score matrix in HBM with running (max, denom, acc) statistics in
+VMEM).
+
+Design (pallas_guide.md patterns):
+- grid = (batch·heads, T/block_q); each program owns one q tile.
+- k/v for the (batch, head) ride in VMEM; the kernel walks them in
+  block_k chunks with ``lax.fori_loop`` — VMEM-resident, MXU matmuls
+  with ``preferred_element_type=float32``.
+- online softmax carries m (running row max), l (running denominator),
+  acc (unnormalized output) — the classic streaming rescale.
+- backward: custom_vjp recomputes attention with plain jnp (XLA) — the
+  rematerialization trade the forward kernel's memory saving pays for.
+
+The public ``flash_attention`` falls back to a jnp reference on
+non-TPU backends (or with ``interpret=True`` runs the kernel in the
+Pallas interpreter — used by tests).
+"""
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from ._support import pl, pltpu, use_kernel
+
+
+def _attention_reference(q, k, v, causal: bool, sm_scale: float):
+    """Numerics oracle + backward path — delegates to the canonical
+    dense attention (parallel/ring_attention.py:170), pre-scaling q so a
+    non-default sm_scale still lands on the same code path."""
+    from ..parallel.ring_attention import attention as dense_attention
+
+    d = q.shape[-1]
+    return dense_attention(q * (sm_scale * math.sqrt(d)), k, v, causal)
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, sm_scale: float,
+                causal: bool, block_q: int, block_k: int, seq_len: int):
+    qi = pl.program_id(1)
+    q = q_ref[0].astype(jnp.float32)                      # (block_q, d)
+    d = q.shape[-1]
+
+    m0 = jnp.full((block_q, 1), -jnp.inf, jnp.float32)
+    l0 = jnp.zeros((block_q, 1), jnp.float32)
+    acc0 = jnp.zeros((block_q, d), jnp.float32)
+
+    q_pos = (qi * block_q
+             + jax.lax.broadcasted_iota(jnp.int32, (block_q, 1), 0))
+
+    def body(j, carry):
+        m, l, acc = carry
+        k = k_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        v = v_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * sm_scale  # (bq, bk)
+        if causal:
+            k_pos = (j * block_k
+                     + jax.lax.broadcasted_iota(jnp.int32, (1, block_k), 1))
+            s = jnp.where(q_pos >= k_pos, s, -jnp.inf)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+        # guard fully-masked rows: exp(-inf - -inf) would be nan
+        m_safe = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
+        p = jnp.exp(s - m_safe)
+        scale = jnp.where(jnp.isfinite(m), jnp.exp(m - m_safe), 0.0)
+        l_new = l * scale + jnp.sum(p, axis=-1, keepdims=True)
+        acc_new = acc * scale + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return m_new, l_new, acc_new
+
+    if causal:
+        # only key blocks at or before this q tile contribute — clamped
+        # to the real key length (cross-attention can have T > S)
+        n_blocks = jnp.minimum(
+            jax.lax.div(qi * block_q + block_q + block_k - 1, block_k),
+            seq_len // block_k)
+    else:
+        n_blocks = seq_len // block_k
+    m, l, acc = jax.lax.fori_loop(0, n_blocks, body, (m0, l0, acc0))
+    o_ref[0] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+
+
+def _flash_fwd(q, k, v, causal: bool, sm_scale: float, block_q: int,
+               block_k: int, interpret: bool):
+    B, H, T, D = q.shape
+    S = k.shape[2]
+    bq = min(block_q, T)
+    bk = min(block_k, S)
+    assert T % bq == 0 and S % bk == 0, (
+        f"seq lens ({T}, {S}) must divide block sizes ({bq}, {bk}); "
+        "pad sequences to a block multiple")
+    qr = q.reshape(B * H, T, D)
+    kr = k.reshape(B * H, S, D)
+    vr = v.reshape(B * H, S, D)
+
+    kernel = functools.partial(_fwd_kernel, sm_scale=sm_scale, causal=causal,
+                               block_q=bq, block_k=bk, seq_len=S)
+    out = pl.pallas_call(
+        kernel,
+        grid=(B * H, T // bq),
+        in_specs=[
+            pl.BlockSpec((1, bq, D), lambda bh, i: (bh, i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, S, D), lambda bh, i: (bh, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, S, D), lambda bh, i: (bh, 0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((1, bq, D), lambda bh, i: (bh, i, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((B * H, T, D), q.dtype),
+        interpret=interpret,
+    )(qr, kr, vr)
+    return out.reshape(B, H, T, D)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def _flash(q, k, v, causal, sm_scale, interpret):
+    return _flash_fwd(q, k, v, causal, sm_scale, 128, 128, interpret)
+
+
+def _flash_fwd_rule(q, k, v, causal, sm_scale, interpret):
+    return _flash(q, k, v, causal, sm_scale, interpret), (q, k, v)
+
+
+def _flash_bwd_rule(causal, sm_scale, interpret, res, g):
+    q, k, v = res
+    _, vjp = jax.vjp(
+        lambda q_, k_, v_: _attention_reference(q_, k_, v_, causal, sm_scale),
+        q, k, v)
+    return vjp(g)
+
+
+_flash.defvjp(_flash_fwd_rule, _flash_bwd_rule)
+
+
+def flash_attention(q, k, v, causal: bool = False,
+                    sm_scale: Optional[float] = None,
+                    interpret: bool = False):
+    """Attention over (B, H, T, D) tensors without materializing scores.
+
+    Uses the Pallas kernel on TPU (or under ``interpret=True``); plain
+    XLA attention elsewhere.  The kernel path takes sequence lengths
+    that are 128-multiples, or short 8-aligned sequences that fit one
+    block; anything else falls back (callers pad — the data layer's
+    fixed-length contract already guarantees static shapes).
+    """
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(q.shape[-1])
+    T, S = q.shape[2], k.shape[2]
+
+    def blockable(n):  # one whole block (8-aligned) or a 128-multiple
+        return (n % 128 == 0) or (n < 128 and n % 8 == 0)
+
+    if use_kernel(interpret) and blockable(T) and blockable(S):
+        return _flash(q, k, v, causal, sm_scale, interpret)
+    return _attention_reference(q, k, v, causal, sm_scale)
