@@ -57,6 +57,11 @@ def _load() -> Optional[ctypes.CDLL]:
     lib.btpu_batch_images_f32.argtypes = [
         f32p, ctypes.c_int64, ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
         f32p, f32p, f32p]
+    i64p = np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS")
+    lib.btpu_parse_records.restype = ctypes.c_int64
+    lib.btpu_parse_records.argtypes = [
+        ctypes.c_char_p, ctypes.c_int64, i64p, i64p, ctypes.c_int64,
+        ctypes.c_int]
     lib.btpu_num_threads.restype = ctypes.c_int
     return lib
 
@@ -143,6 +148,28 @@ def bf16_add(dst: np.ndarray, src: np.ndarray) -> np.ndarray:
         s = bf16_to_f32(dst) + bf16_to_f32(src)
         dst[...] = f32_to_bf16(s)
     return dst
+
+
+# ---------------------------------------------------------------------------
+# record-file framing scan (ingest hot loop)
+# ---------------------------------------------------------------------------
+
+def parse_records(buf: bytes, verify: bool = True):
+    """Scan a TFRecord-framed buffer → list of (offset, length) payload
+    spans, CRC-verified natively.  Returns None when the native library
+    is unavailable (caller falls back to the python scanner); raises
+    IOError on corruption."""
+    lib = _get_lib()
+    if lib is None:
+        return None
+    cap = max(1, len(buf) // 16)
+    offsets = np.empty(cap, np.int64)
+    lengths = np.empty(cap, np.int64)
+    n = lib.btpu_parse_records(buf, len(buf), offsets, lengths, cap,
+                               1 if verify else 0)
+    if n < 0:
+        raise IOError(f"corrupt record at byte {-n - 1}")
+    return list(zip(offsets[:n].tolist(), lengths[:n].tolist()))
 
 
 # ---------------------------------------------------------------------------

@@ -24,6 +24,13 @@ def _pair(v):
     return v if isinstance(v, tuple) else (v, v)
 
 
+def _acc_dtype(x):
+    """f32 accumulation for f32 operands; None for low-precision operands
+    (the TPU MXU still accumulates f32 internally, and a mismatched
+    preferred dtype breaks lax conv transpose rules under vjp)."""
+    return jnp.float32 if x.dtype == jnp.float32 else None
+
+
 class SpatialConvolution(TensorModule):
     """2-D conv, NCHW, group support, optional 'same'-ish explicit pads
     (reference nn/SpatialConvolution.scala:42; im2col path replaced by
@@ -73,14 +80,17 @@ class SpatialConvolution(TensorModule):
             padding=padding,
             dimension_numbers=("NCHW", "OIHW", "NCHW"),
             feature_group_count=self.n_group,
-            preferred_element_type=jnp.float32)
+            preferred_element_type=_acc_dtype(x))
 
     def _apply(self, params, buffers, x, training, rng):
         squeeze = False
         if x.ndim == 3:  # no-batch mode
             x = x[None]
             squeeze = True
-        y = self._conv(x, params["weight"])
+        # mixed precision: compute in the weight dtype (bf16 weights →
+        # bf16 MXU inputs), accumulate f32, emit the weight dtype
+        w = params["weight"]
+        y = self._conv(x.astype(w.dtype), w).astype(w.dtype)
         if self.with_bias:
             y = y + params["bias"][None, :, None, None]
         if squeeze:
@@ -111,7 +121,7 @@ class SpatialDilatedConvolution(SpatialConvolution):
             padding=padding,
             rhs_dilation=(self.dilation_h, self.dilation_w),
             dimension_numbers=("NCHW", "OIHW", "NCHW"),
-            preferred_element_type=jnp.float32)
+            preferred_element_type=_acc_dtype(x))
 
 
 class SpatialFullConvolution(TensorModule):
@@ -150,6 +160,7 @@ class SpatialFullConvolution(TensorModule):
             x = x[None]
             squeeze = True
         w = params["weight"]  # (I, O/g, kh, kw)
+        x = x.astype(w.dtype)  # mixed precision: compute in weight dtype
         # Gradient-of-conv formulation: lhs-dilate input by stride.
         pad_h = self.kh - 1 - self.pad_h
         pad_w = self.kw - 1 - self.pad_w
@@ -169,7 +180,7 @@ class SpatialFullConvolution(TensorModule):
             lhs_dilation=(self.dh, self.dw),
             dimension_numbers=("NCHW", "OIHW", "NCHW"),
             feature_group_count=self.n_group,
-            preferred_element_type=jnp.float32)
+            preferred_element_type=_acc_dtype(x))
         if self.with_bias:
             y = y + params["bias"][None, :, None, None]
         if squeeze:
@@ -211,12 +222,13 @@ class SpatialConvolutionMap(TensorModule):
         if x.ndim == 3:
             x = x[None]
             squeeze = True
-        w = params["weight"] * self._mask
+        w = params["weight"] * self._mask.astype(params["weight"].dtype)
+        x = x.astype(w.dtype)  # mixed precision: compute in weight dtype
         y = lax.conv_general_dilated(
             x, w, (self.dh, self.dw),
             [(self.pad_h, self.pad_h), (self.pad_w, self.pad_w)],
             dimension_numbers=("NCHW", "OIHW", "NCHW"),
-            preferred_element_type=jnp.float32)
+            preferred_element_type=_acc_dtype(x))
         y = y + params["bias"][None, :, None, None]
         if squeeze:
             y = y[0]
@@ -253,11 +265,12 @@ class VolumetricConvolution(TensorModule):
         if x.ndim == 4:
             x = x[None]
             squeeze = True
+        x = x.astype(params["weight"].dtype)  # mixed precision
         y = lax.conv_general_dilated(
             x, params["weight"], self.d,
             [(p, p) for p in self.pad],
             dimension_numbers=("NCDHW", "OIDHW", "NCDHW"),
-            preferred_element_type=jnp.float32)
+            preferred_element_type=_acc_dtype(x))
         if self.with_bias:
             y = y + params["bias"][None, :, None, None, None]
         if squeeze:
@@ -292,11 +305,11 @@ class TemporalConvolution(TensorModule):
             x = x[None]
             squeeze = True
         # (N, T, C) -> (N, C, T)
-        xc = jnp.swapaxes(x, 1, 2)
+        xc = jnp.swapaxes(x, 1, 2).astype(params["weight"].dtype)
         y = lax.conv_general_dilated(
             xc, params["weight"], (self.stride_w,), [(0, 0)],
             dimension_numbers=("NCH", "OIH", "NCH"),
-            preferred_element_type=jnp.float32)
+            preferred_element_type=_acc_dtype(xc))
         y = jnp.swapaxes(y, 1, 2) + params["bias"]
         if squeeze:
             y = y[0]

@@ -41,7 +41,10 @@ class Linear(TensorModule):
         return self
 
     def _apply(self, params, buffers, x, training, rng):
-        y = jnp.dot(x, params["weight"].T)
+        w = params["weight"]
+        # compute in the weight dtype, accumulate f32 on the MXU
+        y = jnp.dot(x.astype(w.dtype), w.T,
+                    preferred_element_type=jnp.float32).astype(w.dtype)
         if self.with_bias:
             y = y + params["bias"]
         return y, buffers

@@ -251,6 +251,51 @@ void btpu_batch_images_f32(const float* images, int64_t n, int64_t h,
   });
 }
 
+// ---------------------------------------------------------------------------
+// Record-file framing scan (the ingest hot loop): walk a TFRecord-framed
+// buffer (len | crc(len) | data | crc(data)), verify both masked CRC32Cs,
+// and emit (offset, length) pairs for the data payloads.  Returns the
+// record count, or -(byte position + 1) at the first corruption.
+// ---------------------------------------------------------------------------
+namespace {
+inline uint32_t masked_crc(const uint8_t* data, int64_t n) {
+  uint32_t crc = btpu_crc32c(data, n, 0);
+  return (((crc >> 15) | (crc << 17)) + 0xA282EAD8u);
+}
+inline uint32_t load_u32(const uint8_t* p) {
+  uint32_t v;
+  std::memcpy(&v, p, 4);
+  return v;
+}
+}  // namespace
+
+int64_t btpu_parse_records(const uint8_t* buf, int64_t n, int64_t* offsets,
+                           int64_t* lengths, int64_t max_records,
+                           int verify) {
+  int64_t pos = 0;
+  int64_t count = 0;
+  while (pos + 12 <= n && count < max_records) {
+    uint64_t len;
+    std::memcpy(&len, buf + pos, 8);
+    // unsigned check first: a length with high bits set must not wrap
+    // negative and slip past the bounds arithmetic below
+    if (len > static_cast<uint64_t>(n) ||
+        pos + 16 + static_cast<int64_t>(len) > n)
+      return -(pos + 1);
+    if (verify) {
+      if (load_u32(buf + pos + 8) != masked_crc(buf + pos, 8))
+        return -(pos + 1);
+      if (load_u32(buf + pos + 12 + len) != masked_crc(buf + pos + 12, len))
+        return -(pos + 1);
+    }
+    offsets[count] = pos + 12;
+    lengths[count] = static_cast<int64_t>(len);
+    ++count;
+    pos += 16 + static_cast<int64_t>(len);
+  }
+  return count;
+}
+
 int btpu_num_threads() { return pool().size(); }
 
 }  // extern "C"

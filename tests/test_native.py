@@ -129,3 +129,29 @@ def test_mt_batcher_transformer():
         imgs[0].astype(np.float32).transpose(2, 0, 1) / 255.0, rtol=1e-6)
     np.testing.assert_array_equal(np.asarray(first.get_target()),
                                   [1.0, 2.0, 3.0, 4.0])
+
+
+def test_native_parse_records_matches_python():
+    import struct as _struct
+    import tempfile
+
+    from bigdl_tpu import native
+    from bigdl_tpu.dataset import Sample, write_seq_files
+    from bigdl_tpu.dataset.ingest import read_records
+
+    samples = [Sample(RNG.rand(4).astype(np.float32), np.float32(i + 1))
+               for i in range(5)]
+    d = tempfile.mkdtemp()
+    [path] = write_seq_files(samples, d, shard_size=8)
+    buf = open(path, "rb").read()
+
+    recs = list(read_records(path))
+    assert len(recs) == 5
+    if native.available():
+        spans = native.parse_records(buf)
+        assert [buf[o:o + n] for o, n in spans] == recs
+        # corruption -> IOError with byte position
+        bad = bytearray(buf)
+        bad[len(buf) // 2] ^= 0xFF
+        with pytest.raises(IOError):
+            native.parse_records(bytes(bad))
