@@ -26,17 +26,6 @@ from ..parallel.tensor_parallel import ColumnParallelLinear, RowParallelLinear
 from ..utils.rng import next_jax_key
 
 
-def _thread_children(modules, params, buffers, x, training, rng, start=0):
-    """Run children sequentially, threading buffers and splitting rng per
-    child (same convention as the Sequential container)."""
-    new_buffers = dict(buffers)
-    for i, m in enumerate(modules, start=start):
-        sub = jax.random.fold_in(rng, i) if rng is not None else None
-        x, nb = m.apply_fn(params[str(i)], buffers[str(i)], x, training, sub)
-        new_buffers[str(i)] = nb
-    return x, new_buffers
-
-
 class TransformerBlock(Container):
     """Pre-norm residual block: x + MHA(LN(x)); x + MLP(LN(x))."""
 
@@ -86,13 +75,15 @@ class TransformerLM(Container):
                  num_heads: int = 8, mlp_dim: Optional[int] = None,
                  num_layers: int = 4, max_len: int = 2048,
                  causal: bool = True, seq_strategy: str = "dense",
-                 seq_axis: str = "seq", model_axis: Optional[str] = None):
+                 seq_axis: str = "seq", model_axis: Optional[str] = None,
+                 remat: bool = False):
         mlp_dim = mlp_dim or 4 * embed_dim
         self.vocab_size = vocab_size
         self.embed_dim = embed_dim
         self.max_len = max_len
         self.seq_axis = seq_axis
         self.seq_strategy = seq_strategy
+        self.remat = remat
         blocks = [TransformerBlock(embed_dim, num_heads, mlp_dim, causal,
                                    seq_strategy, seq_axis, model_axis)
                   for _ in range(num_layers)]
@@ -159,7 +150,22 @@ class TransformerLM(Container):
                                jax.random.fold_in(rng, 0)
                                if rng is not None else None)
         h = h + self._positions(params["pos"], h.shape[1])
-        logits, nb = _thread_children(self.modules[1:], params, buffers, h,
-                                      training, rng, start=1)
-        nb["0"] = eb
-        return jax.nn.log_softmax(logits, axis=-1), nb
+        new_buffers = dict(buffers)
+        for i, m in enumerate(self.modules[1:], start=1):
+            sub = jax.random.fold_in(rng, i) if rng is not None else None
+            apply = m.apply_fn
+            if self.remat and isinstance(m, TransformerBlock):
+                # rematerialize each block's activations in the backward
+                # pass — HBM for FLOPs (jax.checkpoint; SURVEY north-star
+                # memory recipe).  training/sub close over; params/
+                # buffers/h are the differentiated residuals.
+                apply = jax.checkpoint(
+                    lambda p, b, h_, _m=m, _s=sub: _m.apply_fn(
+                        p, b, h_, training, _s))
+                h, nb = apply(params[str(i)], buffers[str(i)], h)
+            else:
+                h, nb = apply(params[str(i)], buffers[str(i)], h, training,
+                              sub)
+            new_buffers[str(i)] = nb
+        new_buffers["0"] = eb
+        return jax.nn.log_softmax(h, axis=-1), new_buffers

@@ -1,6 +1,7 @@
 from .engine import Engine, init_engine
 from .rng import RNG, RandomGenerator, set_global_seed
 from .table import T, Table
+from .directed_graph import DirectedGraph, Node
 from .util import LoggerFilter, kth_largest
 from .gradient_checker import GradientChecker
 from . import torch_file as TorchFile

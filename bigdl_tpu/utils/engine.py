@@ -61,6 +61,40 @@ class Engine:
         cls._mesh = None
         return cls
 
+    _distributed = False
+
+    @classmethod
+    def init_distributed(cls, coordinator_address: Optional[str] = None,
+                         num_processes: Optional[int] = None,
+                         process_id: Optional[int] = None):
+        """Join the multi-host jax runtime then discover topology.
+
+        The DCN analogue of the reference's Spark-cluster bring-up
+        (Engine.createSparkConf + init, Engine.scala:74-93): one process
+        per host, devices global after initialize().  Arguments fall back
+        to the ``bigdl.coordinator.*`` properties / jax env autodetection.
+        Re-entrant like ``init``: the one-shot jax.distributed.initialize
+        only runs on the first call.
+        """
+        if not cls._distributed:
+            kwargs = {}
+            addr = (coordinator_address
+                    or get_property("bigdl.coordinator.address"))
+            if addr is not None:
+                kwargs["coordinator_address"] = addr
+            n = (num_processes
+                 if num_processes is not None
+                 else get_property("bigdl.coordinator.num.processes"))
+            if n is not None:
+                kwargs["num_processes"] = int(n)
+            pid = (process_id if process_id is not None
+                   else get_property("bigdl.coordinator.process.id"))
+            if pid is not None:
+                kwargs["process_id"] = int(pid)
+            jax.distributed.initialize(**kwargs)
+            cls._distributed = True
+        return cls.init()
+
     @classmethod
     def node_number(cls) -> int:
         cls._ensure()

@@ -1,0 +1,56 @@
+"""DirectedGraph/Node utils (reference utils/DirectedGraphSpec)."""
+import pytest
+
+from bigdl_tpu.utils import DirectedGraph, Node
+
+
+def _diamond():
+    a, b, c, d = Node("a"), Node("b"), Node("c"), Node("d")
+    a.add(b)
+    a.add(c)
+    b.add(d)
+    c.add(d)
+    return a, b, c, d
+
+
+def test_size_and_edges():
+    a, *_ = _diamond()
+    g = a.graph()
+    assert g.size() == 4
+    assert g.edges() == 4
+
+
+def test_topology_sort_respects_dependencies():
+    a, b, c, d = _diamond()
+    order = [n.element for n in a.graph().topology_sort()]
+    assert order.index("a") < order.index("b") < order.index("d")
+    assert order.index("a") < order.index("c") < order.index("d")
+
+
+def test_reverse_graph_walks_prev_edges():
+    a, b, c, d = _diamond()
+    order = [n.element for n in DirectedGraph(d, reverse=True).topology_sort()]
+    assert order.index("d") < order.index("b") < order.index("a")
+
+
+def test_cycle_detection():
+    a, b = Node("a"), Node("b")
+    a.add(b)
+    b.add(a)
+    with pytest.raises(ValueError, match="cycle"):
+        a.graph().topology_sort()
+
+
+def test_bfs_dfs_visit_all_once():
+    a, *_ = _diamond()
+    bfs = [n.element for n in a.graph().bfs()]
+    dfs = [n.element for n in a.graph().dfs()]
+    assert sorted(bfs) == sorted(dfs) == ["a", "b", "c", "d"]
+    assert bfs[0] == dfs[0] == "a"
+
+
+def test_delete_edge():
+    a, b, c, d = _diamond()
+    b.delete(d)
+    assert a.graph().edges() == 3
+    assert d not in b.next_nodes and b not in d.prev_nodes
