@@ -190,11 +190,16 @@ class DistriOptimizer(Optimizer):
         data_iter = self.dataset.data(train=True)
         wall_start = time.time()
 
+        pending = None
         while not self.end_when(state):
             state["epoch_finished"] = False
             t_data0 = time.time()
-            batch = next(data_iter)
-            x, y = _device_batch(batch)
+            if pending is not None:
+                batch, x, y = pending
+                pending = None
+            else:
+                batch = next(data_iter)
+                x, y = _device_batch(batch)
             if batch.size() % n_dev != 0:
                 # static-shape contract: global batch must divide the mesh
                 # (reference requires batchSize % nodeNumber == 0 too,
@@ -215,7 +220,12 @@ class DistriOptimizer(Optimizer):
             lr = optim.get_current_lr()
             loss, params, buffers, slots = jitted(
                 params, buffers, slots, jnp.float32(lr), next_jax_key(), x, y)
-            loss = float(loss)
+            # overlap next-batch host prep + infeed with this device step
+            # (in-epoch only, preserving rollover/shuffle semantics)
+            if records_this_epoch + batch.size() < epoch_size:
+                nb = next(data_iter)
+                pending = (nb, *_device_batch(nb))
+            loss = float(loss)  # device sync
             train_time = time.time() - t0
 
             n_records = batch.size()

@@ -194,20 +194,28 @@ class LocalOptimizer(Optimizer):
         data_iter = self.dataset.data(train=True)
         wall_start = time.time()
 
+        def fetch():
+            t0 = time.time()
+            b = next(data_iter)
+            x, y = _device_batch(b)  # device transfer dispatches async
+            return b.size(), x, y, time.time() - t0
+
+        pending = None
         while not self.end_when(state):
             state["epoch_finished"] = False
-            t_data0 = time.time()
-            batch = next(data_iter)
-            x, y = _device_batch(batch)
-            data_time = time.time() - t_data0
+            n_records, x, y, data_time = pending or fetch()
+            pending = None
 
             t0 = time.time()
             lr = optim.get_current_lr()
             rng = next_jax_key()
             loss, params, buffers, slots = jitted(
                 params, buffers, slots, jnp.float32(lr), rng, x, y)
-            loss = float(loss)
-            n_records = batch.size()
+            # prefetch the next batch while the device runs this step —
+            # only within the epoch, so rollover/shuffle semantics hold
+            if records_this_epoch + n_records < epoch_size:
+                pending = fetch()
+            loss = float(loss)  # device sync
             train_time = time.time() - t0
 
             self.metrics.add("computing time average", train_time)
