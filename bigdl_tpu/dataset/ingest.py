@@ -102,6 +102,10 @@ def read_records(path: str, verify: bool = True) -> Iterator[bytes]:
     while pos + 12 <= len(buf):
         (length,) = struct.unpack_from("<Q", buf, pos)
         (hcrc,) = struct.unpack_from("<I", buf, pos + 8)
+        if pos + 16 + length > len(buf):
+            # truncated/corrupt length field — same contract as the
+            # native btpu_parse_records path
+            raise IOError(f"corrupt record in {path}: truncated at {pos}")
         data = buf[pos + 12:pos + 12 + length]
         (dcrc,) = struct.unpack_from("<I", buf, pos + 12 + length)
         if verify and (masked_crc32c(buf[pos:pos + 8]) != hcrc

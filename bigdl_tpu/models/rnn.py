@@ -18,12 +18,15 @@ def SimpleRNN(input_size: int = 4001, hidden_size: int = 40,
 
 
 def LSTMClassifier(vocab_size: int, embed_dim: int, hidden: int,
-                   class_num: int) -> nn.Sequential:
-    """LSTM/GRU text classification config (BASELINE.md workload 5)."""
+                   class_num: int, padding_value: int = 0) -> nn.Sequential:
+    """LSTM/GRU text classification config (BASELINE.md workload 5).
+
+    ``padding_value``: dedicated padding token id whose embedding rows
+    are zeroed (0 = no padding id)."""
     from ..nn.recurrent import LSTM, Recurrent
 
     return nn.Sequential(
-        nn.LookupTable(vocab_size, embed_dim),
+        nn.LookupTable(vocab_size, embed_dim, padding_value=padding_value),
         Recurrent(LSTM(embed_dim, hidden)),
         nn.Select(2, -1),  # last timestep
         nn.Linear(hidden, class_num),

@@ -53,7 +53,12 @@ def _text_samples(vocab_size: int, seq_len: int, train: bool):
         idx = np.array([d.get_index(w) + 1 for w in toks[:seq_len]],
                        np.float32)
         if len(idx) < seq_len:
-            idx = np.pad(idx, (0, seq_len - len(idx)))
+            # pad with the dedicated id (vocab_size + 1): known words map
+            # to 1..vocab_size-1 and the Dictionary's OOV bucket to
+            # vocab_size, so only vocab_size+1 aliases nothing;
+            # LookupTable(padding_value=vocab_size+1) zeroes those rows
+            idx = np.pad(idx, (0, seq_len - len(idx)),
+                         constant_values=float(vocab_size + 1))
         samples.append(Sample(idx, np.float32(label)))
     return samples
 
@@ -109,7 +114,8 @@ def build(model_name: str, args):
         from .rnn import LSTMClassifier
 
         V, T = 2000, 64
-        return (LSTMClassifier(V + 1, 64, 64, 20),
+        # V+2 rows: ids 1..V-1 words, V = OOV bucket, V+1 = padding
+        return (LSTMClassifier(V + 2, 64, 64, 20, padding_value=V + 1),
                 nn.ClassNLLCriterion(),
                 _text_samples(V, T, True), _text_samples(V, T, False),
                 [Top1Accuracy()])

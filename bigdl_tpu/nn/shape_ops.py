@@ -26,13 +26,14 @@ class Reshape(TensorModule):
         self.n_element = int(np.prod(self.size))
 
     def _apply(self, params, buffers, x, training, rng):
-        batch = self.batch_mode
-        if batch is None:
-            batch = (x.ndim > len(self.size)
-                     and int(np.prod(x.shape[1:])) == self.n_element)
-        if batch:
-            return x.reshape((x.shape[0],) + self.size), buffers
-        return x.reshape(self.size), buffers
+        # reference Reshape.scala:53-66 — no-batch iff batchMode=Some(false),
+        # or unset with an exact element match and a non-1 leading dim
+        total = int(np.prod(x.shape))
+        if self.batch_mode is False or (
+                self.batch_mode is None and total == self.n_element
+                and x.shape[0] != 1):
+            return x.reshape(self.size), buffers
+        return x.reshape((x.shape[0],) + self.size), buffers
 
 
 class View(TensorModule):

@@ -37,14 +37,19 @@ class Fill(TensorModule):
 
     def _apply(self, params, buffers, x, training, rng):
         shape = tuple(int(v) for v in np.asarray(x).reshape(-1))
-        return jnp.full(shape, self.value, jnp.float32), buffers
+        # output dtype follows the fill value (reference nn/tf/Fill.scala
+        # preserves the value's dtype)
+        return jnp.full(shape, self.value,
+                        jnp.asarray(self.value).dtype), buffers
 
 
 class Shape(TensorModule):
-    """Output the input's shape as a 1-D tensor (reference nn/tf/Shape.scala)."""
+    """Output the input's shape as a 1-D int32 tensor (reference
+    nn/tf/Shape.scala — shapes are integer tensors; consumers needing
+    floats convert at the use site)."""
 
     def _apply(self, params, buffers, x, training, rng):
-        return jnp.asarray(x.shape, jnp.float32), buffers
+        return jnp.asarray(x.shape, jnp.int32), buffers
 
 
 class SplitAndSelect(TensorModule):

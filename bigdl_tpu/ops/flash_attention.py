@@ -4,14 +4,21 @@ kernel (the hot op the reference era lacked; replaces materializing the
 VMEM).
 
 Design (pallas_guide.md patterns):
-- grid = (batch·heads, T/block_q); each program owns one q tile.
-- k/v for the (batch, head) ride in VMEM; the kernel walks them in
-  block_k chunks with ``lax.fori_loop`` — VMEM-resident, MXU matmuls
-  with ``preferred_element_type=float32``.
+- grid = (batch*heads, T/block_q, S/block_k); each program owns one
+  (q tile, k tile) pair.  K/V blocks are *streamed* from HBM by the
+  BlockSpec index_map — VMEM holds only one (block_k, d) K and V tile at
+  a time, so sequence length is bounded by HBM, not VMEM.
 - online softmax carries m (running row max), l (running denominator),
-  acc (unnormalized output) — the classic streaming rescale.
-- backward: custom_vjp recomputes attention with plain jnp (XLA) — the
-  rematerialization trade the forward kernel's memory saving pays for.
+  acc (unnormalized output) in VMEM scratch across the innermost k grid
+  dimension — the classic streaming rescale; output is written once on
+  the final k step.
+- causal: key blocks strictly above the diagonal are skipped via
+  ``pl.when`` (no wasted MXU work).
+- backward: a two-pass blockwise (FlashAttention-2 style) XLA program —
+  pass 1 recomputes the softmax statistics (m, l, o) online, pass 2
+  scans K/V blocks accumulating dq and emitting per-block dk/dv.  Peak
+  memory is O(T*block), never O(T^2): the dense score matrix is not
+  materialized in either pass.
 
 The public ``flash_attention`` falls back to a jnp reference on
 non-TPU backends (or with ``interpret=True`` runs the kernel in the
@@ -25,65 +32,78 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
+from jax import lax
 
 from ._support import pl, pltpu, use_kernel
 
+NEG_INF = -1e30  # finite mask value — keeps exp()/max() NaN-free
+_LANES = 128  # VMEM scratch lane width (TPU-friendly minor dim)
+
 
 def _attention_reference(q, k, v, causal: bool, sm_scale: float):
-    """Numerics oracle + backward path — delegates to the canonical
-    dense attention (parallel/ring_attention.py:170), pre-scaling q so a
-    non-default sm_scale still lands on the same code path."""
+    """Numerics oracle + short-sequence fallback — delegates to the
+    canonical dense attention (parallel/ring_attention.py:170),
+    pre-scaling q so a non-default sm_scale lands on the same path."""
     from ..parallel.ring_attention import attention as dense_attention
 
     d = q.shape[-1]
     return dense_attention(q * (sm_scale * math.sqrt(d)), k, v, causal)
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, sm_scale: float,
-                causal: bool, block_q: int, block_k: int, seq_len: int):
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, *,
+                sm_scale: float, causal: bool, block_q: int, block_k: int,
+                num_k_blocks: int):
     qi = pl.program_id(1)
-    q = q_ref[0].astype(jnp.float32)                      # (block_q, d)
-    d = q.shape[-1]
+    ki = pl.program_id(2)
 
-    m0 = jnp.full((block_q, 1), -jnp.inf, jnp.float32)
-    l0 = jnp.zeros((block_q, 1), jnp.float32)
-    acc0 = jnp.zeros((block_q, d), jnp.float32)
+    @pl.when(ki == 0)
+    def _init():
+        m_scr[...] = jnp.full_like(m_scr, -jnp.inf)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        acc_scr[...] = jnp.zeros_like(acc_scr)
 
-    q_pos = (qi * block_q
-             + jax.lax.broadcasted_iota(jnp.int32, (block_q, 1), 0))
-
-    def body(j, carry):
-        m, l, acc = carry
-        k = k_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
-        v = v_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
-        s = jax.lax.dot_general(
+    def compute():
+        q = q_ref[0].astype(jnp.float32)                  # (block_q, d)
+        k = k_ref[0].astype(jnp.float32)                  # (block_k, d)
+        v = v_ref[0].astype(jnp.float32)
+        s = lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * sm_scale  # (bq, bk)
         if causal:
-            k_pos = (j * block_k
-                     + jax.lax.broadcasted_iota(jnp.int32, (1, block_k), 1))
+            q_pos = (qi * block_q
+                     + lax.broadcasted_iota(jnp.int32, (block_q, 1), 0))
+            k_pos = (ki * block_k
+                     + lax.broadcasted_iota(jnp.int32, (1, block_k), 1))
             s = jnp.where(q_pos >= k_pos, s, -jnp.inf)
+
+        m = m_scr[...][:, :1]                             # (bq, 1)
+        l = l_scr[...][:, :1]
+        acc = acc_scr[...]
         m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
         # guard fully-masked rows: exp(-inf - -inf) would be nan
         m_safe = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
         p = jnp.exp(s - m_safe)
         scale = jnp.where(jnp.isfinite(m), jnp.exp(m - m_safe), 0.0)
         l_new = l * scale + jnp.sum(p, axis=-1, keepdims=True)
-        acc_new = acc * scale + jax.lax.dot_general(
+        acc_new = acc * scale + lax.dot_general(
             p, v, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
-        return m_new, l_new, acc_new
+        m_scr[...] = jnp.broadcast_to(m_new, m_scr.shape)
+        l_scr[...] = jnp.broadcast_to(l_new, l_scr.shape)
+        acc_scr[...] = acc_new
 
     if causal:
-        # only key blocks at or before this q tile contribute — clamped
-        # to the real key length (cross-attention can have T > S)
-        n_blocks = jnp.minimum(
-            jax.lax.div(qi * block_q + block_q + block_k - 1, block_k),
-            seq_len // block_k)
+        # key blocks strictly above the diagonal contribute nothing
+        @pl.when(ki * block_k <= qi * block_q + block_q - 1)
+        def _():
+            compute()
     else:
-        n_blocks = seq_len // block_k
-    m, l, acc = jax.lax.fori_loop(0, n_blocks, body, (m0, l0, acc0))
-    o_ref[0] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+        compute()
+
+    @pl.when(ki == num_k_blocks - 1)
+    def _finish():
+        l = l_scr[...][:, :1]
+        o_ref[0] = (acc_scr[...] / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
 
 
 def _flash_fwd(q, k, v, causal: bool, sm_scale: float, block_q: int,
@@ -99,22 +119,28 @@ def _flash_fwd(q, k, v, causal: bool, sm_scale: float, block_q: int,
     kr = k.reshape(B * H, S, D)
     vr = v.reshape(B * H, S, D)
 
+    nk = S // bk
     kernel = functools.partial(_fwd_kernel, sm_scale=sm_scale, causal=causal,
-                               block_q=bq, block_k=bk, seq_len=S)
+                               block_q=bq, block_k=bk, num_k_blocks=nk)
     out = pl.pallas_call(
         kernel,
-        grid=(B * H, T // bq),
+        grid=(B * H, T // bq, nk),
         in_specs=[
-            pl.BlockSpec((1, bq, D), lambda bh, i: (bh, i, 0),
+            pl.BlockSpec((1, bq, D), lambda bh, i, j: (bh, i, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, S, D), lambda bh, i: (bh, 0, 0),
+            pl.BlockSpec((1, bk, D), lambda bh, i, j: (bh, j, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, S, D), lambda bh, i: (bh, 0, 0),
+            pl.BlockSpec((1, bk, D), lambda bh, i, j: (bh, j, 0),
                          memory_space=pltpu.VMEM),
         ],
-        out_specs=pl.BlockSpec((1, bq, D), lambda bh, i: (bh, i, 0),
+        out_specs=pl.BlockSpec((1, bq, D), lambda bh, i, j: (bh, i, 0),
                                memory_space=pltpu.VMEM),
         out_shape=jax.ShapeDtypeStruct((B * H, T, D), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((bq, _LANES), jnp.float32),   # running row max
+            pltpu.VMEM((bq, _LANES), jnp.float32),   # running denominator
+            pltpu.VMEM((bq, D), jnp.float32),        # unnormalized output
+        ],
         interpret=interpret,
     )(qr, kr, vr)
     return out.reshape(B, H, T, D)
@@ -130,11 +156,81 @@ def _flash_fwd_rule(q, k, v, causal, sm_scale, interpret):
 
 
 def _flash_bwd_rule(causal, sm_scale, interpret, res, g):
+    """Blockwise (FlashAttention-2) backward: O(T*block) memory.
+
+    Pass 1 recomputes the online-softmax statistics (row max m, row sum
+    l, output o) by scanning K/V blocks; pass 2 scans the same blocks
+    computing per-block p = exp(s - lse) on the fly, accumulating
+    dq and emitting dk/dv per block.  No (T, S) array is ever live."""
     q, k, v = res
-    _, vjp = jax.vjp(
-        lambda q_, k_, v_: _attention_reference(q_, k_, v_, causal, sm_scale),
-        q, k, v)
-    return vjp(g)
+    B, H, Tq, D = q.shape
+    Tk = k.shape[2]
+    block = min(512, Tk)
+    nb = -(-Tk // block)
+    pad = nb * block - Tk
+
+    qf = q.astype(jnp.float32)
+    g32 = g.astype(jnp.float32)
+    kf = k.astype(jnp.float32)
+    vf = v.astype(jnp.float32)
+    if pad:
+        kf = jnp.pad(kf, ((0, 0), (0, 0), (0, pad), (0, 0)))
+        vf = jnp.pad(vf, ((0, 0), (0, 0), (0, pad), (0, 0)))
+    kb = kf.reshape(B, H, nb, block, D).transpose(2, 0, 1, 3, 4)
+    vb = vf.reshape(B, H, nb, block, D).transpose(2, 0, 1, 3, 4)
+    q_pos = jnp.arange(Tq)
+
+    def block_bias(idx):
+        k_pos = idx * block + jnp.arange(block)
+        bias = jnp.where(k_pos < Tk, 0.0, NEG_INF)[None, :]  # pad mask
+        if causal:
+            bias = bias + jnp.where(q_pos[:, None] >= k_pos[None, :],
+                                    0.0, NEG_INF)
+        return bias  # (Tq, block) or (1, block)
+
+    def scores(kblk, idx):
+        s = jnp.einsum("bhqd,bhkd->bhqk", qf, kblk,
+                       preferred_element_type=jnp.float32) * sm_scale
+        return s + block_bias(idx)
+
+    # ---- pass 1: recompute softmax stats + output, online ------------
+    def fwd_body(carry, blk):
+        m, l, o = carry
+        kblk, vblk, idx = blk
+        s = scores(kblk, idx)
+        m_new = jnp.maximum(m, s.max(axis=-1))
+        p = jnp.exp(s - m_new[..., None])
+        c = jnp.exp(m - m_new)
+        l_new = l * c + p.sum(axis=-1)
+        o_new = o * c[..., None] + jnp.einsum("bhqk,bhkd->bhqd", p, vblk)
+        return (m_new, l_new, o_new), None
+
+    init = (jnp.full((B, H, Tq), NEG_INF, jnp.float32),
+            jnp.zeros((B, H, Tq), jnp.float32),
+            jnp.zeros((B, H, Tq, D), jnp.float32))
+    (m, l, o), _ = lax.scan(fwd_body, init, (kb, vb, jnp.arange(nb)))
+    l_safe = jnp.where(l == 0.0, 1.0, l)
+    o = o / l_safe[..., None]
+    lse = m + jnp.log(l_safe)                       # (B, H, Tq)
+    delta = jnp.sum(g32 * o, axis=-1)               # (B, H, Tq)
+
+    # ---- pass 2: dq accumulates; dk/dv emitted per block -------------
+    def bwd_body(dq, blk):
+        kblk, vblk, idx = blk
+        p = jnp.exp(scores(kblk, idx) - lse[..., None])
+        dv_blk = jnp.einsum("bhqk,bhqd->bhkd", p, g32)
+        dp = jnp.einsum("bhqd,bhkd->bhqk", g32, vblk)
+        ds = p * (dp - delta[..., None])
+        dq = dq + jnp.einsum("bhqk,bhkd->bhqd", ds, kblk) * sm_scale
+        dk_blk = jnp.einsum("bhqk,bhqd->bhkd", ds, qf) * sm_scale
+        return dq, (dk_blk, dv_blk)
+
+    dq, (dkb, dvb) = lax.scan(
+        bwd_body, jnp.zeros((B, H, Tq, D), jnp.float32),
+        (kb, vb, jnp.arange(nb)))
+    dk = dkb.transpose(1, 2, 0, 3, 4).reshape(B, H, nb * block, D)[:, :, :Tk]
+    dv = dvb.transpose(1, 2, 0, 3, 4).reshape(B, H, nb * block, D)[:, :, :Tk]
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
 
 
 _flash.defvjp(_flash_fwd_rule, _flash_bwd_rule)

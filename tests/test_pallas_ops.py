@@ -50,6 +50,26 @@ class TestFlashAttention:
             np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                        rtol=1e-3, atol=1e-4)
 
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_grad_multi_block_backward(self, causal):
+        # T=640 → backward block=512, 2 K/V blocks with 384 pad: exercises
+        # the blockwise two-pass backward's rescale + pad masking
+        q, k, v = self._qkv(B=1, H=2, T=640, seed=4)
+
+        def loss_flash(q_, k_, v_):
+            return jnp.sum(flash_attention(q_, k_, v_, causal=causal,
+                                           interpret=True) ** 2)
+
+        def loss_ref(q_, k_, v_):
+            return jnp.sum(_attention_reference(
+                q_, k_, v_, causal, 1 / np.sqrt(q.shape[-1])) ** 2)
+
+        gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+        gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(gf, gr):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-3, atol=1e-4)
+
     def test_causal_cross_attention_t_gt_s(self):
         # T=256 queries over S=128 keys: n_blocks must clamp to S//bk
         rng = np.random.RandomState(8)
