@@ -83,6 +83,26 @@ _LOSS_TO_MODULE = {"SoftmaxWithLoss": "SoftMax", "Softmax": "SoftMax"}
 from ..nn.module import AbstractModule  # noqa: E402
 
 
+class _AxisBias(AbstractModule):
+    """Caffe Bias layer: add a learnable blob broadcast starting at
+    ``axis`` of the input (left-aligned, trailing dims broadcast) —
+    works for any input rank, unlike a fixed (1, C, 1, 1) shape."""
+
+    def __init__(self, blob_shape, axis: int = 1):
+        super().__init__()
+        self.axis = axis
+        self._register_param("bias", jnp.zeros(tuple(blob_shape),
+                                               jnp.float32))
+
+    def _apply(self, params, buffers, x, training, rng):
+        b = params["bias"]
+        axis = self.axis if self.axis >= 0 else x.ndim + self.axis
+        shape = [1] * x.ndim
+        for i, d in enumerate(b.shape):
+            shape[axis + i] = d
+        return x + b.reshape(shape), buffers
+
+
 class _WeightedSum(AbstractModule):
     """Eltwise SUM with per-input coefficients (caffe eltwise coeff)."""
 
@@ -132,7 +152,9 @@ class CaffeConverter:
         if t == "InnerProduct":
             p = layer.inner_product_param
             nout = int(p.num_output)
-            nin = self._linear_nin(layer)
+            # transpose flag: weight blob stored (in, out) instead of
+            # (out, in) (reference LayerConverter InnerProduct handling)
+            nin = self._linear_nin(layer, transpose=p.transpose)
             seq = nn.Sequential(
                 nn.Reshape([nin]),  # batch auto-detect → flatten trailing dims
                 nn.Linear(nin, nout, with_bias=p.bias_term))
@@ -218,11 +240,31 @@ class CaffeConverter:
                                                 momentum=1.0 - (p.moving_average_fraction or 0.999),
                                                 affine=False)
         if t == "Scale":
+            if len(layer.bottom) == 2:
+                # two-bottom Scale = elementwise product of two blobs
+                # (reference LayerConverter fromCaffeScale second branch)
+                return nn.CMulTable()
             p = layer.scale_param
             shape = self._scale_shape(layer)
             if p.bias_term:
                 return nn.Sequential(nn.CMul(shape), nn.CAdd(shape))
             return nn.CMul(shape)
+        if t == "Bias":
+            # learnable bias broadcast at bias_param.axis (reference
+            # Converter fromCaffeBias → Add); two-bottom Bias adds the
+            # second blob elementwise
+            if len(layer.bottom) == 2:
+                return nn.CAddTable()
+            if not layer.blobs:
+                raise ValueError(f"bias layer {layer.name} has no blob")
+            axis = int(layer.bias_param.axis) if layer.HasField(
+                "bias_param") else 1
+            return _AxisBias(_blob_array(layer.blobs[0]).shape, axis)
+        if t == "BNLL":
+            return nn.SoftPlus()
+        if t == "Split":
+            # caffe Split fans one blob out to several tops — pure wiring
+            return nn.Identity()
         if t == "Reshape":
             dims = list(layer.reshape_param.shape.dim)
             return nn.InferReshape([int(d) for d in dims])
@@ -238,10 +280,11 @@ class CaffeConverter:
         raise ValueError(f"conv layer {layer.name} has no weight blob; "
                          "cannot infer input planes")
 
-    def _linear_nin(self, layer) -> int:
+    def _linear_nin(self, layer, transpose: bool = False) -> int:
         if layer.blobs:
             w = _blob_array(layer.blobs[0])
-            return int(w.shape[-1])
+            # blob is (out, in) normally, (in, out) with transpose=true
+            return int(w.shape[0] if transpose else w.shape[-1])
         raise ValueError(f"ip layer {layer.name} has no weight blob")
 
     def _bn_channels(self, layer) -> int:
@@ -272,8 +315,12 @@ class CaffeConverter:
             if len(blobs) > 1 and "bias" in module.params:
                 module.params["bias"] = jnp.asarray(blobs[1].ravel(), jnp.float32)
         elif isinstance(module, nn.Linear):
+            w = blobs[0]
+            if (layer.HasField("inner_product_param")
+                    and layer.inner_product_param.transpose):
+                w = w.T  # blob stored (in, out)
             module.params["weight"] = jnp.asarray(
-                blobs[0].reshape(module.params["weight"].shape), jnp.float32)
+                w.reshape(module.params["weight"].shape), jnp.float32)
             if len(blobs) > 1 and "bias" in module.params:
                 module.params["bias"] = jnp.asarray(blobs[1].ravel(), jnp.float32)
         elif isinstance(module, nn.SpatialBatchNormalization):
@@ -287,9 +334,16 @@ class CaffeConverter:
             module.params["weight"] = jnp.asarray(
                 blobs[0].reshape(module.params["weight"].shape), jnp.float32)
         elif isinstance(module, nn.CAdd):
-            if len(blobs) > 1:
+            # Scale layers carry [scale, bias]; a standalone Bias layer
+            # carries its vector at blobs[0]
+            idx = 1 if layer.type == "Scale" else 0
+            if len(blobs) > idx:
                 module.params["bias"] = jnp.asarray(
-                    blobs[1].reshape(module.params["bias"].shape), jnp.float32)
+                    blobs[idx].reshape(module.params["bias"].shape),
+                    jnp.float32)
+        elif isinstance(module, _AxisBias):
+            module.params["bias"] = jnp.asarray(
+                blobs[0].reshape(module.params["bias"].shape), jnp.float32)
         elif isinstance(module, nn.PReLU):
             module.params["weight"] = jnp.asarray(
                 blobs[0].ravel(), jnp.float32)
@@ -390,6 +444,12 @@ class CaffeLoader:
         for layer in self._merged_layers():
             if self._is_train_only(layer):
                 continue
+            if layer.type == "Slice" and len(layer.top) > 1:
+                # multi-top Slice: one extraction module per top, honoring
+                # slice_point (improves on the reference's single
+                # SplitTable, Converter.scala fromCaffeSlice)
+                self._build_slice_tops(layer, blob_to_node)
+                continue
             try:
                 module = self.converter.convert(layer)
             except NotImplementedError:
@@ -420,6 +480,28 @@ class CaffeLoader:
                 seen.add(n.uid)
                 uniq.append(n)
         return Graph(input_nodes, uniq)
+
+    def _build_slice_tops(self, layer, blob_to_node):
+        from .. import nn
+
+        p = layer.slice_param
+        axis = int(p.axis)  # proto default is 1; 0 and negatives honored
+        points = [int(x) for x in p.slice_point]
+        bottoms = [blob_to_node[b] for b in layer.bottom
+                   if b in blob_to_node]
+        n_tops = len(layer.top)
+        dim = axis + 1 if axis >= 0 else axis  # negative: resolved at runtime
+        for i, top in enumerate(layer.top):
+            if points:
+                start = 0 if i == 0 else points[i - 1]
+                if i < len(points):
+                    mod = nn.Narrow(dim, start + 1, points[i] - start)
+                else:  # last segment runs to the end
+                    mod = nn.Narrow(dim, start + 1, -1)
+            else:  # no slice_point: equal chunks among the tops
+                mod = nn.SplitAndSelect(dim, i + 1, n_tops)
+            mod.set_name(f"{layer.name}.{top}")
+            blob_to_node[top] = mod.inputs(*bottoms)
 
     # -- weight copy into an existing model (CaffeLoader.load:380) ---------
     @staticmethod

@@ -3,9 +3,31 @@ TensorflowToBigDL.scala pattern table, TensorflowSaver.scala,
 BigDLToTensorflow.scala).
 
 ``TensorflowLoader.load`` parses a binary GraphDef, builds the node DAG
-(buildTFGraph parity, TensorflowLoader.scala:85), fuses the standard
-``{Conv2D,MatMul} + BiasAdd`` / ``FusedBatchNorm`` subgraph patterns and
-emits a :class:`~bigdl_tpu.nn.graph.Graph` (buildBigDLModel:126).
+(buildTFGraph parity, TensorflowLoader.scala:85) and converts it through
+an ORDERED SUBGRAPH-PATTERN TABLE (the reference's TensorflowToBigDL
+pattern-matching design, TensorflowToBigDL.scala:~1216): each pattern is
+tried top-down at the node being visited (traversal runs outputs →
+inputs, so consumers match before their producers), may swallow internal
+nodes (e.g. the MatMul under a BiasAdd), and emits one bigdl_tpu module.
+Pattern order, most specific first:
+
+1. dropout subgraph  (mul(div(x, keep), floor(keep + uniform)) → Dropout)
+2. flatten subgraph  (Reshape whose shape = Pack(strided_slice(Shape(x)),
+   consts) → InferReshape)
+3. fully-connected   (BiasAdd/Add ∘ MatMul → Linear, weights baked)
+4. conv + bias       (BiasAdd/Add ∘ Conv2D → SpatialConvolution)
+5. flat per-op table (everything else, incl. multi-output Split/Unpack
+   via output-slot selection and reduce/layout ops)
+
+On top of the table sits CONSTANT FOLDING: ``const_of`` resolves any
+subgraph of Const/Identity/elementwise/Pack/Concat/StridedSlice/Reshape
+nodes to a numpy array, which is how frozen-graph decomposed batch-norm
+(mul/rsqrt/sub chains over Consts) loads without a dedicated pattern —
+the folded scale/shift become ``x * C1 + C2`` const-binary modules.
+Unrolled RNN/LSTM/GRU cell subgraphs (ConcatV2 → MatMul+BiasAdd → Split
+→ gate elementwise soup) convert COMPOSITIONALLY through the same table
+— XLA re-fuses the elementwise gates on TPU, so no monolithic cell
+pattern is needed for either correctness or speed.
 
 Layout: TF spatial ops default to NHWC; bigdl_tpu spatial modules are
 NCHW (the TPU-friendly conv layout under XLA's dimension-number
@@ -22,7 +44,7 @@ from __future__ import annotations
 import logging
 import os
 import sys
-from typing import Dict, List, Optional, Sequence
+from typing import Dict, List, Optional, Sequence, Tuple
 
 import jax.numpy as jnp
 import numpy as np
@@ -78,11 +100,173 @@ def proto_to_tensor(t: tfpb.TensorProto) -> np.ndarray:
     return arr.reshape(shape)
 
 
+def _norm_ref(ref: str) -> Tuple[str, int]:
+    """'name:k' → (name, k); control-dep '^name' → (name, 0)."""
+    ref = ref.lstrip("^")
+    if ":" in ref:
+        base, slot = ref.rsplit(":", 1)
+        if slot.isdigit():
+            return base, int(slot)
+    return ref, 0
+
+
 def _canon(name: str) -> str:
     """Strip the output-slot suffix and control-dep marker from an input ref."""
-    name = name.lstrip("^")
-    return name.split(":")[0]
+    return _norm_ref(name)[0]
 
+
+# --------------------------------------------------------------------------
+# Conversion context: node maps + constant folding
+# --------------------------------------------------------------------------
+
+_FOLD_BINARY = {
+    "Add": np.add, "AddV2": np.add, "Sub": np.subtract, "Mul": np.multiply,
+    "Div": np.divide, "RealDiv": np.divide, "Maximum": np.maximum,
+    "Minimum": np.minimum, "Pow": np.power, "FloorDiv": np.floor_divide,
+    "FloorMod": np.mod, "BiasAdd": np.add,
+}
+_FOLD_UNARY = {
+    "Neg": np.negative, "Rsqrt": lambda a: 1.0 / np.sqrt(a),
+    "Sqrt": np.sqrt, "Exp": np.exp, "Log": np.log, "Floor": np.floor,
+    "Ceil": np.ceil, "Abs": np.abs, "Square": np.square,
+    "Tanh": np.tanh, "Sigmoid": lambda a: 1.0 / (1.0 + np.exp(-a)),
+}
+
+
+def _apply_strided_slice(arr, begin, end, strides, attr):
+    """NumPy evaluation of a (simple-mask) StridedSlice."""
+    begin_mask = int(attr["begin_mask"].i)
+    end_mask = int(attr["end_mask"].i)
+    shrink_mask = int(attr["shrink_axis_mask"].i)
+    if attr["ellipsis_mask"].i or attr["new_axis_mask"].i:
+        raise NotImplementedError("StridedSlice ellipsis/new_axis masks")
+    idx = []
+    for d in range(len(begin)):
+        b = None if begin_mask & (1 << d) else int(begin[d])
+        e = None if end_mask & (1 << d) else int(end[d])
+        s = int(strides[d]) if strides is not None else 1
+        if shrink_mask & (1 << d):
+            idx.append(int(begin[d]))
+        else:
+            idx.append(slice(b, e, s))
+    return arr[tuple(idx)]
+
+
+class _Ctx:
+    """Everything a pattern needs: the node table, consumers, declared
+    graph outputs, and a constant folder over frozen-graph subgraphs."""
+
+    def __init__(self, graph_def, nn, outputs=()):
+        self.nn = nn
+        self.nodes: Dict[str, tfpb.NodeDef] = {
+            n.name: n for n in graph_def.node}
+        self.outputs = {_canon(o) for o in outputs}
+        self.consumers: Dict[str, List[tfpb.NodeDef]] = {}
+        for n in graph_def.node:
+            for i in n.input:
+                self.consumers.setdefault(_canon(i), []).append(n)
+        self._const_cache: Dict[str, Optional[np.ndarray]] = {}
+
+    def data_inputs(self, tf_node) -> List[str]:
+        return [i for i in tf_node.input if not i.startswith("^")]
+
+    def sole_consumer(self, name: str) -> Optional[tfpb.NodeDef]:
+        cs = self.consumers.get(name, [])
+        return cs[0] if len(cs) == 1 else None
+
+    def swallowable(self, name: str, by) -> bool:
+        """An internal node may be fused into a pattern only if the
+        pattern root is its sole consumer and it is not itself a
+        declared graph output (its pre-fusion value stays observable
+        otherwise)."""
+        name = _canon(name)
+        return (self.sole_consumer(name) is by
+                and name not in self.outputs)
+
+    # -- constant folding (frozen-graph Const subgraphs) ---------------
+    def const_of(self, ref: str) -> Optional[np.ndarray]:
+        base, slot = _norm_ref(ref)
+        if slot:
+            return None
+        if base in self._const_cache:
+            return self._const_cache[base]
+        self._const_cache[base] = None  # cycle guard
+        n = self.nodes.get(base)
+        val = None
+        if n is not None:
+            ins = self.data_inputs(n)
+            if n.op == "Const":
+                val = proto_to_tensor(n.attr["value"].tensor)
+            elif n.op in ("Identity", "StopGradient", "CheckNumerics"):
+                val = self.const_of(ins[0])
+            elif n.op in _FOLD_UNARY:
+                a = self.const_of(ins[0])
+                if a is not None:
+                    val = _FOLD_UNARY[n.op](a).astype(a.dtype)
+            elif n.op in _FOLD_BINARY and len(ins) == 2:
+                a, b = self.const_of(ins[0]), self.const_of(ins[1])
+                if a is not None and b is not None:
+                    val = np.asarray(_FOLD_BINARY[n.op](a, b))
+            elif n.op in ("Pack", "Stack"):
+                parts = [self.const_of(i) for i in ins]
+                if all(p is not None for p in parts):
+                    val = np.stack(parts, axis=int(n.attr["axis"].i))
+            elif n.op in ("Concat", "ConcatV2"):
+                if n.op == "ConcatV2":
+                    axis, parts = self.const_of(ins[-1]), ins[:-1]
+                else:
+                    axis, parts = self.const_of(ins[0]), ins[1:]
+                vals = [self.const_of(i) for i in parts]
+                if axis is not None and all(v is not None for v in vals):
+                    val = np.concatenate(vals, axis=int(axis.ravel()[0]))
+            elif n.op == "StridedSlice":
+                a = self.const_of(ins[0])
+                b = self.const_of(ins[1])
+                e = self.const_of(ins[2])
+                s = self.const_of(ins[3]) if len(ins) > 3 else None
+                if a is not None and b is not None and e is not None:
+                    try:
+                        val = _apply_strided_slice(a, b.ravel(), e.ravel(),
+                                                   None if s is None
+                                                   else s.ravel(), n.attr)
+                    except NotImplementedError:
+                        val = None
+            elif n.op == "Reshape":
+                a, shp = self.const_of(ins[0]), self.const_of(ins[1])
+                if a is not None and shp is not None:
+                    val = a.reshape([int(d) for d in shp.ravel()])
+            elif n.op == "ExpandDims":
+                a, d = self.const_of(ins[0]), self.const_of(ins[1])
+                if a is not None and d is not None:
+                    val = np.expand_dims(a, int(d.ravel()[0]))
+            elif n.op == "Squeeze":
+                a = self.const_of(ins[0])
+                if a is not None:
+                    dims = list(n.attr["squeeze_dims"].list.i)
+                    val = np.squeeze(a, tuple(dims) if dims else None)
+            elif n.op == "Cast":
+                a = self.const_of(ins[0])
+                if a is not None:
+                    val = a.astype(_DT_TO_NP.get(n.attr["DstT"].type,
+                                                 np.dtype(np.float32)))
+        self._const_cache[base] = val
+        return val
+
+    def subgraph_has_op(self, ref: str, op: str, depth: int = 6) -> bool:
+        if depth < 0:
+            return False
+        n = self.nodes.get(_canon(ref))
+        if n is None:
+            return False
+        if n.op == op:
+            return True
+        return any(self.subgraph_has_op(i, op, depth - 1)
+                   for i in self.data_inputs(n))
+
+
+# --------------------------------------------------------------------------
+# Loader
+# --------------------------------------------------------------------------
 
 class TensorflowLoader:
     """GraphDef → bigdl_tpu Graph (reference TensorflowLoader.scala:38)."""
@@ -106,21 +290,8 @@ class TensorflowLoader:
         from .. import nn
         from ..nn.graph import Graph, Input
 
-        nodes: Dict[str, tfpb.NodeDef] = {n.name: n for n in graph_def.node}
-        consts: Dict[str, np.ndarray] = {
-            n.name: proto_to_tensor(n.attr["value"].tensor)
-            for n in graph_def.node if n.op == "Const"}
-
-        def const_of(name: str) -> Optional[np.ndarray]:
-            name = _canon(name)
-            if name in consts:
-                return consts[name]
-            n = nodes.get(name)
-            if n is not None and n.op == "Identity":
-                return const_of(n.input[0])
-            return None
-
-        built: Dict[str, object] = {}  # tf node name -> ModuleNode
+        ctx = _Ctx(graph_def, nn, outputs)
+        built: Dict[str, object] = {}  # canonical ref -> ModuleNode
         input_nodes = []
         for name in inputs:
             node = Input()
@@ -128,39 +299,37 @@ class TensorflowLoader:
             built[_canon(name)] = node
             input_nodes.append(node)
 
-        # consumers map for the BiasAdd fusion
-        consumers: Dict[str, List[tfpb.NodeDef]] = {}
-        for n in graph_def.node:
-            for i in n.input:
-                consumers.setdefault(_canon(i), []).append(n)
-
-        fused_into: Dict[str, str] = {}  # BiasAdd name -> producing op name
-
-        def data_inputs(tf_node) -> List[str]:
-            return [_canon(i) for i in tf_node.input if not i.startswith("^")]
-
-        def visit(name: str):
-            name = _canon(name)
-            if name in built:
-                return built[name]
-            if name in fused_into:
-                built[name] = visit(fused_into[name])
-                return built[name]
-            tf_node = nodes[name]
-            module, dep_names = _convert_node(
-                tf_node, const_of, consumers, fused_into, nn, nodes)
-            if module is None:  # passthrough (Identity, Const feeding, etc.)
-                deps = dep_names if dep_names else data_inputs(tf_node)
+        def visit(ref: str):
+            base, slot = _norm_ref(ref)
+            key = base if slot == 0 else f"{base}:{slot}"
+            if key in built:
+                return built[key]
+            tf_node = ctx.nodes.get(base)
+            if tf_node is None:
+                raise KeyError(f"graph has no node {base!r}")
+            for pattern in _PATTERNS:
+                res = pattern(tf_node, slot, ctx)
+                if res is not None:
+                    break
+            else:
+                raise NotImplementedError(
+                    f"unsupported TF op {tf_node.op} at node {base} "
+                    "(reference TensorflowLoader throws for unmatched "
+                    "patterns too)")
+            module, deps, covered = res
+            if module is None:  # passthrough
                 if not deps:
                     raise ValueError(
-                        f"node {name} ({tf_node.op}) has no data inputs and "
-                        "is not convertible")
-                built[name] = visit(deps[0])
-                return built[name]
-            module.set_name(name)
-            parents = [visit(d) for d in dep_names]
+                        f"node {base} ({tf_node.op}) has no data inputs "
+                        "and is not convertible")
+                built[key] = visit(deps[0])
+                return built[key]
+            module.set_name(key)
+            parents = [visit(d) for d in deps]
             node = module.inputs(*parents)
-            built[name] = node
+            built[key] = node
+            for c in covered:
+                built[c] = node  # swallowed internal nodes (single-consumer)
             return node
 
         output_nodes = [visit(o) for o in outputs]
@@ -177,94 +346,340 @@ def _nhwc(tf_node) -> bool:
     return fmt == "NHWC"
 
 
-def _convert_node(tf_node, const_of, consumers, fused_into, nn, nodes):
-    """Return (module, dep tf-node names) or (None, …) for passthrough.
+def _single_output(slot: int, tf_node):
+    if slot != 0:
+        raise NotImplementedError(
+            f"output slot {slot} of single-output op {tf_node.op} "
+            f"({tf_node.name})")
 
-    The module may be a small Sequential when a TF op maps to a fused
-    pattern (conv+bias) or needs layout adapters (NHWC→NCHW)
-    (reference TensorflowToBigDL.scala pattern table).
-    """
+
+# --------------------------------------------------------------------------
+# Pattern table (ordered, most specific first — TensorflowToBigDL parity)
+# --------------------------------------------------------------------------
+
+def _pattern_passthrough(tf_node, slot, ctx):
+    op = tf_node.op
+    if op in ("Placeholder", "PlaceholderV2"):
+        return (None, [], [])  # feeds must be declared inputs
+    if op == "Const":
+        # a Const visited as DATA (e.g. an RNN's zero initial state, not
+        # a weight swallowed by const_of) becomes a source Const module
+        _single_output(slot, tf_node)
+        return (ctx.nn.Const(proto_to_tensor(tf_node.attr["value"].tensor)),
+                [], [])
+    if op in ("Identity", "StopGradient", "CheckNumerics", "NoOp"):
+        return (None, ctx.data_inputs(tf_node), [])
+    return None
+
+
+def _pattern_dropout(tf_node, slot, ctx):
+    """tf.nn.dropout subgraph: mul(div(x, keep), floor(keep + uniform))
+    → nn.Dropout(1 - keep) (reference TensorflowToBigDL DropoutTF)."""
+    if tf_node.op != "Mul":
+        return None
+    ins = ctx.data_inputs(tf_node)
+    if len(ins) != 2:
+        return None
+    for div_ref, floor_ref in (ins, ins[::-1]):
+        div = ctx.nodes.get(_canon(div_ref))
+        fl = ctx.nodes.get(_canon(floor_ref))
+        if div is None or fl is None:
+            continue
+        if div.op not in ("Div", "RealDiv") or fl.op != "Floor":
+            continue
+        keep = ctx.const_of(ctx.data_inputs(div)[1])
+        if keep is None or keep.size != 1:
+            continue
+        if not ctx.subgraph_has_op(floor_ref, "RandomUniform"):
+            continue
+        if not (ctx.swallowable(div_ref, tf_node)
+                and ctx.swallowable(floor_ref, tf_node)):
+            continue  # intermediate observable elsewhere: no fusion
+        _single_output(slot, tf_node)
+        p = 1.0 - float(keep.ravel()[0])
+        return (ctx.nn.Dropout(p), [ctx.data_inputs(div)[0]],
+                [_canon(div_ref), _canon(floor_ref)])
+    return None
+
+
+def _pattern_flatten(tf_node, slot, ctx):
+    """slim-style flatten: Reshape(x, Pack([strided_slice(Shape(x)),
+    consts])) → InferReshape([0, consts...])."""
+    if tf_node.op != "Reshape":
+        return None
+    ins = ctx.data_inputs(tf_node)
+    if ctx.const_of(ins[1]) is not None:
+        return None  # plain const reshape: flat table handles it
+    pack = ctx.nodes.get(_canon(ins[1]))
+    if pack is None or pack.op not in ("Pack", "Stack"):
+        return None
+    if not ctx.swallowable(ins[1], tf_node):
+        return None
+    elems = ctx.data_inputs(pack)
+    dims: List[int] = []
+    for i, e in enumerate(elems):
+        c = ctx.const_of(e)
+        if c is not None and c.size == 1:
+            dims.append(int(c.ravel()[0]))
+        elif i == 0 and ctx.subgraph_has_op(e, "Shape", depth=3):
+            dims.append(0)  # batch dim carried through
+        else:
+            return None
+    _single_output(slot, tf_node)
+    return (ctx.nn.InferReshape(dims), [ins[0]], [_canon(ins[1])])
+
+
+def _pattern_fullconnection(tf_node, slot, ctx):
+    """BiasAdd/Add(MatMul(x, W), b) → Linear with baked weights
+    (reference TensorflowToBigDL FullConnectionTF)."""
+    if tf_node.op not in ("BiasAdd", "Add", "AddV2"):
+        return None
+    ins = ctx.data_inputs(tf_node)
+    if len(ins) != 2:
+        return None
+    for mm_ref, bias_ref in (ins, ins[::-1]):
+        mm = ctx.nodes.get(_canon(mm_ref))
+        bias = ctx.const_of(bias_ref)
+        if (mm is None or mm.op != "MatMul" or bias is None
+                or ctx.const_of(mm_ref) is not None
+                or not ctx.swallowable(mm_ref, tf_node)):
+            continue
+        lin_deps = _matmul_to_linear(mm, ctx, bias)
+        if lin_deps is None:
+            continue
+        _single_output(slot, tf_node)
+        lin, deps = lin_deps
+        return (lin, deps, [mm.name])
+    return None
+
+
+def _pattern_convbias(tf_node, slot, ctx):
+    """BiasAdd/Add(Conv2D(x, W), b) → SpatialConvolution with bias
+    (reference TensorflowToBigDL Conv2D pattern)."""
+    if tf_node.op not in ("BiasAdd", "Add", "AddV2"):
+        return None
+    ins = ctx.data_inputs(tf_node)
+    if len(ins) != 2:
+        return None
+    for conv_ref, bias_ref in (ins, ins[::-1]):
+        conv_n = ctx.nodes.get(_canon(conv_ref))
+        bias = ctx.const_of(bias_ref)
+        if (conv_n is None or conv_n.op != "Conv2D" or bias is None
+                or not ctx.swallowable(conv_ref, tf_node)):
+            continue
+        built = _conv2d_to_module(conv_n, ctx, bias)
+        if built is None:
+            continue
+        _single_output(slot, tf_node)
+        mod, deps = built
+        return (mod, deps, [conv_n.name])
+    return None
+
+
+def _matmul_to_linear(mm, ctx, bias):
+    ins = ctx.data_inputs(mm)
+    w = ctx.const_of(ins[1])
+    x_dep = ins[0]
+    if w is None:
+        if ctx.const_of(ins[0]) is not None:
+            # MatMul(W_const, x) computes W@x — not a batch Linear; the
+            # transpose flags describe the other operand, so mapping the
+            # left const to Linear weights would be silently wrong
+            raise NotImplementedError(
+                "MatMul with const LEFT operand (W@x) is not a Linear")
+        return None
+    if mm.attr["transpose_a"].b:
+        raise NotImplementedError("MatMul transpose_a=true")
+    if not mm.attr["transpose_b"].b:
+        w = w.T  # tf stores (in, out); Linear wants (out, in)
+    nn = ctx.nn
+    lin = nn.Linear(int(w.shape[1]), int(w.shape[0]),
+                    with_bias=bias is not None)
+    lin.params["weight"] = jnp.asarray(w, jnp.float32)
+    if bias is not None:
+        lin.params["bias"] = jnp.asarray(bias.ravel(), jnp.float32)
+    return lin, [x_dep]
+
+
+def _conv2d_to_module(tf_node, ctx, bias):
+    nn = ctx.nn
+    ins = ctx.data_inputs(tf_node)
+    w = ctx.const_of(ins[1])
+    if w is None:
+        raise NotImplementedError("Conv2D with non-const filter")
+    # tf filter layout: (kH, kW, inC, outC) -> OIHW
+    w_oihw = np.transpose(w, (3, 2, 0, 1))
+    strides = _attr_list_i(tf_node, "strides")
+    dilations = _attr_list_i(tf_node, "dilations")
+    if dilations and any(d != 1 for d in dilations):
+        raise NotImplementedError(
+            f"dilated Conv2D (dilations={dilations}) not supported")
+    nhwc = _nhwc(tf_node)
+    sh, sw = (strides[1], strides[2]) if nhwc else (strides[2], strides[3])
+    padding = tf_node.attr["padding"].s.decode() or "SAME"
+    if padding == "EXPLICIT":
+        ep = _attr_list_i(tf_node, "explicit_paddings")
+        # attr order follows data_format
+        ph0, ph1, pw0, pw1 = ((ep[2], ep[3], ep[4], ep[5]) if nhwc
+                              else (ep[4], ep[5], ep[6], ep[7]))
+        if ph0 != ph1 or pw0 != pw1:
+            raise NotImplementedError("asymmetric explicit conv padding")
+        pad_h, pad_w = int(ph0), int(pw0)
+    else:
+        pad_h = pad_w = -1 if padding == "SAME" else 0
+    conv = nn.SpatialConvolution(
+        int(w_oihw.shape[1]), int(w_oihw.shape[0]),
+        int(w_oihw.shape[3]), int(w_oihw.shape[2]), sw, sh,
+        pad_w, pad_h, with_bias=bias is not None)
+    conv.params["weight"] = jnp.asarray(w_oihw, jnp.float32)
+    if bias is not None:
+        conv.params["bias"] = jnp.asarray(bias.ravel(), jnp.float32)
+    return _wrap_nhwc(conv, nhwc, nn), [ins[0]]
+
+
+_CONST_BINARY_OPS = {
+    "Add": lambda x, c: x + c, "AddV2": lambda x, c: x + c,
+    "BiasAdd": lambda x, c: x + c,
+    "Sub": lambda x, c: x - c, "RSub": lambda x, c: c - x,
+    "Mul": lambda x, c: x * c,
+    "Div": lambda x, c: x / c, "RealDiv": lambda x, c: x / c,
+    "RDiv": lambda x, c: c / x,
+    "Maximum": jnp.maximum, "Minimum": jnp.minimum,
+    "Pow": lambda x, c: x ** c}
+
+
+def _const_binary(op: str, const: np.ndarray, reversed_: bool = False):
+    """Elementwise <op>(x, C) / <op>(C, x) module with a baked constant."""
+    from ..nn.module import TensorModule
+
+    if reversed_:
+        op = {"Sub": "RSub", "Div": "RDiv", "RealDiv": "RDiv"}.get(op, op)
+    fn = _CONST_BINARY_OPS[op]
+
+    class _Mod(TensorModule):
+        def __init__(self):
+            super().__init__()
+            self.const = jnp.asarray(const)
+            self.op = op
+
+        def _apply(self, params, buffers, x, training, rng):
+            return fn(x, self.const), buffers
+
+    _Mod.__name__ = f"Const{op}"
+    return _Mod()
+
+
+def _Reduce(op: str, axes: Sequence[int], keepdims: bool):
+    from ..nn.module import TensorModule
+
+    fn = {"Mean": jnp.mean, "Sum": jnp.sum, "Max": jnp.max,
+          "Min": jnp.min, "Prod": jnp.prod}[op]
+
+    class _Mod(TensorModule):
+        def __init__(self):
+            super().__init__()
+            self.axes, self.keepdims = tuple(axes), keepdims
+
+        def _apply(self, params, buffers, x, training, rng):
+            ax = tuple(a if a >= 0 else x.ndim + a for a in self.axes)
+            return fn(x, axis=ax, keepdims=self.keepdims), buffers
+
+    _Mod.__name__ = f"Reduce{op}"
+    return _Mod()
+
+
+def _Permute(perm: Sequence[int]):
+    from ..nn.module import TensorModule
+
+    class _Mod(TensorModule):
+        def __init__(self):
+            super().__init__()
+            self.perm = tuple(int(p) for p in perm)
+
+        def _apply(self, params, buffers, x, training, rng):
+            return jnp.transpose(x, self.perm), buffers
+
+    _Mod.__name__ = "Permute"
+    return _Mod()
+
+
+def _SliceModule(begin, size):
+    from ..nn.module import TensorModule
+
+    class _Mod(TensorModule):
+        def _apply(self, params, buffers, x, training, rng):
+            idx = tuple(
+                slice(int(b), None if int(s) == -1 else int(b) + int(s))
+                for b, s in zip(begin, size))
+            return x[idx], buffers
+
+    _Mod.__name__ = "Slice"
+    return _Mod()
+
+
+def _StridedSliceModule(begin, end, strides, attr):
+    from ..nn.module import TensorModule
+
+    class _Mod(TensorModule):
+        def _apply(self, params, buffers, x, training, rng):
+            return _apply_strided_slice(x, begin, end, strides, attr), buffers
+
+    _Mod.__name__ = "StridedSlice"
+    return _Mod()
+
+
+def _CastModule(np_dtype):
+    from ..nn.module import TensorModule
+
+    class _Mod(TensorModule):
+        def _apply(self, params, buffers, x, training, rng):
+            return x.astype(np_dtype), buffers
+
+    _Mod.__name__ = "Cast"
+    return _Mod()
+
+
+def _pattern_flat(tf_node, slot, ctx):
+    """The per-op table — flat (single-node) conversions, including
+    multi-output Split/Unpack via the visit slot."""
+    nn = ctx.nn
     op = tf_node.op
     name = tf_node.name
-    ins = [i for i in tf_node.input if not i.startswith("^")]
+    ins = ctx.data_inputs(tf_node)
+    const_of = ctx.const_of
 
-    def bias_consumer():
-        """If our SOLE consumer is BiasAdd/Add with a const bias, fuse it.
-        With more than one consumer the pre-bias tensor is observable
-        elsewhere, so fusion would be wrong — leave the add unfused."""
-        my_consumers = consumers.get(name, [])
-        if len(my_consumers) != 1:
-            return None, None
-        c = my_consumers[0]
-        if c.op in ("BiasAdd", "Add", "AddV2") and len(c.input) == 2:
-            other = [i for i in c.input if _canon(i) != name]
-            if other and const_of(other[0]) is not None:
-                return c, const_of(other[0])
-        return None, None
+    def dim1(axis):
+        # 0-based TF axis → 1-based module dim; negative axes pass
+        # through (the modules resolve them against ndim at runtime)
+        return axis + 1 if axis >= 0 else axis
 
-    if op in ("Placeholder", "PlaceholderV2"):
-        return None, None
-    if op == "Const":
-        return None, None
-    if op in ("Identity", "StopGradient", "CheckNumerics", "NoOp"):
-        return None, None
+    # ---- multi-output ops: slot selects the emitted chunk ------------
+    if op == "Split":  # inputs: (split_dim, value)
+        axis = int(const_of(ins[0]).ravel()[0])
+        num = int(tf_node.attr["num_split"].i)
+        return (nn.SplitAndSelect(dim1(axis), slot + 1, num), [ins[1]], [])
+    if op == "SplitV":  # inputs: (value, size_splits, split_dim)
+        sizes = const_of(ins[1])
+        axis = int(const_of(ins[2]).ravel()[0])
+        if sizes is None:
+            raise NotImplementedError("SplitV with dynamic size_splits")
+        sizes = [int(s) for s in sizes.ravel()]
+        start = sum(sizes[:slot])
+        return (nn.Narrow(dim1(axis), start + 1, sizes[slot]), [ins[0]], [])
+    if op in ("Unpack", "Unstack"):
+        axis = int(tf_node.attr["axis"].i)
+        return (nn.Select(dim1(axis), slot + 1), [ins[0]], [])
+
+    _single_output(slot, tf_node)
 
     if op == "MatMul":
-        w = const_of(ins[1])
-        x_dep = _canon(ins[0])
-        if w is None:
-            w = const_of(ins[0])
-            x_dep = _canon(ins[1])
-        if w is None:
+        built = _matmul_to_linear(tf_node, ctx, None)
+        if built is None:
             raise NotImplementedError("MatMul with two non-const operands")
-        if tf_node.attr["transpose_a"].b:
-            raise NotImplementedError("MatMul transpose_a=true")
-        if not tf_node.attr["transpose_b"].b:
-            w = w.T  # tf stores (in, out); Linear wants (out, in)
-        bias_node, bias = bias_consumer()
-        lin = nn.Linear(int(w.shape[1]), int(w.shape[0]),
-                        with_bias=bias is not None)
-        lin.params["weight"] = jnp.asarray(w, jnp.float32)
-        if bias is not None:
-            lin.params["bias"] = jnp.asarray(bias.ravel(), jnp.float32)
-            fused_into[bias_node.name] = name
-        return lin, [x_dep]
+        return (*built, [])
 
     if op == "Conv2D":
-        w = const_of(ins[1])
-        if w is None:
-            raise NotImplementedError("Conv2D with non-const filter")
-        # tf filter layout: (kH, kW, inC, outC) -> OIHW
-        w_oihw = np.transpose(w, (3, 2, 0, 1))
-        strides = _attr_list_i(tf_node, "strides")
-        dilations = _attr_list_i(tf_node, "dilations")
-        if dilations and any(d != 1 for d in dilations):
-            raise NotImplementedError(
-                f"dilated Conv2D (dilations={dilations}) not supported")
-        nhwc = _nhwc(tf_node)
-        sh, sw = (strides[1], strides[2]) if nhwc else (strides[2], strides[3])
-        padding = tf_node.attr["padding"].s.decode() or "SAME"
-        if padding == "EXPLICIT":
-            ep = _attr_list_i(tf_node, "explicit_paddings")
-            # attr order follows data_format
-            ph0, ph1, pw0, pw1 = ((ep[2], ep[3], ep[4], ep[5]) if nhwc
-                                  else (ep[4], ep[5], ep[6], ep[7]))
-            if ph0 != ph1 or pw0 != pw1:
-                raise NotImplementedError("asymmetric explicit conv padding")
-            pad_h, pad_w = int(ph0), int(pw0)
-        else:
-            pad_h = pad_w = -1 if padding == "SAME" else 0
-        bias_node, bias = bias_consumer()
-        conv = nn.SpatialConvolution(
-            int(w_oihw.shape[1]), int(w_oihw.shape[0]),
-            int(w_oihw.shape[3]), int(w_oihw.shape[2]), sw, sh,
-            pad_w, pad_h, with_bias=bias is not None)
-        conv.params["weight"] = jnp.asarray(w_oihw, jnp.float32)
-        if bias is not None:
-            conv.params["bias"] = jnp.asarray(bias.ravel(), jnp.float32)
-            fused_into[bias_node.name] = name
-        mod = _wrap_nhwc(conv, nhwc, nn)
-        return mod, [_canon(ins[0])]
+        return (*_conv2d_to_module(tf_node, ctx, None), [])
 
     if op in ("MaxPool", "AvgPool"):
         ksize = _attr_list_i(tf_node, "ksize")
@@ -278,9 +693,9 @@ def _convert_node(tf_node, const_of, consumers, fused_into, nn, nodes):
             pool = nn.SpatialMaxPooling(kw, kh, sw, sh, pad, pad)
         else:
             pool = nn.SpatialAveragePooling(kw, kh, sw, sh, pad, pad)
-        return _wrap_nhwc(pool, nhwc, nn), [_canon(ins[0])]
+        return (_wrap_nhwc(pool, nhwc, nn), [ins[0]], [])
 
-    if op == "FusedBatchNorm" or op == "FusedBatchNormV2" or op == "FusedBatchNormV3":
+    if op in ("FusedBatchNorm", "FusedBatchNormV2", "FusedBatchNormV3"):
         scale = const_of(ins[1])
         offset = const_of(ins[2])
         mean = const_of(ins[3])
@@ -296,30 +711,23 @@ def _convert_node(tf_node, const_of, consumers, fused_into, nn, nodes):
         if mean is not None and mean.size:
             bn.buffers["running_mean"] = jnp.asarray(mean.ravel(), jnp.float32)
             bn.buffers["running_var"] = jnp.asarray(var.ravel(), jnp.float32)
-        return _wrap_nhwc(bn, _nhwc(tf_node), nn), [_canon(ins[0])]
+        return (_wrap_nhwc(bn, _nhwc(tf_node), nn), [ins[0]], [])
 
     unary = {
         "Relu": nn.ReLU, "Relu6": nn.ReLU6, "Elu": nn.ELU,
         "Sigmoid": nn.Sigmoid, "Tanh": nn.Tanh, "Softplus": nn.SoftPlus,
         "Softsign": nn.SoftSign, "Abs": nn.Abs, "Exp": nn.Exp, "Log": nn.Log,
         "Softmax": nn.SoftMax, "LogSoftmax": nn.LogSoftMax,
-        "Square": nn.Square, "Sqrt": nn.Sqrt, "Sign": None,
+        "Square": nn.Square, "Sqrt": nn.Sqrt,
     }
-    if op in unary and unary[op] is not None:
-        return unary[op](), [_canon(ins[0])]
-
-    if op in ("BiasAdd", "Add", "AddV2") and len(ins) == 2:
-        # bias fused into a preceding MatMul/Conv2D? then this node is a
-        # passthrough — the producer's converter picks the bias up via
-        # bias_consumer() (TensorflowToBigDL fused-pattern parity).
-        # Either operand order; producer must have no other consumers.
-        for data_in, const_in in ((ins[0], ins[1]), (ins[1], ins[0])):
-            producer = nodes.get(_canon(data_in))
-            if (producer is not None and producer.op in ("MatMul", "Conv2D")
-                    and const_of(const_in) is not None
-                    and const_of(data_in) is None
-                    and len(consumers.get(producer.name, [])) == 1):
-                return None, [_canon(data_in)]  # passthrough to the producer
+    if op in unary:
+        return (unary[op](), [ins[0]], [])
+    if op == "Rsqrt":
+        return (nn.Power(-0.5), [ins[0]], [])
+    if op == "Neg":
+        return (nn.MulConstant(-1.0), [ins[0]], [])
+    if op == "Floor":
+        return (_floor_module(), [ins[0]], [])
 
     if op == "BiasAdd":  # unfused: add const bias on the channel dim
         bias = const_of(ins[1])
@@ -329,58 +737,130 @@ def _convert_node(tf_node, const_of, consumers, fused_into, nn, nodes):
             shape = (int(bias.size),)
         else:  # NCHW: bias lives on dim 2 of (N,C,H,W)
             shape = (int(bias.size), 1, 1)
-        add = nn.CAdd(shape)
-        add.params["bias"] = jnp.asarray(bias.reshape(shape), jnp.float32)
-        return add, [_canon(ins[0])]
+        return (_const_binary("Add", bias.reshape(shape)), [ins[0]], [])
 
-    binary = {"Add": nn.CAddTable, "AddV2": nn.CAddTable, "Sub": nn.CSubTable,
-              "Mul": nn.CMulTable, "Maximum": nn.CMaxTable,
-              "Minimum": nn.CMinTable}
-    if op in binary:
-        return binary[op](), [_canon(i) for i in ins]
+    binary = {"Add": nn.CAddTable, "AddV2": nn.CAddTable,
+              "Sub": nn.CSubTable, "Mul": nn.CMulTable,
+              "Div": nn.CDivTable, "RealDiv": nn.CDivTable,
+              "Maximum": nn.CMaxTable, "Minimum": nn.CMinTable}
+    if op in binary and len(ins) == 2:
+        # const operand → fused const-binary module (decomposed batchnorm
+        # scale/shift lands here after folding)
+        for i, (data_in, const_in) in enumerate(
+                ((ins[0], ins[1]), (ins[1], ins[0]))):
+            c = const_of(const_in)
+            if c is not None and const_of(data_in) is None:
+                return (_const_binary(op, c, reversed_=(i == 1)),
+                        [data_in], [])
+        return (binary[op](), list(ins), [])
 
     if op in ("ConcatV2", "Concat"):
         if op == "ConcatV2":
             axis = int(const_of(ins[-1]).ravel()[0])
-            deps = [_canon(i) for i in ins[:-1]]
+            deps = list(ins[:-1])
         else:
             axis = int(const_of(ins[0]).ravel()[0])
-            deps = [_canon(i) for i in ins[1:]]
-        return nn.JoinTable(axis + 1), deps
+            deps = list(ins[1:])
+        return (nn.JoinTable(axis + 1), deps, [])
+
+    if op == "Pack" or op == "Stack":
+        axis = int(tf_node.attr["axis"].i)
+        return (nn.Pack(axis + 1), list(ins), [])
 
     if op == "Reshape":
         shape = const_of(ins[1])
         if shape is None:
             raise NotImplementedError("Reshape with dynamic shape")
         dims = [int(d) for d in shape.ravel()]
-        return nn.InferReshape(dims), [_canon(ins[0])]
+        return (nn.InferReshape(dims), [ins[0]], [])
 
     if op == "Squeeze":
         dims = _attr_list_i(tf_node, "squeeze_dims")
         if not dims:
-            return nn.Squeeze(), [_canon(ins[0])]
+            return (nn.Squeeze(), [ins[0]], [])
         seq = nn.Sequential(*[nn.Squeeze(d + 1)
                               for d in sorted(dims, reverse=True)])
-        return seq, [_canon(ins[0])]
+        return (seq, [ins[0]], [])
+
+    if op == "ExpandDims":
+        d = const_of(ins[1])
+        if d is None:
+            raise NotImplementedError("ExpandDims with dynamic dim")
+        return (nn.Unsqueeze(int(d.ravel()[0]) + 1), [ins[0]], [])
+
+    if op == "Transpose":
+        perm = const_of(ins[1])
+        if perm is None:
+            raise NotImplementedError("Transpose with dynamic perm")
+        return (_Permute(perm.ravel()), [ins[0]], [])
+
+    if op in ("Mean", "Sum", "Max", "Min", "Prod"):
+        axes = const_of(ins[1])
+        if axes is None:
+            raise NotImplementedError(f"{op} with dynamic reduction axes")
+        keep = bool(tf_node.attr["keep_dims"].b or tf_node.attr["keepdims"].b)
+        return (_Reduce(op, [int(a) for a in axes.ravel()], keep),
+                [ins[0]], [])
+
+    if op == "Slice":
+        begin, size = const_of(ins[1]), const_of(ins[2])
+        if begin is None or size is None:
+            raise NotImplementedError("Slice with dynamic begin/size")
+        return (_SliceModule(begin.ravel(), size.ravel()), [ins[0]], [])
+
+    if op == "StridedSlice":
+        begin, end = const_of(ins[1]), const_of(ins[2])
+        strides = const_of(ins[3]) if len(ins) > 3 else None
+        if begin is None or end is None:
+            raise NotImplementedError("StridedSlice with dynamic bounds")
+        return (_StridedSliceModule(
+            begin.ravel(), end.ravel(),
+            None if strides is None else strides.ravel(), tf_node.attr),
+            [ins[0]], [])
+
+    if op == "Cast":
+        dt = _DT_TO_NP.get(tf_node.attr["DstT"].type, np.dtype(np.float32))
+        return (_CastModule(dt), [ins[0]], [])
+
+    if op == "Shape":
+        return (nn.Shape(), [ins[0]], [])
 
     if op == "LRN":
         size = 2 * int(tf_node.attr["depth_radius"].i or 5) + 1
         alpha = (tf_node.attr["alpha"].f or 1.0) * size
         beta = tf_node.attr["beta"].f or 0.5
         k = tf_node.attr["bias"].f or 1.0
-        return _wrap_nhwc(nn.SpatialCrossMapLRN(size, alpha, beta, k),
-                          True, nn), [_canon(ins[0])]
+        return (_wrap_nhwc(nn.SpatialCrossMapLRN(size, alpha, beta, k),
+                           True, nn), [ins[0]], [])
 
     if op == "Pad":
         pads = const_of(ins[1])
         if pads is None:
             raise NotImplementedError("Pad with dynamic paddings")
         mod = nn.Identity() if not np.any(pads) else _PadModule(pads)
-        return mod, [_canon(ins[0])]
+        return (mod, [ins[0]], [])
 
-    raise NotImplementedError(
-        f"unsupported TF op {op} at node {name} "
-        "(reference TensorflowLoader throws for unmatched patterns too)")
+    return None
+
+
+def _floor_module():
+    from ..nn.module import TensorModule
+
+    class _Floor(TensorModule):
+        def _apply(self, params, buffers, x, training, rng):
+            return jnp.floor(x), buffers
+
+    return _Floor()
+
+
+_PATTERNS = (
+    _pattern_passthrough,
+    _pattern_dropout,
+    _pattern_flatten,
+    _pattern_fullconnection,
+    _pattern_convbias,
+    _pattern_flat,
+)
 
 
 def _wrap_nhwc(module, nhwc: bool, nn):

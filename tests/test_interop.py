@@ -263,3 +263,166 @@ def test_tf_bias_fusion():
     x = RNG.rand(5, 6).astype(np.float32)
     np.testing.assert_allclose(np.asarray(model.forward(x)), x @ w + b,
                                rtol=1e-5, atol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# Caffe layer breadth (reference LayerConverter/V1LayerConverter coverage)
+# ---------------------------------------------------------------------------
+
+def _caffe_pb2():
+    import sys, os
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        __import__("bigdl_tpu.interop.caffe", fromlist=["x"]).__file__),
+        "protos"))
+    import caffe_pb2
+    return caffe_pb2
+
+
+def _add_blob(layer, arr):
+    blob = layer.blobs.add()
+    blob.shape.dim.extend(arr.shape)
+    blob.data.extend(np.asarray(arr, np.float32).ravel().tolist())
+
+
+def test_caffe_slice_multi_top_equal_chunks(tmp_path):
+    prototxt = tmp_path / "s.prototxt"
+    prototxt.write_text("""
+name: "s"
+input: "data"
+input_dim: 1 input_dim: 6 input_dim: 2 input_dim: 2
+layer {
+  name: "slice" type: "Slice" bottom: "data"
+  top: "a" top: "b" top: "c"
+  slice_param { axis: 1 }
+}
+layer { name: "sum" type: "Eltwise" bottom: "a" bottom: "b" bottom: "c"
+        top: "out" eltwise_param { operation: SUM } }
+""")
+    pb2 = _caffe_pb2()
+    net = pb2.NetParameter()
+    (tmp_path / "s.caffemodel").write_bytes(net.SerializeToString())
+    g = CaffeLoader(str(prototxt), str(tmp_path / "s.caffemodel")
+                    ).create_caffe_model()
+    x = RNG.rand(1, 6, 2, 2).astype(np.float32)
+    out = np.asarray(g.forward(x))
+    np.testing.assert_allclose(out, x[:, :2] + x[:, 2:4] + x[:, 4:6],
+                               rtol=1e-6)
+
+
+def test_caffe_slice_points_uneven(tmp_path):
+    prototxt = tmp_path / "sp.prototxt"
+    prototxt.write_text("""
+name: "sp"
+input: "data"
+input_dim: 1 input_dim: 6 input_dim: 2 input_dim: 2
+layer {
+  name: "slice" type: "Slice" bottom: "data"
+  top: "a" top: "b" top: "c"
+  slice_param { axis: 1 slice_point: 1 slice_point: 3 }
+}
+""")
+    pb2 = _caffe_pb2()
+    (tmp_path / "sp.caffemodel").write_bytes(
+        pb2.NetParameter().SerializeToString())
+    g = CaffeLoader(str(prototxt), str(tmp_path / "sp.caffemodel")
+                    ).create_caffe_model()
+    x = RNG.rand(1, 6, 2, 2).astype(np.float32)
+    out = g.forward(x)
+    # three unconsumed tops -> Table of segments sized 1, 2, 3
+    np.testing.assert_allclose(np.asarray(out[1]), x[:, 0:1], rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(out[2]), x[:, 1:3], rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(out[3]), x[:, 3:6], rtol=1e-6)
+
+
+def test_caffe_inner_product_transpose(tmp_path):
+    prototxt = tmp_path / "t.prototxt"
+    prototxt.write_text("""
+name: "t"
+input: "data"
+input_dim: 1 input_dim: 4
+layer {
+  name: "ip" type: "InnerProduct" bottom: "data" top: "out"
+  inner_product_param { num_output: 3 bias_term: false transpose: true }
+}
+""")
+    pb2 = _caffe_pb2()
+    net = pb2.NetParameter()
+    l = net.layer.add(); l.name = "ip"; l.type = "InnerProduct"
+    l.inner_product_param.num_output = 3
+    l.inner_product_param.transpose = True
+    w_in_out = RNG.rand(4, 3).astype(np.float32)  # (in, out) layout
+    _add_blob(l, w_in_out)
+    (tmp_path / "t.caffemodel").write_bytes(net.SerializeToString())
+    g = CaffeLoader(str(prototxt), str(tmp_path / "t.caffemodel")
+                    ).create_caffe_model()
+    x = RNG.rand(2, 4).astype(np.float32)
+    np.testing.assert_allclose(np.asarray(g.forward(x)), x @ w_in_out,
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_caffe_bias_layer(tmp_path):
+    prototxt = tmp_path / "b.prototxt"
+    prototxt.write_text("""
+name: "b"
+input: "data"
+input_dim: 1 input_dim: 3 input_dim: 2 input_dim: 2
+layer { name: "bias" type: "Bias" bottom: "data" top: "out" }
+""")
+    pb2 = _caffe_pb2()
+    net = pb2.NetParameter()
+    l = net.layer.add(); l.name = "bias"; l.type = "Bias"
+    bias = RNG.rand(3).astype(np.float32)
+    _add_blob(l, bias)
+    (tmp_path / "b.caffemodel").write_bytes(net.SerializeToString())
+    g = CaffeLoader(str(prototxt), str(tmp_path / "b.caffemodel")
+                    ).create_caffe_model()
+    x = RNG.rand(1, 3, 2, 2).astype(np.float32)
+    np.testing.assert_allclose(np.asarray(g.forward(x)),
+                               x + bias.reshape(1, 3, 1, 1), rtol=1e-6)
+
+
+def test_caffe_scale_two_bottoms_and_bnll(tmp_path):
+    prototxt = tmp_path / "sc.prototxt"
+    prototxt.write_text("""
+name: "sc"
+input: "data"
+input_dim: 1 input_dim: 4 input_dim: 2 input_dim: 2
+layer {
+  name: "slice" type: "Slice" bottom: "data" top: "a" top: "b"
+  slice_param { axis: 1 }
+}
+layer { name: "prod" type: "Scale" bottom: "a" bottom: "b" top: "p" }
+layer { name: "bnll" type: "BNLL" bottom: "p" top: "out" }
+""")
+    pb2 = _caffe_pb2()
+    (tmp_path / "sc.caffemodel").write_bytes(
+        pb2.NetParameter().SerializeToString())
+    g = CaffeLoader(str(prototxt), str(tmp_path / "sc.caffemodel")
+                    ).create_caffe_model()
+    x = RNG.rand(1, 4, 2, 2).astype(np.float32)
+    prod = x[:, :2] * x[:, 2:]
+    np.testing.assert_allclose(np.asarray(g.forward(x)),
+                               np.log1p(np.exp(prod)), rtol=1e-5)
+
+
+def test_caffe_bias_layer_2d_bottom(tmp_path):
+    # Bias after a flat (N, F) bottom must broadcast at axis 1, not
+    # assume a 4-D (1, C, 1, 1) shape
+    prototxt = tmp_path / "b2.prototxt"
+    prototxt.write_text("""
+name: "b2"
+input: "data"
+input_dim: 2 input_dim: 5
+layer { name: "bias" type: "Bias" bottom: "data" top: "out" }
+""")
+    pb2 = _caffe_pb2()
+    net = pb2.NetParameter()
+    l = net.layer.add(); l.name = "bias"; l.type = "Bias"
+    bias = RNG.rand(5).astype(np.float32)
+    _add_blob(l, bias)
+    (tmp_path / "b2.caffemodel").write_bytes(net.SerializeToString())
+    g = CaffeLoader(str(prototxt), str(tmp_path / "b2.caffemodel")
+                    ).create_caffe_model()
+    x = RNG.rand(2, 5).astype(np.float32)
+    np.testing.assert_allclose(np.asarray(g.forward(x)), x + bias,
+                               rtol=1e-6)

@@ -1,0 +1,179 @@
+"""Interop validated against the reference's REAL artifacts.
+
+Round-1 interop tests only round-tripped our own output — a
+self-consistent-but-wrong wire format would have passed.  These tests
+read the byte-identical fixture files the reference ships in
+spark/dl/src/test/resources/{caffe,tf,torch} (copied to tests/fixtures)
+and assert decoded tensors / forward outputs against independent
+oracles:
+
+* caffe: the exact weight values hardcoded in the reference's own
+  CaffeLoaderSpec.scala:63-117 ("load caffe match all parameters").
+* tf: test.pb is a frozen graph with analytically-known constants
+  (tf/test.py: W=0.2, b=0.1 everywhere), so the forward output must be
+  2*tanh(0.2x + 0.1) + 0.1 exactly.
+* torch: .t7 ImageNet preprocess tensors (genPreprocessRefTensors.lua)
+  — shape/dtype plus byte-offset-sensitive golden spot values.
+"""
+import os
+
+import numpy as np
+import pytest
+
+FIXTURES = os.path.join(os.path.dirname(__file__), "fixtures")
+CAFFE = os.path.join(FIXTURES, "caffe")
+TF = os.path.join(FIXTURES, "tf")
+TORCH = os.path.join(FIXTURES, "torch")
+
+
+class TestCaffeRealArtifacts:
+    """reference CaffeLoaderSpec.scala over caffe/test.{prototxt,caffemodel}."""
+
+    def _model(self, conv2_name="conv2"):
+        from bigdl_tpu import nn
+
+        return nn.Sequential(
+            nn.SpatialConvolution(3, 4, 2, 2).set_name("conv"),
+            nn.SpatialConvolution(4, 3, 2, 2).set_name(conv2_name),
+            nn.Linear(27, 2, with_bias=False).set_name("ip"))
+
+    def test_load_matches_reference_spec_values(self):
+        from bigdl_tpu.interop.caffe import CaffeLoader
+
+        model = CaffeLoader.load(
+            self._model(), os.path.join(CAFFE, "test.prototxt"),
+            os.path.join(CAFFE, "test.caffemodel"))
+
+        conv_w = np.asarray(model.modules[0].params["weight"]).ravel()
+        conv_b = np.asarray(model.modules[0].params["bias"]).ravel()
+        ip_w = np.asarray(model.modules[2].params["weight"]).ravel()
+        conv2_b = np.asarray(model.modules[1].params["bias"]).ravel()
+
+        # expected decodings from the reference's own CaffeLoaderSpec
+        np.testing.assert_allclose(conv_w[:8], [
+            0.4156779647, 0.3547672033, 0.1817495823, -0.1393318474,
+            0.4004031420, 0.0634599924, 0.1571258903, 0.4180541039],
+            atol=1e-6)
+        np.testing.assert_allclose(conv_w[-4:], [
+            -0.4454920888, -0.4200569391, -0.4690187573, -0.4590228796],
+            atol=1e-6)
+        np.testing.assert_allclose(conv_b, [
+            0.0458712392, -0.0029324144, -0.0251041390, 0.0052924110],
+            atol=1e-6)
+        np.testing.assert_allclose(ip_w[:4], [
+            0.0189033747, 0.0401176214, 0.0525088012, 0.3013394773],
+            atol=1e-6)
+        np.testing.assert_allclose(ip_w[-2:], [0.0032395422, 0.2072965205],
+                                   atol=1e-6)
+        np.testing.assert_allclose(conv2_b, [0.0, 0.0, 0.0], atol=1e-6)
+        assert conv_w.shape == (4 * 3 * 2 * 2,)
+        assert ip_w.shape == (2 * 27,)
+
+    def test_match_all_raises_on_missing_layer(self):
+        from bigdl_tpu.interop.caffe import CaffeLoader
+
+        with pytest.raises(ValueError, match="match_all"):
+            CaffeLoader.load(
+                self._model(conv2_name="conv3"),
+                os.path.join(CAFFE, "test.prototxt"),
+                os.path.join(CAFFE, "test.caffemodel"))
+
+    def test_partial_match_copies_named_layers(self):
+        from bigdl_tpu.interop.caffe import CaffeLoader
+
+        model = CaffeLoader.load(
+            self._model(conv2_name="conv3"),
+            os.path.join(CAFFE, "test.prototxt"),
+            os.path.join(CAFFE, "test.caffemodel"), match_all=False)
+        conv_b = np.asarray(model.modules[0].params["bias"]).ravel()
+        np.testing.assert_allclose(conv_b, [
+            0.0458712392, -0.0029324144, -0.0251041390, 0.0052924110],
+            atol=1e-6)
+
+    def test_dynamic_graph_build_and_forward(self):
+        # conv(3->4,k2): 5->4; conv2(4->3,k2): 4->3; ip: 27->2; the
+        # unknown "Dummy" layer falls back to Identity; SoftmaxWithLoss
+        # becomes SoftMax — output is a (1, 2) distribution
+        from bigdl_tpu.interop.caffe import CaffeLoader
+
+        loader = CaffeLoader(os.path.join(CAFFE, "test.prototxt"),
+                             os.path.join(CAFFE, "test.caffemodel"))
+        graph = loader.create_caffe_model()
+        x = np.random.RandomState(0).rand(1, 3, 5, 5).astype(np.float32)
+        out = np.asarray(graph.forward(x))
+        assert out.shape == (1, 2)
+        np.testing.assert_allclose(out.sum(), 1.0, rtol=1e-5)
+
+    def test_static_and_dynamic_agree(self):
+        # reference CaffeLoaderSpec "Dynamic loaded module should have
+        # the same result as static one"
+        from bigdl_tpu import nn
+        from bigdl_tpu.interop.caffe import CaffeLoader
+
+        loaded = CaffeLoader.load(
+            self._model(), os.path.join(CAFFE, "test.prototxt"),
+            os.path.join(CAFFE, "test.caffemodel"))
+        static = nn.Sequential(
+            loaded.modules[0], loaded.modules[1],
+            nn.Reshape([27]), loaded.modules[2],  # flatten before ip
+            nn.SoftMax())
+        dynamic = CaffeLoader(
+            os.path.join(CAFFE, "test.prototxt"),
+            os.path.join(CAFFE, "test.caffemodel")).create_caffe_model()
+        x = np.random.RandomState(1).rand(1, 3, 5, 5).astype(np.float32)
+        np.testing.assert_allclose(np.asarray(static.forward(x)),
+                                   np.asarray(dynamic.forward(x)),
+                                   rtol=1e-5, atol=1e-6)
+
+
+class TestTensorflowRealArtifacts:
+    """reference tf/test.pb — frozen graph with analytically-known
+    weights (tf/test.py builds W1=0.2 (1x10), b1=0.1, tanh, W2=0.2
+    (10x1), b2=0.1 then freezes)."""
+
+    def test_load_and_forward_matches_analytic(self):
+        from bigdl_tpu.interop.tensorflow import TensorflowLoader
+
+        model = TensorflowLoader.load(os.path.join(TF, "test.pb"),
+                                      ["Placeholder"], ["output"])
+        x = np.array([[1.0], [-0.5], [3.0], [0.0]], np.float32)
+        out = np.asarray(model.forward(x))
+        # out = sum_10(0.2 * tanh(0.2x + 0.1)) + 0.1
+        expected = 2.0 * np.tanh(0.2 * x + 0.1) + 0.1
+        np.testing.assert_allclose(out, expected, rtol=1e-6, atol=1e-6)
+
+    def test_parse_exposes_frozen_consts(self):
+        from bigdl_tpu.interop.tensorflow import TensorflowLoader
+
+        g = TensorflowLoader.parse(os.path.join(TF, "test.pb"))
+        ops = {n.name: n.op for n in g.node}
+        assert ops["MatMul"] == "MatMul"
+        assert ops["output"] == "BiasAdd"
+        assert ops["Variable"] == "Const"  # frozen variable
+
+
+class TestTorchRealArtifacts:
+    """reference torch/*.t7 — Torch7-serialized float tensors written by
+    genPreprocessRefTensors.lua (3x224x224 normalized ImageNet crops)."""
+
+    @pytest.mark.parametrize("name,first3,mean", [
+        ("n02110063_11239", [-3.4117649, -3.9607844, -2.8235292],
+         -0.6127880811691284),
+        ("n04370456_5753", [6.0, 6.0, 6.0], 0.15317882597446442),
+    ])
+    def test_decode_golden(self, name, first3, mean):
+        from bigdl_tpu.utils.torch_file import load as t7_load
+
+        a = np.asarray(t7_load(os.path.join(TORCH, f"{name}.t7")))
+        assert a.shape == (3, 224, 224)
+        assert a.dtype == np.float32
+        assert np.isfinite(a).all()
+        np.testing.assert_allclose(a[0, 0, :3], first3, rtol=1e-6)
+        np.testing.assert_allclose(float(a.mean()), mean, rtol=1e-6)
+
+    def test_distinct_files_decode_distinct_content(self):
+        from bigdl_tpu.utils.torch_file import load as t7_load
+
+        a = np.asarray(t7_load(os.path.join(TORCH, "n02110063_11239.t7")))
+        b = np.asarray(t7_load(os.path.join(TORCH, "n04370456_5753.t7")))
+        assert not np.allclose(a, b)

@@ -1,0 +1,284 @@
+"""TF loader subgraph-pattern tests (reference TensorflowToBigDL.scala
+pattern table / TensorflowLoaderSpec).
+
+GraphDefs are built in-memory with the same proto builders the saver
+uses, shaped exactly like TF v1 emits them (frozen Const weights,
+BiasAdd fusion points, Split slot refs, decomposed batch-norm math,
+dropout's div/floor/mul subgraph, slim's Shape/Pack flatten) and
+checked against NumPy oracles.
+"""
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from bigdl_tpu.interop import tensorflow as tfi
+from bigdl_tpu.interop.tensorflow import TensorflowLoader, tensor_to_proto
+from bigdl_tpu.utils.table import Table
+
+tfpb = tfi.tfpb
+
+
+class GB:
+    """Minimal GraphDef builder mimicking tf-v1 frozen-graph structure."""
+
+    def __init__(self):
+        self.g = tfpb.GraphDef()
+
+    def placeholder(self, name):
+        n = self.g.node.add()
+        n.op, n.name = "Placeholder", name
+        n.attr["dtype"].type = tfpb.DT_FLOAT
+        return name
+
+    def const(self, name, arr, dtype=np.float32):
+        n = self.g.node.add()
+        n.op, n.name = "Const", name
+        n.attr["value"].tensor.CopyFrom(
+            tensor_to_proto(np.asarray(arr, dtype)))
+        return name
+
+    def op(self, op, name, inputs, **attrs):
+        n = self.g.node.add()
+        n.op, n.name = op, name
+        n.input.extend(inputs)
+        for k, v in attrs.items():
+            if isinstance(v, bool):
+                n.attr[k].b = v
+            elif isinstance(v, int):
+                n.attr[k].i = v
+            elif isinstance(v, float):
+                n.attr[k].f = v
+            elif isinstance(v, (list, tuple)):
+                n.attr[k].list.i.extend(int(x) for x in v)
+            elif isinstance(v, str):
+                n.attr[k].s = v.encode()
+        return name
+
+
+def sigmoid(a):
+    return 1.0 / (1.0 + np.exp(-a))
+
+
+class TestUnrolledLSTM:
+    """A 2-step unrolled BasicLSTMCell graph, node-for-node as TF v1
+    static_rnn freezes it (ConcatV2 → MatMul → BiasAdd → Split(4) →
+    i/j/f/o gate soup), loaded compositionally and checked against a
+    NumPy LSTM oracle (reference TensorflowToBigDL LSTM pattern)."""
+
+    B, D, H, T = 2, 3, 4, 2
+    FORGET_BIAS = 1.0
+
+    def _build(self, rng):
+        B, D, H = self.B, self.D, self.H
+        W = rng.randn(D + H, 4 * H).astype(np.float32) * 0.3
+        b = rng.randn(4 * H).astype(np.float32) * 0.1
+
+        gb = GB()
+        gb.placeholder("x0")
+        gb.placeholder("x1")
+        gb.const("kernel", W)
+        gb.const("bias", b)
+        gb.const("axis1", np.int32(1), np.int32)
+        gb.const("split_dim", np.int32(1), np.int32)
+        gb.const("zeros_c", np.zeros((B, H)))
+        gb.const("zeros_h", np.zeros((B, H)))
+        gb.const("forget_bias", np.float32(self.FORGET_BIAS))
+
+        h_prev, c_prev = "zeros_h", "zeros_c"
+        for t in range(self.T):
+            p = f"cell_{t}/"
+            gb.op("ConcatV2", p + "concat", [f"x{t}", h_prev, "axis1"])
+            gb.op("MatMul", p + "matmul", [p + "concat", "kernel"],
+                  transpose_a=False, transpose_b=False)
+            gb.op("BiasAdd", p + "gates", [p + "matmul", "bias"])
+            gb.op("Split", p + "split", ["split_dim", p + "gates"],
+                  num_split=4)
+            i, j, f, o = (p + "split", p + "split:1", p + "split:2",
+                          p + "split:3")
+            gb.op("Add", p + "f_fb", [f, "forget_bias"])
+            gb.op("Sigmoid", p + "sig_f", [p + "f_fb"])
+            gb.op("Mul", p + "c_keep", [c_prev, p + "sig_f"])
+            gb.op("Sigmoid", p + "sig_i", [i])
+            gb.op("Tanh", p + "tanh_j", [j])
+            gb.op("Mul", p + "c_in", [p + "sig_i", p + "tanh_j"])
+            gb.op("AddV2", p + "c_new", [p + "c_keep", p + "c_in"])
+            gb.op("Tanh", p + "tanh_c", [p + "c_new"])
+            gb.op("Sigmoid", p + "sig_o", [o])
+            gb.op("Mul", p + "h_new", [p + "tanh_c", p + "sig_o"])
+            h_prev, c_prev = p + "h_new", p + "c_new"
+        return gb.g, W, b, h_prev
+
+    def _oracle(self, x0, x1, W, b):
+        H = self.H
+        h = np.zeros((self.B, H), np.float32)
+        c = np.zeros((self.B, H), np.float32)
+        for x in (x0, x1):
+            gates = np.concatenate([x, h], axis=1) @ W + b
+            i, j, f, o = np.split(gates, 4, axis=1)
+            c = c * sigmoid(f + self.FORGET_BIAS) + sigmoid(i) * np.tanh(j)
+            h = np.tanh(c) * sigmoid(o)
+        return h
+
+    def test_forward_matches_numpy_oracle(self):
+        rng = np.random.RandomState(0)
+        g, W, b, out_name = self._build(rng)
+        model = TensorflowLoader.build(g, ["x0", "x1"], [out_name])
+        x0 = rng.randn(self.B, self.D).astype(np.float32)
+        x1 = rng.randn(self.B, self.D).astype(np.float32)
+        out = np.asarray(model.forward(Table(jnp.asarray(x0),
+                                             jnp.asarray(x1))))
+        np.testing.assert_allclose(out, self._oracle(x0, x1, W, b),
+                                   rtol=1e-5, atol=1e-6)
+
+
+class TestDecomposedBatchNorm:
+    """Frozen tf-v1 batch_norm: y = x*[gamma*rsqrt(var+eps)] +
+    [beta - mean*gamma*rsqrt(var+eps)] as a Mul/Rsqrt/Sub node chain over
+    Consts — loads through constant folding, no dedicated pattern."""
+
+    def test_matches_formula(self):
+        rng = np.random.RandomState(1)
+        C = 3
+        gamma = rng.rand(C).astype(np.float32) + 0.5
+        beta = rng.randn(C).astype(np.float32)
+        mean = rng.randn(C).astype(np.float32)
+        var = rng.rand(C).astype(np.float32) + 0.1
+        eps = 1e-3
+
+        gb = GB()
+        gb.placeholder("x")
+        gb.const("gamma", gamma)
+        gb.const("beta", beta)
+        gb.const("mean", mean)
+        gb.const("var", var)
+        gb.const("eps", np.float32(eps))
+        gb.op("Add", "var_eps", ["var", "eps"])
+        gb.op("Rsqrt", "rsqrt", ["var_eps"])
+        gb.op("Mul", "factor", ["rsqrt", "gamma"])
+        gb.op("Mul", "scaled", ["x", "factor"])
+        gb.op("Mul", "mean_f", ["mean", "factor"])
+        gb.op("Sub", "shift", ["beta", "mean_f"])
+        gb.op("AddV2", "out", ["scaled", "shift"])
+
+        model = TensorflowLoader.build(gb.g, ["x"], ["out"])
+        x = rng.randn(4, C).astype(np.float32)
+        out = np.asarray(model.forward(jnp.asarray(x)))
+        expected = x * (gamma / np.sqrt(var + eps)) + (
+            beta - mean * gamma / np.sqrt(var + eps))
+        np.testing.assert_allclose(out, expected, rtol=1e-5, atol=1e-6)
+
+
+class TestDropoutSubgraph:
+    """tf.nn.dropout's mul(div(x, keep), floor(keep + uniform)) subgraph
+    → nn.Dropout (reference DropoutTF pattern)."""
+
+    def _graph(self, keep=0.8):
+        gb = GB()
+        gb.placeholder("x")
+        gb.const("keep", np.float32(keep))
+        gb.const("shape", np.asarray([4, 5], np.int32), np.int32)
+        gb.op("RealDiv", "div", ["x", "keep"])
+        gb.op("RandomUniform", "uniform", ["shape"])
+        gb.op("Add", "add", ["uniform", "keep"])
+        gb.op("Floor", "floor", ["add"])
+        gb.op("Mul", "dropout", ["div", "floor"])
+        return gb.g
+
+    def test_maps_to_dropout_module(self):
+        from bigdl_tpu import nn
+
+        model = TensorflowLoader.build(self._graph(), ["x"], ["dropout"])
+        mods = [type(m).__name__ for m in model.modules_iter()]
+        assert "Dropout" in mods
+        drop = [m for m in model.modules_iter()
+                if isinstance(m, nn.Dropout)][0]
+        np.testing.assert_allclose(drop.p, 0.2, atol=1e-6)
+
+    def test_eval_forward_is_identity(self):
+        model = TensorflowLoader.build(self._graph(), ["x"], ["dropout"])
+        model.evaluate()
+        x = np.random.RandomState(2).randn(4, 5).astype(np.float32)
+        np.testing.assert_allclose(np.asarray(model.forward(jnp.asarray(x))),
+                                   x, rtol=1e-6)
+
+
+class TestFlattenSubgraph:
+    """slim flatten: Reshape(x, Pack([strided_slice(Shape(x)), -1]))
+    → InferReshape([0, -1])."""
+
+    def test_flattens_batch(self):
+        gb = GB()
+        gb.placeholder("x")
+        gb.const("ss_begin", np.asarray([0], np.int32), np.int32)
+        gb.const("ss_end", np.asarray([1], np.int32), np.int32)
+        gb.const("ss_stride", np.asarray([1], np.int32), np.int32)
+        gb.const("minus1", np.int32(-1), np.int32)
+        gb.op("Shape", "shape", ["x"])
+        gb.op("StridedSlice", "batch",
+              ["shape", "ss_begin", "ss_end", "ss_stride"],
+              shrink_axis_mask=1)
+        gb.op("Pack", "pack", ["batch", "minus1"], axis=0)
+        gb.op("Reshape", "flatten", ["x", "pack"])
+
+        model = TensorflowLoader.build(gb.g, ["x"], ["flatten"])
+        x = np.random.RandomState(3).randn(2, 3, 4).astype(np.float32)
+        out = np.asarray(model.forward(jnp.asarray(x)))
+        np.testing.assert_allclose(out, x.reshape(2, 12), rtol=1e-6)
+
+
+class TestSplitAndFriends:
+    def test_split_slots_reassembled_by_concat(self):
+        gb = GB()
+        gb.placeholder("x")
+        gb.const("dim", np.int32(1), np.int32)
+        gb.const("axis", np.int32(1), np.int32)
+        gb.op("Split", "split", ["dim", "x"], num_split=3)
+        gb.op("ConcatV2", "out", ["split:2", "split", "axis"])
+
+        model = TensorflowLoader.build(gb.g, ["x"], ["out"])
+        x = np.random.RandomState(4).randn(2, 6).astype(np.float32)
+        out = np.asarray(model.forward(jnp.asarray(x)))
+        np.testing.assert_allclose(
+            out, np.concatenate([x[:, 4:6], x[:, 0:2]], axis=1), rtol=1e-6)
+
+    def test_unpack_selects_rows(self):
+        gb = GB()
+        gb.placeholder("x")
+        gb.op("Unpack", "unstack", ["x"], axis=1, num=3)
+        model = TensorflowLoader.build(gb.g, ["x"], ["unstack:1"])
+        x = np.random.RandomState(5).randn(2, 3, 4).astype(np.float32)
+        out = np.asarray(model.forward(jnp.asarray(x)))
+        np.testing.assert_allclose(out, x[:, 1, :], rtol=1e-6)
+
+    def test_mean_reduce(self):
+        gb = GB()
+        gb.placeholder("x")
+        gb.const("axes", np.asarray([1], np.int32), np.int32)
+        gb.op("Mean", "mean", ["x", "axes"], keep_dims=False)
+        model = TensorflowLoader.build(gb.g, ["x"], ["mean"])
+        x = np.random.RandomState(6).randn(2, 5).astype(np.float32)
+        np.testing.assert_allclose(np.asarray(model.forward(jnp.asarray(x))),
+                                   x.mean(axis=1), rtol=1e-5)
+
+    def test_transpose_perm(self):
+        gb = GB()
+        gb.placeholder("x")
+        gb.const("perm", np.asarray([0, 2, 1], np.int32), np.int32)
+        gb.op("Transpose", "tr", ["x", "perm"])
+        model = TensorflowLoader.build(gb.g, ["x"], ["tr"])
+        x = np.random.RandomState(7).randn(2, 3, 4).astype(np.float32)
+        np.testing.assert_allclose(np.asarray(model.forward(jnp.asarray(x))),
+                                   x.transpose(0, 2, 1), rtol=1e-6)
+
+    def test_matmul_without_bias_as_output(self):
+        gb = GB()
+        gb.placeholder("x")
+        W = np.random.RandomState(8).randn(3, 2).astype(np.float32)
+        gb.const("W", W)
+        gb.op("MatMul", "mm", ["x", "W"],
+              transpose_a=False, transpose_b=False)
+        model = TensorflowLoader.build(gb.g, ["x"], ["mm"])
+        x = np.random.RandomState(9).randn(4, 3).astype(np.float32)
+        np.testing.assert_allclose(np.asarray(model.forward(jnp.asarray(x))),
+                                   x @ W, rtol=1e-5)
