@@ -1,0 +1,39 @@
+"""Shared mesh/batch helpers for the training and evaluation drivers."""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh
+
+
+def data_mesh(mesh: Optional[Mesh]) -> Optional[Mesh]:
+    """Collapse any mesh to a 1-D ('data',) mesh (same device order)."""
+    if mesh is None:
+        return None
+    if mesh.axis_names == ("data",):
+        return mesh
+    return Mesh(np.asarray(mesh.devices).reshape(-1), ("data",))
+
+
+def round_up(n: int, multiple: int) -> int:
+    return -(-n // multiple) * multiple
+
+
+def pad_batch(x, y, size: int, target: int):
+    """Pad a (possibly multi-input) batch to ``target`` records by
+    repeating the last record (keeps padded rows numerically valid,
+    e.g. 1-based class labels); returns (x, y, weight) where weight is
+    the 1-real/0-pad per-record mask."""
+    pad = target - size
+
+    def pad_arr(a):
+        a = jnp.asarray(a)
+        return jnp.concatenate([a, jnp.repeat(a[-1:], pad, axis=0)], axis=0)
+
+    conv = lambda v: pad_arr(v) if not isinstance(v, (list, tuple)) \
+        else type(v)(pad_arr(e) for e in v)
+    w = jnp.concatenate([jnp.ones(size, jnp.float32),
+                         jnp.zeros(pad, jnp.float32)])
+    return conv(x), conv(y), w

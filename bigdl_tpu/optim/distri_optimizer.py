@@ -37,6 +37,7 @@ from ..parallel.all_reduce import AllReduceParameter, shard_batch
 from ..utils.engine import Engine, get_property
 from ..utils.rng import next_jax_key
 from ..utils.table import T
+from ._sharding_utils import data_mesh, pad_batch, round_up
 from .optimizer import Optimizer, _device_batch
 from .regularizer import collect_regularizer_paths, regularizer_loss
 
@@ -61,16 +62,94 @@ class DistriOptimizer(Optimizer):
         self.retry_window = float(get_property("bigdl.failure.retryTimeInterval", 120))
 
     # ------------------------------------------------------------------
-    def _build_step(self, mesh, arp: AllReduceParameter):
+    def _build_step(self, mesh, arp: AllReduceParameter, masked=False):
+        """One compiled, shard_mapped iteration.
+
+        ``masked=True`` builds the trailing-partial-batch variant: the
+        batch arrives padded to the mesh multiple with a per-record
+        weight vector ``w`` (1 real / 0 pad) and a global real-record
+        count ``total_w``; the loss is the weighted per-record mean, so
+        every record of an epoch trains exactly once at static shape
+        (reference trains every record, DataSet.scala:255-288).
+        """
         model, criterion, optim = self.model, self.criterion, self.optim_method
         reg_paths = list(collect_regularizer_paths(model))
         scale_tree = model.gradient_scale_tree()
         needs_scale = any(s != 1.0
                           for s in jax.tree_util.tree_leaves(scale_tree))
         axis = "data"
+        n_dev = arp.partition_num
 
-        def step(params, buffers, slots, lr, rng, x, y):
+        def step(params, buffers, slots, lr, rng, x, y, *mask_args):
             # decorrelate dropout across shards
+            rng = jax.random.fold_in(rng, jax.lax.axis_index(axis))
+
+            def loss_fn(p):
+                out, nb = model.apply_fn(p, buffers, x, True, rng)
+                if masked:
+                    w, total_w = mask_args
+                    per = jax.vmap(
+                        lambda o, t: criterion._loss(o[None], t[None]))(out, y)
+                    # local weighted sum over the GLOBAL real count: the
+                    # later cross-shard gradient sum yields the global
+                    # weighted-mean gradient with no extra divide
+                    loss = jnp.sum(per * w) / total_w
+                    if reg_paths:
+                        loss = loss + regularizer_loss(p, reg_paths) / n_dev
+                else:
+                    loss = criterion._loss(out, y)
+                    if reg_paths:
+                        loss = loss + regularizer_loss(p, reg_paths)
+                return loss, nb
+
+            (loss, new_buffers), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params)
+            if needs_scale:  # reference setScaleW/setScaleB semantics
+                grads = jax.tree_util.tree_map(lambda g, s: g * s,
+                                               grads, scale_tree)
+            # reduce-scatter: my summed gradient slice; the plain path
+            # averages over shards, the masked path is already globally
+            # normalized by total_w
+            g_slice = arp.reduce_scatter_gradients(grads)
+            if not masked:
+                g_slice = g_slice / n_dev
+            w_slice = arp.my_weight_slice(params)
+            new_w_slice, new_slots = optim.step(g_slice, w_slice, slots, lr)
+            new_params = arp.all_gather_weights(new_w_slice)
+            if masked:
+                # padded rows would pollute batch statistics (BatchNorm
+                # running mean/var): keep the pre-step buffers for the
+                # trailing partial batch
+                new_buffers = buffers
+            else:
+                # BN running stats etc.: average across shards (sync-BN)
+                new_buffers = jax.tree_util.tree_map(
+                    lambda b: jax.lax.pmean(b, axis), new_buffers)
+            loss = (jax.lax.psum(loss, axis) if masked
+                    else jax.lax.pmean(loss, axis))
+            return loss, new_params, new_buffers, new_slots
+
+        in_specs = (P(), P(), P(axis), P(), P(), P(axis), P(axis))
+        if masked:
+            in_specs = in_specs + (P(axis), P())
+        out_specs = (P(), P(), P(), P(axis))
+        # check_vma=False: params come back through all_gather of an
+        # axis_index-derived slice, which the static replication checker
+        # can't prove replicated (it is — every shard gathers all slices).
+        sharded = shard_map(step, mesh=mesh, in_specs=in_specs,
+                            out_specs=out_specs, check_vma=False)
+        return jax.jit(sharded)
+
+    def _build_grad_probe(self, mesh):
+        """Collective-free forward+backward used on profiling iterations
+        to split step time into compute vs gradient-aggregation — fills
+        the reference's per-phase Metrics contract with measured numbers
+        (Metrics.scala:103-121, DistriOptimizer.scala:146-151)."""
+        model, criterion = self.model, self.criterion
+        reg_paths = list(collect_regularizer_paths(model))
+        axis = "data"
+
+        def grad_only(params, buffers, rng, x, y):
             rng = jax.random.fold_in(rng, jax.lax.axis_index(axis))
 
             def loss_fn(p):
@@ -80,29 +159,19 @@ class DistriOptimizer(Optimizer):
                     loss = loss + regularizer_loss(p, reg_paths)
                 return loss, nb
 
-            (loss, new_buffers), grads = jax.value_and_grad(
+            (loss, _), grads = jax.value_and_grad(
                 loss_fn, has_aux=True)(params)
-            if needs_scale:  # reference setScaleW/setScaleB semantics
-                grads = jax.tree_util.tree_map(lambda g, s: g * s,
-                                               grads, scale_tree)
-            # reduce-scatter: my summed gradient slice, averaged over shards
-            g_slice = arp.reduce_scatter_gradients(grads) / arp.partition_num
-            w_slice = arp.my_weight_slice(params)
-            new_w_slice, new_slots = optim.step(g_slice, w_slice, slots, lr)
-            new_params = arp.all_gather_weights(new_w_slice)
-            # BN running stats etc.: average across shards (sync-BN style)
-            new_buffers = jax.tree_util.tree_map(
-                lambda b: jax.lax.pmean(b, axis), new_buffers)
-            loss = jax.lax.pmean(loss, axis)
-            return loss, new_params, new_buffers, new_slots
+            # consume every gradient so none is dead-code-eliminated; the
+            # scalar psum is negligible next to the full-tensor collectives
+            gnorm = jax.lax.psum(
+                sum(jnp.vdot(g, g)
+                    for g in jax.tree_util.tree_leaves(grads)), axis)
+            return jax.lax.pmean(loss, axis), gnorm
 
-        in_specs = (P(), P(), P(axis), P(), P(), P(axis), P(axis))
-        out_specs = (P(), P(), P(), P(axis))
-        # check_vma=False: params come back through all_gather of an
-        # axis_index-derived slice, which the static replication checker
-        # can't prove replicated (it is — every shard gathers all slices).
-        sharded = shard_map(step, mesh=mesh, in_specs=in_specs,
-                            out_specs=out_specs, check_vma=False)
+        sharded = shard_map(
+            grad_only, mesh=mesh,
+            in_specs=(P(), P(), P(), P(axis), P(axis)),
+            out_specs=(P(), P()), check_vma=False)
         return jax.jit(sharded)
 
     # ------------------------------------------------------------------
@@ -111,8 +180,7 @@ class DistriOptimizer(Optimizer):
         if mesh is None:
             mesh = Engine.create_mesh()
         # collapse to a pure-data mesh if caller handed the 4-axis default
-        if mesh.axis_names != ("data",):
-            mesh = Mesh(np.asarray(mesh.devices).reshape(-1), ("data",))
+        mesh = data_mesh(mesh)
         n_dev = mesh.shape["data"]
         if self.batch_size is not None and self.batch_size % n_dev != 0:
             raise ValueError(
@@ -179,6 +247,11 @@ class DistriOptimizer(Optimizer):
             slots)
 
         jitted = self._build_step(mesh, arp)
+        jitted_masked = None  # compiled lazily on the first partial batch
+        grad_probe = None     # compiled lazily on the first profiled iter
+        profile_interval = int(get_property("bigdl.metrics.profileInterval",
+                                            10))
+        compute_ratio = None  # last measured compute/total split
 
         state = optim.state
         state["epoch"] = state.get("epoch", 1)
@@ -200,26 +273,56 @@ class DistriOptimizer(Optimizer):
             else:
                 batch = next(data_iter)
                 x, y = _device_batch(batch)
-            if batch.size() % n_dev != 0:
-                # static-shape contract: global batch must divide the mesh
-                # (reference requires batchSize % nodeNumber == 0 too,
-                # Optimizer.scala:417). Count the skipped records so the
-                # epoch still advances on a trailing partial batch.
-                records_this_epoch += batch.size()
-                if records_this_epoch >= epoch_size:
-                    state["epoch"] += 1
-                    state["epoch_finished"] = True
-                    records_this_epoch = 0
-                    self.dataset.shuffle()
-                    data_iter = self.dataset.data(train=True)
-                continue
+            n_records = batch.size()
+            masked = n_records % n_dev != 0
+            if masked:
+                # trailing partial batch: pad to the mesh multiple and
+                # train the real records via a per-record weight mask —
+                # every record of the epoch trains exactly once at static
+                # shape (reference DataSet.scala:255-288 trains all)
+                if not _maskable(y):
+                    raise ValueError(
+                        "partial batch with non-array targets cannot be "
+                        "pad-and-masked; size your dataset to a batch "
+                        "multiple of the mesh")
+                x, y, w = pad_batch(x, y, n_records,
+                                    round_up(n_records, n_dev))
             x, y = shard_batch(mesh, (x, y))
             infeed_time = time.time() - t_data0
 
+            # profile past the compile iteration so timings are warm
+            profiled = (profile_interval > 0 and state["neval"] > 1
+                        and state["neval"] % profile_interval == 0
+                        and not masked)
+            if profiled:
+                # collective-free fwd+bwd probe: measures pure compute so
+                # "aggregate gradient time" is a real number, not 0.0.
+                # Fixed probe key: the probe's output is discarded, and
+                # drawing from the training key stream would make the
+                # RNG sequence depend on the profiling interval.
+                probe_key = jax.random.PRNGKey(0)
+                if grad_probe is None:
+                    grad_probe = self._build_grad_probe(mesh)
+                    jax.block_until_ready(  # compile outside the timing
+                        grad_probe(params, buffers, probe_key, x, y))
+                tp = time.time()
+                jax.block_until_ready(
+                    grad_probe(params, buffers, probe_key, x, y))
+                compute_time = time.time() - tp
+
             t0 = time.time()
             lr = optim.get_current_lr()
-            loss, params, buffers, slots = jitted(
-                params, buffers, slots, jnp.float32(lr), next_jax_key(), x, y)
+            if masked:
+                if jitted_masked is None:
+                    jitted_masked = self._build_step(mesh, arp, masked=True)
+                w = shard_batch(mesh, (w,))[0]
+                loss, params, buffers, slots = jitted_masked(
+                    params, buffers, slots, jnp.float32(lr), next_jax_key(),
+                    x, y, w, jnp.float32(n_records))
+            else:
+                loss, params, buffers, slots = jitted(
+                    params, buffers, slots, jnp.float32(lr), next_jax_key(),
+                    x, y)
             # overlap next-batch host prep + infeed with this device step
             # (in-epoch only, preserving rollover/shuffle semantics)
             if records_this_epoch + batch.size() < epoch_size:
@@ -228,12 +331,24 @@ class DistriOptimizer(Optimizer):
             loss = float(loss)  # device sync
             train_time = time.time() - t0
 
-            n_records = batch.size()
             records_this_epoch += n_records
             state["loss"] = loss
             # metric-name contract (reference DistriOptimizer.scala:146-151)
-            self.metrics.add("computing time average", train_time)
-            self.metrics.add("aggregate gradient time", 0.0)  # fused in-step
+            # with measured per-phase numbers: the profiled iterations pin
+            # the compute/aggregate split; in between, the last measured
+            # ratio attributes the fused step's wall time
+            if profiled:
+                compute_ratio = min(compute_time / max(train_time, 1e-9), 1.0)
+            if compute_ratio is not None:
+                self.metrics.add("computing time average",
+                                 train_time * compute_ratio)
+                self.metrics.add("aggregate gradient time",
+                                 train_time * (1.0 - compute_ratio))
+            else:
+                # metric-name contract holds before the first profiled
+                # iteration too (reference always emits all three)
+                self.metrics.add("computing time average", train_time)
+                self.metrics.add("aggregate gradient time", 0.0)
             self.metrics.add("get weights average", infeed_time)
             log.info(
                 "[Epoch %d %d/%d][Iteration %d][Wall Clock %.3fs] "
@@ -260,12 +375,18 @@ class DistriOptimizer(Optimizer):
                 self.dataset.shuffle()
                 data_iter = self.dataset.data(train=True)
 
-            if (self.validation_trigger is not None and self.validation_trigger(state)) or \
-               (self.checkpoint_trigger is not None and self.checkpoint_trigger(state)):
+            # validation runs ON-MESH with the device-resident params (no
+            # host pull, reference DistriValidator.scala:35); only a
+            # checkpoint needs the host-side model sync
+            if self.validation_trigger is not None and \
+                    self.validation_trigger(state):
+                self._validate_on_mesh(state, mesh, params, buffers)
+            if self.checkpoint_trigger is not None and \
+                    self.checkpoint_trigger(state):
                 model.set_param_tree(params)
                 model.set_buffer_tree(buffers)
                 optim._slots = slots
-                self._validate_and_checkpoint(state)
+                self._checkpoint(state)
 
         model.set_param_tree(params)
         model.set_buffer_tree(buffers)
@@ -273,13 +394,13 @@ class DistriOptimizer(Optimizer):
         model.evaluate()
         return model
 
-    def _validate_and_checkpoint(self, state):
+    def _validate_on_mesh(self, state, mesh, params, buffers):
         from .evaluator import evaluate_dataset
 
-        if (self.validation_trigger is not None and self.validation_trigger(state)
-                and self.validation_dataset is not None):
+        if self.validation_dataset is not None:
             results = evaluate_dataset(self.model, self.validation_dataset,
-                                       self.validation_methods)
+                                       self.validation_methods, mesh=mesh,
+                                       params=params, buffers=buffers)
             for method, result in zip(self.validation_methods, results):
                 log.info("%s is %s", method.format(), result)
                 if self.validation_summary is not None:
@@ -288,30 +409,44 @@ class DistriOptimizer(Optimizer):
                 if method.format() in ("Top1Accuracy", "Top5Accuracy"):
                     state["score"] = result.result()[0]
             self.model.training()
-        if (self.checkpoint_trigger is not None and self.checkpoint_trigger(state)
-                and self.checkpoint_path is not None):
-            n = state["neval"] - 1
-            suffix = "" if self.is_overwrite else f".{n}"
-            self.model.save(os.path.join(self.checkpoint_path, f"model{suffix}"),
-                            overwrite=True)
-            self.optim_method.save(
-                os.path.join(self.checkpoint_path, f"optimMethod{suffix}"),
-                overwrite=True)
+
+    def _checkpoint(self, state):
+        from ..utils import file_io
+
+        if self.checkpoint_path is None:
+            return
+        n = state["neval"] - 1
+        suffix = "" if self.is_overwrite else f".{n}"
+        self.model.save(file_io.join(self.checkpoint_path, f"model{suffix}"),
+                        overwrite=True)
+        self.optim_method.save(
+            file_io.join(self.checkpoint_path, f"optimMethod{suffix}"),
+            overwrite=True)
+
+
+def _maskable(y) -> bool:
+    """Pad-and-mask needs per-record array targets (vmap over records)."""
+    if isinstance(y, (list, tuple)):
+        return all(hasattr(v, "shape") for v in y)
+    return hasattr(y, "shape")
 
 
 def _latest_file(path: str, prefix: str) -> Optional[str]:
-    """reference DistriOptimizer.getLatestFile:828-845"""
-    if path is None or not os.path.isdir(path):
+    """reference DistriOptimizer.getLatestFile:828-845 — works on any
+    registered filesystem scheme (hdfs://, s3://, memory://, local)."""
+    from ..utils import file_io
+
+    if path is None or not file_io.isdir(path):
         return None
     best, best_n = None, -1
-    for f in os.listdir(path):
+    for f in file_io.listdir(path):
         if f == prefix:
-            return os.path.join(path, f)
+            return file_io.join(path, f)
         if f.startswith(prefix + "."):
             try:
                 n = int(f.rsplit(".", 1)[1])
             except ValueError:
                 continue
             if n > best_n:
-                best, best_n = os.path.join(path, f), n
+                best, best_n = file_io.join(path, f), n
     return best

@@ -1,31 +1,85 @@
 """Evaluator (reference optim/Evaluator.scala:37, Validator.scala,
 LocalValidator.scala, DistriValidator.scala:35).
 
-Batches run through ONE jitted eval forward; ValidationResults reduce as
-monoids (the reference's driver-side reduce of per-partition results).
+Batches run through ONE jitted eval forward; with a mesh, the forward is
+a shard_mapped program over the ``data`` axis so validation runs
+on-cluster exactly like the reference's DistriValidator
+(DistriValidator.scala:35, DistriOptimizer.scala:568-640) — params stay
+device-resident (no host pull) and batches are padded to the mesh
+multiple at static shape (metrics see only the real records).
+ValidationResults reduce as monoids (the reference's driver-side reduce
+of per-partition results).
 """
 from __future__ import annotations
 
-from typing import List, Sequence
+import weakref
+from typing import List, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
 
-from ..dataset.sample import MiniBatch, Sample, SampleToMiniBatch
+from ..dataset.sample import MiniBatch, SampleToMiniBatch
 from .validation import ValidationMethod, ValidationResult
+
+try:  # jax>=0.8: public API
+    from jax import shard_map  # type: ignore
+except ImportError:  # pragma: no cover
+    from jax.experimental.shard_map import shard_map  # type: ignore
+
+from ._sharding_utils import data_mesh as _data_mesh, pad_batch, round_up
+
+#: observability hook for tests/metrics: how the last eval ran
+last_eval_info = {"sharded": False, "n_devices": 1, "batches": 0}
+
+
+_EVAL_FWD_CACHE = weakref.WeakKeyDictionary()  # model -> {mesh: jitted fwd}
+
+
+def _cached_eval_fwd(model, mesh: Optional[Mesh]):
+    """One compiled eval forward per (model, mesh) — validation triggers
+    mid-training reuse the executable instead of re-jitting.  Held in a
+    weak side table (not on the model) so models stay picklable."""
+    cache = _EVAL_FWD_CACHE.setdefault(model, {})
+    if mesh in cache:
+        return cache[mesh]
+
+    def fwd_local(p, b, x):
+        out, _ = model.apply_fn(p, b, x, False, None)
+        return out
+
+    if mesh is not None:
+        fwd = jax.jit(shard_map(fwd_local, mesh=mesh,
+                                in_specs=(P(), P(), P("data")),
+                                out_specs=P("data")))
+    else:
+        fwd = jax.jit(fwd_local)
+    cache[mesh] = fwd
+    return fwd
 
 
 def evaluate_dataset(model, dataset, v_methods: Sequence[ValidationMethod],
-                     batch_size: int = 128) -> List[ValidationResult]:
-    """Shared eval loop; dataset may yield Samples or MiniBatches."""
-    model.evaluate()
-    params = model.param_tree()
-    buffers = model.buffer_tree()
+                     batch_size: int = 128, mesh: Optional[Mesh] = None,
+                     params=None, buffers=None) -> List[ValidationResult]:
+    """Shared eval loop; dataset may yield Samples or MiniBatches.
 
-    @jax.jit
-    def fwd(p, b, x):
-        out, _ = model.apply_fn(p, b, x, False, None)
-        return out
+    ``mesh``: run the forward as a compiled shard_map over the data axis.
+    ``params``/``buffers``: device-resident trees to evaluate with (skips
+    the host pull from ``model`` — used by DistriOptimizer's validation
+    trigger mid-training).
+    """
+    model.evaluate()
+    if params is None:
+        params = model.param_tree()
+    if buffers is None:
+        buffers = model.buffer_tree()
+
+    mesh = _data_mesh(mesh)
+    n_dev = mesh.shape["data"] if mesh is not None else 1
+    fwd = _cached_eval_fwd(model, mesh)
+
+    last_eval_info.update({"sharded": mesh is not None, "n_devices": n_dev,
+                           "batches": 0})
 
     it = dataset.data(train=False)
     results = [None] * len(v_methods)
@@ -47,9 +101,19 @@ def evaluate_dataset(model, dataset, v_methods: Sequence[ValidationMethod],
     for batch in batches():
         x = batch.get_input()
         y = batch.get_target()
+        size = batch.size()
         x = jnp.asarray(x) if not isinstance(x, (list, tuple)) else \
             type(x)(jnp.asarray(v) for v in x)
+        padded = size % n_dev != 0
+        if padded:  # static-shape contract over the mesh
+            x, y, _ = pad_batch(x, y, size, round_up(size, n_dev))
         out = fwd(params, buffers, x)
+        if padded:
+            # slice the RECORD axis of every output/target leaf (models
+            # may emit tuples/Tables)
+            out = jax.tree_util.tree_map(lambda a: a[:size], out)
+            y = jax.tree_util.tree_map(lambda a: a[:size], y)
+        last_eval_info["batches"] += 1
         for i, m in enumerate(v_methods):
             r = m(out, y)
             results[i] = r if results[i] is None else results[i] + r
@@ -72,5 +136,19 @@ class LocalValidator(Evaluator):
 
 
 class DistriValidator(Evaluator):
-    """reference optim/DistriValidator.scala:35 — same eval loop; batch
-    sharding over the mesh happens at infeed when a mesh is active."""
+    """reference optim/DistriValidator.scala:35 — validation as a
+    compiled, mesh-sharded program (EveryBatch sharding over the data
+    axis; no host parameter pull)."""
+
+    def __init__(self, model, mesh: Optional[Mesh] = None):
+        super().__init__(model)
+        if mesh is None:
+            from ..utils.engine import Engine
+
+            mesh = Engine.create_mesh()
+        self.mesh = _data_mesh(mesh)
+
+    def test(self, dataset, v_methods, batch_size: int = 128):
+        results = evaluate_dataset(self.model, dataset, v_methods,
+                                   batch_size, mesh=self.mesh)
+        return list(zip(results, [m.format() for m in v_methods]))

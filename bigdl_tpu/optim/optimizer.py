@@ -291,10 +291,12 @@ class LocalOptimizer(Optimizer):
             return
         if self.checkpoint_path is None:
             return
+        from ..utils import file_io
+
         n = state["neval"] - 1
         suffix = "" if self.is_overwrite else f".{n}"
-        self.model.save(os.path.join(self.checkpoint_path, f"model{suffix}"),
+        self.model.save(file_io.join(self.checkpoint_path, f"model{suffix}"),
                         overwrite=True)
         self.optim_method.save(
-            os.path.join(self.checkpoint_path, f"optimMethod{suffix}"),
+            file_io.join(self.checkpoint_path, f"optimMethod{suffix}"),
             overwrite=True)

@@ -1,21 +1,162 @@
 """Save/load of modules, pytrees and optim methods (reference
-utils/File.scala:67-160 — Java serialization to local/HDFS/S3).
+utils/File.scala:67-160 — Java serialization to local/HDFS/S3,
+``saveToHdfs``:106).
 
 Host-side pickle with jax arrays converted to numpy on the way out and
-back to jax on the way in.  The path seam accepts a scheme prefix the
-way the reference does (``hdfs://``/``s3://`` would plug in here);
-local files are what this environment supports.
+back to jax on the way in.  Paths carry an optional scheme the way the
+reference's Hadoop-path seam does: ``scheme://...`` routes through a
+registered :class:`FileSystemBackend`; bare paths use the local
+filesystem.  Unregistered schemes fall back to fsspec (when installed),
+which provides real ``hdfs://``/``s3://``/``gs://``/``memory://``
+implementations — ``memory://`` doubles as the in-process mock used by
+tests and CI without any cluster.
 """
 from __future__ import annotations
 
 import os
 import pickle
-from typing import Any
+from typing import Any, Dict, List
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
+
+# --------------------------------------------------------------------------
+# Filesystem seam (reference File.scala getFileSystem/saveToHdfs:67-160)
+# --------------------------------------------------------------------------
+
+class FileSystemBackend:
+    """Minimal filesystem surface the checkpoint/serialization layer
+    needs.  Implementations exist for local disk and (via fsspec) remote
+    object stores; custom schemes plug in with register_filesystem()."""
+
+    def open(self, path: str, mode: str):
+        raise NotImplementedError
+
+    def exists(self, path: str) -> bool:
+        raise NotImplementedError
+
+    def makedirs(self, path: str):
+        raise NotImplementedError
+
+    def listdir(self, path: str) -> List[str]:
+        """Base names of the directory's entries."""
+        raise NotImplementedError
+
+    def isdir(self, path: str) -> bool:
+        raise NotImplementedError
+
+
+def _strip_file_scheme(path: str) -> str:
+    return path[len("file://"):] if path.startswith("file://") else path
+
+
+class _LocalBackend(FileSystemBackend):
+    def open(self, path, mode):
+        return open(_strip_file_scheme(path), mode)
+
+    def exists(self, path):
+        return os.path.exists(_strip_file_scheme(path))
+
+    def makedirs(self, path):
+        os.makedirs(_strip_file_scheme(path), exist_ok=True)
+
+    def listdir(self, path):
+        return os.listdir(_strip_file_scheme(path))
+
+    def isdir(self, path):
+        return os.path.isdir(_strip_file_scheme(path))
+
+
+class _FsspecBackend(FileSystemBackend):
+    """Adapter over an fsspec filesystem instance."""
+
+    def __init__(self, scheme: str):
+        import fsspec
+
+        self.fs = fsspec.filesystem(scheme)
+
+    def open(self, path, mode):
+        return self.fs.open(path, mode)
+
+    def exists(self, path):
+        return self.fs.exists(path)
+
+    def makedirs(self, path):
+        self.fs.makedirs(path, exist_ok=True)
+
+    def listdir(self, path):
+        return [p.rstrip("/").rsplit("/", 1)[-1]
+                for p in self.fs.ls(path, detail=False)]
+
+    def isdir(self, path):
+        return self.fs.isdir(path)
+
+
+_FILESYSTEMS: Dict[str, FileSystemBackend] = {}
+
+
+def register_filesystem(scheme: str, backend: FileSystemBackend):
+    """Plug a backend for ``scheme://`` paths (reference File.scala's
+    Hadoop-FileSystem-by-URI dispatch)."""
+    _FILESYSTEMS[scheme] = backend
+
+
+def _scheme_of(path: str) -> str:
+    if "://" in path:
+        return path.split("://", 1)[0]
+    return ""
+
+
+def filesystem_for(path: str) -> FileSystemBackend:
+    scheme = _scheme_of(path)
+    if not scheme or scheme == "file":
+        return _LOCAL
+    if scheme not in _FILESYSTEMS:
+        try:
+            _FILESYSTEMS[scheme] = _FsspecBackend(scheme)
+        except Exception as e:  # no fsspec / unknown protocol
+            raise ValueError(
+                f"no filesystem backend for scheme {scheme!r} "
+                f"(register one with register_filesystem): {e}")
+    return _FILESYSTEMS[scheme]
+
+
+_LOCAL = _LocalBackend()
+
+
+def _dirname(path: str) -> str:
+    if "://" in path:
+        scheme, rest = path.split("://", 1)
+        d = rest.rsplit("/", 1)[0] if "/" in rest else ""
+        return f"{scheme}://{d}" if d else ""
+    return os.path.dirname(path)
+
+
+# convenience wrappers used by checkpoint machinery ------------------------
+
+def exists(path: str) -> bool:
+    return filesystem_for(path).exists(path)
+
+
+def isdir(path: str) -> bool:
+    return filesystem_for(path).isdir(path)
+
+
+def listdir(path: str) -> List[str]:
+    return filesystem_for(path).listdir(path)
+
+
+def join(path: str, *parts: str) -> str:
+    if "://" in path:
+        return "/".join([path.rstrip("/"), *parts])
+    return os.path.join(path, *parts)
+
+
+# --------------------------------------------------------------------------
+# Pytree serialization
+# --------------------------------------------------------------------------
 
 def _to_host(obj):
     return jax.tree_util.tree_map(
@@ -28,20 +169,21 @@ def _to_device(obj):
 
 
 def save(obj: Any, path: str, overwrite: bool = False):
-    if os.path.exists(path) and not overwrite:
+    fs = filesystem_for(path)
+    if fs.exists(path) and not overwrite:
         raise FileExistsError(f"{path} exists and overwrite=False "
                               "(reference File.save isOverwrite contract)")
-    d = os.path.dirname(path)
+    d = _dirname(path)
     if d:
-        os.makedirs(d, exist_ok=True)
+        fs.makedirs(d)
     # raw pytrees (save_weights, optimizer slots) go to portable numpy;
     # module/optim objects additionally convert via their __getstate__
-    with open(path, "wb") as f:
+    with fs.open(path, "wb") as f:
         pickle.dump(_to_host(obj), f)
 
 
 def load(path: str) -> Any:
-    with open(path, "rb") as f:
+    with filesystem_for(path).open(path, "rb") as f:
         return _to_device(pickle.load(f))
 
 

@@ -172,3 +172,109 @@ def test_checkpoint_retry_recovers(tmp_path):
     trained = opt.optimize()  # must ride through the injected failure
     assert trained is model
     assert opt.optim_method.state["neval"] > 10
+
+
+def test_partial_batches_train_all_records():
+    """Dataset size % (batch, mesh) != 0: every record still trains
+    (pad-and-mask), and the weights move under the trailing batch
+    (reference trains every record per epoch, DataSet.scala:255-288)."""
+    from bigdl_tpu.dataset import SampleToMiniBatch
+
+    n = 70  # batch 64 -> trailing batch of 6, and 6 % 8 != 0
+    ds = array(xor_samples(n=n))
+    model = xor_model()
+    opt = DistriOptimizer(model, ds, nn.ClassNLLCriterion(), batch_size=64)
+    opt.set_optim_method(SGD(learning_rate=0.5))
+    opt.set_end_when(max_epoch(200))
+    trained = opt.optimize()
+    # 2 iterations per epoch: the trailing 6-record batch was trained,
+    # not skipped
+    assert opt.optim_method.state["neval"] - 1 == 2 * 200
+    # fit on the training records themselves: proves the trailing batch
+    # contributed gradients (70 samples are too few to test generalization)
+    res = trained.evaluate(array(xor_samples(n=n)), [Top1Accuracy()])
+    assert res[0][0].result()[0] > 0.85
+
+
+def test_masked_trailing_batch_matches_full_gradient():
+    """The masked step's update on a padded batch must equal the plain
+    step's update on the same records run at an exactly-divisible size."""
+    samples = xor_samples(n=8, seed=11)
+
+    from bigdl_tpu.utils.rng import RNG
+
+    RNG().set_seed(3)
+    m1 = xor_model()
+    RNG().set_seed(3)
+    m2 = xor_model()
+
+    # divisible path: all 8 records in one batch of 8
+    o1 = DistriOptimizer(m1, array(samples), nn.ClassNLLCriterion(),
+                         batch_size=8)
+    o1.set_optim_method(SGD(learning_rate=0.1))
+    o1.set_end_when(max_iteration(1))
+    o1.optimize()
+
+    # masked path: batch_size 16 -> single partial batch of 8? no — use
+    # n=8 with batch 16 gives one batch of 8 (divisible). Force masking
+    # with a 6-record tail: train 1 iteration on a 6-record dataset,
+    # batch 16 -> batch of 6, 6 % 8 != 0 -> masked step.
+    samples6 = samples[:6]
+    RNG().set_seed(3)
+    m3 = xor_model()
+    RNG().set_seed(3)
+    m4 = xor_model()
+    o3 = DistriOptimizer(m3, array(samples6 + samples6[:2]),
+                         nn.ClassNLLCriterion(), batch_size=8)
+    o3.set_optim_method(SGD(learning_rate=0.1))
+    o3.set_end_when(max_iteration(1))
+    o3.optimize()  # 8 records divisible — reference update
+
+    o4 = DistriOptimizer(m4, array(samples6), nn.ClassNLLCriterion(),
+                         batch_size=8)
+    o4.set_optim_method(SGD(learning_rate=0.1))
+    o4.set_end_when(max_iteration(1))
+    o4.optimize()  # 6 records -> padded to 8, masked
+
+    # the masked 6-record mean gradient differs from the 8-record one,
+    # but both must be finite and the masked one must not include the
+    # padded rows: compare against a LocalOptimizer on the same 6
+    from bigdl_tpu.optim import LocalOptimizer
+
+    RNG().set_seed(3)
+    m5 = xor_model()
+    lo = LocalOptimizer(m5, array(samples6), nn.ClassNLLCriterion(),
+                        batch_size=8)
+    lo.set_optim_method(SGD(learning_rate=0.1))
+    lo.set_end_when(max_iteration(1))
+    lo.optimize()
+
+    w4, _ = m4.get_parameters()
+    w5, _ = m5.get_parameters()
+    np.testing.assert_allclose(np.asarray(w4), np.asarray(w5), atol=2e-4)
+
+
+def test_validation_runs_on_mesh_and_metrics_are_real():
+    """The validation trigger must run a compiled sharded eval (no host
+    param pull) and the Metrics phase breakdown must be measured, not
+    hardcoded zero (reference Metrics.scala:103-121)."""
+    import bigdl_tpu.optim.evaluator as ev
+    from bigdl_tpu.optim import several_iteration
+
+    ds = array(xor_samples(n=128))
+    model = xor_model()
+    opt = DistriOptimizer(model, ds, nn.ClassNLLCriterion(), batch_size=64)
+    opt.set_optim_method(SGD(learning_rate=0.5))
+    opt.set_end_when(max_iteration(25))
+    # validation dataset of 100 -> one 100-record eval batch, 100 % 8 != 0
+    # -> exercises eval-side pad too
+    opt.set_validation(several_iteration(10), array(xor_samples(n=100, seed=4)),
+                       [Top1Accuracy()], batch_size=100)
+    ev.last_eval_info.update({"sharded": False, "n_devices": 1})
+    opt.optimize()
+    assert ev.last_eval_info["sharded"] is True
+    assert ev.last_eval_info["n_devices"] == 8
+    summary = opt.metrics.summary()
+    agg = opt.metrics.get("aggregate gradient time")
+    # profiled at iterations 11 and 21 -> a real (non-zero) split exists
+    assert agg is not None and agg > 0.0, summary
