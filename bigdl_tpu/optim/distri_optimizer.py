@@ -38,7 +38,8 @@ from ..utils.engine import Engine, get_property
 from ..utils.rng import next_jax_key
 from ..utils.table import T
 from ._sharding_utils import data_mesh, pad_batch, round_up
-from .optimizer import Optimizer, _device_batch
+from .optimizer import (Optimizer, _cast_floats, _device_batch,
+                        _restore_dtypes)
 from .regularizer import collect_regularizer_paths, regularizer_loss
 
 log = logging.getLogger("bigdl_tpu")
@@ -79,13 +80,25 @@ class DistriOptimizer(Optimizer):
                           for s in jax.tree_util.tree_leaves(scale_tree))
         axis = "data"
         n_dev = arp.partition_num
+        cdtype = self.compute_dtype
 
         def step(params, buffers, slots, lr, rng, x, y, *mask_args):
             # decorrelate dropout across shards
             rng = jax.random.fold_in(rng, jax.lax.axis_index(axis))
 
             def loss_fn(p):
-                out, nb = model.apply_fn(p, buffers, x, True, rng)
+                p_c, x_c = p, x
+                if cdtype is not None:
+                    # bf16 compute, f32 master weights: grads return f32
+                    # through the cast's vjp; the slice-owned update below
+                    # stays full precision (TPU analogue of the fp16 wire
+                    # codec, reference FP16CompressedTensor.scala:26)
+                    p_c = _cast_floats(p, cdtype)
+                    x_c = _cast_floats(x, cdtype)
+                out, nb = model.apply_fn(p_c, buffers, x_c, True, rng)
+                if cdtype is not None:
+                    out = _cast_floats(out, jnp.float32)
+                    nb = _restore_dtypes(nb, buffers)
                 if masked:
                     w, total_w = mask_args
                     per = jax.vmap(
@@ -138,7 +151,9 @@ class DistriOptimizer(Optimizer):
         # can't prove replicated (it is — every shard gathers all slices).
         sharded = shard_map(step, mesh=mesh, in_specs=in_specs,
                             out_specs=out_specs, check_vma=False)
-        return jax.jit(sharded)
+        # donate params/buffers/slots: in-place HBM update — old+new
+        # copies never coexist (the product-driver MFU fix, VERDICT r2)
+        return jax.jit(sharded, donate_argnums=(0, 1, 2))
 
     def _build_grad_probe(self, mesh):
         """Collective-free forward+backward used on profiling iterations
@@ -303,11 +318,15 @@ class DistriOptimizer(Optimizer):
                 probe_key = jax.random.PRNGKey(0)
                 if grad_probe is None:
                     grad_probe = self._build_grad_probe(mesh)
-                    jax.block_until_ready(  # compile outside the timing
-                        grad_probe(params, buffers, probe_key, x, y))
+                    # compile outside the timing; fetch the scalar values
+                    # as the execution barrier — on the tunneled TPU
+                    # backend block_until_ready returns before the
+                    # computation runs, a value fetch does not
+                    _l, _g = grad_probe(params, buffers, probe_key, x, y)
+                    float(_l), float(_g)
                 tp = time.time()
-                jax.block_until_ready(
-                    grad_probe(params, buffers, probe_key, x, y))
+                _l, _g = grad_probe(params, buffers, probe_key, x, y)
+                float(_l), float(_g)
                 compute_time = time.time() - tp
 
             t0 = time.time()

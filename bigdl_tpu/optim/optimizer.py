@@ -66,6 +66,12 @@ class Optimizer:
         self.drop_percentage = 0.0  # reference straggler knob — no-op on TPU (SURVEY P6)
         self.max_drop_percentage = 0.0
         self.compute_threshold_batchsize = 100
+        # mixed precision: compute dtype for fwd/bwd; master weights,
+        # gradients and the optimizer update stay float32 (the TPU-native
+        # analogue of the reference's fp16 wire codec,
+        # FP16CompressedTensor.scala:26 — on TPU the precision knob moves
+        # from the wire to the MXU)
+        self.compute_dtype = None
 
     # -- fluent config (Optimizer.scala:98-243) -------------------------
     def set_optim_method(self, method: OptimMethod):
@@ -100,6 +106,14 @@ class Optimizer:
 
     def set_validation_summary(self, summary):
         self.validation_summary = summary
+        return self
+
+    def set_compute_dtype(self, dtype):
+        """Mixed-precision training: run forward/backward in ``dtype``
+        (typically ``jnp.bfloat16``) while keeping float32 master weights
+        and a float32 optimizer update.  Gradients arrive float32 through
+        the cast's vjp.  Pass ``None`` to restore full precision."""
+        self.compute_dtype = jnp.dtype(dtype) if dtype is not None else None
         return self
 
     def set_drop_module_property(self, drop_percentage, max_drop_percentage,
@@ -137,6 +151,20 @@ def _resume_slots(optim, fresh_slots):
     return saved if ok else fresh_slots
 
 
+def _cast_floats(tree, dtype):
+    """Cast every floating leaf of a pytree to ``dtype`` (ints pass)."""
+    return jax.tree_util.tree_map(
+        lambda a: a.astype(dtype)
+        if jnp.issubdtype(jnp.result_type(a), jnp.floating) else a, tree)
+
+
+def _restore_dtypes(tree, template):
+    """Cast ``tree``'s leaves back to the dtypes of ``template`` — keeps
+    BatchNorm running stats f32 under a bf16 compute pass."""
+    return jax.tree_util.tree_map(
+        lambda a, t: jnp.asarray(a, jnp.result_type(t)), tree, template)
+
+
 def _device_batch(batch: MiniBatch):
     x = batch.get_input()
     y = batch.get_target()
@@ -159,11 +187,22 @@ class LocalOptimizer(Optimizer):
         needs_scale = any(s != 1.0
                           for s in jax.tree_util.tree_leaves(scale_tree))
 
+        cdtype = self.compute_dtype
+
         def train_step(params, buffers, slots, lr, rng, x, y):
             def loss_fn(p):
-                out, nb = model.apply_fn(p, buffers, x, True, rng)
+                p_c, x_c = p, x
+                if cdtype is not None:
+                    # cast inside the differentiated fn: the cast's vjp
+                    # returns f32 grads against the f32 master weights
+                    p_c = _cast_floats(p, cdtype)
+                    x_c = _cast_floats(x, cdtype)
+                out, nb = model.apply_fn(p_c, buffers, x_c, True, rng)
+                if cdtype is not None:
+                    out = _cast_floats(out, jnp.float32)
+                    nb = _restore_dtypes(nb, buffers)
                 loss = criterion._loss(out, y)
-                if reg_paths:
+                if reg_paths:  # regularize the f32 master weights
                     loss = loss + regularizer_loss(p, reg_paths)
                 return loss, nb
             (loss, new_buffers), grads = jax.value_and_grad(
@@ -174,7 +213,10 @@ class LocalOptimizer(Optimizer):
             new_params, new_slots = optim.step(grads, params, slots, lr)
             return loss, new_params, new_buffers, new_slots
 
-        jitted = jax.jit(train_step)
+        # donate params/buffers/slots: the update is in-place in HBM —
+        # without this every step keeps old+new parameters live and pays
+        # a copy (a direct MFU tax at ResNet scale)
+        jitted = jax.jit(train_step, donate_argnums=(0, 1, 2))
 
         params = model.param_tree()
         buffers = model.buffer_tree()
