@@ -76,8 +76,16 @@ class TransformerLM(Container):
                  num_layers: int = 4, max_len: int = 2048,
                  causal: bool = True, seq_strategy: str = "dense",
                  seq_axis: str = "seq", model_axis: Optional[str] = None,
-                 remat: bool = False):
+                 remat: bool = False, output: str = "log_probs"):
+        if output not in ("log_probs", "logits"):
+            raise ValueError(f"output {output!r} not in (log_probs, logits)")
         mlp_dim = mlp_dim or 4 * embed_dim
+        # "logits" skips the final log_softmax: pair with the fused
+        # CrossEntropyCriterion so the [B,T,V] log-prob tensor is never
+        # materialised (the vocab head is HBM-bound at LM scale).
+        # NOT ``self.output`` — AbstractModule uses that name for the
+        # cached forward activation (module.py), which would clobber it.
+        self._output_mode = output
         self.vocab_size = vocab_size
         self.embed_dim = embed_dim
         self.max_len = max_len
@@ -168,4 +176,6 @@ class TransformerLM(Container):
                               sub)
             new_buffers[str(i)] = nb
         new_buffers["0"] = eb
+        if self._output_mode == "logits":
+            return h, new_buffers
         return jax.nn.log_softmax(h, axis=-1), new_buffers

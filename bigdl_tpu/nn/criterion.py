@@ -21,6 +21,12 @@ from .module import to_array
 
 
 class AbstractCriterion:
+    # Criterions that accumulate internally in f32 set this True: the
+    # mixed-precision drivers then skip the blanket f32 upcast of the
+    # model output — at LM vocab sizes that upcast alone materialises
+    # a gigabyte-scale [N, V] tensor the fused path exists to avoid.
+    accepts_low_precision = False
+
     def __init__(self):
         self.output = 0.0
         self.grad_input = None
@@ -81,15 +87,30 @@ class ClassNLLCriterion(AbstractCriterion):
 
 
 class CrossEntropyCriterion(AbstractCriterion):
-    """LogSoftMax + ClassNLL fused (reference nn/CrossEntropyCriterion.scala)."""
+    """LogSoftMax + ClassNLL fused (reference nn/CrossEntropyCriterion.scala).
+
+    The unweighted path uses ``ops.fused_xent``: logits stay in their
+    compute dtype, the log-sum-exp accumulates f32, and the backward
+    recomputes the softmax instead of storing it — at LM vocab sizes
+    this removes gigabytes of HBM traffic per step."""
 
     def __init__(self, weights=None, size_average: bool = True):
         super().__init__()
         self.nll = ClassNLLCriterion(weights, size_average)
         self.size_average = size_average
+        # the fused path accumulates f32 internally; bf16 logits welcome
+        self.accepts_low_precision = weights is None
 
     def _loss(self, inp, target):
-        return self.nll._loss(jax.nn.log_softmax(inp, axis=-1), target)
+        if self.nll.weights is not None:
+            return self.nll._loss(jax.nn.log_softmax(inp, axis=-1), target)
+        from ..ops.fused_xent import softmax_xent_rows
+
+        if inp.ndim == 1:
+            inp = inp[None]
+        t = target.astype(jnp.int32).reshape(-1) - 1
+        rows = softmax_xent_rows(inp.reshape(-1, inp.shape[-1]), t)
+        return jnp.mean(rows) if self.size_average else jnp.sum(rows)
 
 
 class MSECriterion(AbstractCriterion):
@@ -459,6 +480,7 @@ class TimeDistributedCriterion(AbstractCriterion):
         super().__init__()
         self.critrn = critrn
         self.size_average = size_average
+        self.accepts_low_precision = critrn.accepts_low_precision
 
     def _loss(self, inp, target):
         steps = inp.shape[1]

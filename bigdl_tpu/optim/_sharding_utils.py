@@ -25,15 +25,20 @@ def pad_batch(x, y, size: int, target: int):
     """Pad a (possibly multi-input) batch to ``target`` records by
     repeating the last record (keeps padded rows numerically valid,
     e.g. 1-based class labels); returns (x, y, weight) where weight is
-    the 1-real/0-pad per-record mask."""
+    the 1-real/0-pad per-record mask.
+
+    ``x``/``y`` may be any pytree of per-record arrays — bare arrays,
+    tuples, or ``Table`` targets (multi-output criterions keep the
+    every-record guarantee; reference DataSet.scala:255-288)."""
+    import jax
+
     pad = target - size
 
     def pad_arr(a):
         a = jnp.asarray(a)
         return jnp.concatenate([a, jnp.repeat(a[-1:], pad, axis=0)], axis=0)
 
-    conv = lambda v: pad_arr(v) if not isinstance(v, (list, tuple)) \
-        else type(v)(pad_arr(e) for e in v)
     w = jnp.concatenate([jnp.ones(size, jnp.float32),
                          jnp.zeros(pad, jnp.float32)])
-    return conv(x), conv(y), w
+    return (jax.tree_util.tree_map(pad_arr, x),
+            jax.tree_util.tree_map(pad_arr, y), w)

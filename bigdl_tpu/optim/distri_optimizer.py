@@ -81,6 +81,8 @@ class DistriOptimizer(Optimizer):
         axis = "data"
         n_dev = arp.partition_num
         cdtype = self.compute_dtype
+        # f32-accumulating criterions (fused xent) take bf16 output as-is
+        upcast_out = not getattr(criterion, "accepts_low_precision", False)
 
         def step(params, buffers, slots, lr, rng, x, y, *mask_args):
             # decorrelate dropout across shards
@@ -97,12 +99,16 @@ class DistriOptimizer(Optimizer):
                     x_c = _cast_floats(x, cdtype)
                 out, nb = model.apply_fn(p_c, buffers, x_c, True, rng)
                 if cdtype is not None:
-                    out = _cast_floats(out, jnp.float32)
+                    if upcast_out:
+                        out = _cast_floats(out, jnp.float32)
                     nb = _restore_dtypes(nb, buffers)
                 if masked:
                     w, total_w = mask_args
+                    add_axis = lambda v: jax.tree_util.tree_map(
+                        lambda a: a[None], v)
                     per = jax.vmap(
-                        lambda o, t: criterion._loss(o[None], t[None]))(out, y)
+                        lambda o, t: criterion._loss(add_axis(o),
+                                                     add_axis(t)))(out, y)
                     # local weighted sum over the GLOBAL real count: the
                     # later cross-shard gradient sum yields the global
                     # weighted-mean gradient with no extra divide
@@ -295,11 +301,11 @@ class DistriOptimizer(Optimizer):
                 # train the real records via a per-record weight mask —
                 # every record of the epoch trains exactly once at static
                 # shape (reference DataSet.scala:255-288 trains all)
-                if not _maskable(y):
+                if not _maskable(y, n_records):
                     raise ValueError(
-                        "partial batch with non-array targets cannot be "
-                        "pad-and-masked; size your dataset to a batch "
-                        "multiple of the mesh")
+                        "partial batch targets must be a pytree of "
+                        "record-leading arrays for pad-and-mask; size "
+                        "your dataset to a batch multiple of the mesh")
                 x, y, w = pad_batch(x, y, n_records,
                                     round_up(n_records, n_dev))
             x, y = shard_batch(mesh, (x, y))
@@ -443,11 +449,13 @@ class DistriOptimizer(Optimizer):
             overwrite=True)
 
 
-def _maskable(y) -> bool:
-    """Pad-and-mask needs per-record array targets (vmap over records)."""
-    if isinstance(y, (list, tuple)):
-        return all(hasattr(v, "shape") for v in y)
-    return hasattr(y, "shape")
+def _maskable(y, n_records: int) -> bool:
+    """Pad-and-mask vmaps the per-record loss over every target leaf:
+    any pytree (array / tuple / Table) of record-leading arrays works."""
+    leaves = jax.tree_util.tree_leaves(y)
+    return bool(leaves) and all(
+        hasattr(v, "shape") and getattr(v, "ndim", 0) >= 1
+        and v.shape[0] == n_records for v in leaves)
 
 
 def _latest_file(path: str, prefix: str) -> Optional[str]:

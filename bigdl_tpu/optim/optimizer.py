@@ -168,8 +168,8 @@ def _restore_dtypes(tree, template):
 def _device_batch(batch: MiniBatch):
     x = batch.get_input()
     y = batch.get_target()
-    conv = lambda v: jnp.asarray(v) if not isinstance(v, (list, tuple)) \
-        else type(v)(jnp.asarray(e) for e in v)
+    # inputs/targets are pytrees: arrays, tuples, or Table activities
+    conv = lambda v: jax.tree_util.tree_map(jnp.asarray, v)
     return conv(x), conv(y)
 
 
@@ -188,6 +188,10 @@ class LocalOptimizer(Optimizer):
                           for s in jax.tree_util.tree_leaves(scale_tree))
 
         cdtype = self.compute_dtype
+        # f32-accumulating criterions (fused xent) take the low-precision
+        # output directly — upcasting [N, V] logits first would undo the
+        # fused path's HBM saving
+        upcast_out = not getattr(criterion, "accepts_low_precision", False)
 
         def train_step(params, buffers, slots, lr, rng, x, y):
             def loss_fn(p):
@@ -199,7 +203,8 @@ class LocalOptimizer(Optimizer):
                     x_c = _cast_floats(x, cdtype)
                 out, nb = model.apply_fn(p_c, buffers, x_c, True, rng)
                 if cdtype is not None:
-                    out = _cast_floats(out, jnp.float32)
+                    if upcast_out:
+                        out = _cast_floats(out, jnp.float32)
                     nb = _restore_dtypes(nb, buffers)
                 loss = criterion._loss(out, y)
                 if reg_paths:  # regularize the f32 master weights

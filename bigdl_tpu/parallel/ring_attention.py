@@ -32,6 +32,7 @@ from functools import partial
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 
 NEG_INF = -1e30
@@ -169,16 +170,23 @@ def ulysses_attention(q, k, v, axis_name: str = "seq",
 
 def attention(q, k, v, causal: bool = False):
     """Dense reference attention (materialises [T, T]); oracle for tests
-    and the fast path for short sequences where one matmul wins."""
-    scale = 1.0 / jnp.sqrt(q.shape[-1]).astype(q.dtype)
-    s = jnp.einsum("bhqd,bhkd->bhqk", q, k,
-                   preferred_element_type=jnp.float32) * scale
+    and the fast path for short sequences where one matmul wins.
+
+    Scores stay in the INPUT dtype (bf16 under mixed precision — an f32
+    [B,H,T,T] tensor is pure HBM burn, measured 25% of the whole dense
+    grad on a v5e); only the softmax normalisation accumulates f32,
+    which preserves the max-subtracted exp's accuracy.
+    """
+    scale = jnp.asarray(1.0 / np.sqrt(q.shape[-1]), q.dtype)
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
     if causal:
         Tq, Tk = s.shape[-2:]
-        s = s + _causal_bias(jnp.arange(Tq), jnp.arange(Tk))
-    p = jax.nn.softmax(s, axis=-1)
-    return jnp.einsum("bhqk,bhkd->bhqd", p,
-                      v.astype(jnp.float32)).astype(q.dtype)
+        s = jnp.where(jnp.arange(Tq)[:, None] >= jnp.arange(Tk)[None, :],
+                      s, jnp.asarray(NEG_INF, s.dtype))
+    m = jnp.max(s, axis=-1, keepdims=True)
+    e = jnp.exp((s - m).astype(jnp.float32))
+    p = (e / jnp.sum(e, axis=-1, keepdims=True)).astype(q.dtype)
+    return jnp.einsum("bhqk,bhkd->bhqd", p, v)
 
 
 def make_ring_attention_sharded(mesh, axis_name: str = "seq",

@@ -278,3 +278,64 @@ def test_validation_runs_on_mesh_and_metrics_are_real():
     agg = opt.metrics.get("aggregate gradient time")
     # profiled at iterations 11 and 21 -> a real (non-zero) split exists
     assert agg is not None and agg > 0.0, summary
+
+
+def test_pytree_table_targets_pad_and_mask():
+    """VERDICT r2 #7: multi-output/table-criterion models keep the
+    every-record guarantee — a two-target model with a trailing partial
+    batch (6 % 8 != 0) trains through the masked step, and matches a
+    LocalOptimizer run on the same records."""
+    from bigdl_tpu.dataset import array
+    from bigdl_tpu.dataset.sample import MiniBatch
+    from bigdl_tpu.optim import LocalOptimizer
+    from bigdl_tpu.utils.rng import RNG
+    from bigdl_tpu.utils.table import T
+
+    rng = np.random.RandomState(5)
+
+    def two_target_batches(n_full, tail):
+        """Full batches of 8 plus one trailing batch of ``tail``."""
+        batches = []
+        for size in [8] * n_full + [tail]:
+            x = rng.rand(size, 2).astype(np.float32)
+            cls = ((x[:, 0] > 0.5) ^ (x[:, 1] > 0.5)).astype(np.float32) + 1
+            reg = (x.sum(axis=1, keepdims=True) * 0.5).astype(np.float32)
+            batches.append(MiniBatch(x, T(jnp.asarray(cls), jnp.asarray(reg))))
+        return batches
+
+    def two_head_model():
+        return nn.ConcatTable(
+            nn.Sequential(nn.Linear(2, 8), nn.Tanh(), nn.Linear(8, 2),
+                          nn.LogSoftMax()),
+            nn.Linear(2, 1))
+
+    def two_head_criterion():
+        return (nn.ParallelCriterion()
+                .add(nn.ClassNLLCriterion(), 1.0)
+                .add(nn.MSECriterion(), 0.5))
+
+    rng = np.random.RandomState(5)
+    batches = two_target_batches(2, 6)
+
+    RNG().set_seed(9)
+    m_dist = two_head_model()
+    opt = DistriOptimizer(m_dist, array(batches), two_head_criterion())
+    opt.set_optim_method(SGD(learning_rate=0.1))
+    opt.set_end_when(max_iteration(3))
+    opt.optimize()
+    # all 3 batches trained, including the masked trailing 6-record one
+    assert opt.optim_method.state["neval"] - 1 == 3
+
+    rng = np.random.RandomState(5)
+    batches = two_target_batches(2, 6)
+    RNG().set_seed(9)
+    m_local = two_head_model()
+    lo = LocalOptimizer(m_local, array(batches), two_head_criterion())
+    lo.set_optim_method(SGD(learning_rate=0.1))
+    lo.set_end_when(max_iteration(3))
+    lo.optimize()
+
+    w_d, _ = m_dist.get_parameters()
+    w_l, _ = m_local.get_parameters()
+    # 5e-4: psum_scatter vs local-sum f32 accumulation order over 3 steps
+    np.testing.assert_allclose(np.asarray(w_d), np.asarray(w_l), atol=5e-4)
