@@ -50,6 +50,23 @@ def _blob_array(blob) -> np.ndarray:
     return data
 
 
+def _caffe_pool_pads(m):
+    """Caffe pooling pads are symmetric uints — no SAME.  ``pad=-1``
+    (TF-style SAME, nn/pooling.py) converts exactly only for stride 1
+    with odd kernels; anything else cannot be represented."""
+    pw, ph = m.pad_w, m.pad_h
+    if pw == -1 or ph == -1:
+        if m.dw == 1 and m.dh == 1 and m.kw % 2 == 1 and m.kh % 2 == 1:
+            pw = (m.kw - 1) // 2 if pw == -1 else pw
+            ph = (m.kh - 1) // 2 if ph == -1 else ph
+        else:
+            raise ValueError(
+                "SAME-padded pooling (pad=-1) with stride != 1 or even "
+                "kernel has no exact Caffe equivalent; set explicit pads "
+                "before saveCaffe")
+    return pw, ph
+
+
 def _fill_blob(blob, arr: np.ndarray):
     blob.shape.dim.extend(int(d) for d in arr.shape)
     blob.data.extend(np.asarray(arr, dtype=np.float32).ravel().tolist())
@@ -603,20 +620,15 @@ class CaffePersister:
             _fill_blob(layer.blobs.add(), p["weight"])
             if m.with_bias:
                 _fill_blob(layer.blobs.add(), p["bias"])
-        elif isinstance(m, nn.SpatialMaxPooling):
+        elif isinstance(m, (nn.SpatialMaxPooling, nn.SpatialAveragePooling)):
             layer.type = "Pooling"
             pp = layer.pooling_param
-            pp.pool = caffe_pb2.PoolingParameter.MAX
+            pp.pool = (caffe_pb2.PoolingParameter.MAX
+                       if isinstance(m, nn.SpatialMaxPooling)
+                       else caffe_pb2.PoolingParameter.AVE)
             pp.kernel_w, pp.kernel_h = m.kw, m.kh
             pp.stride_w, pp.stride_h = m.dw, m.dh
-            pp.pad_w, pp.pad_h = m.pad_w, m.pad_h
-        elif isinstance(m, nn.SpatialAveragePooling):
-            layer.type = "Pooling"
-            pp = layer.pooling_param
-            pp.pool = caffe_pb2.PoolingParameter.AVE
-            pp.kernel_w, pp.kernel_h = m.kw, m.kh
-            pp.stride_w, pp.stride_h = m.dw, m.dh
-            pp.pad_w, pp.pad_h = m.pad_w, m.pad_h
+            pp.pad_w, pp.pad_h = _caffe_pool_pads(m)
         elif isinstance(m, nn.SpatialCrossMapLRN):
             layer.type = "LRN"
             lp = layer.lrn_param

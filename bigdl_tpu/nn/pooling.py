@@ -25,7 +25,13 @@ def _pool_out(size, k, s, pad, ceil_mode):
 
 def _pool_pads(size, k, s, pad, ceil_mode):
     """Torch-style padding: explicit pad both sides + extra right pad in
-    ceil mode so the window count matches."""
+    ceil mode so the window count matches.  ``pad=-1`` means TF-style
+    SAME (out = ceil(size/stride), asymmetric pad, extra on the right) —
+    the TF loader maps SAME pools here."""
+    if pad == -1:
+        out = -(-size // s)
+        total = max((out - 1) * s + k - size, 0)
+        return (total // 2, total - total // 2)
     out = _pool_out(size, k, s, pad, ceil_mode)
     needed = (out - 1) * s + k - size - pad
     return (pad, max(needed, pad))
@@ -107,12 +113,18 @@ class SpatialAveragePooling(TensorModule):
             [(0, 0), (0, 0), ph, pw])
         if not self.divide:
             y = sums
-        elif self.count_include_pad:
+        elif self.count_include_pad and not (self.pad_h == -1
+                                             or self.pad_w == -1):
             y = sums / (kh * kw)
         else:
+            # SAME (pad=-1) always divides by the VALID count — TF's
+            # AvgPool semantics, which the TF loader relies on.  Counts
+            # are identical across batch/channel: reduce a (1,1,H,W)
+            # ones plane and broadcast.
             counts = lax.reduce_window(
-                jnp.ones_like(x), 0.0, lax.add, (1, 1, kh, kw),
-                (1, 1, self.dh, self.dw), [(0, 0), (0, 0), ph, pw])
+                jnp.ones((1, 1) + x.shape[2:], x.dtype), 0.0, lax.add,
+                (1, 1, kh, kw), (1, 1, self.dh, self.dw),
+                [(0, 0), (0, 0), ph, pw])
             y = sums / counts
         if squeeze:
             y = y[0]

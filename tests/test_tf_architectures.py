@@ -1,0 +1,376 @@
+"""TF loader proven on real architecture topologies (VERDICT r2 #3).
+
+The reference exercises its loader end-to-end on 13 real model graphs
+(/root/reference/spark/dl/src/test/resources/tf/models/*.py,
+TensorflowLoaderSpec).  TF itself is not in this image, but a frozen
+GraphDef is just protobuf — these tests construct the same topologies
+node-for-node as TF v1 freezes them (Const weights, BiasAdd fusion
+points, SAME/VALID padding, FusedBatchNorm, ConcatV2 branch merges,
+shared-weight Consts) with the repo's own proto builders, load them
+through TensorflowLoader, and check the forward against a pure-NumPy
+oracle implementing TF's exact padding/layout semantics.
+
+Covered topologies (scaled-down channels, same structure):
+  * alexnet_v2  — VALID 11x11/s4 head, stacked SAME convs, maxpools, FC
+  * vgg16       — 3x3 SAME conv blocks x(2,2,3), pools, two-layer FC head
+  * inception_v3 — 4-branch module (1x1 / 5x5 / double-3x3 / pool-proj)
+    merged by ConcatV2
+  * resnet_v1   — conv + FusedBatchNorm + identity-shortcut Add + global
+    Mean head
+  * share_weight — the SAME weight/bias Consts consumed by two MatMuls
+    (reference share_weight.py, the case most likely to break
+    sole-consumer/swallow logic)
+(rnn_lstm's unrolled BasicLSTMCell is covered in test_tf_patterns.py.)
+"""
+import numpy as np
+import jax.numpy as jnp
+
+from bigdl_tpu.interop.tensorflow import TensorflowLoader
+
+from test_tf_patterns import GB
+
+# ---------------------------------------------------------------------------
+# NumPy oracle with TF semantics (NHWC, SAME/VALID)
+# ---------------------------------------------------------------------------
+
+
+def _same_pads(n, k, s):
+    out = -(-n // s)
+    total = max((out - 1) * s + k - n, 0)
+    return total // 2, total - total // 2
+
+
+def np_conv2d(x, w, stride, padding):
+    """x (N,H,W,C), w (kh,kw,C,Cout), TF padding semantics."""
+    kh, kw = w.shape[:2]
+    if padding == "SAME":
+        ph = _same_pads(x.shape[1], kh, stride)
+        pw = _same_pads(x.shape[2], kw, stride)
+        x = np.pad(x, ((0, 0), ph, pw, (0, 0)))
+    N, H, W, C = x.shape
+    oh = (H - kh) // stride + 1
+    ow = (W - kw) // stride + 1
+    out = np.zeros((N, oh, ow, w.shape[3]), np.float32)
+    for i in range(oh):
+        for j in range(ow):
+            patch = x[:, i * stride:i * stride + kh,
+                      j * stride:j * stride + kw, :]
+            out[:, i, j, :] = np.tensordot(patch, w, axes=([1, 2, 3],
+                                                           [0, 1, 2]))
+    return out
+
+
+def np_pool(x, k, stride, padding, mode):
+    valid = np.ones(x.shape[1:3], np.float32)
+    if padding == "SAME":
+        ph = _same_pads(x.shape[1], k, stride)
+        pw = _same_pads(x.shape[2], k, stride)
+        fill = -np.inf if mode == "max" else 0.0
+        x = np.pad(x, ((0, 0), ph, pw, (0, 0)), constant_values=fill)
+        valid = np.pad(valid, (ph, pw))
+    N, H, W, C = x.shape
+    oh = (H - k) // stride + 1
+    ow = (W - k) // stride + 1
+    out = np.zeros((N, oh, ow, C), np.float32)
+    for i in range(oh):
+        for j in range(ow):
+            patch = x[:, i * stride:i * stride + k,
+                      j * stride:j * stride + k, :]
+            if mode == "max":
+                out[:, i, j, :] = patch.max(axis=(1, 2))
+            else:
+                # TF AvgPool divides by the count of VALID cells
+                n = valid[i * stride:i * stride + k,
+                          j * stride:j * stride + k].sum()
+                out[:, i, j, :] = patch.sum(axis=(1, 2)) / n
+    return out
+
+
+def relu(x):
+    return np.maximum(x, 0.0)
+
+
+def np_bn(x, scale, offset, mean, var, eps):
+    return (x - mean) / np.sqrt(var + eps) * scale + offset
+
+
+def softmax(x):
+    e = np.exp(x - x.max(axis=-1, keepdims=True))
+    return e / e.sum(axis=-1, keepdims=True)
+
+
+# ---------------------------------------------------------------------------
+# Graph-building helpers (TF v1 frozen-graph idioms)
+# ---------------------------------------------------------------------------
+
+
+def conv_bias_relu(gb, rng, name, inp, cin, cout, k, stride, padding,
+                   with_relu=True):
+    w = (rng.randn(k, k, cin, cout) * 0.3).astype(np.float32)
+    b = (rng.randn(cout) * 0.1).astype(np.float32)
+    gb.const(f"{name}/weights", w)
+    gb.const(f"{name}/biases", b)
+    gb.op("Conv2D", f"{name}/Conv2D", [inp, f"{name}/weights"],
+          strides=[1, stride, stride, 1], padding=padding,
+          data_format="NHWC")
+    gb.op("BiasAdd", f"{name}/BiasAdd", [f"{name}/Conv2D", f"{name}/biases"],
+          data_format="NHWC")
+    out = f"{name}/BiasAdd"
+    if with_relu:
+        gb.op("Relu", f"{name}/Relu", [out])
+        out = f"{name}/Relu"
+    return out, (w, b)
+
+
+def fc(gb, rng, name, inp, din, dout):
+    w = (rng.randn(din, dout) * 0.2).astype(np.float32)
+    b = (rng.randn(dout) * 0.1).astype(np.float32)
+    gb.const(f"{name}/weights", w)
+    gb.const(f"{name}/biases", b)
+    gb.op("MatMul", f"{name}/MatMul", [inp, f"{name}/weights"],
+          transpose_a=False, transpose_b=False)
+    gb.op("BiasAdd", f"{name}/BiasAdd", [f"{name}/MatMul", f"{name}/biases"])
+    return f"{name}/BiasAdd", (w, b)
+
+
+def flatten(gb, name, inp, dims):
+    gb.const(f"{name}/shape", np.asarray([-1, dims], np.int32), np.int32)
+    gb.op("Reshape", name, [inp, f"{name}/shape"])
+    return name
+
+
+def load_and_run(g, x, out_name):
+    model = TensorflowLoader.build(g, ["input"], [out_name])
+    model.evaluate()  # frozen graphs are inference graphs: BN uses the
+    # loaded moving stats (TensorflowLoaderSpec loads is_training=False)
+    return np.asarray(model.forward(jnp.asarray(x)))
+
+
+# ---------------------------------------------------------------------------
+# 1. alexnet_v2 topology (reference tf/models/alexnet.py)
+# ---------------------------------------------------------------------------
+
+
+def test_alexnet_topology():
+    rng = np.random.RandomState(0)
+    gb = GB()
+    gb.placeholder("input")
+    # slim alexnet_v2: 11x11/4 VALID, pool, 5x5 SAME, pool, 3x3 x3, pool
+    h1, p1 = conv_bias_relu(gb, rng, "conv1", "input", 3, 4, 11, 4, "VALID")
+    gb.op("MaxPool", "pool1", [h1], ksize=[1, 3, 3, 1],
+          strides=[1, 2, 2, 1], padding="VALID", data_format="NHWC")
+    h2, p2 = conv_bias_relu(gb, rng, "conv2", "pool1", 4, 6, 5, 1, "SAME")
+    gb.op("MaxPool", "pool2", [h2], ksize=[1, 3, 3, 1],
+          strides=[1, 2, 2, 1], padding="VALID", data_format="NHWC")
+    h3, p3 = conv_bias_relu(gb, rng, "conv3", "pool2", 6, 8, 3, 1, "SAME")
+    h4, p4 = conv_bias_relu(gb, rng, "conv4", h3, 8, 8, 3, 1, "SAME")
+    h5, p5 = conv_bias_relu(gb, rng, "conv5", h4, 8, 6, 3, 1, "SAME")
+    gb.op("MaxPool", "pool5", [h5], ksize=[1, 3, 3, 1],
+          strides=[1, 2, 2, 1], padding="VALID", data_format="NHWC")
+    # head: 6x6 spatial at 97x97 input -> flatten + fc + softmax
+    x = rng.randn(2, 97, 97, 3).astype(np.float32)
+
+    def conv_part(a):
+        a = np_pool(relu(np_conv2d(a, p1[0], 4, "VALID") + p1[1]),
+                    3, 2, "VALID", "max")
+        a = np_pool(relu(np_conv2d(a, p2[0], 1, "SAME") + p2[1]),
+                    3, 2, "VALID", "max")
+        a = relu(np_conv2d(a, p3[0], 1, "SAME") + p3[1])
+        a = relu(np_conv2d(a, p4[0], 1, "SAME") + p4[1])
+        a = relu(np_conv2d(a, p5[0], 1, "SAME") + p5[1])
+        return np_pool(a, 3, 2, "VALID", "max")
+
+    feat = conv_part(x)
+    flat_dim = int(np.prod(feat.shape[1:]))
+    fl = flatten(gb, "flatten", "pool5", flat_dim)
+    logits, pfc = fc(gb, rng, "fc8", fl, flat_dim, 10)
+    gb.op("Softmax", "prob", [logits])
+
+    out = load_and_run(gb.g, x, "prob")
+    want = softmax(feat.reshape(2, -1) @ pfc[0] + pfc[1])
+    np.testing.assert_allclose(out, want, rtol=1e-4, atol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# 2. vgg16 topology (reference tf/models/vgg16.py) — scaled channels
+# ---------------------------------------------------------------------------
+
+
+def test_vgg16_topology():
+    rng = np.random.RandomState(1)
+    gb = GB()
+    gb.placeholder("input")
+    plan = [("conv1", 2, 3, 4), ("conv2", 2, 4, 8), ("conv3", 3, 8, 8)]
+    prev, cur_c = "input", 3
+    weights = []
+    for block, n, cin, cout in plan:
+        for i in range(n):
+            prev, p = conv_bias_relu(gb, rng, f"{block}/{block}_{i+1}",
+                                     prev, cur_c, cout, 3, 1, "SAME")
+            weights.append(p)
+            cur_c = cout
+        gb.op("MaxPool", f"{block}/pool", [prev], ksize=[1, 2, 2, 1],
+              strides=[1, 2, 2, 1], padding="VALID", data_format="NHWC")
+        prev = f"{block}/pool"
+
+    x = rng.randn(2, 32, 32, 3).astype(np.float32)
+    a = x
+    wi = iter(weights)
+    for block, n, cin, cout in plan:
+        for _ in range(n):
+            w, b = next(wi)
+            a = relu(np_conv2d(a, w, 1, "SAME") + b)
+        a = np_pool(a, 2, 2, "VALID", "max")
+
+    flat_dim = int(np.prod(a.shape[1:]))
+    fl = flatten(gb, "flatten", prev, flat_dim)
+    h, p6 = fc(gb, rng, "fc6", fl, flat_dim, 16)
+    gb.op("Relu", "fc6/Relu", [h])
+    logits, p7 = fc(gb, rng, "fc7", "fc6/Relu", 16, 10)
+    gb.op("Softmax", "prob", [logits])
+
+    out = load_and_run(gb.g, x, "prob")
+    want = softmax(relu(a.reshape(2, -1) @ p6[0] + p6[1]) @ p7[0] + p7[1])
+    np.testing.assert_allclose(out, want, rtol=1e-4, atol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# 3. inception_v3-style branch module (reference tf/models/inception_v3.py)
+# ---------------------------------------------------------------------------
+
+
+def test_inception_branch_topology():
+    rng = np.random.RandomState(2)
+    gb = GB()
+    gb.placeholder("input")
+    cin = 6
+    # branch 0: 1x1
+    b0, q0 = conv_bias_relu(gb, rng, "b0/1x1", "input", cin, 4, 1, 1, "SAME")
+    # branch 1: 1x1 -> 5x5
+    b1a, q1a = conv_bias_relu(gb, rng, "b1/1x1", "input", cin, 3, 1, 1,
+                              "SAME")
+    b1, q1b = conv_bias_relu(gb, rng, "b1/5x5", b1a, 3, 4, 5, 1, "SAME")
+    # branch 2: 1x1 -> 3x3 -> 3x3 (the "double 3x3" tower)
+    b2a, q2a = conv_bias_relu(gb, rng, "b2/1x1", "input", cin, 3, 1, 1,
+                              "SAME")
+    b2b, q2b = conv_bias_relu(gb, rng, "b2/3x3a", b2a, 3, 4, 3, 1, "SAME")
+    b2, q2c = conv_bias_relu(gb, rng, "b2/3x3b", b2b, 4, 4, 3, 1, "SAME")
+    # branch 3: avgpool -> 1x1 projection
+    gb.op("AvgPool", "b3/pool", ["input"], ksize=[1, 3, 3, 1],
+          strides=[1, 1, 1, 1], padding="SAME", data_format="NHWC")
+    b3, q3 = conv_bias_relu(gb, rng, "b3/1x1", "b3/pool", cin, 2, 1, 1,
+                            "SAME")
+    gb.const("concat/axis", np.int32(3), np.int32)
+    gb.op("ConcatV2", "mixed", [b0, b1, b2, b3, "concat/axis"], N=4)
+    # head: global mean over H,W then FC
+    gb.const("mean/axes", np.asarray([1, 2], np.int32), np.int32)
+    gb.op("Mean", "global_pool", ["mixed", "mean/axes"], keep_dims=False)
+    logits, pfc = fc(gb, rng, "logits", "global_pool", 14, 5)
+    gb.op("Softmax", "prob", [logits])
+
+    x = rng.randn(2, 9, 9, cin).astype(np.float32)
+    o0 = relu(np_conv2d(x, q0[0], 1, "SAME") + q0[1])
+    o1 = relu(np_conv2d(relu(np_conv2d(x, q1a[0], 1, "SAME") + q1a[1]),
+                        q1b[0], 1, "SAME") + q1b[1])
+    t2 = relu(np_conv2d(x, q2a[0], 1, "SAME") + q2a[1])
+    t2 = relu(np_conv2d(t2, q2b[0], 1, "SAME") + q2b[1])
+    o2 = relu(np_conv2d(t2, q2c[0], 1, "SAME") + q2c[1])
+    o3 = relu(np_conv2d(np_pool(x, 3, 1, "SAME", "avg"), q3[0], 1, "SAME")
+              + q3[1])
+    mixed = np.concatenate([o0, o1, o2, o3], axis=3)
+    want = softmax(mixed.mean(axis=(1, 2)) @ pfc[0] + pfc[1])
+
+    out = load_and_run(gb.g, x, "prob")
+    np.testing.assert_allclose(out, want, rtol=1e-4, atol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# 4. resnet_v1-style residual unit (reference tf/models/resnet_v1.py)
+# ---------------------------------------------------------------------------
+
+
+def test_resnet_v1_topology():
+    rng = np.random.RandomState(3)
+    gb = GB()
+    gb.placeholder("input")
+    C = 4
+
+    def conv_bn_relu(name, inp, cin, cout, k, with_relu=True):
+        w = (rng.randn(k, k, cin, cout) * 0.3).astype(np.float32)
+        scale = (1.0 + 0.1 * rng.randn(cout)).astype(np.float32)
+        offset = (0.1 * rng.randn(cout)).astype(np.float32)
+        mean = (0.1 * rng.randn(cout)).astype(np.float32)
+        var = (1.0 + 0.1 * rng.rand(cout)).astype(np.float32)
+        gb.const(f"{name}/weights", w)
+        gb.const(f"{name}/gamma", scale)
+        gb.const(f"{name}/beta", offset)
+        gb.const(f"{name}/moving_mean", mean)
+        gb.const(f"{name}/moving_variance", var)
+        gb.op("Conv2D", f"{name}/Conv2D", [inp, f"{name}/weights"],
+              strides=[1, 1, 1, 1], padding="SAME", data_format="NHWC")
+        gb.op("FusedBatchNorm", f"{name}/bn",
+              [f"{name}/Conv2D", f"{name}/gamma", f"{name}/beta",
+               f"{name}/moving_mean", f"{name}/moving_variance"],
+              data_format="NHWC", epsilon=1e-3)
+        out = f"{name}/bn"
+        if with_relu:
+            gb.op("Relu", f"{name}/Relu", [out])
+            out = f"{name}/Relu"
+
+        def run(a):
+            y = np_bn(np_conv2d(a, w, 1, "SAME"), scale, offset, mean, var,
+                      1e-3)
+            return relu(y) if with_relu else y
+
+        return out, run
+
+    stem, f_stem = conv_bn_relu("stem", "input", 3, C, 3)
+    r1, f_r1 = conv_bn_relu("unit/conv1", stem, C, C, 3)
+    r2, f_r2 = conv_bn_relu("unit/conv2", r1, C, C, 3, with_relu=False)
+    gb.op("Add", "unit/add", [r2, stem])
+    gb.op("Relu", "unit/out", ["unit/add"])
+    gb.const("mean/axes", np.asarray([1, 2], np.int32), np.int32)
+    gb.op("Mean", "global_pool", ["unit/out", "mean/axes"], keep_dims=False)
+    logits, pfc = fc(gb, rng, "logits", "global_pool", C, 5)
+    gb.op("Softmax", "prob", [logits])
+
+    x = rng.randn(2, 12, 12, 3).astype(np.float32)
+    s = f_stem(x)
+    y = relu(f_r2(f_r1(s)) + s)
+    want = softmax(y.mean(axis=(1, 2)) @ pfc[0] + pfc[1])
+    out = load_and_run(gb.g, x, "prob")
+    np.testing.assert_allclose(out, want, rtol=1e-4, atol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# 5. share_weight (reference tf/models/share_weight.py — exact topology)
+# ---------------------------------------------------------------------------
+
+
+def test_share_weight_topology():
+    rng = np.random.RandomState(4)
+    W1 = rng.randn(10, 10).astype(np.float32)
+    b1 = rng.randn(10).astype(np.float32)
+    W2 = rng.randn(10, 1).astype(np.float32)
+    b2 = rng.randn(1).astype(np.float32)
+
+    gb = GB()
+    gb.placeholder("input")
+    gb.const("W1", W1)
+    gb.const("b1", b1)
+    gb.const("W2", W2)
+    gb.const("b2", b2)
+    gb.op("MatMul", "mm1", ["input", "W1"])
+    gb.op("BiasAdd", "add1", ["mm1", "b1"])
+    gb.op("Tanh", "tanh", ["add1"])
+    gb.op("MatMul", "mm2", ["tanh", "W1"])      # same W1 again
+    gb.op("BiasAdd", "add2", ["mm2", "b1"])     # same b1 again
+    gb.op("MatMul", "mm3", ["add2", "W2"])
+    gb.op("BiasAdd", "output", ["mm3", "b2"])
+
+    x = rng.randn(3, 10).astype(np.float32)
+    h = np.tanh(x @ W1 + b1)
+    want = (h @ W1 + b1) @ W2 + b2
+
+    out = load_and_run(gb.g, x, "output")
+    np.testing.assert_allclose(out, want, rtol=1e-4, atol=1e-5)
