@@ -833,11 +833,18 @@ def _pattern_flat(tf_node, slot, ctx):
         return (_wrap_nhwc(nn.SpatialCrossMapLRN(size, alpha, beta, k),
                            True, nn), [ins[0]], [])
 
-    if op == "Pad":
+    if op in ("Pad", "PadV2"):
         pads = const_of(ins[1])
         if pads is None:
             raise NotImplementedError("Pad with dynamic paddings")
-        mod = nn.Identity() if not np.any(pads) else _PadModule(pads)
+        value = 0.0
+        if op == "PadV2":
+            c = const_of(ins[2])
+            if c is None:
+                raise NotImplementedError("PadV2 with dynamic value")
+            value = float(c.ravel()[0])
+        mod = (nn.Identity() if not np.any(pads)
+               else _PadModule(pads, value))
         return (mod, [ins[0]], [])
 
     return None
@@ -875,24 +882,39 @@ def _wrap_nhwc(module, nhwc: bool, nn):
         nn.Transpose([(2, 4), (2, 3)]))   # NCHW -> NHWC
 
 
-def _PadModule(pads):
-    """Generic N-D zero pad from a TF paddings matrix."""
+def _PadModule(pads, value=0.0):
+    """Generic N-D constant pad from a TF paddings matrix (Pad/PadV2)."""
     from ..nn.module import TensorModule
 
     class _Pad(TensorModule):
-        def __init__(self, p):
+        def __init__(self, p, v):
             super().__init__()
             self.pad_cfg = [(int(a), int(b)) for a, b in np.asarray(p)]
+            self.pad_value = float(v)
 
         def _apply(self, params, buffers, x, training, rng):
-            return jnp.pad(x, self.pad_cfg), buffers
+            return jnp.pad(x, self.pad_cfg,
+                           constant_values=jnp.asarray(self.pad_value,
+                                                       x.dtype)), buffers
 
-    return _Pad(pads)
+    return _Pad(pads, value)
+
+
 
 
 class TensorflowSaver:
-    """Module → GraphDef (reference TensorflowSaver.scala,
-    AbstractModule.saveTF:405)."""
+    """Module → frozen GraphDef (reference BigDLToTensorflow.scala — ~20
+    layer converters over arbitrary graphs — driven by
+    AbstractModule.saveTF, AbstractModule.scala:405).
+
+    Walks ``Graph`` models in topo order (multi-input fan-in included)
+    and ``Sequential`` chains (nested containers, ``Concat`` fan-out,
+    ``ConcatTable``+``CAddTable`` residual blocks); every converter emits
+    the op shapes TF v1 freezes (Const weights, BiasAdd, FusedBatchNorm,
+    ConcatV2) so the repo's own loader — and TF — can read the result.
+    Layout is NCHW (the framework's native layout; TF supports it
+    everywhere except LRN, which gets a transpose sandwich).
+    """
 
     @staticmethod
     def save(module, input_shape: Sequence[int], path: str,
@@ -901,28 +923,7 @@ class TensorflowSaver:
 
         g = tfpb.GraphDef()
         g.versions.producer = 26
-
-        def add_node(op, name, inputs=(), **attrs):
-            n = g.node.add()
-            n.op = op
-            n.name = name
-            n.input.extend(inputs)
-            for k, v in attrs.items():
-                if isinstance(v, np.ndarray):
-                    n.attr[k].tensor.CopyFrom(tensor_to_proto(v))
-                elif isinstance(v, bool):
-                    n.attr[k].b = v
-                elif k in ("dtype", "T", "type"):
-                    n.attr[k].type = v
-                elif isinstance(v, int):
-                    n.attr[k].i = v
-                elif isinstance(v, float):
-                    n.attr[k].f = v
-                elif isinstance(v, bytes):
-                    n.attr[k].s = v
-                elif isinstance(v, str):
-                    n.attr[k].s = v.encode()
-            return name
+        em = _SaveEmitter(g, nn)
 
         ph = g.node.add()
         ph.op = "Placeholder"
@@ -931,108 +932,347 @@ class TensorflowSaver:
         for d in input_shape:
             ph.attr["shape"].shape.dim.add().size = int(d)
 
-        if isinstance(module, nn.Sequential):
-            mods = list(module.modules)
+        from ..nn.graph import Graph
+
+        if isinstance(module, Graph):
+            out = em.emit_graph(module, [input_name])
         else:
-            mods = [module]
-
-        prev = input_name
-        idx = [0]
-
-        def emit(m, prev):
-            nm = (m.get_name() or type(m).__name__) + f"_{idx[0]}"
-            idx[0] += 1
-            p = {k: np.asarray(v, np.float32) for k, v in m.params.items()}
-            if isinstance(m, nn.Linear):
-                wname = add_node("Const", nm + "/weight",
-                                 value=np.ascontiguousarray(p["weight"].T),
-                                 dtype=tfpb.DT_FLOAT)
-                out = add_node("MatMul", nm, [prev, wname],
-                               transpose_a=False, transpose_b=False)
-                if m.with_bias:
-                    bname = add_node("Const", nm + "/bias", value=p["bias"],
-                                     dtype=tfpb.DT_FLOAT)
-                    out = add_node("BiasAdd", nm + "/biasadd", [out, bname])
-                return out
-            if isinstance(m, nn.SpatialConvolution):
-                # OIHW -> tf HWIO
-                w = np.transpose(p["weight"], (2, 3, 1, 0))
-                wname = add_node("Const", nm + "/filter",
-                                 value=np.ascontiguousarray(w),
-                                 dtype=tfpb.DT_FLOAT)
-                n = g.node.add()
-                n.op = "Conv2D"
-                n.name = nm
-                n.input.extend([prev, wname])
-                n.attr["strides"].list.i.extend(
-                    [1, 1, m.stride_h, m.stride_w])
-                if m.pad_w == -1 or m.pad_h == -1:
-                    n.attr["padding"].s = b"SAME"
-                elif (m.pad_w, m.pad_h) == (0, 0):
-                    n.attr["padding"].s = b"VALID"
-                else:
-                    n.attr["padding"].s = b"EXPLICIT"
-                    n.attr["explicit_paddings"].list.i.extend(
-                        [0, 0, 0, 0, m.pad_h, m.pad_h, m.pad_w, m.pad_w])
-                n.attr["data_format"].s = b"NCHW"
-                out = nm
-                if m.with_bias:
-                    bname = add_node("Const", nm + "/bias", value=p["bias"],
-                                     dtype=tfpb.DT_FLOAT)
-                    bn = g.node.add()
-                    bn.op = "BiasAdd"
-                    bn.name = nm + "/biasadd"
-                    bn.input.extend([out, bname])
-                    bn.attr["data_format"].s = b"NCHW"
-                    out = bn.name
-                return out
-            if isinstance(m, nn.SpatialMaxPooling) or isinstance(
-                    m, nn.SpatialAveragePooling):
-                n = g.node.add()
-                n.op = ("MaxPool" if isinstance(m, nn.SpatialMaxPooling)
-                        else "AvgPool")
-                n.name = nm
-                n.input.append(prev)
-                n.attr["ksize"].list.i.extend([1, 1, m.kh, m.kw])
-                n.attr["strides"].list.i.extend([1, 1, m.dh, m.dw])
-                if (m.pad_w, m.pad_h) == (0, 0):
-                    n.attr["padding"].s = b"VALID"
-                elif m.pad_w == -1 or m.pad_h == -1:
-                    n.attr["padding"].s = b"SAME"
-                else:
-                    raise NotImplementedError(
-                        "TF pooling has no explicit-pad attr; pad the input "
-                        "with SpatialZeroPadding before export")
-                n.attr["data_format"].s = b"NCHW"
-                return nm
-            simple = {nn.ReLU: "Relu", nn.ReLU6: "Relu6", nn.Tanh: "Tanh",
-                      nn.Sigmoid: "Sigmoid", nn.SoftMax: "Softmax",
-                      nn.LogSoftMax: "LogSoftmax", nn.Abs: "Abs",
-                      nn.Exp: "Exp", nn.Log: "Log", nn.Square: "Square",
-                      nn.Sqrt: "Sqrt", nn.SoftPlus: "Softplus",
-                      nn.SoftSign: "Softsign", nn.ELU: "Elu"}
-            for cls, opname in simple.items():
-                if type(m) is cls:
-                    return add_node(opname, nm, [prev])
-            if isinstance(m, (nn.Reshape, nn.View, nn.InferReshape)):
-                sizes = list(getattr(m, "size", ()) or getattr(m, "sizes", ()))
-                shape = np.asarray([-1] + [int(s) for s in sizes], np.int32)
-                sname = add_node("Const", nm + "/shape", value=shape,
-                                 dtype=tfpb.DT_INT32)
-                return add_node("Reshape", nm, [prev, sname])
-            if isinstance(m, nn.Dropout):
-                return prev  # inference graph: dropout is identity
-            if isinstance(m, nn.Identity):
-                return prev
-            raise NotImplementedError(
-                f"saveTF of {type(m).__name__} not supported")
-
-        for m in mods:
-            prev = emit(m, prev)
+            out = em.emit(module, input_name)
+        if isinstance(out, list):
+            raise ValueError("model output is a Table; saveTF needs a "
+                             "single output node")
 
         d = os.path.dirname(path)
         if d:
             os.makedirs(d, exist_ok=True)
         with open(path, "wb") as f:
             f.write(g.SerializeToString())
-        return prev  # name of the output node
+        return out  # name of the output node
+
+
+class _SaveEmitter:
+    def __init__(self, g, nn):
+        self.g = g
+        self.nn = nn
+        self.idx = 0
+
+    def add(self, op, name, inputs=(), **attrs):
+        n = self.g.node.add()
+        n.op = op
+        n.name = name
+        n.input.extend(inputs)
+        for k, v in attrs.items():
+            # np.generic: 0-d scalars (np.int32(1)) are tensor values
+            # too, NOT python ints — they must land in .tensor or the
+            # Const comes out empty
+            if isinstance(v, (np.ndarray, np.generic)):
+                n.attr[k].tensor.CopyFrom(tensor_to_proto(np.asarray(v)))
+            elif isinstance(v, bool):
+                n.attr[k].b = v
+            elif k in ("dtype", "T", "type"):
+                n.attr[k].type = v
+            elif isinstance(v, int):
+                n.attr[k].i = v
+            elif isinstance(v, float):
+                n.attr[k].f = v
+            elif isinstance(v, bytes):
+                n.attr[k].s = v
+            elif isinstance(v, str):
+                n.attr[k].s = v.encode()
+            elif isinstance(v, (list, tuple)):
+                n.attr[k].list.i.extend(int(x) for x in v)
+        return name
+
+    def fresh(self, m):
+        nm = (m.get_name() or type(m).__name__) + f"_{self.idx}"
+        self.idx += 1
+        return nm
+
+    # -- graph walking -------------------------------------------------
+    def emit_graph(self, graph, input_names):
+        outputs = {}
+        for i, node in enumerate(graph.input_nodes):
+            # input nodes still carry an element Graph.apply_fn runs
+            # (nn.Input() is Identity, but BigDL lets a real layer be
+            # the input node) — emit it fed by the placeholder
+            outputs[node.uid] = self.emit(node.element, input_names[i])
+        for node in graph.sorted_nodes:
+            if node.uid in outputs:
+                continue
+            ins = [outputs[p.uid] for p in node.prev_nodes]
+            prev = ins[0] if len(ins) == 1 else ins
+            outputs[node.uid] = self.emit(node.element, prev)
+        outs = [outputs[o.uid] for o in graph.output_nodes]
+        return outs[0] if len(outs) == 1 else outs
+
+    # -- converters ----------------------------------------------------
+    def emit(self, m, prev):
+        """Emit nodes for module ``m`` fed by ``prev`` (a node name, or a
+        list of names when the input is a Table); returns the output
+        node name (or a list for Table outputs)."""
+        nn = self.nn
+        from ..nn.graph import Graph
+
+        # containers -----------------------------------------------------
+        if isinstance(m, Graph):
+            return self.emit_graph(m, prev if isinstance(prev, list)
+                                   else [prev])
+        if isinstance(m, nn.Sequential):
+            for child in m.modules:
+                prev = self.emit(child, prev)
+            return prev
+        if isinstance(m, nn.Concat):
+            outs = [self.emit(child, prev) for child in m.modules]
+            return self._concat(outs, m.dimension, self.fresh(m))
+        if isinstance(m, nn.ConcatTable):
+            return [self.emit(child, prev) for child in m.modules]
+        if isinstance(m, nn.ParallelTable):
+            return [self.emit(child, p)
+                    for child, p in zip(m.modules, prev)]
+        if isinstance(m, nn.CAddTable):
+            return self._fold_binary("Add", prev, self.fresh(m))
+        if isinstance(m, nn.CMulTable):
+            return self._fold_binary("Mul", prev, self.fresh(m))
+        if isinstance(m, nn.JoinTable):
+            return self._concat(prev, m.dimension, self.fresh(m))
+
+        nm = self.fresh(m)
+        p = {k: np.asarray(v, np.float32) for k, v in m.params.items()}
+
+        # parameterised layers ------------------------------------------
+        if isinstance(m, nn.Linear):
+            w = self.add("Const", nm + "/weight",
+                         value=np.ascontiguousarray(p["weight"].T),
+                         dtype=tfpb.DT_FLOAT)
+            out = self.add("MatMul", nm, [prev, w],
+                           transpose_a=False, transpose_b=False)
+            if m.with_bias:
+                b = self.add("Const", nm + "/bias", value=p["bias"],
+                             dtype=tfpb.DT_FLOAT)
+                out = self.add("BiasAdd", nm + "/biasadd", [out, b])
+            return out
+        if isinstance(m, nn.SpatialConvolution):
+            if m.n_group != 1:
+                raise NotImplementedError(
+                    "TF Conv2D has no group attr (reference "
+                    "BigDLToTensorflow rejects grouped conv too)")
+            w = np.transpose(p["weight"], (2, 3, 1, 0))  # OIHW → HWIO
+            wname = self.add("Const", nm + "/filter",
+                             value=np.ascontiguousarray(w),
+                             dtype=tfpb.DT_FLOAT)
+            attrs = {"strides": [1, 1, m.stride_h, m.stride_w],
+                     "data_format": b"NCHW"}
+            if m.pad_w == -1 or m.pad_h == -1:
+                attrs["padding"] = b"SAME"
+            elif (m.pad_w, m.pad_h) == (0, 0):
+                attrs["padding"] = b"VALID"
+            else:
+                attrs["padding"] = b"EXPLICIT"
+                attrs["explicit_paddings"] = [0, 0, 0, 0, m.pad_h, m.pad_h,
+                                              m.pad_w, m.pad_w]
+            out = self.add("Conv2D", nm, [prev, wname], **attrs)
+            if m.with_bias:
+                b = self.add("Const", nm + "/bias", value=p["bias"],
+                             dtype=tfpb.DT_FLOAT)
+                out = self.add("BiasAdd", nm + "/biasadd", [out, b],
+                               data_format=b"NCHW")
+            return out
+        if isinstance(m, (nn.SpatialMaxPooling, nn.SpatialAveragePooling)):
+            is_max = isinstance(m, nn.SpatialMaxPooling)
+            op = "MaxPool" if is_max else "AvgPool"
+            ceil = bool(getattr(m, "ceil_mode", False))
+            if getattr(m, "global_pooling", False):
+                raise NotImplementedError(
+                    "saveTF of global_pooling pools: the kernel extent "
+                    "is input-dependent; use Mean or a fixed kernel")
+            if (m.pad_w, m.pad_h) == (0, 0):
+                # TF has no ceil attr.  Unpadded ceil pools map to SAME
+                # (out = ceil(in/s); max pads -inf, TF SAME avg divides
+                # by the valid count like a truncated Torch ceil
+                # window).  Torch-ceil emits ceil((in-k)/s)+1: equal to
+                # SAME for every input only when k == s; for k <= 2s-1
+                # it needs the input extent ≡ 0 (mod s) — true of every
+                # zoo trace (224/112/56/28/14), so warn rather than
+                # reject; beyond that the shapes always differ.
+                if ceil and (m.kw > 2 * m.dw - 1 or m.kh > 2 * m.dh - 1):
+                    raise NotImplementedError(
+                        "saveTF of ceil-mode pooling with kernel > "
+                        "2*stride-1 has no TF equivalent")
+                if ceil and (m.kw != m.dw or m.kh != m.dh):
+                    import warnings
+
+                    warnings.warn(
+                        "ceil-mode pool exported as TF SAME: exact only "
+                        "when the input spatial extent is a multiple of "
+                        "the stride", stacklevel=2)
+                padding = b"SAME" if ceil else b"VALID"
+            elif m.pad_w == -1 or m.pad_h == -1:
+                padding = b"SAME"
+            else:
+                # TF pooling has no explicit-pad attr: PadV2 (-inf for
+                # max — the Torch pad semantics; 0 for avg, which with
+                # count_include_pad=True divides by k*k like the module)
+                # then a VALID pool.  Exact for stride 1 (where ceil is
+                # a no-op); ceil with stride > 1 would add an
+                # input-dependent extra right window TF cannot express.
+                if ceil and (m.dw > 1 or m.dh > 1):
+                    raise NotImplementedError(
+                        "saveTF of ceil-mode pooling with explicit pads "
+                        "and stride > 1 has no TF equivalent")
+                if not is_max and not m.count_include_pad:
+                    raise NotImplementedError(
+                        "saveTF of padded AvgPool with "
+                        "count_include_pad=False has no TF equivalent "
+                        "(TF divides padded windows by k*k after an "
+                        "explicit Pad)")
+                pads = np.asarray([[0, 0], [0, 0],
+                                   [m.pad_h, m.pad_h],
+                                   [m.pad_w, m.pad_w]], np.int32)
+                cp = self.add("Const", nm + "/paddings", value=pads,
+                              dtype=tfpb.DT_INT32)
+                fill = np.float32(-np.inf if is_max else 0.0)
+                cf = self.add("Const", nm + "/pad_value", value=fill,
+                              dtype=tfpb.DT_FLOAT)
+                prev = self.add("PadV2", nm + "/pad", [prev, cp, cf])
+                padding = b"VALID"
+            return self.add(op, nm, [prev],
+                            ksize=[1, 1, m.kh, m.kw],
+                            strides=[1, 1, m.dh, m.dw],
+                            padding=padding, data_format=b"NCHW")
+        if isinstance(m, nn.SpatialBatchNormalization):
+            gamma = p.get("weight", np.ones(m.n_output, np.float32))
+            beta = p.get("bias", np.zeros(m.n_output, np.float32))
+            mean = np.asarray(m.buffers["running_mean"], np.float32)
+            var = np.asarray(m.buffers["running_var"], np.float32)
+            cg = self.add("Const", nm + "/gamma", value=gamma,
+                          dtype=tfpb.DT_FLOAT)
+            cb = self.add("Const", nm + "/beta", value=beta,
+                          dtype=tfpb.DT_FLOAT)
+            cm = self.add("Const", nm + "/moving_mean", value=mean,
+                          dtype=tfpb.DT_FLOAT)
+            cv = self.add("Const", nm + "/moving_variance", value=var,
+                          dtype=tfpb.DT_FLOAT)
+            return self.add("FusedBatchNorm", nm, [prev, cg, cb, cm, cv],
+                            epsilon=float(m.eps), is_training=False,
+                            data_format=b"NCHW")
+        if isinstance(m, nn.BatchNormalization):
+            # 1-D BN over (N, C): FusedBatchNorm is 4-D only — freeze to
+            # the affine y = x*a + c (a = γ/√(σ²+ε), c = β − μ·a)
+            gamma = p.get("weight", np.ones(m.n_output, np.float32))
+            beta = p.get("bias", np.zeros(m.n_output, np.float32))
+            mean = np.asarray(m.buffers["running_mean"], np.float32)
+            var = np.asarray(m.buffers["running_var"], np.float32)
+            a = gamma / np.sqrt(var + m.eps)
+            c = beta - mean * a
+            ca = self.add("Const", nm + "/scale", value=a.astype(np.float32),
+                          dtype=tfpb.DT_FLOAT)
+            cc = self.add("Const", nm + "/shift", value=c.astype(np.float32),
+                          dtype=tfpb.DT_FLOAT)
+            out = self.add("Mul", nm + "/mul", [prev, ca])
+            return self.add("Add", nm, [out, cc])
+        if isinstance(m, nn.SpatialCrossMapLRN):
+            # TF LRN is NHWC-only: transpose sandwich
+            pre = self.add("Const", nm + "/to_nhwc",
+                           value=np.asarray([0, 2, 3, 1], np.int32),
+                           dtype=tfpb.DT_INT32)
+            post = self.add("Const", nm + "/to_nchw",
+                            value=np.asarray([0, 3, 1, 2], np.int32),
+                            dtype=tfpb.DT_INT32)
+            t1 = self.add("Transpose", nm + "/nhwc", [prev, pre])
+            lrn = self.add("LRN", nm, [t1],
+                           depth_radius=(m.size - 1) // 2,
+                           alpha=float(m.alpha / m.size),
+                           beta=float(m.beta), bias=float(m.k))
+            return self.add("Transpose", nm + "/nchw", [lrn, post])
+        if type(m) is nn.Scale:
+            w = np.asarray(m.cmul.params["weight"], np.float32)
+            b = np.asarray(m.cadd.params["bias"], np.float32)
+            cw = self.add("Const", nm + "/weight", value=w,
+                          dtype=tfpb.DT_FLOAT)
+            cb = self.add("Const", nm + "/bias", value=b,
+                          dtype=tfpb.DT_FLOAT)
+            out = self.add("Mul", nm + "/mul", [prev, cw])
+            return self.add("Add", nm, [out, cb])
+        if isinstance(m, nn.MulConstant):
+            c = self.add("Const", nm + "/c",
+                         value=np.float32(m.constant_scalar),
+                         dtype=tfpb.DT_FLOAT)
+            return self.add("Mul", nm, [prev, c])
+        if isinstance(m, nn.AddConstant):
+            c = self.add("Const", nm + "/c",
+                         value=np.float32(m.constant_scalar),
+                         dtype=tfpb.DT_FLOAT)
+            return self.add("Add", nm, [prev, c])
+
+        # activations ----------------------------------------------------
+        simple = {nn.ReLU: "Relu", nn.ReLU6: "Relu6", nn.Tanh: "Tanh",
+                  nn.Sigmoid: "Sigmoid", nn.SoftMax: "Softmax",
+                  nn.LogSoftMax: "LogSoftmax", nn.Abs: "Abs",
+                  nn.Exp: "Exp", nn.Log: "Log", nn.Square: "Square",
+                  nn.Sqrt: "Sqrt", nn.SoftPlus: "Softplus",
+                  nn.SoftSign: "Softsign", nn.ELU: "Elu"}
+        for cls, opname in simple.items():
+            if type(m) is cls:
+                return self.add(opname, nm, [prev])
+
+        # shape ops ------------------------------------------------------
+        if isinstance(m, (nn.Reshape, nn.View, nn.InferReshape)):
+            sizes = list(getattr(m, "size", ()) or getattr(m, "sizes", ()))
+            shape = np.asarray([-1] + [int(s) for s in sizes], np.int32)
+            s = self.add("Const", nm + "/shape", value=shape,
+                         dtype=tfpb.DT_INT32)
+            return self.add("Reshape", nm, [prev, s])
+        if isinstance(m, nn.Squeeze):
+            # num_input_dims > 0 = batch mode: the frozen graph always
+            # sees batched input, so the axis shifts right by one
+            off = 1 if m.num_input_dims > 0 else 0
+            dims = [] if m.dim is None else [int(m.dim) - 1 + off]
+            return self.add("Squeeze", nm, [prev], squeeze_dims=dims)
+        if isinstance(m, nn.Unsqueeze):
+            off = 1 if m.num_input_dims > 0 else 0
+            d = self.add("Const", nm + "/dim",
+                         value=np.int32(m.pos - 1 + off),
+                         dtype=tfpb.DT_INT32)
+            return self.add("ExpandDims", nm, [prev, d])
+        if isinstance(m, nn.SpatialZeroPadding):
+            l, r, t, b = m.pads
+            pads = np.asarray([[0, 0], [0, 0], [t, b], [l, r]], np.int32)
+            c = self.add("Const", nm + "/paddings", value=pads,
+                         dtype=tfpb.DT_INT32)
+            return self.add("Pad", nm, [prev, c])
+        if isinstance(m, nn.Mean):
+            # batch mode (n_input_dims > 0): axis shifts right by one on
+            # the batched input the frozen graph sees (Mean._axis)
+            off = 1 if m.n_input_dims > 0 else 0
+            axes = np.asarray([m.dimension - 1 + off], np.int32)
+            c = self.add("Const", nm + "/axes", value=axes,
+                         dtype=tfpb.DT_INT32)
+            return self.add("Mean", nm, [prev, c],
+                            keep_dims=not m.squeeze)
+
+        # no-ops ---------------------------------------------------------
+        if isinstance(m, nn.Dropout):
+            return prev  # inference graph: dropout is identity
+        if isinstance(m, nn.Identity):
+            return prev
+        raise NotImplementedError(
+            f"saveTF of {type(m).__name__} not supported (reference "
+            "BigDLToTensorflow.scala covers the same converter set)")
+
+    # -- helpers ---------------------------------------------------------
+    def _concat(self, inputs, dimension, nm):
+        if not isinstance(inputs, list):
+            raise ValueError("concat needs a Table input")
+        axis = self.add("Const", nm + "/axis",
+                        value=np.int32(dimension - 1), dtype=tfpb.DT_INT32)
+        return self.add("ConcatV2", nm, list(inputs) + [axis],
+                        N=len(inputs))
+
+    def _fold_binary(self, op, inputs, nm):
+        if not isinstance(inputs, list) or len(inputs) < 2:
+            raise ValueError(f"{op} table needs >=2 inputs")
+        out = inputs[0]
+        for i, other in enumerate(inputs[1:]):
+            out = self.add(op, f"{nm}/{i}" if i < len(inputs) - 2 else nm,
+                           [out, other])
+        return out

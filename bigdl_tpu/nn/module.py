@@ -353,15 +353,17 @@ class AbstractModule:
         self.set_param_tree(jax.tree_util.tree_map(jnp.asarray, tree))
         return self
 
-    def predict(self, dataset, batch_size: int = 32):
+    def predict(self, dataset, batch_size: int = 32, mesh=None):
+        """Distributed when given a mesh (reference Predictor.scala:34
+        broadcasts + forwards per partition; here a compiled shard_map)."""
         from ..optim.predictor import Predictor
 
-        return Predictor(self).predict(dataset, batch_size)
+        return Predictor(self, mesh=mesh).predict(dataset, batch_size)
 
-    def predict_class(self, dataset, batch_size: int = 32):
+    def predict_class(self, dataset, batch_size: int = 32, mesh=None):
         from ..optim.predictor import Predictor
 
-        return Predictor(self).predict_class(dataset, batch_size)
+        return Predictor(self, mesh=mesh).predict_class(dataset, batch_size)
 
     # -- pickling: jax arrays travel as numpy (checkpoint format seam) ---
     def __getstate__(self):

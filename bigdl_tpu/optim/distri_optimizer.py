@@ -58,6 +58,9 @@ class DistriOptimizer(Optimizer):
                  mesh: Optional[Mesh] = None):
         super().__init__(model, dataset, criterion, batch_size, end_trigger)
         self.mesh = mesh
+        # how the last profiled iteration's phase split was measured:
+        # "trace" (jax.profiler device events) or "probe" (fallback)
+        self.phase_source = None
         # retry policy (reference DistriOptimizer.scala:750-752)
         self.max_retry = int(get_property("bigdl.failure.retryTimes", 5))
         self.retry_window = float(get_property("bigdl.failure.retryTimeInterval", 120))
@@ -315,46 +318,72 @@ class DistriOptimizer(Optimizer):
             profiled = (profile_interval > 0 and state["neval"] > 1
                         and state["neval"] % profile_interval == 0
                         and not masked)
+
+            t0 = time.time()
+            lr = optim.get_current_lr()
+            if masked and jitted_masked is None:
+                jitted_masked = self._build_step(mesh, arp, masked=True)
+            if masked:
+                w = shard_batch(mesh, (w,))[0]
+
+            def dispatch():
+                if masked:
+                    return jitted_masked(
+                        params, buffers, slots, jnp.float32(lr),
+                        next_jax_key(), x, y, w, jnp.float32(n_records))
+                return jitted(params, buffers, slots, jnp.float32(lr),
+                              next_jax_key(), x, y)
+
+            def prefetch():
+                # overlap next-batch host prep + infeed with this device
+                # step (in-epoch only, preserving rollover/shuffle)
+                nonlocal pending
+                if records_this_epoch + batch.size() < epoch_size:
+                    nb = next(data_iter)
+                    pending = (nb, *_device_batch(nb))
+
+            trace_split = None
             if profiled:
-                # collective-free fwd+bwd probe: measures pure compute so
-                # "aggregate gradient time" is a real number, not 0.0.
-                # Fixed probe key: the probe's output is discarded, and
-                # drawing from the training key stream would make the
-                # RNG sequence depend on the profiling interval.
+                # phase split measured from the profiler trace of THIS
+                # step's execution: collective vs compute device time
+                # (reference Metrics.scala:103-121 measures per phase).
+                # The value fetch (= execution barrier; block_until_ready
+                # returns early on the tunneled TPU backend) must happen
+                # inside the trace so device events are captured; the
+                # step is timed inside run_traced so trace start/parse
+                # overhead never pollutes the phase metrics.
+                from .profiling import trace_phase_split
+
+                step_out = []
+
+                def run_traced():
+                    tr = time.time()
+                    out = dispatch()
+                    loss_v = float(out[0])
+                    step_out.append((out, loss_v, time.time() - tr))
+                trace_split = trace_phase_split(run_traced)
+                out, loss, train_time = step_out[0]
+                prefetch()
+            else:
+                out = dispatch()
+                prefetch()
+                loss = float(out[0])  # device sync after prefetch overlap
+                train_time = time.time() - t0
+            _, params, buffers, slots = out
+
+            if profiled and trace_split is None:
+                # fallback: collective-free fwd+bwd probe pins the pure
+                # compute time (runs on the post-step params — identical
+                # shapes/program, so identical timing)
                 probe_key = jax.random.PRNGKey(0)
                 if grad_probe is None:
                     grad_probe = self._build_grad_probe(mesh)
-                    # compile outside the timing; fetch the scalar values
-                    # as the execution barrier — on the tunneled TPU
-                    # backend block_until_ready returns before the
-                    # computation runs, a value fetch does not
                     _l, _g = grad_probe(params, buffers, probe_key, x, y)
                     float(_l), float(_g)
                 tp = time.time()
                 _l, _g = grad_probe(params, buffers, probe_key, x, y)
                 float(_l), float(_g)
                 compute_time = time.time() - tp
-
-            t0 = time.time()
-            lr = optim.get_current_lr()
-            if masked:
-                if jitted_masked is None:
-                    jitted_masked = self._build_step(mesh, arp, masked=True)
-                w = shard_batch(mesh, (w,))[0]
-                loss, params, buffers, slots = jitted_masked(
-                    params, buffers, slots, jnp.float32(lr), next_jax_key(),
-                    x, y, w, jnp.float32(n_records))
-            else:
-                loss, params, buffers, slots = jitted(
-                    params, buffers, slots, jnp.float32(lr), next_jax_key(),
-                    x, y)
-            # overlap next-batch host prep + infeed with this device step
-            # (in-epoch only, preserving rollover/shuffle semantics)
-            if records_this_epoch + batch.size() < epoch_size:
-                nb = next(data_iter)
-                pending = (nb, *_device_batch(nb))
-            loss = float(loss)  # device sync
-            train_time = time.time() - t0
 
             records_this_epoch += n_records
             state["loss"] = loss
@@ -363,7 +392,14 @@ class DistriOptimizer(Optimizer):
             # the compute/aggregate split; in between, the last measured
             # ratio attributes the fused step's wall time
             if profiled:
-                compute_ratio = min(compute_time / max(train_time, 1e-9), 1.0)
+                if trace_split is not None:
+                    c_s, agg_s = trace_split
+                    compute_ratio = c_s / max(c_s + agg_s, 1e-12)
+                    self.phase_source = "trace"
+                else:
+                    compute_ratio = min(
+                        compute_time / max(train_time, 1e-9), 1.0)
+                    self.phase_source = "probe"
             if compute_ratio is not None:
                 self.metrics.add("computing time average",
                                  train_time * compute_ratio)

@@ -1,33 +1,30 @@
 """Predictor (reference optim/Predictor.scala:34, LocalPredictor.scala:37).
 
-Inference with the model's params broadcast once (jit constant-folds
-them — the TPU analogue of ModelBroadcast, SURVEY §2.2 P7)."""
+Distributed like the reference's: Predictor.scala broadcasts the model
+once and forwards per partition; here ``predict`` routes through the
+same cached compiled shard_map eval forward the validator uses
+(evaluator.py), params device-resident, batches padded to the mesh
+multiple at static shape and sliced back.  Without a mesh the compiled
+single-device forward is used — jit constant-folds the params (the TPU
+analogue of ModelBroadcast, SURVEY §2.2 P7).
+"""
 from __future__ import annotations
 
-from typing import Iterator, List
+from typing import List, Optional
 
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax.sharding import Mesh
 
-from ..dataset.sample import MiniBatch, Sample, SampleToMiniBatch
+from ..dataset.sample import MiniBatch, SampleToMiniBatch
+from ._sharding_utils import pad_batch, round_up
 
 
 class Predictor:
-    def __init__(self, model):
+    def __init__(self, model, mesh: Optional[Mesh] = None):
         self.model = model
-
-    def _fwd(self):
-        model = self.model
-        params = model.param_tree()
-        buffers = model.buffer_tree()
-
-        @jax.jit
-        def fwd(x):
-            out, _ = model.apply_fn(params, buffers, x, False, None)
-            return out
-
-        return fwd
+        self.mesh = mesh
 
     def _batches(self, dataset, batch_size):
         batcher = SampleToMiniBatch(batch_size)
@@ -45,14 +42,26 @@ class Predictor:
 
     def predict(self, dataset, batch_size: int = 32) -> List[np.ndarray]:
         """RDD[Activity] analogue: list of per-sample outputs."""
+        from .evaluator import _cached_eval_fwd, _data_mesh
+
         self.model.evaluate()
-        fwd = self._fwd()
+        mesh = _data_mesh(self.mesh)
+        n_dev = mesh.shape["data"] if mesh is not None else 1
+        fwd = _cached_eval_fwd(self.model, mesh)
+        params = self.model.param_tree()
+        buffers = self.model.buffer_tree()
+
         outs = []
         for batch in self._batches(dataset, batch_size):
-            x = batch.get_input()
-            x = jnp.asarray(x) if not isinstance(x, (list, tuple)) else \
-                type(x)(jnp.asarray(v) for v in x)
-            out = np.asarray(fwd(x))
+            x = jax.tree_util.tree_map(jnp.asarray, batch.get_input())
+            size = batch.size()
+            padded = size % n_dev != 0
+            if padded:  # static-shape contract over the mesh
+                x, _, _ = pad_batch(x, (), size, round_up(size, n_dev))
+            out = fwd(params, buffers, x)
+            if padded:
+                out = jax.tree_util.tree_map(lambda a: a[:size], out)
+            out = np.asarray(out)
             outs.extend(out[i] for i in range(out.shape[0]))
         return outs
 

@@ -1,0 +1,120 @@
+"""Phase-split extraction from jax.profiler traces.
+
+The reference attributes each iteration's wall time to named phases via
+Spark accumulators ("computing time average", "aggregate gradient time"
+— Metrics.scala:103-121, DistriOptimizer.scala:146-151).  On TPU the
+whole iteration is ONE fused XLA program, so the honest split comes from
+the profiler: trace the step's execution, classify device-side op events
+into collective (gradient aggregation / weight exchange) vs compute, and
+sum their durations.  ``DistriOptimizer`` does this on profiling
+iterations, falling back to the collective-free probe when a trace
+yields nothing parsable (e.g. an execution backend whose xplane has no
+device lines).
+"""
+from __future__ import annotations
+
+import glob
+import os
+import shutil
+import tempfile
+from typing import Callable, Optional, Tuple
+
+# Substrings identifying communication ops in XLA/xplane event names
+# (TPU planes use HLO names: all-reduce.N, all-gather.N, ...; the CPU
+# backend surfaces its thread rendezvous instead).
+_COLLECTIVE_MARKS = (
+    "all-reduce", "allreduce", "all-gather", "allgather",
+    "reduce-scatter", "reducescatter", "all-to-all", "alltoall",
+    "collective", "permute", "psum", "rendezvous", "wait:",
+    "send", "recv",
+)
+# Host-side bookkeeping events that are neither compute nor collective.
+_SKIP_MARKS = (
+    "threadpoollistener", "startregion", "stopregion", "parsearguments",
+    "collectgarbage", "end:",
+)
+
+
+def _classify(name: str) -> Optional[str]:
+    n = name.lower()
+    if any(m in n for m in _SKIP_MARKS):
+        return None
+    if any(m in n for m in _COLLECTIVE_MARKS):
+        return "collective"
+    return "compute"
+
+
+def _device_lines(profile_data):
+    """Yield (plane, line) pairs holding device-side execution events.
+
+    TPU planes are named /device:TPU:N (lines per XLA op stream); the
+    CPU PJRT backend nests its executor threads under /host:CPU with
+    tf_XLAPjRtCpuClient/... line names."""
+    for plane in profile_data.planes:
+        dev_plane = plane.name.startswith("/device:")
+        for line in plane.lines:
+            if dev_plane and "step" not in line.name.lower():
+                yield line
+            elif "XLAPjRtCpuClient" in line.name:
+                yield line
+
+
+def split_from_xplane(path: str) -> Tuple[float, float]:
+    """Sum (compute_seconds, collective_seconds) over a trace file."""
+    from jax.profiler import ProfileData
+
+    pd = ProfileData.from_file(path)
+    compute_ns = 0
+    collective_ns = 0
+    for line in _device_lines(pd):
+        for ev in line.events:
+            kind = _classify(ev.name)
+            if kind == "compute":
+                compute_ns += ev.duration_ns
+            elif kind == "collective":
+                collective_ns += ev.duration_ns
+    return compute_ns / 1e9, collective_ns / 1e9
+
+
+def trace_phase_split(run: Callable[[], None]) -> Optional[Tuple[float, float]]:
+    """Run ``run()`` under a jax.profiler trace; return the device-time
+    (compute_s, collective_s) split, or None when the trace has no
+    classifiable device events (caller falls back to the probe).
+
+    ``run`` ALWAYS executes exactly once, and its exceptions propagate —
+    the driver's failure-retry loop depends on seeing training errors.
+    Only the profiling machinery itself is allowed to fail silently."""
+    import jax
+
+    tmp = tempfile.mkdtemp(prefix="bigdl_phase_")
+    ctx, started = None, False
+    try:
+        try:
+            ctx = jax.profiler.trace(tmp)
+            ctx.__enter__()
+            started = True
+        except Exception:  # backend without trace support: just run
+            pass
+        try:
+            run()
+        finally:
+            if started:
+                try:
+                    ctx.__exit__(None, None, None)
+                except Exception:
+                    started = False
+        if not started:
+            return None
+        try:
+            files = glob.glob(os.path.join(tmp, "**", "*.xplane.pb"),
+                              recursive=True)
+            if not files:
+                return None
+            compute_s, collective_s = split_from_xplane(files[0])
+            if compute_s <= 0.0:
+                return None
+            return compute_s, collective_s
+        except Exception:  # unparsable trace — fall back
+            return None
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)

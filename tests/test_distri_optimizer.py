@@ -278,6 +278,50 @@ def test_validation_runs_on_mesh_and_metrics_are_real():
     agg = opt.metrics.get("aggregate gradient time")
     # profiled at iterations 11 and 21 -> a real (non-zero) split exists
     assert agg is not None and agg > 0.0, summary
+    # VERDICT r2 #6: the split must come from a jax.profiler trace of the
+    # step's own execution (collective vs compute device events), with
+    # the collective-free probe only as fallback
+    assert opt.phase_source == "trace", opt.phase_source
+
+
+def test_trace_phase_split_classifies_collectives():
+    """Unit: the xplane classifier separates psum/rendezvous events from
+    compute on the 8-device CPU backend."""
+    import jax.numpy as jnp
+    from jax import lax, shard_map
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from bigdl_tpu.optim.profiling import trace_phase_split
+
+    mesh = Mesh(np.array(jax.devices()), ("data",))
+
+    def step(x, w):
+        return lax.psum(x @ w, "data")
+
+    f = jax.jit(shard_map(step, mesh=mesh, in_specs=(P("data"), P()),
+                          out_specs=P()))
+    x = jnp.ones((8, 256, 256))
+    w = jnp.ones((256, 256))
+    jax.block_until_ready(f(x, w))  # compile outside the trace
+    split = trace_phase_split(lambda: jax.block_until_ready(f(x, w)))
+    assert split is not None
+    compute_s, collective_s = split
+    assert compute_s > 0.0 and collective_s > 0.0
+
+
+def test_trace_phase_split_propagates_run_errors():
+    """Training errors must escape the profiler wrapper — the driver's
+    checkpoint-retry loop depends on them (DistriOptimizer.scala:750)."""
+    from bigdl_tpu.optim.profiling import trace_phase_split
+
+    class Boom(RuntimeError):
+        pass
+
+    def run():
+        raise Boom("training failure")
+
+    with pytest.raises(Boom):
+        trace_phase_split(run)
 
 
 def test_pytree_table_targets_pad_and_mask():
