@@ -990,6 +990,10 @@ class _SaveEmitter:
 
     # -- graph walking -------------------------------------------------
     def emit_graph(self, graph, input_names):
+        if len(graph.input_nodes) != len(input_names):
+            raise NotImplementedError(
+                f"saveTF supports {len(input_names)}-input graphs here; "
+                f"model has {len(graph.input_nodes)} input nodes")
         outputs = {}
         for i, node in enumerate(graph.input_nodes):
             # input nodes still carry an element Graph.apply_fn runs
@@ -1034,7 +1038,11 @@ class _SaveEmitter:
         if isinstance(m, nn.CMulTable):
             return self._fold_binary("Mul", prev, self.fresh(m))
         if isinstance(m, nn.JoinTable):
-            return self._concat(prev, m.dimension, self.fresh(m))
+            # batch mode (n_input_dims > 0): the frozen graph always
+            # sees batched input, so the concat axis shifts right by one
+            # (JoinTable._apply)
+            dim = m.dimension + (1 if m.n_input_dims > 0 else 0)
+            return self._concat(prev, dim, self.fresh(m))
 
         nm = self.fresh(m)
         p = {k: np.asarray(v, np.float32) for k, v in m.params.items()}

@@ -484,9 +484,22 @@ class TimeDistributedCriterion(AbstractCriterion):
 
     def _loss(self, inp, target):
         steps = inp.shape[1]
+        c = self.critrn
+        # Fused path for the classification criterions: sum_t mean_b ==
+        # steps * mean_{b,t}, so one flattened (B*T, V) call replaces T
+        # traced per-timestep calls — at LM scale the unrolled loop
+        # dominates compile AND step time.
+        flat_ok = (isinstance(c, (ClassNLLCriterion, CrossEntropyCriterion))
+                   and c.size_average and inp.ndim == 3
+                   and (c.weights if isinstance(c, ClassNLLCriterion)
+                        else c.nll.weights) is None)
+        if flat_ok:
+            flat = c._loss(inp.reshape(-1, inp.shape[-1]),
+                           target.reshape(-1))
+            return flat if self.size_average else flat * steps
 
         def per_t(i):
-            return self.critrn._loss(inp[:, i], target[:, i])
+            return c._loss(inp[:, i], target[:, i])
 
         total = sum(per_t(i) for i in range(steps))
         return total / steps if self.size_average else total

@@ -45,15 +45,18 @@ def _classify(name: str) -> Optional[str]:
 
 
 def _device_lines(profile_data):
-    """Yield (plane, line) pairs holding device-side execution events.
+    """Yield lines holding device-side PER-OP execution events.
 
-    TPU planes are named /device:TPU:N (lines per XLA op stream); the
-    CPU PJRT backend nests its executor threads under /host:CPU with
-    tf_XLAPjRtCpuClient/... line names."""
+    TPU planes are named /device:TPU:N; only their "XLA Ops" line is
+    per-op — "XLA Modules" carries one whole-executable event (compute
+    AND collective time) and "Framework Ops"/"Steps" duplicate the op
+    stream, all of which would double-count.  The CPU PJRT backend nests
+    its executor threads under /host:CPU with tf_XLAPjRtCpuClient/...
+    line names."""
     for plane in profile_data.planes:
         dev_plane = plane.name.startswith("/device:")
         for line in plane.lines:
-            if dev_plane and "step" not in line.name.lower():
+            if dev_plane and "xla ops" in line.name.lower():
                 yield line
             elif "XLAPjRtCpuClient" in line.name:
                 yield line

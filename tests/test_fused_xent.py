@@ -88,3 +88,32 @@ def test_transformer_logits_output_matches_log_probs():
     # genuinely unnormalised
     row_mass = float(jnp.exp(lg[0, 0].astype(jnp.float32)).sum())
     assert abs(row_mass - 1.0) > 1e-3, "logits output is still normalised"
+
+
+def test_time_distributed_fused_path_matches_loop():
+    """TimeDistributedCriterion's flattened classification fast path must
+    equal the per-timestep loop, for both size_average settings."""
+    rng = np.random.RandomState(8)
+    B, T, V = 4, 6, 11
+    logits = jnp.asarray(rng.randn(B, T, V), jnp.float32)
+    target = jnp.asarray(rng.randint(1, V + 1, (B, T)), jnp.float32)
+
+    for size_avg in (True, False):
+        td = nn.TimeDistributedCriterion(nn.CrossEntropyCriterion(),
+                                         size_avg)
+        got = float(td._loss(logits, target))
+        inner = nn.CrossEntropyCriterion()
+        want = sum(float(inner._loss(logits[:, i], target[:, i]))
+                   for i in range(T))
+        want = want / T if size_avg else want
+        np.testing.assert_allclose(got, want, rtol=1e-5)
+
+    # weighted inner criterion must still take the loop path
+    w = jnp.asarray(rng.rand(V).astype(np.float32) + 0.5)
+    td_w = nn.TimeDistributedCriterion(nn.ClassNLLCriterion(weights=w), True)
+    lp = jax.nn.log_softmax(logits, axis=-1)
+    got = float(td_w._loss(lp, target))
+    want = sum(float(nn.ClassNLLCriterion(weights=w)._loss(lp[:, i],
+                                                           target[:, i]))
+               for i in range(T)) / T
+    np.testing.assert_allclose(got, want, rtol=1e-5)
