@@ -1,2 +1,13 @@
 """Example programs (reference example/ — SURVEY §1.8): pretrained-model
-validation, GloVe-CNN text classification, and UDF-style serving."""
+validation, GloVe-CNN text classification, UDF-style serving, ML
+pipelines, TF load/save, image prediction, train-to-accuracy proofs."""
+
+
+def default_to_cpu():
+    """Examples run hermetically on CPU unless the user pins a platform:
+    the image preloads jax with the (flaky, slow-to-init) tunneled TPU
+    backend, which would stall a demo — override before first use."""
+    import jax
+
+    if jax.config.jax_platforms and "axon" in str(jax.config.jax_platforms):
+        jax.config.update("jax_platforms", "cpu")

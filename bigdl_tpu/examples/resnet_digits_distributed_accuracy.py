@@ -52,10 +52,9 @@ def digits_as_cifar():
 
 def main(max_epoch_n: int = 30, depth: int = 20, target: float = 0.97,
          batch_size: int = 64) -> float:
-    import jax
+    from . import default_to_cpu
 
-    if jax.config.jax_platforms and "axon" in str(jax.config.jax_platforms):
-        jax.config.update("jax_platforms", "cpu")
+    default_to_cpu()
 
     from bigdl_tpu import nn
     from bigdl_tpu.dataset import array

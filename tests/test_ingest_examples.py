@@ -154,3 +154,48 @@ class TestExamples:
                           np.float32(1)) for _ in range(6)]
         res = validate(loaded, samples, batch_size=3)
         assert res[0][0].count == 6
+
+
+class TestRound3Examples:
+    def test_tensorflow_load_save_roundtrip(self):
+        """reference example/tensorflow/{Load,Save}.scala"""
+        from bigdl_tpu.examples.tensorflow_load_save import save_then_load
+
+        _, err = save_then_load(sample_batch=2)
+        assert err < 1e-4
+
+    def test_ml_pipeline_logistic_regression(self):
+        """reference example/MLPipeline/DLClassifierLogisticRegression"""
+        from bigdl_tpu.examples.ml_pipeline import logistic_regression
+
+        assert logistic_regression(n=128, epochs=25) > 0.9
+
+    def test_ml_pipeline_multi_label(self):
+        """reference example/MLPipeline/DLEstimatorMultiLabelLR"""
+        from bigdl_tpu.examples.ml_pipeline import multi_label_lr
+
+        assert multi_label_lr(n=128, epochs=40) < 0.05
+
+    def test_image_predictor_folder(self, tmp_path):
+        """reference example/imageclassification/ImagePredictor: write a
+        tiny class-per-subdir PNG tree, predict it through the folder
+        pipeline (classes exist, count matches)."""
+        import numpy as np
+        from PIL import Image
+
+        from bigdl_tpu import nn
+        from bigdl_tpu.examples.image_predictor import predict_folder
+
+        rng = np.random.RandomState(0)
+        for cls in ("a", "b"):
+            d = tmp_path / cls
+            d.mkdir()
+            for i in range(3):
+                arr = rng.randint(0, 255, (8, 8, 3), dtype=np.uint8)
+                Image.fromarray(arr).save(str(d / f"{i}.png"))
+        model = nn.Sequential(nn.Reshape([3 * 8 * 8]), nn.Linear(192, 2),
+                              nn.LogSoftMax())
+        classes, samples = predict_folder(model, str(tmp_path),
+                                          image_size=8, batch_size=4)
+        assert len(classes) == 6
+        assert all(c in (1, 2) for c in classes)
