@@ -54,8 +54,11 @@ def _online_block(q, k, v, bias, m, l, o):
     p = jnp.exp(s - m_new[..., None])
     correction = jnp.exp(m - m_new)
     l_new = l * correction + p.sum(axis=-1)
+    # PV on the MXU in the input dtype (an f32 matmul runs at a fraction
+    # of bf16 rate); the o accumulator itself stays f32
     o_new = o * correction[..., None] + jnp.einsum(
-        "bhqk,bhkd->bhqd", p, v.astype(jnp.float32))
+        "bhqk,bhkd->bhqd", p.astype(v.dtype), v,
+        preferred_element_type=jnp.float32)
     return m_new, l_new, o_new
 
 
