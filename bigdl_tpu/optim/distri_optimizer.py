@@ -283,7 +283,9 @@ class DistriOptimizer(Optimizer):
         state["epoch_finished"] = False
 
         records_this_epoch = 0
-        epoch_size = self.dataset.size()
+        from .optimizer import _epoch_records
+
+        epoch_size = _epoch_records(self.dataset)
         data_iter = self.dataset.data(train=True)
         wall_start = time.time()
 

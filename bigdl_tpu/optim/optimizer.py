@@ -137,6 +137,25 @@ def _yields_minibatch(dataset) -> bool:
     return isinstance(probe, MiniBatch)
 
 
+def _epoch_records(dataset) -> int:
+    """Records per epoch.  MiniBatch-DIRECT datasets (an in-memory list
+    of prebuilt batches) count items, not records, in ``size()`` — sum
+    their sizes, which is free because the batches already exist.  Every
+    other dataset (including Sample streams wrapped by SampleToMiniBatch,
+    whose ``size()`` is already the record count) keeps ``size()``: a
+    counting pass through a transformed pipeline would read and decode
+    the whole dataset before the first step."""
+    from ..dataset.dataset import TransformedDataSet
+
+    base = dataset
+    while isinstance(base, TransformedDataSet):
+        base = base.base
+    items = getattr(base, "_data", None)
+    if items and isinstance(items[0], MiniBatch):
+        return sum(b.size() for b in items)
+    return dataset.size()
+
+
 def _resume_slots(optim, fresh_slots):
     """Reuse checkpointed optimizer slots when their pytree structure and
     leaf shapes match a fresh init; otherwise start clean."""
@@ -237,7 +256,7 @@ class LocalOptimizer(Optimizer):
         state["epoch_finished"] = False
 
         records_this_epoch = 0
-        epoch_size = self.dataset.size()
+        epoch_size = _epoch_records(self.dataset)
         data_iter = self.dataset.data(train=True)
         wall_start = time.time()
 
