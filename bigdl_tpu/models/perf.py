@@ -58,8 +58,8 @@ def performance(model_name: str, batch_size: int, iterations: int,
     host_x = (np.full((batch_size,) + shape, 0.01, np.float32)
               if input_data == "constant"
               else rng.rand(batch_size, *shape).astype(np.float32))
-    x = jnp.asarray(host_x, jnp.bfloat16 if dtype == "bfloat16"
-                    else jnp.float32)
+    cdtype = jnp.bfloat16 if dtype == "bfloat16" else None
+    x = jnp.asarray(host_x, cdtype or jnp.float32)
     y = jnp.ones((batch_size,), jnp.float32)
 
     params, buffers = model.param_tree(), model.buffer_tree()
@@ -67,7 +67,17 @@ def performance(model_name: str, batch_size: int, iterations: int,
 
     def step(p, b, s, xx, yy):
         def loss_fn(pp):
-            out, nb = model.apply_fn(pp, b, xx, True, jax.random.PRNGKey(0))
+            if cdtype is not None:
+                # bf16 compute / f32 master weights: grads arrive f32
+                # through the cast's vjp (same scheme as the drivers'
+                # set_compute_dtype)
+                pp_c = jax.tree_util.tree_map(
+                    lambda a: a.astype(cdtype)
+                    if a.dtype == jnp.float32 else a, pp)
+            else:
+                pp_c = pp
+            out, nb = model.apply_fn(pp_c, b, xx, True,
+                                     jax.random.PRNGKey(0))
             return criterion._loss(jnp.asarray(out, jnp.float32), yy), nb
 
         (loss, nb), grads = jax.value_and_grad(loss_fn, has_aux=True)(p)
@@ -84,24 +94,25 @@ def performance(model_name: str, batch_size: int, iterations: int,
         y = jax.device_put(y, xs)
         params = jax.device_put(params, rep)
         step = jax.jit(step, in_shardings=(rep, rep, rep, xs, xs),
-                       out_shardings=(rep, rep, rep, rep))
+                       out_shardings=(rep, rep, rep, rep),
+                       donate_argnums=(0, 1, 2))
     else:
-        step = jax.jit(step)
+        step = jax.jit(step, donate_argnums=(0, 1, 2))
 
     for _ in range(warmup):
         loss, params, buffers, slots = step(params, buffers, slots, x, y)
-    jax.block_until_ready(loss)
+    float(loss)  # value fetch = execution barrier (docs/PERF.md)
 
     times = []
     for i in range(iterations):
         t0 = time.perf_counter()
         loss, params, buffers, slots = step(params, buffers, slots, x, y)
-        jax.block_until_ready(loss)
+        loss_v = float(loss)  # value fetch = execution barrier
         dt = time.perf_counter() - t0
         times.append(dt)
         print(f"Iteration {i + 1} {model_name} batch {batch_size}: "
               f"{dt * 1000:.1f} ms, throughput {batch_size / dt:.2f} "
-              f"records/second, loss {float(loss):.4f}")
+              f"records/second, loss {loss_v:.4f}")
     avg = float(np.mean(times))
     print(f"Average throughput is {batch_size / avg:.2f} records/second "
           f"(avg iteration {avg * 1000:.1f} ms over {iterations} runs)")
@@ -122,6 +133,16 @@ def main(argv=None):
     parser.add_argument("--dtype", default="float32",
                         choices=("float32", "bfloat16"))
     args = parser.parse_args(argv)
+    # honor an explicit JAX_PLATFORMS env: the image preloads jax with
+    # its own platform setting before this CLI runs, so the env var
+    # alone is parsed too late without this
+    import os
+
+    import jax
+
+    want = os.environ.get("JAX_PLATFORMS")
+    if want and str(jax.config.jax_platforms or "") != want:
+        jax.config.update("jax_platforms", want)
     performance(args.model, args.batchSize, args.iteration, args.inputdata,
                 distributed=args.distributed, dtype=args.dtype)
 
