@@ -191,7 +191,8 @@ def bench_model(model, criterion, x, y, iters=20, warmup=3, lr=0.01,
     return x.shape[0] * iters * K / dt, flops
 
 
-def _bench_resnet(batch, iters, warmup, compute_dtype, rng, spd=1):
+def _bench_resnet(batch, iters, warmup, compute_dtype, rng, spd=1,
+                  stem="conv7"):
     import jax.numpy as jnp
     from bigdl_tpu import nn
     from bigdl_tpu.models.resnet import ResNet50
@@ -199,7 +200,8 @@ def _bench_resnet(batch, iters, warmup, compute_dtype, rng, spd=1):
     x = rng.rand(batch, 3, 224, 224).astype(
         "float32" if compute_dtype is None else str(jnp.dtype(compute_dtype)))
     y = rng.randint(1, 1001, batch).astype("float32")
-    ips, flops = bench_model(ResNet50(1000), nn.ClassNLLCriterion(), x, y,
+    ips, flops = bench_model(ResNet50(1000, stem=stem),
+                             nn.ClassNLLCriterion(), x, y,
                              iters=iters, warmup=warmup,
                              compute_dtype=compute_dtype,
                              steps_per_dispatch=spd)
@@ -229,14 +231,15 @@ def _bench_transformer_lm(rng, iters=16, spd=2):
     return tokens_per_sec, 6.0 * n_params * tokens_per_sec
 
 
-def _bench_resnet_adaptive(batch, iters, warmup, compute_dtype, rng, spd=1):
+def _bench_resnet_adaptive(batch, iters, warmup, compute_dtype, rng, spd=1,
+                           stem="conv7"):
     """Halve the batch on OOM/compile failure down to 4 — the TPU chip
     behind the tunnel has unknown HBM; never die on a size guess."""
     last_err = None
     while batch >= 4:
         try:
             ips, flops = _bench_resnet(batch, iters, warmup, compute_dtype,
-                                       rng, spd=spd)
+                                       rng, spd=spd, stem=stem)
             return ips, flops, batch, None
         except Exception as e:  # RESOURCE_EXHAUSTED etc.
             last_err = f"{type(e).__name__}: {e}"
@@ -244,7 +247,8 @@ def _bench_resnet_adaptive(batch, iters, warmup, compute_dtype, rng, spd=1):
     return None, None, None, last_err
 
 
-def _bench_resnet_sweep(batches, iters, warmup, compute_dtype, rng, spd=1):
+def _bench_resnet_sweep(batches, iters, warmup, compute_dtype, rng, spd=1,
+                        stem="conv7"):
     """Sweep batch size UP to the HBM limit and keep the best throughput
     (VERDICT r2 weak #2: a pinned small batch under-utilizes the chip).
     Returns (best_ips, xla_flops, best_batch, err, sweep_dict)."""
@@ -254,7 +258,7 @@ def _bench_resnet_sweep(batches, iters, warmup, compute_dtype, rng, spd=1):
     for b in batches:
         try:
             ips, flops = _bench_resnet(b, iters, warmup, compute_dtype, rng,
-                                       spd=spd)
+                                       spd=spd, stem=stem)
             sweep[str(b)] = round(ips, 2)
             if best[0] is None or ips > best[0]:
                 best = (ips, flops, b)
@@ -263,7 +267,7 @@ def _bench_resnet_sweep(batches, iters, warmup, compute_dtype, rng, spd=1):
             break
     if best[0] is None:
         ips, flops, b, err = _bench_resnet_adaptive(
-            batches[0], iters, warmup, compute_dtype, rng, spd=spd)
+            batches[0], iters, warmup, compute_dtype, rng, spd=spd, stem=stem)
         return ips, flops, b, err or last_err, sweep
     return best[0], best[1], best[2], None, sweep
 
@@ -315,9 +319,35 @@ def run_worker(backend: str) -> None:
         f32_ips, f32_flops, f32_batch, f32_err = _bench_resnet_adaptive(
             4, 2, 1, None, rng)
 
+    # Space-to-depth stem: the SAME network function (exactness pinned in
+    # tests/test_resnet_s2d.py) with the MXU-starved 7x7x3 stem conv
+    # rewritten as 4x4x12 — measure at the best dense-stem batch and
+    # take it as headline when faster.
+    s2d_ips = None
+    if on_tpu and bf16_ips:
+        try:
+            s2d_ips, s2d_flops, s2d_batch, s2d_err, s2d_sweep = \
+                _bench_resnet_sweep((64, 128, 256), 20, 5, jnp.bfloat16,
+                                    rng, spd=4, stem="s2d")
+            if s2d_sweep:
+                out["resnet50_s2d_batch_sweep"] = s2d_sweep
+            if s2d_ips:
+                out["resnet50_s2d_images_per_sec_per_chip"] = round(
+                    s2d_ips, 2)
+                out["resnet50_s2d_batch"] = s2d_batch
+            elif s2d_err:
+                out["resnet50_s2d_error"] = s2d_err
+        except Exception as e:
+            out["resnet50_s2d_error"] = f"{type(e).__name__}: {e}"[:300]
+
     head_ips = bf16_ips if bf16_ips else f32_ips
     head_flops = bf16_flops if bf16_ips else f32_flops
     head_batch = bf16_batch if bf16_ips else f32_batch
+    if bf16_ips or f32_ips:
+        out["resnet50_headline_stem"] = "conv7"
+    if s2d_ips and head_ips and s2d_ips > head_ips:
+        head_ips, head_flops = s2d_ips, s2d_flops
+        out["resnet50_headline_stem"] = "s2d"
     if f32_ips:
         out["resnet50_images_per_sec_per_chip"] = round(f32_ips, 2)
         out["resnet50_batch"] = f32_batch
@@ -363,9 +393,15 @@ def run_worker(backend: str) -> None:
         x_rnn = np.eye(V, dtype=np.float32)[seq[:, :-1]]
         y_rnn = (seq[:, 1:] + 1).astype(np.float32)
         rnn_crit = nn.TimeDistributedCriterion(nn.ClassNLLCriterion(), True)
+        # batch-12 steps are ~1 ms of compute; over the tunnel the ~5 ms
+        # dispatch round-trip dominates — chain steps per dispatch, as
+        # for ResNet/LM above (steps still run back-to-back on-device)
+        rnn_spd = 32 if on_tpu else 1
         rnn_rps, _ = bench_model(SimpleRNN(V, H, V), rnn_crit, x_rnn, y_rnn,
-                                 iters=20 if on_tpu else 10)
+                                 iters=64 if on_tpu else 10,
+                                 steps_per_dispatch=rnn_spd)
         out["simplernn_records_per_sec"] = round(rnn_rps, 2)
+        out["simplernn_steps_per_dispatch"] = rnn_spd
     except Exception as e:
         rnn_rps = None
         out["simplernn_error"] = f"{type(e).__name__}: {e}"
@@ -375,9 +411,12 @@ def run_worker(backend: str) -> None:
         B_l = 256
         x_len = rng.rand(B_l, 784).astype(np.float32)
         y_len = rng.randint(1, 11, B_l).astype(np.float32)
+        lenet_spd = 32 if on_tpu else 1
         lenet_ips, _ = bench_model(LeNet5(10), nn.ClassNLLCriterion(),
-                                   x_len, y_len, iters=20 if on_tpu else 10)
+                                   x_len, y_len, iters=64 if on_tpu else 10,
+                                   steps_per_dispatch=lenet_spd)
         out["lenet5_images_per_sec"] = round(lenet_ips, 2)
+        out["lenet5_steps_per_dispatch"] = lenet_spd
     except Exception as e:
         out["lenet5_error"] = f"{type(e).__name__}: {e}"
 

@@ -8,7 +8,15 @@ compiler's liveness analysis, so those knobs vanish by design.
 """
 from __future__ import annotations
 
+import functools
+
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
 from .. import nn
+from ..nn.conv import _acc_dtype
+from ..nn.initialization import ONE_D, OUT_IN_KW_KH, RandomUniform
 
 
 def _shortcut(n_in: int, n_out: int, stride: int, shortcut_type: str):
@@ -84,13 +92,119 @@ def ResNetCifar(depth: int = 20, class_num: int = 10,
     return model
 
 
-def ResNet50(class_num: int = 1000, shortcut_type: str = "B") -> nn.Sequential:
+class SpaceToDepthStem(nn.TensorModule):
+    """The ImageNet stem's 7x7/stride-2 conv rewritten EXACTLY as a
+    4x4/stride-1 conv over space-to-depth(2) input.
+
+    The 7x7 conv reads 3 input channels — the MXU's 128-wide reduction
+    lanes run 97% empty on the contraction (7*7*3 = 147 taps scattered
+    over strided spatial loads).  Space-to-depth with block 2 folds the
+    stride into the layout: input (B,3,H,W) -> (B,12,H/2,W/2), and the
+    7x7/s2 kernel becomes a dense 4x4/s1 kernel over 12 channels with
+    asymmetric (2,1) padding.  Output is bit-for-bit the same function
+    (weight remap in :meth:`weight_from_conv7`; exactness asserted in
+    tests/test_resnet_s2d.py).  Standard TPU trick (MLPerf ResNet).
+    """
+
+    def __init__(self, n_output_plane: int = 64):
+        super().__init__()
+        self.n_output_plane = n_output_plane
+        self.reset()
+
+    @staticmethod
+    @functools.lru_cache(maxsize=None)
+    def _valid_tap_mask():
+        """1.0 where the (12, 4, 4) tap maps to a real 7x7 tap, 0.0 for
+        the taps the conv7 bijection requires to be zero (kh or kw
+        outside [0, 7)) — derived from the remap itself so the two can
+        never desynchronize.  Cached: it is a constant."""
+        ones = SpaceToDepthStem.weight_from_conv7(np.ones((1, 3, 7, 7)))
+        return (ones[0] != 0).astype(jnp.float32)
+
+    def reset(self):
+        w_init = self._init_methods.get("weight", (RandomUniform(), None))[0]
+        # zero the out-of-window taps so a fresh s2d stem stays inside
+        # the 7x7-conv function family (and remains convertible back)
+        self._register_param(
+            "weight", w_init.init((self.n_output_plane, 12, 4, 4),
+                                  OUT_IN_KW_KH) * self._valid_tap_mask())
+        b_init = self._init_methods.get("bias", (RandomUniform(), None))[0]
+        self._register_param("bias",
+                             b_init.init((self.n_output_plane,), ONE_D))
+        return self
+
+    @staticmethod
+    def weight_from_conv7(w7):
+        """Remap a standard (O,3,7,7) stem weight to the equivalent
+        (O,12,4,4) s2d weight: output(oi,oj) of the 7x7/s2 conv sums
+        x[c, 2*oi+kh-3, 2*oj+kw-3]; writing the input pixel in s2d
+        coordinates (i, di) with kh = 2m+di-1 (m the 4-tap kernel index,
+        di the intra-block offset) gives W[o, (c*2+di)*2+dj, m, n] =
+        W7[o, c, 2m+di-1, 2n+dj-1], zero where the 7x7 index falls
+        outside [0, 7).  The result keeps w7's dtype."""
+        in_dtype = jnp.asarray(w7).dtype
+        w7 = np.asarray(w7, np.float32)
+        o = w7.shape[0]
+        ws = np.zeros((o, 3, 2, 2, 4, 4), np.float32)
+        for m in range(4):
+            for di in range(2):
+                kh = 2 * m + di - 1
+                if not 0 <= kh < 7:
+                    continue
+                for n in range(4):
+                    for dj in range(2):
+                        kw = 2 * n + dj - 1
+                        if not 0 <= kw < 7:
+                            continue
+                        ws[:, :, di, dj, m, n] = w7[:, :, kh, kw]
+        return jnp.asarray(ws.reshape(o, 12, 4, 4), in_dtype)
+
+    def _apply(self, params, buffers, x, training, rng):
+        squeeze = x.ndim == 3
+        if squeeze:
+            x = x[None]
+        b, c, h, w = x.shape
+        if h % 2 or w % 2:
+            raise ValueError(
+                f"SpaceToDepthStem needs even spatial dims, got {(h, w)}; "
+                "use the conv7 stem (or pad) for odd inputs")
+        xs = x.reshape(b, c, h // 2, 2, w // 2, 2)
+        xs = xs.transpose(0, 1, 3, 5, 2, 4).reshape(b, c * 4, h // 2, w // 2)
+        # mask inside the traced fn: the invalid taps contribute nothing
+        # AND receive zero gradient, so training never drifts out of the
+        # 7x7-conv function family (the multiply is 12x4x4 — negligible,
+        # and the backward masking is exactly the point)
+        wt = params["weight"]
+        wt = wt * self._valid_tap_mask().astype(wt.dtype)
+        xs = xs.astype(wt.dtype)
+        y = lax.conv_general_dilated(
+            xs, wt, window_strides=(1, 1),
+            padding=[(2, 1), (2, 1)],
+            dimension_numbers=("NCHW", "OIHW", "NCHW"),
+            preferred_element_type=_acc_dtype(xs),
+        ).astype(wt.dtype)
+        y = y + params["bias"][None, :, None, None]
+        if squeeze:
+            y = y[0]
+        return y, buffers
+
+
+def ResNet50(class_num: int = 1000, shortcut_type: str = "B",
+             stem: str = "conv7") -> nn.Sequential:
     """ImageNet ResNet-50 (reference ResNet.scala imagenet path) — the
-    north-star benchmark model (BASELINE.md)."""
+    north-star benchmark model (BASELINE.md).
+
+    ``stem="s2d"`` swaps the 7x7/s2 first conv for the mathematically
+    identical :class:`SpaceToDepthStem` (better MXU utilization on TPU);
+    ``weight_from_conv7`` converts checkpoints between the two."""
     cfg = [(64, 256, 3, 1), (128, 512, 4, 2), (256, 1024, 6, 2),
            (512, 2048, 3, 2)]
+    if stem not in ("conv7", "s2d"):
+        raise ValueError(f"stem must be 'conv7' or 's2d', got {stem!r}")
+    first = (SpaceToDepthStem(64) if stem == "s2d"
+             else nn.SpatialConvolution(3, 64, 7, 7, 2, 2, 3, 3))
     model = nn.Sequential(
-        nn.SpatialConvolution(3, 64, 7, 7, 2, 2, 3, 3),
+        first,
         nn.SpatialBatchNormalization(64),
         nn.ReLU(True),
         nn.SpatialMaxPooling(3, 3, 2, 2, 1, 1))

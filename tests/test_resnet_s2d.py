@@ -1,0 +1,103 @@
+"""Space-to-depth ResNet stem: exact equivalence with the 7x7/s2 conv.
+
+The s2d stem is a pure performance rewrite (models/resnet.py
+SpaceToDepthStem) — same function, MXU-friendly layout.  These tests pin
+the math: remapped weights must reproduce the standard stem bit-for-bit
+(f32 tolerance), and the full ResNet-50 s2d variant must run a train
+step.  Reference model: models/resnet/ResNet.scala imagenet path.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from bigdl_tpu import nn
+from bigdl_tpu.models.resnet import ResNet50, SpaceToDepthStem
+
+
+def test_s2d_stem_matches_conv7_exactly():
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randn(2, 3, 64, 64).astype(np.float32))
+
+    conv7 = nn.SpatialConvolution(3, 64, 7, 7, 2, 2, 3, 3)
+    s2d = SpaceToDepthStem(64)
+    s2d.params["weight"] = SpaceToDepthStem.weight_from_conv7(
+        conv7.params["weight"])
+    s2d.params["bias"] = conv7.params["bias"]
+
+    ref, _ = conv7.apply_fn(conv7.param_tree(), conv7.buffer_tree(), x, False,
+                            None)
+    got, _ = s2d.apply_fn(s2d.param_tree(), s2d.buffer_tree(), x, False, None)
+    assert got.shape == ref.shape == (2, 64, 32, 32)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref), rtol=1e-5,
+                               atol=1e-5)
+
+
+def test_s2d_stem_odd_border_taps():
+    # the remap zeroes kernel taps that fall outside the 7x7 window —
+    # exercise inputs whose border pixels hit exactly those taps
+    rng = np.random.RandomState(1)
+    x = jnp.asarray(rng.randn(1, 3, 32, 32).astype(np.float32))
+    conv7 = nn.SpatialConvolution(3, 8, 7, 7, 2, 2, 3, 3)
+    s2d = SpaceToDepthStem(8)
+    s2d.params["weight"] = SpaceToDepthStem.weight_from_conv7(
+        conv7.params["weight"])
+    s2d.params["bias"] = conv7.params["bias"]
+    ref, _ = conv7.apply_fn(conv7.param_tree(), conv7.buffer_tree(), x, False,
+                            None)
+    got, _ = s2d.apply_fn(s2d.param_tree(), s2d.buffer_tree(), x, False, None)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref), rtol=1e-5,
+                               atol=1e-5)
+
+
+def test_s2d_masked_taps_frozen_under_training():
+    # the 7x7 bijection leaves 45 of the 192 s2d taps out-of-window;
+    # they must contribute nothing AND receive zero gradient, or one SGD
+    # step drifts the stem out of the conv7 function family
+    rng = np.random.RandomState(3)
+    s2d = SpaceToDepthStem(8)
+    x = jnp.asarray(rng.randn(2, 3, 16, 16).astype(np.float32))
+    mask = np.asarray(SpaceToDepthStem._valid_tap_mask())
+
+    def loss(p):
+        y, _ = s2d.apply_fn(p, {}, x, True, None)
+        return jnp.sum(y * y)
+
+    g = jax.grad(loss)(s2d.param_tree())
+    assert np.all(np.asarray(g["weight"]) * (1.0 - mask) == 0.0)
+    # dirty out-of-window taps (a foreign checkpoint) must not change
+    # the computed function
+    y0, _ = s2d.apply_fn(s2d.param_tree(), {}, x, False, None)
+    dirty = dict(s2d.param_tree())
+    dirty["weight"] = dirty["weight"] + 7.0 * (1.0 - mask)
+    y1, _ = s2d.apply_fn(dirty, {}, x, False, None)
+    np.testing.assert_allclose(np.asarray(y0), np.asarray(y1), rtol=1e-6)
+
+
+def test_weight_from_conv7_keeps_dtype():
+    w7 = jnp.ones((4, 3, 7, 7), jnp.bfloat16)
+    ws = SpaceToDepthStem.weight_from_conv7(w7)
+    assert ws.dtype == jnp.bfloat16 and ws.shape == (4, 12, 4, 4)
+
+
+def test_resnet50_stem_arg_validated():
+    with pytest.raises(ValueError):
+        ResNet50(10, stem="S2D")
+
+
+def test_resnet50_s2d_forward_and_train_step():
+    model = ResNet50(10, stem="s2d")
+    crit = nn.ClassNLLCriterion()
+    rng = np.random.RandomState(2)
+    x = jnp.asarray(rng.rand(1, 3, 224, 224).astype(np.float32))
+    y = jnp.ones((1,), jnp.float32)
+    params, buffers = model.param_tree(), model.buffer_tree()
+
+    def loss_fn(p):
+        out, nb = model.apply_fn(p, buffers, x, True, jax.random.PRNGKey(0))
+        return crit._loss(out, y), nb
+
+    (loss, _), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
+    assert np.isfinite(float(loss))
+    gleaf = jax.tree_util.tree_leaves(grads)
+    assert gleaf and all(np.all(np.isfinite(np.asarray(g))) for g in gleaf)
