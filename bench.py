@@ -67,8 +67,13 @@ PEAK_FLOPS_TABLE = (
 )
 
 PROBE_TIMEOUT = float(os.environ.get("BENCH_PROBE_TIMEOUT", "300"))
-TPU_TIMEOUT = float(os.environ.get("BENCH_TPU_TIMEOUT", "1800"))
+TPU_TIMEOUT = float(os.environ.get("BENCH_TPU_TIMEOUT", "2700"))
 CPU_TIMEOUT = float(os.environ.get("BENCH_CPU_TIMEOUT", "1500"))
+# soft budget INSIDE the worker: optional extras (s2d sweep, long-seq
+# LM) are skipped past these fractions of it, so a slow tunnel degrades
+# the run to fewer metrics instead of tripping the hard subprocess
+# timeout and losing the whole TPU result
+WORKER_BUDGET = float(os.environ.get("BENCH_WORKER_BUDGET", "1800"))
 
 
 def peak_flops_per_sec(device_kind: str):
@@ -208,7 +213,7 @@ def _bench_resnet(batch, iters, warmup, compute_dtype, rng, spd=1,
     return ips, flops
 
 
-def _bench_transformer_lm(rng, iters=16, spd=2):
+def _bench_transformer_lm(rng, iters=16, spd=2, seq_len=1024, batch=16):
     """Flagship LM: flash attention + fused xent, bf16.  Returns
     (tokens_per_sec, model_flops_per_sec) with the standard 6ND count."""
     import jax
@@ -216,7 +221,7 @@ def _bench_transformer_lm(rng, iters=16, spd=2):
     from bigdl_tpu import nn
     from bigdl_tpu.models.transformer import TransformerLM
 
-    V, D, L, T, B = 32000, 1024, 8, 1024, 16
+    V, D, L, T, B = 32000, 1024, 8, seq_len, batch
     model = TransformerLM(V, embed_dim=D, num_heads=16, num_layers=L,
                           max_len=T, seq_strategy="flash", output="logits")
     crit = nn.TimeDistributedCriterion(nn.CrossEntropyCriterion(), True)
@@ -290,6 +295,11 @@ def run_worker(backend: str) -> None:
 
     set_global_seed(42)
     rng = np.random.RandomState(0)
+    t_worker = time.time()
+
+    def over_budget(frac):
+        return time.time() - t_worker > WORKER_BUDGET * frac
+
     dev = jax.devices()[0]
     device_kind = getattr(dev, "device_kind", "") or str(dev)
     on_tpu = dev.platform != "cpu"
@@ -321,10 +331,15 @@ def run_worker(backend: str) -> None:
 
     # Space-to-depth stem: the SAME network function (exactness pinned in
     # tests/test_resnet_s2d.py) with the MXU-starved 7x7x3 stem conv
-    # rewritten as 4x4x12 — measure at the best dense-stem batch and
-    # take it as headline when faster.
+    # rewritten as 4x4x12 — swept over the same batches as the dense stem
+    # (a fair optimum-vs-optimum comparison; the memory layouts differ,
+    # so their best batches can too) and taken as headline when faster.
+    # The worker-budget guard above absorbs the extra sweep time on a
+    # slow tunnel.
     s2d_ips = None
-    if on_tpu and bf16_ips:
+    if on_tpu and bf16_ips and over_budget(0.45):
+        out["resnet50_s2d_skipped"] = "worker time budget"
+    elif on_tpu and bf16_ips:
         try:
             s2d_ips, s2d_flops, s2d_batch, s2d_err, s2d_sweep = \
                 _bench_resnet_sweep((64, 128, 256), 20, 5, jnp.bfloat16,
@@ -385,6 +400,20 @@ def run_worker(backend: str) -> None:
                 out["transformerlm_mfu"] = round(lm_fps / peak, 4)
         except Exception as e:
             out["transformerlm_error"] = f"{type(e).__name__}: {e}"[:300]
+        # long-context: same model at T=4096 (dense attention OOMs here;
+        # the flash kernels' O(T*block) memory is what makes it run)
+        if over_budget(0.75):
+            out["transformerlm_T4096_skipped"] = "worker time budget"
+        else:
+            try:
+                long_tps, long_fps = _bench_transformer_lm(
+                    rng, iters=8, spd=2, seq_len=4096, batch=4)
+                out["transformerlm_T4096_tokens_per_sec"] = round(long_tps, 1)
+                if peak:
+                    out["transformerlm_T4096_mfu"] = round(long_fps / peak, 4)
+            except Exception as e:
+                out["transformerlm_T4096_error"] = \
+                    f"{type(e).__name__}: {e}"[:300]
 
     # --- SimpleRNN: the reference's published workload (batch 12) -------
     try:

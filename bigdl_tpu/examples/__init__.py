@@ -6,8 +6,23 @@ pipelines, TF load/save, image prediction, train-to-accuracy proofs."""
 def default_to_cpu():
     """Examples run hermetically on CPU unless the user pins a platform:
     the image preloads jax with the (flaky, slow-to-init) tunneled TPU
-    backend, which would stall a demo — override before first use."""
+    backend, which would stall a demo — override before first use.
+
+    Set ``bigdl.examples.platform`` (env ``BIGDL_EXAMPLES_PLATFORM``) to
+    ``device`` to keep the preloaded accelerator backend and run the
+    example on real hardware."""
+    import warnings
+
     import jax
 
+    from ..utils.engine import get_property
+
+    val = get_property("bigdl.examples.platform", "cpu")
+    if val == "device":
+        return
+    if val != "cpu":
+        warnings.warn(
+            f"bigdl.examples.platform={val!r} not recognized (use 'cpu' or "
+            "'device'); falling back to the hermetic CPU default")
     if jax.config.jax_platforms and "axon" in str(jax.config.jax_platforms):
         jax.config.update("jax_platforms", "cpu")
