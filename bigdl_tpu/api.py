@@ -73,6 +73,25 @@ def __getattr__(attr: str):
     raise AttributeError(attr)
 
 
+def createModel(inputs, outputs):
+    """Graph model from input/output nodes (PythonBigDL.scala:1681)."""
+    return nn.Model(list(inputs), list(outputs))
+
+
+def createNode(module, x=None):
+    """Wire a module into the graph: ``module.inputs(*x)``
+    (PythonBigDL.scala:1685-1691)."""
+    return module.inputs(*(x or []))
+
+
+def createInput():
+    """Free-standing graph input node (PythonBigDL.scala:1694)."""
+    return nn.Input()
+
+
+create_model, create_node, create_input = createModel, createNode, createInput
+
+
 # ----------------------------------------------------------------- model verbs
 def model_forward(model, inp):
     """PythonBigDL.modelForward (:1421)."""

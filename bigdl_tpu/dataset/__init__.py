@@ -11,3 +11,15 @@ from .ingest import (
     write_seq_files,
 )
 from . import datasets, image, ingest, text
+
+
+class DataSet:
+    """Factory namespace matching the reference ``DataSet`` object
+    (dataset/DataSet.scala:319-557: array/rdd/ImageFolder/SeqFileFolder);
+    the free functions above are the primary API, this mirrors the
+    reference spelling."""
+
+    array = staticmethod(array)
+    rdd = staticmethod(rdd)
+    ImageFolder = staticmethod(image_folder)
+    SeqFileFolder = SeqFileFolder

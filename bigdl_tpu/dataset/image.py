@@ -188,3 +188,142 @@ class MTLabeledImgToBatch(Transformer):
     def _make(self, native, MiniBatch, buf, labels):
         batch = native.batch_images(np.stack(buf), self.mean, self.std)
         return MiniBatch(batch, np.asarray(labels, np.float32))
+
+
+class BGRImgPixelNormalizer(Transformer):
+    """Subtract a full per-pixel mean image (reference
+    dataset/image/BGRImgPixelNormalizer.scala: content - means,
+    elementwise over the whole H*W*3 buffer)."""
+
+    def __init__(self, means):
+        self.means = np.asarray(means, np.float32)
+
+    def apply(self, it):
+        for img, label in it:
+            img = np.asarray(img, np.float32)
+            if img.size != self.means.size:
+                raise ValueError(
+                    f"mean image has {self.means.size} values, image has "
+                    f"{img.size}")
+            yield img - self.means.reshape(img.shape), label
+
+
+class BytesToBGRImg(Transformer):
+    """(bytes, label) record → (HWC BGR float image, label).  Record
+    layout per the reference (BytesToBGRImg.scala:33): 4-byte big-endian
+    width, 4-byte big-endian height, then H*W*3 BGR pixel bytes; pixels
+    are divided by ``normalize``."""
+
+    def __init__(self, normalize: float = 255.0):
+        self.normalize = float(normalize)
+
+    def apply(self, it):
+        for data, label in it:
+            w = int.from_bytes(data[0:4], "big")
+            h = int.from_bytes(data[4:8], "big")
+            px = np.frombuffer(data, np.uint8, h * w * 3, offset=8)
+            img = px.reshape(h, w, 3).astype(np.float32) / self.normalize
+            yield img, label
+
+
+class BytesToGreyImg(Transformer):
+    """(bytes, label) → (row x col grey float image /255, label)
+    (reference BytesToGreyImg.scala:33; MNIST idx pixel payload)."""
+
+    def __init__(self, row: int, col: int):
+        self.row, self.col = row, col
+
+    def apply(self, it):
+        for data, label in it:
+            px = np.frombuffer(data, np.uint8)
+            if px.size != self.row * self.col:
+                raise ValueError(
+                    f"record has {px.size} bytes, expected "
+                    f"{self.row}x{self.col}")
+            yield (px.reshape(self.row, self.col).astype(np.float32)
+                   / 255.0), label
+
+
+class GreyImgCropper(BGRImgCropper):
+    """Random crop on (H, W) grey images (reference GreyImgCropper.scala)
+    — the crop body is dimension-agnostic, so the BGR cropper serves."""
+
+
+class GreyImgToBatch(Transformer):
+    """Grey image stream → MiniBatch stream with (B, H, W) features
+    (reference GreyImgToBatch.scala:36; trailing partial batch kept)."""
+
+    def __init__(self, batch_size: int):
+        self.batch_size = batch_size
+
+    def _stack(self, imgs, labels):
+        from .sample import MiniBatch
+
+        return MiniBatch(np.stack(imgs).astype(np.float32),
+                         np.asarray(labels, np.float32))
+
+    def apply(self, it):
+        imgs, labels = [], []
+        for img, label in it:
+            imgs.append(np.asarray(img, np.float32))
+            labels.append(np.float32(label))
+            if len(imgs) == self.batch_size:
+                yield self._stack(imgs, labels)
+                imgs, labels = [], []
+        if imgs:
+            yield self._stack(imgs, labels)
+
+
+class BGRImgToBatch(GreyImgToBatch):
+    """HWC BGR image stream → MiniBatch stream with (B, 3, H, W) CHW
+    features (reference BGRImgToBatch.scala)."""
+
+    def _stack(self, imgs, labels):
+        from .sample import MiniBatch
+
+        feat = np.stack(imgs).astype(np.float32).transpose(0, 3, 1, 2)
+        return MiniBatch(feat, np.asarray(labels, np.float32))
+
+
+class LocalImgReader(Transformer):
+    """(path, label) → (HWC BGR float image / normalize, label).
+    ``scale_to`` resizes the shorter edge (aspect preserved, reference
+    LocalScaleImgReader); ``resize_w``/``resize_h`` force both edges
+    (reference LocalResizeImgReader).  Uses PIL, as the seq-file ingest
+    already does (ingest.py)."""
+
+    NO_SCALE = -1
+
+    def __init__(self, scale_to: int = NO_SCALE, normalize: float = 255.0,
+                 resize_w: Optional[int] = None,
+                 resize_h: Optional[int] = None):
+        self.scale_to = scale_to
+        self.normalize = float(normalize)
+        self.resize_w, self.resize_h = resize_w, resize_h
+
+    def _load(self, path):
+        from PIL import Image
+
+        im = Image.open(path).convert("RGB")
+        if self.resize_w is not None and self.resize_h is not None:
+            im = im.resize((self.resize_w, self.resize_h), Image.BILINEAR)
+        elif self.scale_to != self.NO_SCALE:
+            w, h = im.size
+            if w < h:
+                im = im.resize(
+                    (self.scale_to, max(1, h * self.scale_to // w)),
+                    Image.BILINEAR)
+            else:
+                im = im.resize(
+                    (max(1, w * self.scale_to // h), self.scale_to),
+                    Image.BILINEAR)
+        rgb = np.asarray(im, np.float32)
+        return rgb[:, :, ::-1] / self.normalize  # BGR, like the reference
+
+    def apply(self, it):
+        for path, label in it:
+            yield self._load(path), label
+
+
+# reference class name (dataset/image/MTLabeledBGRImgToBatch.scala:46)
+MTLabeledBGRImgToBatch = MTLabeledImgToBatch

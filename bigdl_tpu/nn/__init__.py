@@ -21,8 +21,8 @@ from .linear import (
 from .activations import (
     Abs, Clamp, ELU, Exp, HardShrink, HardTanh, LeakyReLU, Log, LogSigmoid,
     LogSoftMax, Max, Mean, Min, Power, PReLU, ReLU, ReLU6, RReLU, Sigmoid,
-    SoftMax, SoftMin, SoftPlus, SoftShrink, SoftSign, Sqrt, Square, Tanh,
-    TanhShrink, Threshold,
+    SoftMax, SoftMin, SoftPlus, SoftShrink, SoftSign, Sqrt, Square, Sum,
+    Tanh, TanhShrink, Threshold,
 )
 from .conv import (
     SpatialConvolution, SpatialConvolutionMap, SpatialDilatedConvolution,

@@ -246,6 +246,43 @@ class Mean(TensorModule):
         return jnp.mean(x, axis=self._axis(x), keepdims=not self.squeeze), buffers
 
 
+class Sum(TensorModule):
+    """Sum over a (1-based) dim (reference nn/Sum.scala:44): negative
+    dims count from the end, ``n_input_dims`` marks batch mode (one
+    extra leading dim shifts the axis), ``size_average`` divides by the
+    reduced extent, ``squeeze`` drops the reduced dim."""
+
+    def __init__(self, dimension: int = 1, n_input_dims: int = -1,
+                 size_average: bool = False, squeeze: bool = True):
+        super().__init__()
+        self.dimension = dimension
+        self.n_input_dims = n_input_dims
+        self.size_average = size_average
+        self.squeeze = squeeze
+
+    def _axis(self, x):
+        # the reference resolves a negative dim and THEN applies the
+        # batch shift (two sequential ifs, Sum.scala getPositiveDimension)
+        # — the combination can run past the rank, and then it raises
+        # there too (its require(input.dim() >= dimension))
+        d = self.dimension
+        if d < 0:
+            d = x.ndim + d + 1
+        if self.n_input_dims > 0 and x.ndim == self.n_input_dims + 1:
+            d += 1
+        if not 1 <= d <= x.ndim:
+            raise ValueError(
+                f"Sum dimension {self.dimension} exceeds input rank {x.ndim}")
+        return d - 1
+
+    def _apply(self, params, buffers, x, training, rng):
+        axis = self._axis(x)
+        y = jnp.sum(x, axis=axis, keepdims=not (self.squeeze and x.ndim > 1))
+        if self.size_average:
+            y = y / x.shape[axis]
+        return y, buffers
+
+
 class Max(TensorModule):
     def __init__(self, dim: int = 1, num_input_dims: int = -1):
         super().__init__()

@@ -14,5 +14,6 @@ from .validation import (
 from .regularizer import L1L2Regularizer, L1Regularizer, L2Regularizer, Regularizer
 from .metrics import Metrics
 from .optimizer import LocalOptimizer, Optimizer
+from .distri_optimizer import DistriOptimizer
 from .evaluator import DistriValidator, Evaluator, LocalValidator
-from .predictor import Predictor
+from .predictor import LocalPredictor, Predictor

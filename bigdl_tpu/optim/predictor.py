@@ -68,3 +68,12 @@ class Predictor:
     def predict_class(self, dataset, batch_size: int = 32) -> List[int]:
         """1-based argmax classes (reference predictClass)."""
         return [int(np.argmax(o)) + 1 for o in self.predict(dataset, batch_size)]
+
+
+class LocalPredictor(Predictor):
+    """Single-process predictor (reference optim/LocalPredictor.scala:37).
+    Local IS the base behavior without a mesh — same class split as
+    LocalValidator vs the Validator base."""
+
+    def __init__(self, model):
+        super().__init__(model, mesh=None)

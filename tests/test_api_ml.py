@@ -126,3 +126,22 @@ class TestPerfHarness:
 
         with pytest.raises(ValueError):
             build_model("alexnet")
+
+
+class TestGraphConstructionApi:
+    def test_model_node_input_trio(self):
+        # PythonBigDL.scala:1681-1695 createModel/createNode/createInput
+        import jax.numpy as jnp
+
+        inp = api.createInput()
+        h = api.createNode(api.createLinear(4, 3), [inp])
+        out = api.createNode(api.createReLU(), [h])
+        model = api.createModel([inp], [out])
+        y = model.forward(jnp.asarray(np.random.RandomState(0).rand(2, 4),
+                                      jnp.float32))
+        assert y.shape == (2, 3)
+        assert api.create_input is api.createInput  # snake aliases
+
+    def test_node_with_no_inputs_starts_free(self):
+        node = api.createNode(nn.Linear(2, 2))
+        assert node is not None

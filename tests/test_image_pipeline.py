@@ -1,0 +1,104 @@
+"""Image-pipeline transformer specs (reference dataset/image/*.scala) and
+the DataSet factory / LocalPredictor name-parity additions."""
+import numpy as np
+import pytest
+
+from bigdl_tpu import nn
+from bigdl_tpu.dataset import DataSet, Sample, array
+from bigdl_tpu.dataset.image import (
+    BGRImgPixelNormalizer, BGRImgToBatch, BytesToBGRImg, BytesToGreyImg,
+    GreyImgCropper, GreyImgToBatch, LocalImgReader, MTLabeledBGRImgToBatch,
+    MTLabeledImgToBatch,
+)
+from bigdl_tpu.optim import LocalPredictor, Predictor
+
+
+def test_bytes_to_bgr_img_decodes_header_and_normalizes():
+    # reference BytesToBGRImg.scala:33 — 4B BE width, 4B BE height, BGR bytes
+    h, w = 3, 2
+    px = np.arange(h * w * 3, dtype=np.uint8)
+    rec = w.to_bytes(4, "big") + h.to_bytes(4, "big") + px.tobytes()
+    (img, label), = list(BytesToBGRImg(normalize=255.0).apply(
+        iter([(rec, 5.0)])))
+    assert img.shape == (h, w, 3) and label == 5.0
+    np.testing.assert_allclose(img.ravel(), px.astype(np.float32) / 255.0)
+
+
+def test_bytes_to_grey_img():
+    px = np.arange(28 * 28, dtype=np.uint8)
+    (img, label), = list(BytesToGreyImg(28, 28).apply(
+        iter([(px.tobytes(), 1.0)])))
+    assert img.shape == (28, 28)
+    np.testing.assert_allclose(img, px.reshape(28, 28) / 255.0)
+    with pytest.raises(ValueError):
+        list(BytesToGreyImg(28, 28).apply(iter([(b"\x00" * 10, 1.0)])))
+
+
+def test_pixel_normalizer_subtracts_mean_image():
+    img = np.ones((4, 4, 3), np.float32)
+    means = np.full((4, 4, 3), 0.25, np.float32)
+    (out, _), = list(BGRImgPixelNormalizer(means).apply(iter([(img, 1.0)])))
+    np.testing.assert_allclose(out, 0.75)
+    with pytest.raises(ValueError):
+        list(BGRImgPixelNormalizer(np.zeros((2, 2, 3))).apply(
+            iter([(img, 1.0)])))
+
+
+def test_grey_cropper_shape():
+    img = np.random.RandomState(0).rand(10, 12).astype(np.float32)
+    (out, _), = list(GreyImgCropper(8, 6).apply(iter([(img, 1.0)])))
+    assert out.shape == (6, 8)
+
+
+def test_grey_and_bgr_to_batch_layouts():
+    greys = [(np.full((5, 6), i, np.float32), float(i)) for i in range(5)]
+    batches = list(GreyImgToBatch(2).apply(iter(greys)))
+    assert [b.size() for b in batches] == [2, 2, 1]  # trailing kept
+    assert batches[0].get_input().shape == (2, 5, 6)  # (B, H, W)
+
+    bgrs = [(np.full((5, 6, 3), i, np.float32), float(i)) for i in range(4)]
+    bb = list(BGRImgToBatch(2).apply(iter(bgrs)))
+    assert bb[0].get_input().shape == (2, 3, 5, 6)  # CHW
+    np.testing.assert_allclose(np.asarray(bb[1].get_target()), [2.0, 3.0])
+
+
+def test_local_img_reader_scale_and_resize(tmp_path):
+    from PIL import Image
+
+    p = tmp_path / "img.png"
+    rgb = np.zeros((8, 4, 3), np.uint8)
+    rgb[..., 0] = 255  # pure red
+    Image.fromarray(rgb).save(p)
+
+    # shorter-edge scaling preserves aspect (4,8) -> (6,12)
+    (img, label), = list(LocalImgReader(scale_to=6).apply(
+        iter([(str(p), 2.0)])))
+    assert img.shape == (12, 6, 3) and label == 2.0
+    # BGR order: red lands in the LAST channel
+    np.testing.assert_allclose(img[..., 2], 1.0)
+    np.testing.assert_allclose(img[..., 0], 0.0)
+
+    (img2, _), = list(LocalImgReader(resize_w=5, resize_h=7).apply(
+        iter([(str(p), 2.0)])))
+    assert img2.shape == (7, 5, 3)
+
+
+def test_mt_batcher_reference_alias():
+    assert MTLabeledBGRImgToBatch is MTLabeledImgToBatch
+
+
+def test_dataset_factory_namespace():
+    ds = DataSet.array([Sample(np.zeros(4, np.float32), 1.0)])
+    assert ds.size() == 1
+    assert DataSet.rdd and DataSet.ImageFolder and DataSet.SeqFileFolder
+
+
+def test_local_predictor_matches_predictor():
+    model = nn.Sequential(nn.Linear(4, 3), nn.LogSoftMax())
+    rng = np.random.RandomState(0)
+    samples = [Sample(rng.rand(4).astype(np.float32), 1.0) for _ in range(5)]
+    ds = array(samples)
+    base = Predictor(model).predict_class(ds, batch_size=2)
+    local = LocalPredictor(model).predict_class(ds, batch_size=2)
+    assert base == local and len(local) == 5
+    assert all(1 <= c <= 3 for c in local)

@@ -223,3 +223,37 @@ def test_gradient_scale():
     lin.backward(x, go)
     np.testing.assert_allclose(_np(lin.grads["weight"]), 0.5 * base_w, rtol=1e-6)
     np.testing.assert_allclose(_np(lin.grads["bias"]), 2.0 * base_b, rtol=1e-6)
+
+
+def test_sum():
+    # reference nn/Sum.scala:44 — dim sum with size_average/squeeze/
+    # batch-mode/negative-dim semantics
+    class TorchSum(torch.nn.Module):
+        def __init__(self, axis, avg):
+            super().__init__()
+            self.axis, self.avg = axis, avg
+
+        def forward(self, x):
+            y = x.sum(dim=self.axis)
+            return y / x.shape[self.axis] if self.avg else y
+
+    check_fwd_bwd(nn.Sum(1), TorchSum(0, False), X2)
+    check_fwd_bwd(nn.Sum(2), TorchSum(1, False), X2)
+    check_fwd_bwd(nn.Sum(2, size_average=True), TorchSum(1, True), X2)
+    check_fwd_bwd(nn.Sum(-1), TorchSum(-1, False), X4)
+    # batch mode: n_input_dims=1 on a (4, 6) batch sums dim 2
+    check_fwd_bwd(nn.Sum(1, n_input_dims=1), TorchSum(1, False), X2)
+    # squeeze=False keeps the reduced dim
+    y = nn.Sum(2, squeeze=False).forward(jnp.asarray(X2))
+    assert y.shape == (4, 1)
+    with pytest.raises(ValueError):
+        nn.Sum(3).forward(jnp.asarray(X2))
+
+
+def test_sum_negative_dim_plus_batch_mode_raises_like_reference():
+    # Sum.scala getPositiveDimension applies BOTH the negative-dim
+    # resolution and the batch shift sequentially; on a (4, 6) input
+    # Sum(-1, nInputDims=1) resolves to dim 3 > rank and its
+    # require(input.dim() >= dimension) throws — ours must too
+    with pytest.raises(ValueError):
+        nn.Sum(-1, n_input_dims=1).forward(jnp.asarray(X2))
