@@ -448,14 +448,23 @@ class SoftmaxWithCriterion(AbstractCriterion):
         self.normalize_mode = normalize_mode
 
     def _loss(self, inp, target):
-        # inp (N, C, H, W) or (N, C); target 1-based labels
+        # inp (N, C, H, W) or (N, C); target 1-based labels.  The
+        # reference reads the label storage FLAT (labelData(i*innerNum+j),
+        # SoftmaxWithCriterion.scala:64-72), so any target shape with
+        # N*H*W elements is legal — notably Caffe's (N, 1, H, W)
         logp = jax.nn.log_softmax(inp, axis=1)
-        t = target.astype(jnp.int32) - 1
+        # clamp the gather index: ignored labels (Caffe convention 255,
+        # usually >= C) must not poison the gather with NaN fills — the
+        # reference skips them before ever indexing
+        # (SoftmaxWithCriterion.scala:72-76); the mask below then zeroes
+        # the clamped picks
+        t = jnp.clip(target.astype(jnp.int32) - 1, 0, inp.shape[1] - 1)
         if inp.ndim == 2:
             picked = jnp.take_along_axis(logp, t.reshape(-1, 1), axis=1)[:, 0]
         else:
-            picked = jnp.take_along_axis(
-                logp, t.reshape(t.shape[0], 1, *t.shape[1:]), axis=1)[:, 0]
+            spatial = inp.shape[2:]
+            t = t.reshape(inp.shape[0], 1, *spatial)
+            picked = jnp.take_along_axis(logp, t, axis=1)[:, 0]
         if self.ignore_label is not None:
             mask = (target != self.ignore_label).astype(inp.dtype)
             mask = mask.reshape(picked.shape)
