@@ -1,0 +1,105 @@
+"""Reference-optimizer fixtures (SURVEY §4.4: RefLocalOptimizer /
+RefDistriOptimizer — naive known-good whole-gradient loops checked
+against the production drivers).
+
+The ref here is a hand-rolled training loop: full-batch gradient via
+jax.grad on the same pure apply, then an explicit numpy implementation
+of the SGD update (momentum + L2 weight decay + Step schedule) — no
+driver, no sharding, no jit caching.  Batch size == dataset size makes
+the comparison shuffle-invariant (a full-batch mean gradient does not
+depend on sample order)."""
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from bigdl_tpu import nn
+from bigdl_tpu.dataset import Sample
+from bigdl_tpu.dataset.dataset import array
+from bigdl_tpu.optim import SGD, Step, max_iteration
+from bigdl_tpu.optim.distri_optimizer import DistriOptimizer
+from bigdl_tpu.optim.optimizer import LocalOptimizer
+from bigdl_tpu.utils.rng import RNG
+
+N, LR, WD, MOM = 64, 0.2, 0.01, 0.9
+STEPS = 5
+
+
+def _samples():
+    rng = np.random.RandomState(11)
+    xs = rng.rand(N, 4).astype(np.float32)
+    ys = (1.0 + (xs.sum(axis=1) > 2.0)).astype(np.float32)  # 1-based
+    return [Sample(x, y) for x, y in zip(xs, ys)]
+
+
+def _model():
+    RNG().set_seed(3)
+    return nn.Sequential(nn.Linear(4, 8), nn.Tanh(), nn.Linear(8, 2),
+                         nn.LogSoftMax())
+
+
+@functools.lru_cache(maxsize=1)
+def _ref_weights():
+    """Naive loop: whole-batch grad + explicit SGD(momentum, L2, Step)."""
+    model = _model()
+    crit = nn.ClassNLLCriterion()
+    samples = _samples()
+    x = jnp.asarray(np.stack([np.asarray(s.feature) for s in samples]))
+    y = jnp.asarray(np.stack([np.asarray(s.label) for s in samples]))
+    params = model.param_tree()
+    buffers = model.buffer_tree()
+
+    def loss_fn(p):
+        out, _ = model.apply_fn(p, buffers, x, True, jax.random.PRNGKey(0))
+        return crit._loss(out, y)
+
+    flat_params = {k: np.asarray(v) for k, v in
+                   jax.tree_util.tree_leaves_with_path(params)}
+    vel = {k: np.zeros_like(v) for k, v in flat_params.items()}
+    for it in range(STEPS):
+        lr = LR * (0.5 ** (it // 2))  # Step(step_size=2, gamma=0.5)
+        grads = jax.grad(loss_fn)(params)
+        g_flat = {k: np.asarray(v) for k, v in
+                  jax.tree_util.tree_leaves_with_path(grads)}
+        for k in flat_params:
+            g = g_flat[k] + WD * flat_params[k]       # L2 weight decay
+            # dampening defaults to momentum (reference SGD.scala)
+            vel[k] = MOM * vel[k] + (1 - MOM) * g
+            flat_params[k] = flat_params[k] - lr * vel[k]
+        # rebuild the pytree for the next grad evaluation
+        leaves_keys = [k for k, _ in jax.tree_util.tree_leaves_with_path(params)]
+        params = jax.tree_util.tree_unflatten(
+            jax.tree_util.tree_structure(params),
+            [jnp.asarray(flat_params[k]) for k in leaves_keys])
+    return params
+
+
+def _driver_weights(driver_cls, **kw):
+    model = _model()
+    opt = driver_cls(model, array(_samples()), nn.ClassNLLCriterion(),
+                     batch_size=N, **kw)
+    opt.set_optim_method(
+        SGD(learning_rate=LR, momentum=MOM, weight_decay=WD, nesterov=False,
+            learning_rate_schedule=Step(2, 0.5)))
+    opt.set_end_when(max_iteration(STEPS))
+    opt.optimize()
+    return model.param_tree()
+
+
+def _assert_tree_close(a, b, atol):
+    la = jax.tree_util.tree_leaves(a)
+    lb = jax.tree_util.tree_leaves(b)
+    assert len(la) == len(lb)
+    for x, y in zip(la, lb):
+        np.testing.assert_allclose(np.asarray(x), np.asarray(y), atol=atol)
+
+
+def test_local_optimizer_matches_ref_fixture():
+    _assert_tree_close(_driver_weights(LocalOptimizer), _ref_weights(),
+                       atol=5e-5)
+
+
+def test_distri_optimizer_matches_ref_fixture():
+    _assert_tree_close(_driver_weights(DistriOptimizer), _ref_weights(),
+                       atol=5e-4)
