@@ -46,7 +46,8 @@ def make_dataset(n: int, seed: int):
     return samples
 
 
-def main(max_epoch_n: int = 25, target: float = 0.95) -> float:
+def main(max_epoch_n: int = 25, target: float = 0.95,
+         cell: str = "lstm") -> float:
     from . import default_to_cpu
 
     default_to_cpu()
@@ -62,7 +63,7 @@ def main(max_epoch_n: int = 25, target: float = 0.95) -> float:
     train, test = make_dataset(2000, seed=1), make_dataset(400, seed=2)
 
     model = LSTMClassifier(VOCAB, embed_dim=16, hidden=32,
-                           class_num=CLASSES)
+                           class_num=CLASSES, cell=cell)
     ckpt = tempfile.mkdtemp(prefix="lstm_text_")
     opt = LocalOptimizer(model, array(train), nn.ClassNLLCriterion(),
                          batch_size=100)
@@ -78,7 +79,7 @@ def main(max_epoch_n: int = 25, target: float = 0.95) -> float:
     result = LocalValidator(trained).test(array(test), [Top1Accuracy()],
                                           batch_size=100)
     acc = result[0][0].result()[0]
-    print(f"Final LSTM Top1Accuracy on held-out sequences: {acc:.4f} "
+    print(f"Final {cell.upper()} Top1Accuracy on held-out sequences: {acc:.4f} "
           f"(target {target}) over 400 samples")
 
     # restore-from-checkpoint exactness (same contract as the other proofs)

@@ -18,16 +18,23 @@ def SimpleRNN(input_size: int = 4001, hidden_size: int = 40,
 
 
 def LSTMClassifier(vocab_size: int, embed_dim: int, hidden: int,
-                   class_num: int, padding_value: int = 0) -> nn.Sequential:
+                   class_num: int, padding_value: int = 0,
+                   cell: str = "lstm") -> nn.Sequential:
     """LSTM/GRU text classification config (BASELINE.md workload 5).
 
     ``padding_value``: dedicated padding token id whose embedding rows
-    are zeroed (0 = no padding id)."""
-    from ..nn.recurrent import LSTM, Recurrent
+    are zeroed (0 = no padding id).  ``cell``: "lstm" or "gru"."""
+    from ..nn.recurrent import GRU, LSTM, Recurrent
 
+    if cell not in ("lstm", "gru"):
+        raise ValueError(f"cell must be 'lstm' or 'gru', got {cell!r}")
+    # NOTE: layer construction order is part of the seeded-RNG contract
+    # (each init consumes global draws) — keep LookupTable first so
+    # seeded runs reproduce across versions
     return nn.Sequential(
         nn.LookupTable(vocab_size, embed_dim, padding_value=padding_value),
-        Recurrent(LSTM(embed_dim, hidden)),
+        Recurrent(GRU(embed_dim, hidden) if cell == "gru"
+                  else LSTM(embed_dim, hidden)),
         nn.Select(2, -1),  # last timestep
         nn.Linear(hidden, class_num),
         nn.LogSoftMax(),

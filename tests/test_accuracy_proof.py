@@ -35,3 +35,13 @@ def test_lstm_recurrent_lifecycle_accuracy():
 
     acc = main(max_epoch_n=12, target=0.85)
     assert acc >= 0.85, f"LSTM sequence accuracy regressed: {acc}"
+
+
+def test_gru_classifier_learns_same_task():
+    """The GRU variant (BASELINE.md workload 5 says 'LSTM/GRU') learns
+    the same memory task through the same full lifecycle, evaluated on
+    the held-out set."""
+    from bigdl_tpu.examples.lstm_text_accuracy import main
+
+    acc = main(max_epoch_n=16, target=0.8, cell="gru")
+    assert acc >= 0.8, f"GRU classifier accuracy regressed: {acc}"
