@@ -280,8 +280,35 @@ class TensorflowLoader:
 
     @staticmethod
     def load(graph_path: str, inputs: Sequence[str], outputs: Sequence[str]):
-        return TensorflowLoader.build(TensorflowLoader.parse(graph_path),
-                                      inputs, outputs)
+        """Load with explicit endpoints (the reference's loadTF contract,
+        TensorflowLoader.scala:38); empty ``inputs``/``outputs`` are
+        auto-detected — Placeholders as inputs, unconsumed non-Const
+        nodes as outputs — instead of silently building an empty graph."""
+        graph_def = TensorflowLoader.parse(graph_path)
+        if not inputs:
+            inputs = [n.name for n in graph_def.node if n.op == "Placeholder"]
+            if len(inputs) > 1:
+                # aux placeholders (keep_prob, is_training, ...) would
+                # become extra Graph inputs and silently mis-bind data —
+                # refuse rather than guess
+                raise ValueError(
+                    f"graph {graph_path!r} has {len(inputs)} Placeholders "
+                    f"{inputs!r}; pass inputs explicitly")
+        if not outputs:
+            # data-edge consumers only: a control dep ('^name') does not
+            # make a node a non-terminal
+            consumed = {_norm_ref(ref)[0] for node in graph_def.node
+                        for ref in node.input if not ref.startswith("^")}
+            outputs = [n.name for n in graph_def.node
+                       if n.name not in consumed
+                       and n.op not in ("Const", "Placeholder", "NoOp",
+                                        "Assert")]
+        if not inputs or not outputs:
+            raise ValueError(
+                f"cannot auto-detect graph endpoints of {graph_path!r} "
+                f"(found inputs={list(inputs)!r}, "
+                f"outputs={list(outputs)!r}); pass them explicitly")
+        return TensorflowLoader.build(graph_def, inputs, outputs)
 
     # -- graph building ---------------------------------------------------
     @staticmethod

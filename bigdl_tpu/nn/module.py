@@ -340,6 +340,24 @@ class AbstractModule:
         torch_file.save(self, path, overwrite)
         return self
 
+    def save_caffe(self, prototxt_path: str, model_path: str,
+                   use_v2: bool = True, overwrite: bool = False):
+        """Write this module as Caffe prototxt+caffemodel (reference
+        AbstractModule.saveCaffe, AbstractModule.scala:398)."""
+        from ..interop.caffe import CaffePersister
+
+        CaffePersister.persist(prototxt_path, model_path, self,
+                               use_v2=use_v2, overwrite=overwrite)
+        return self
+
+    def save_tf(self, input_shape, path: str, **kwargs):
+        """Write this module as a frozen TF GraphDef (reference
+        AbstractModule.saveTF, AbstractModule.scala:405)."""
+        from ..interop.tensorflow import TensorflowSaver
+
+        TensorflowSaver.save(self, input_shape, path, **kwargs)
+        return self
+
     def save_weights(self, path: str, overwrite: bool = False):
         from ..utils.file_io import save as _save
 

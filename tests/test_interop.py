@@ -426,3 +426,22 @@ layer { name: "bias" type: "Bias" bottom: "data" top: "out" }
     x = RNG.rand(2, 5).astype(np.float32)
     np.testing.assert_allclose(np.asarray(g.forward(x)), x + bias,
                                rtol=1e-6)
+
+
+def test_module_save_caffe_verb_roundtrip(tmp_path):
+    # AbstractModule.saveCaffe parity (AbstractModule.scala:398)
+    import jax.numpy as jnp
+
+    from bigdl_tpu import nn
+    from bigdl_tpu.interop.caffe import CaffeLoader
+
+    m = nn.Sequential(nn.SpatialConvolution(3, 4, 3, 3, 1, 1, 1, 1),
+                      nn.ReLU(), nn.View(256), nn.Linear(256, 5))
+    m.evaluate()
+    x = jnp.asarray(np.random.RandomState(0).rand(2, 3, 8, 8), jnp.float32)
+    want = np.asarray(m.forward(x))
+    proto, weights = str(tmp_path / "n.prototxt"), str(tmp_path / "n.caffemodel")
+    assert m.save_caffe(proto, weights) is m  # fluent
+    loaded = CaffeLoader(proto, weights).create_caffe_model().evaluate()
+    np.testing.assert_allclose(np.asarray(loaded.forward(x)), want,
+                               rtol=1e-4, atol=1e-5)

@@ -113,3 +113,57 @@ def test_unsupported_module_raises(tmp_path):
     model = nn.Sequential(nn.LSTM(4, 4))
     with pytest.raises(NotImplementedError):
         TensorflowSaver.save(model, [1, 4], str(tmp_path / "m.pb"))
+
+
+def test_module_save_tf_verb_and_auto_endpoints(tmp_path):
+    # AbstractModule.saveTF parity (AbstractModule.scala:405) + loadTF
+    # endpoint auto-detection (empty inputs/outputs must find the
+    # Placeholder and the terminal op, not build an empty graph)
+    from bigdl_tpu.api import load_tf
+
+    # batch 2: with batch 1 the element count equals View's size and
+    # View eats the batch dim (the reference's View batch ambiguity)
+    m = nn.Sequential(nn.SpatialConvolution(3, 4, 3, 3, 1, 1, 1, 1),
+                      nn.ReLU(), nn.View(256), nn.Linear(256, 5))
+    m.evaluate()
+    x = jnp.asarray(np.random.RandomState(0).rand(2, 3, 8, 8), jnp.float32)
+    want = np.asarray(m.forward(x))
+    path = str(tmp_path / "verb.pb")
+    assert m.save_tf((2, 3, 8, 8), path) is m  # fluent
+    got = np.asarray(load_tf(path).evaluate().forward(x))
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+def test_load_tf_auto_detect_failure_is_loud(tmp_path):
+    from bigdl_tpu.interop.tensorflow import TensorflowLoader, tfpb
+
+    g = tfpb.GraphDef()  # no nodes at all
+    p = tmp_path / "empty.pb"
+    p.write_bytes(g.SerializeToString())
+    with pytest.raises(ValueError, match="auto-detect"):
+        TensorflowLoader.load(str(p), [], [])
+
+
+def test_load_tf_auto_detect_handles_control_deps_and_aux_placeholders(tmp_path):
+    from bigdl_tpu.interop.tensorflow import TensorflowLoader, tfpb
+
+    # terminal 'out' is also a control input of a NoOp (tf.group pattern):
+    # the control edge must not demote it from the auto-detected outputs
+    g = tfpb.GraphDef()
+    ph = g.node.add(); ph.op, ph.name = "Placeholder", "input"
+    ident = g.node.add(); ident.op, ident.name = "Identity", "out"
+    ident.input.append("input")
+    grp = g.node.add(); grp.op, grp.name = "NoOp", "init"
+    grp.input.append("^out")
+    p = tmp_path / "ctrl.pb"
+    p.write_bytes(g.SerializeToString())
+    m = TensorflowLoader.load(str(p), [], [])
+    x = jnp.asarray(np.random.RandomState(0).rand(2, 3), jnp.float32)
+    np.testing.assert_allclose(np.asarray(m.forward(x)), np.asarray(x))
+
+    # two Placeholders: refuse loudly instead of silently mis-binding
+    ph2 = g.node.add(); ph2.op, ph2.name = "Placeholder", "keep_prob"
+    p2 = tmp_path / "aux.pb"
+    p2.write_bytes(g.SerializeToString())
+    with pytest.raises(ValueError, match="Placeholders"):
+        TensorflowLoader.load(str(p2), [], [])
