@@ -203,6 +203,14 @@ class DistriOptimizer(Optimizer):
         mesh = self.mesh
         if mesh is None:
             mesh = Engine.create_mesh()
+        # a mesh with a real model/seq axis routes to the multi-axis SPMD
+        # step (parallel/spmd.py: tensor + sequence parallelism composed
+        # with data parallelism in one program); a pure-data mesh keeps
+        # the reference-shaped AllReduceParameter path below
+        extra_axes = [a for a in ("model", "seq")
+                      if a in mesh.axis_names and mesh.shape[a] > 1]
+        if extra_axes:
+            return self._optimize_multi_axis(mesh)
         # collapse to a pure-data mesh if caller handed the 4-axis default
         mesh = data_mesh(mesh)
         n_dev = mesh.shape["data"]
@@ -212,24 +220,7 @@ class DistriOptimizer(Optimizer):
                 f"mesh's data-axis size {n_dev} (reference Optimizer.scala:417 "
                 "requires batchSize % nodeNumber == 0)")
 
-        attempts = 0
-        window_start = time.time()
-        while True:
-            try:
-                return self._optimize_once(mesh, n_dev,
-                                           resume=attempts > 0)
-            except KeyboardInterrupt:
-                raise
-            except Exception as e:  # driver retry loop (reference :750-816)
-                if time.time() - window_start > self.retry_window:
-                    attempts = 0
-                    window_start = time.time()
-                attempts += 1
-                if attempts > self.max_retry or self.checkpoint_path is None:
-                    raise
-                log.warning("Error during training: %s — retry %d/%d from "
-                            "latest checkpoint", e, attempts, self.max_retry)
-                self._restore_latest()
+        return self._with_retry(lambda: self._optimize_once(mesh, n_dev))
 
     def _restore_latest(self):
         from ..utils.file_io import load
@@ -246,7 +237,188 @@ class DistriOptimizer(Optimizer):
             self.optim_method = OptimMethod.load(latest_om)
 
     # ------------------------------------------------------------------
-    def _optimize_once(self, mesh, n_dev, resume=False) -> AbstractModule:
+    # multi-axis (data x seq x model) SPMD path
+    # ------------------------------------------------------------------
+    def _optimize_multi_axis(self, mesh) -> AbstractModule:
+        """Full Optimizer lifecycle over a multi-axis mesh: the step is
+        ``parallel.spmd.make_train_step`` (tensor-parallel param specs,
+        sequence sharding, pmean'd grads — one compiled program), the
+        lifecycle (triggers, canonical log line, summaries, checkpoint,
+        retry-from-checkpoint) is the same contract as the data path.
+        Exceeds reference parity by design (the reference is data-only,
+        SURVEY §2.2); the data-parallel path is unchanged."""
+        n_data = mesh.shape.get("data", 1)
+        if self.batch_size is not None and self.batch_size % n_data != 0:
+            raise ValueError(
+                f"batch size {self.batch_size} must be divisible by the "
+                f"mesh's data-axis size {n_data}")
+        return self._with_retry(lambda: self._optimize_multi_axis_once(mesh))
+
+    def _with_retry(self, fn):
+        """Driver retry-from-checkpoint loop shared by both mesh paths
+        (reference DistriOptimizer.scala:750-816)."""
+        attempts = 0
+        window_start = time.time()
+        while True:
+            try:
+                return fn()
+            except KeyboardInterrupt:
+                raise
+            except Exception as e:
+                if time.time() - window_start > self.retry_window:
+                    attempts = 0
+                    window_start = time.time()
+                attempts += 1
+                if attempts > self.max_retry or self.checkpoint_path is None:
+                    raise
+                log.warning("Error during training: %s — retry %d/%d from "
+                            "latest checkpoint", e, attempts, self.max_retry)
+                self._restore_latest()
+
+    def _optimize_multi_axis_once(self, mesh) -> AbstractModule:
+        from jax.sharding import NamedSharding
+
+        from ..parallel.spmd import make_train_step
+        from .optimizer import _epoch_records, _resume_slots
+
+        model, optim = self.model, self.optim_method
+        model.training()
+        n_data = mesh.shape.get("data", 1)
+        n_seq = mesh.shape.get("seq", 1)
+
+        step = make_train_step(model, self.criterion, optim, mesh,
+                               input_seq_dim=1 if n_seq > 1 else None,
+                               compute_dtype=self.compute_dtype, donate=True)
+        put = lambda tree, specs: jax.tree_util.tree_map(
+            lambda a, s: jax.device_put(a, NamedSharding(mesh, s)),
+            tree, specs)
+        params = put(model.param_tree(), step.param_specs)
+        slots = _resume_slots(optim, optim.init_state(params))
+        slots = put(slots, step.slot_specs)
+        # device_put COPIES: the step donates its inputs, and a retry
+        # must not hand the model's own (now-deleted) arrays back in
+        buffers = put(model.buffer_tree(),
+                      jax.tree_util.tree_map(lambda _: P(),
+                                             model.buffer_tree()))
+
+        state = optim.state
+        state["epoch"] = state.get("epoch", 1)
+        state["neval"] = state.get("neval", 1)
+        state["epoch_finished"] = False
+        records_this_epoch = 0
+        epoch_size = _epoch_records(self.dataset)
+        data_iter = self.dataset.data(train=True)
+        wall_start = time.time()
+
+        while not self.end_when(state):
+            state["epoch_finished"] = False
+            t_data0 = time.time()
+            batch = next(data_iter)
+            x, y = _device_batch(batch)
+            n_records = batch.size()
+            if n_records % n_data != 0:
+                raise ValueError(
+                    f"multi-axis training needs every batch divisible by "
+                    f"the data-axis size {n_data}, got a {n_records}-record "
+                    "batch; size the dataset to a batch multiple (the "
+                    "pad-and-mask partial-batch path exists on the "
+                    "data-parallel mesh only)")
+            if n_seq > 1:
+                bad = [a.shape for a in jax.tree_util.tree_leaves(x)
+                       if getattr(a, "ndim", 0) > 1
+                       and a.shape[1] % n_seq != 0]
+                if bad:
+                    raise ValueError(
+                        f"sequence dim of inputs {bad} must divide the "
+                        f"mesh's seq-axis size {n_seq}; pad sequences to "
+                        "a multiple")
+            infeed_time = time.time() - t_data0
+
+            t0 = time.time()
+            lr = optim.get_current_lr()
+            loss, params, slots, buffers = step(params, slots, buffers,
+                                                lr, x, y, rng=next_jax_key())
+            loss = float(loss)  # value fetch = execution barrier
+            train_time = time.time() - t0
+
+            records_this_epoch += n_records
+            state["loss"] = loss
+            # metric-name contract (reference DistriOptimizer.scala:146-151);
+            # collectives are fused into the one program here, so the wall
+            # time is attributed to compute (no trace split on this path)
+            self.metrics.add("computing time average", train_time)
+            self.metrics.add("aggregate gradient time", 0.0)
+            self.metrics.add("get weights average", infeed_time)
+            log.info(
+                "[Epoch %d %d/%d][Iteration %d][Wall Clock %.3fs] "
+                "Train %d in %.4f seconds. Throughput is %.1f "
+                "records/second. Loss is %.5f.",
+                state["epoch"], records_this_epoch, epoch_size,
+                state["neval"], time.time() - wall_start, n_records,
+                train_time + infeed_time,
+                n_records / max(train_time + infeed_time, 1e-9), loss)
+            if self.train_summary is not None:
+                self.train_summary.add_scalar("Loss", loss, state["neval"])
+                self.train_summary.add_scalar(
+                    "Throughput",
+                    n_records / max(train_time + infeed_time, 1e-9),
+                    state["neval"])
+
+            state["neval"] += 1
+            optim.state = state
+            if records_this_epoch >= epoch_size:
+                state["epoch"] += 1
+                state["epoch_finished"] = True
+                records_this_epoch = 0
+                self.dataset.shuffle()
+                data_iter = self.dataset.data(train=True)
+
+            # evaluate each trigger exactly once per iteration: stateful
+            # user triggers must not see a second call, and the action
+            # below must never run without the host-param sync above it
+            do_validate = (self.validation_trigger is not None
+                           and self.validation_trigger(state))
+            do_checkpoint = (self.checkpoint_trigger is not None
+                             and self.checkpoint_trigger(state))
+            if do_validate or do_checkpoint:
+                # host-gather the sharded params once for validation and/or
+                # checkpoint (model-sharded leaves reassemble on fetch)
+                model.set_param_tree(jax.device_get(params))
+                model.set_buffer_tree(jax.device_get(buffers))
+                optim._slots = jax.device_get(slots)
+            if do_validate:
+                self._validate_host(state)
+            if do_checkpoint:
+                self._checkpoint(state)
+
+        model.set_param_tree(jax.device_get(params))
+        model.set_buffer_tree(jax.device_get(buffers))
+        optim._slots = jax.device_get(slots)
+        model.evaluate()
+        return model
+
+    def _validate_host(self, state):
+        """Validation with host-gathered params (the multi-axis step's
+        params are model-sharded; the evaluator's data-mesh program
+        expects replicated params)."""
+        from .evaluator import evaluate_dataset
+
+        if self.validation_dataset is None:
+            return
+        results = evaluate_dataset(self.model, self.validation_dataset,
+                                   self.validation_methods,
+                                   batch_size=self.batch_size or 128)
+        for method, result in zip(self.validation_methods, results):
+            log.info("%s is %s", method.format(), result)
+            if self.validation_summary is not None:
+                self.validation_summary.add_scalar(
+                    method.format(), result.result()[0], state["neval"] - 1)
+            if method.format() in ("Top1Accuracy", "Top5Accuracy"):
+                state["score"] = result.result()[0]
+        self.model.training()
+
+    # ------------------------------------------------------------------
+    def _optimize_once(self, mesh, n_dev) -> AbstractModule:
         model, optim = self.model, self.optim_method
         model.training()
 

@@ -79,11 +79,17 @@ def make_train_step(model, criterion, optim, mesh,
                     data_axis: Optional[str] = "data",
                     seq_axis: Optional[str] = "seq",
                     model_axis: Optional[str] = "model",
-                    input_seq_dim: Optional[int] = 1):
+                    input_seq_dim: Optional[int] = 1,
+                    compute_dtype=None, donate: bool = False):
     """Build the jitted SPMD train step over ``mesh``.
 
     ``input_seq_dim`` — which dim of x/y is the sequence (None: inputs
     are not sequence-sharded).  Axes not present in the mesh are ignored.
+    ``compute_dtype`` — bf16 compute / f32 master weights (the drivers'
+    ``set_compute_dtype`` scheme: grads return f32 through the cast's
+    vjp).  ``donate=True`` donates params/slots/buffers to the step —
+    no old+new copies in HBM; the caller must rebind them each call (the
+    training drivers do; leave False for ad-hoc use).
     """
     axes = set(mesh.axis_names)
     data_axis = data_axis if data_axis in axes else None
@@ -98,10 +104,16 @@ def make_train_step(model, criterion, optim, mesh,
 
     def in_spec(ndim):
         parts = [data_axis]
-        if input_seq_dim is not None and seq_axis:
+        if input_seq_dim is not None and seq_axis and ndim > input_seq_dim:
             parts += [None] * (input_seq_dim - 1) + [seq_axis]
-        parts += [None] * (ndim - len(parts))
+        parts = parts[:ndim] + [None] * (ndim - len(parts))
         return P(*parts)
+
+    def io_spec(tree):
+        """Rank-aware specs: batch dim on ``data``, the sequence dim (when
+        present and the leaf has one) on ``seq``, rest replicated."""
+        return jax.tree_util.tree_map(
+            lambda a: in_spec(getattr(a, "ndim", 0)), tree)
 
     x_spec, y_spec = in_spec(2), in_spec(2)
 
@@ -128,30 +140,90 @@ def make_train_step(model, criterion, optim, mesh,
             return g / n_model
         return lax.pmean(g, all_axes) if all_axes else g
 
-    def local_step(params, slots, buf, lr, x, y):
+    from ..optim.optimizer import _cast_floats, _restore_dtypes
+    from ..optim.regularizer import (collect_regularizer_paths,
+                                     regularizer_loss)
+
+    upcast_out = not getattr(criterion, "accepts_low_precision", False)
+    reg_paths = list(collect_regularizer_paths(model))
+    scale_tree = model.gradient_scale_tree()
+    needs_scale = any(s != 1.0 for s in jax.tree_util.tree_leaves(scale_tree))
+
+    def local_step(params, slots, buf, lr, rng, x, y):
+        if rng is not None and batch_axes:
+            # decorrelate dropout across batch shards; model-axis peers
+            # keep the SAME key (they hold slices of one logical model)
+            for a in batch_axes:
+                rng = jax.random.fold_in(rng, lax.axis_index(a))
+
         def loss_fn(p):
-            out, nb = model.apply_fn(p, buf, x, True, None)
+            p_c, x_c = p, x
+            if compute_dtype is not None:
+                p_c = _cast_floats(p, compute_dtype)
+                x_c = _cast_floats(x, compute_dtype)
+            out, nb = model.apply_fn(p_c, buf, x_c, True, rng)
+            if compute_dtype is not None:
+                if upcast_out:
+                    out = _cast_floats(out, jnp.float32)
+                nb = _restore_dtypes(nb, buf)
             return criterion._loss(out, y), nb
 
         (loss, nb), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
         grads = jax.tree_util.tree_map(_reduce_grad, grads, pspecs)
+        if reg_paths:
+            # regularizer gradients in a SEPARATE pass added after the
+            # cross-shard reduction: each shard's reg grad for its own
+            # (slice of the) parameter is already exact, so it must not
+            # go through _reduce_grad's pmean/n_model scaling
+            reg_g = jax.grad(lambda p: regularizer_loss(p, reg_paths))(params)
+            grads = jax.tree_util.tree_map(lambda g, r: g + r, grads, reg_g)
+            # logged loss includes the reg term (local view: exact without
+            # a model axis; with one, sharded-param reg counts the local
+            # slice — gradients above are exact either way)
+            loss = loss + regularizer_loss(params, reg_paths)
+        if needs_scale:  # reference setScaleW/setScaleB semantics
+            grads = jax.tree_util.tree_map(lambda g, s: g * s,
+                                           grads, scale_tree)
         if batch_axes:
             loss = lax.pmean(loss, batch_axes)
+            # sync running stats (BatchNorm) across batch shards, as the
+            # data-parallel driver does (distri_optimizer.py:148)
+            nb = jax.tree_util.tree_map(
+                lambda b: (lax.pmean(b, batch_axes)
+                           if jnp.issubdtype(b.dtype, jnp.floating) else b),
+                nb)
         new_params, new_slots = optim.step(grads, params, slots, lr)
         return loss, new_params, new_slots, nb
 
-    sharded = shard_map(
-        local_step, mesh=mesh,
-        in_specs=(pspecs, sslots, bspecs, P(), x_spec, y_spec),
-        out_specs=(P(), pspecs, sslots, bspecs),
-        check_vma=False)
+    _jitted_cache = {}
 
-    jitted = jax.jit(sharded)
+    def _jitted_for(x, y):
+        """shard_map specs are static: build (and cache) one executable
+        per input tree-structure/rank signature."""
+        key = jax.tree_util.tree_structure((x, y)), tuple(
+            getattr(a, "ndim", 0)
+            for a in jax.tree_util.tree_leaves((x, y)))
+        if key not in _jitted_cache:
+            sharded = shard_map(
+                local_step, mesh=mesh,
+                in_specs=(pspecs, sslots, bspecs, P(), P(), io_spec(x),
+                          io_spec(y)),
+                out_specs=(P(), pspecs, sslots, bspecs),
+                check_vma=False)
+            _jitted_cache[key] = jax.jit(
+                sharded, donate_argnums=(0, 1, 2) if donate else (),
+                static_argnums=())
+        return _jitted_cache[key]
 
-    def step(params, slots, buf, lr, x, y):
-        return jitted(params, slots, buf, jnp.float32(lr),
-                      jnp.asarray(x), jnp.asarray(y))
+    def step(params, slots, buf, lr, x, y, rng=None):
+        x = jax.tree_util.tree_map(jnp.asarray, x)
+        y = jax.tree_util.tree_map(jnp.asarray, y)
+        if rng is None:  # deterministic default (ad-hoc/test use)
+            rng = jax.random.PRNGKey(0)
+        return _jitted_for(x, y)(params, slots, buf, jnp.float32(lr), rng,
+                                 x, y)
 
     step.param_specs = pspecs
+    step.slot_specs = sslots
     step.input_spec = x_spec
     return step
