@@ -133,16 +133,9 @@ def main(argv=None):
     parser.add_argument("--dtype", default="float32",
                         choices=("float32", "bfloat16"))
     args = parser.parse_args(argv)
-    # honor an explicit JAX_PLATFORMS env: the image preloads jax with
-    # its own platform setting before this CLI runs, so the env var
-    # alone is parsed too late without this
-    import os
+    from ..utils.engine import Engine
 
-    import jax
-
-    want = os.environ.get("JAX_PLATFORMS")
-    if want and str(jax.config.jax_platforms or "") != want:
-        jax.config.update("jax_platforms", want)
+    Engine.honor_jax_platforms_env()
     performance(args.model, args.batchSize, args.iteration, args.inputdata,
                 distributed=args.distributed, dtype=args.dtype)
 

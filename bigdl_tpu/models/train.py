@@ -137,7 +137,24 @@ def main(argv=None):
     parser.add_argument("--summary-dir", default=None)
     parser.add_argument("--distributed", action="store_true",
                         help="DistriOptimizer over all visible devices")
+    def positive_int(v):
+        v = int(v)
+        if v < 1:
+            raise argparse.ArgumentTypeError(f"must be >= 1, got {v}")
+        return v
+
+    parser.add_argument("--tensor-parallel", type=positive_int, default=1,
+                        metavar="N",
+                        help="model-axis size (mesh becomes data x model; "
+                             "the model must use Column/RowParallelLinear "
+                             "layers to benefit; requires --distributed)")
     args = parser.parse_args(argv)
+    if args.tensor_parallel > 1 and not args.distributed:
+        parser.error("--tensor-parallel requires --distributed")
+
+    from ..utils.engine import Engine as _Engine
+
+    _Engine.honor_jax_platforms_env()
 
     # per-model defaults from the reference Train configs
     defaults = {
@@ -163,11 +180,11 @@ def main(argv=None):
     model, criterion, train_s, val_s, v_methods = build(args.model, args)
 
     if args.distributed:
-        import jax
-        from jax.sharding import Mesh
         from ..optim.distri_optimizer import DistriOptimizer
 
-        mesh = Mesh(np.array(jax.devices()), ("data",))
+        # Engine.create_mesh validates divisibility; model > 1 routes
+        # DistriOptimizer onto the multi-axis SPMD path
+        mesh = Engine.create_mesh(model=args.tensor_parallel)
         opt = DistriOptimizer(model, array(train_s), criterion,
                               batch_size=batch, mesh=mesh)
     else:

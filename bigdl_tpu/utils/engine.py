@@ -122,6 +122,19 @@ class Engine:
         return cls._initialized
 
     # ------------------------------------------------------------------
+    @staticmethod
+    def honor_jax_platforms_env():
+        """Make an explicit ``JAX_PLATFORMS`` env effective for a CLI:
+        the image preloads jax (sitecustomize) with its own platform
+        setting before any entry point runs, so the env var alone is
+        parsed too late.  Call before first backend use."""
+        import jax
+
+        want = os.environ.get("JAX_PLATFORMS")
+        if want and str(jax.config.jax_platforms or "") != want:
+            jax.config.update("jax_platforms", want)
+
+    # ------------------------------------------------------------------
     # Mesh factory — the TPU-native replacement for parseExecutorAndCore
     # ------------------------------------------------------------------
     @classmethod

@@ -111,6 +111,14 @@ class TestZooTrainer:
                       "--max-epoch", "1"])
         assert model is not None
 
+    def test_lenet_cli_distributed_tensor_parallel(self):
+        from bigdl_tpu.models.train import main
+
+        model = main(["--model", "lenet5", "--batch-size", "64",
+                      "--max-epoch", "1", "--distributed",
+                      "--tensor-parallel", "2"])
+        assert model is not None
+
     def test_rnn_cli_builds(self):
         from bigdl_tpu.models.train import build
 
