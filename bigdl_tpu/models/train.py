@@ -119,6 +119,42 @@ def build(model_name: str, args):
                 nn.ClassNLLCriterion(),
                 _text_samples(V, T, True), _text_samples(V, T, False),
                 [Top1Accuracy()])
+    if name == "transformer":
+        from ..dataset import Sample
+        from .transformer import TransformerLM
+
+        V, T = 256, 64
+        sp = getattr(args, "seq_parallel", 1) > 1
+        tp = getattr(args, "tensor_parallel", 1) > 1
+        # logits output: the fused CrossEntropyCriterion computes its own
+        # log-sum-exp, so a log_softmax head would be pure wasted [B,T,V]
+        # bandwidth at the hottest layer (models/transformer.py docstring)
+        lm = TransformerLM(
+            V, embed_dim=64, num_heads=4, num_layers=2, max_len=T,
+            seq_strategy="ring" if sp else "dense",
+            seq_axis="seq" if sp else None,
+            model_axis="model" if tp else None,
+            output="logits")
+        crit = nn.TimeDistributedCriterion(nn.CrossEntropyCriterion(), True)
+        # synthetic char-LM with learnable structure: next token is a
+        # fixed permutation of the current one, plus noise tokens
+        rng = np.random.RandomState(0)
+        perm = rng.permutation(V - 1) + 1
+
+        def mk(n, seed):
+            r = np.random.RandomState(seed)
+            out = []
+            for _ in range(n):
+                seq = np.empty(T + 1, np.int64)
+                seq[0] = r.randint(1, V)
+                for t in range(1, T + 1):
+                    seq[t] = (perm[seq[t - 1] - 1] if r.rand() < 0.9
+                              else r.randint(1, V))
+                out.append(Sample(seq[:-1].astype(np.float32),
+                                  (seq[1:] + 1).astype(np.float32)))
+            return out
+
+        return (lm, crit, mk(512, 1), mk(64, 2), [Loss(crit)])
     raise ValueError(f"unknown model {model_name!r}")
 
 
@@ -127,7 +163,8 @@ def main(argv=None):
         description="bigdl_tpu zoo trainer (reference models/*/Train.scala)")
     parser.add_argument("--model", default="lenet5",
                         choices=("lenet5", "vgg", "resnet", "inception_v1",
-                                 "inception_v2", "rnn", "autoencoder"))
+                                 "inception_v2", "rnn", "autoencoder",
+                                 "transformer"))
     parser.add_argument("-f", "--folder", default=None,
                         help="dataset folder (synthetic data when absent)")
     parser.add_argument("-b", "--batch-size", type=int, default=None)
@@ -148,9 +185,16 @@ def main(argv=None):
                         help="model-axis size (mesh becomes data x model; "
                              "the model must use Column/RowParallelLinear "
                              "layers to benefit; requires --distributed)")
+    parser.add_argument("--seq-parallel", type=positive_int, default=1,
+                        metavar="N",
+                        help="seq-axis size for sequence models (ring "
+                             "attention over the mesh's seq axis; "
+                             "requires --distributed)")
     args = parser.parse_args(argv)
-    if args.tensor_parallel > 1 and not args.distributed:
-        parser.error("--tensor-parallel requires --distributed")
+    if ((args.tensor_parallel > 1 or args.seq_parallel > 1)
+            and not args.distributed):
+        parser.error("--tensor-parallel/--seq-parallel require "
+                     "--distributed")
 
     from ..utils.engine import Engine as _Engine
 
@@ -165,6 +209,7 @@ def main(argv=None):
         "inception_v2": (32, 1, 0.01),
         "rnn": (32, 5, 0.1),             # models/rnn/Train.scala
         "autoencoder": (128, 5, 0.01),
+        "transformer": (32, 2, 0.1),     # long-context extension workload
     }[args.model]
     batch = args.batch_size or defaults[0]
     epochs = args.max_epoch or defaults[1]
@@ -182,9 +227,10 @@ def main(argv=None):
     if args.distributed:
         from ..optim.distri_optimizer import DistriOptimizer
 
-        # Engine.create_mesh validates divisibility; model > 1 routes
+        # Engine.create_mesh validates divisibility; model/seq > 1 route
         # DistriOptimizer onto the multi-axis SPMD path
-        mesh = Engine.create_mesh(model=args.tensor_parallel)
+        mesh = Engine.create_mesh(model=args.tensor_parallel,
+                                  seq=args.seq_parallel)
         opt = DistriOptimizer(model, array(train_s), criterion,
                               batch_size=batch, mesh=mesh)
     else:

@@ -289,6 +289,7 @@ class DistriOptimizer(Optimizer):
         step = make_train_step(model, self.criterion, optim, mesh,
                                input_seq_dim=1 if n_seq > 1 else None,
                                compute_dtype=self.compute_dtype, donate=True)
+        eval_fwd = None  # built lazily on the first validation trigger
         put = lambda tree, specs: jax.tree_util.tree_map(
             lambda a, s: jax.device_put(a, NamedSharding(mesh, s)),
             tree, specs)
@@ -380,15 +381,22 @@ class DistriOptimizer(Optimizer):
                            and self.validation_trigger(state))
             do_checkpoint = (self.checkpoint_trigger is not None
                              and self.checkpoint_trigger(state))
-            if do_validate or do_checkpoint:
-                # host-gather the sharded params once for validation and/or
-                # checkpoint (model-sharded leaves reassemble on fetch)
+            if do_validate:
+                if eval_fwd is None:
+                    from ..parallel.spmd import make_eval_forward
+
+                    eval_fwd = make_eval_forward(
+                        model, mesh,
+                        input_seq_dim=1 if n_seq > 1 else None,
+                        compute_dtype=self.compute_dtype)
+                self._validate_multi_axis(state, eval_fwd, params, buffers,
+                                          n_data, n_seq)
+            if do_checkpoint:
+                # host-gather the sharded params for the checkpoint
+                # (model-sharded leaves reassemble on fetch)
                 model.set_param_tree(jax.device_get(params))
                 model.set_buffer_tree(jax.device_get(buffers))
                 optim._slots = jax.device_get(slots)
-            if do_validate:
-                self._validate_host(state)
-            if do_checkpoint:
                 self._checkpoint(state)
 
         model.set_param_tree(jax.device_get(params))
@@ -397,17 +405,34 @@ class DistriOptimizer(Optimizer):
         model.evaluate()
         return model
 
-    def _validate_host(self, state):
-        """Validation with host-gathered params (the multi-axis step's
-        params are model-sharded; the evaluator's data-mesh program
-        expects replicated params)."""
+    def _validate_multi_axis(self, state, eval_fwd, params, buffers,
+                             n_data, n_seq=1):
+        """On-mesh validation for the multi-axis path: the compiled
+        eval forward (parallel.spmd.make_eval_forward) runs with the
+        device-resident sharded params — no host pull, and models whose
+        forward needs bound mesh axes (ring attention, RowParallel psum)
+        validate correctly.  Reuses evaluate_dataset's batching/padding/
+        accumulation loop via its ``fwd`` override."""
         from .evaluator import evaluate_dataset
 
         if self.validation_dataset is None:
             return
+        if n_seq > 1:
+            probe = next(iter(self.validation_dataset.data(train=False)),
+                         None)
+            if probe is not None and not hasattr(probe, "size"):
+                arr = np.asarray(probe.feature)
+                if arr.ndim >= 1 and arr.shape[0] % n_seq != 0:
+                    raise ValueError(
+                        f"validation sequence length {arr.shape[0]} must "
+                        f"divide the mesh's seq-axis size {n_seq}; pad "
+                        "sequences to a multiple")
         results = evaluate_dataset(self.model, self.validation_dataset,
                                    self.validation_methods,
-                                   batch_size=self.batch_size or 128)
+                                   batch_size=self.batch_size or 128,
+                                   params=params, buffers=buffers,
+                                   fwd=eval_fwd, n_shard=n_data)
+        self.model.training()
         for method, result in zip(self.validation_methods, results):
             log.info("%s is %s", method.format(), result)
             if self.validation_summary is not None:
@@ -415,7 +440,6 @@ class DistriOptimizer(Optimizer):
                     method.format(), result.result()[0], state["neval"] - 1)
             if method.format() in ("Top1Accuracy", "Top5Accuracy"):
                 state["score"] = result.result()[0]
-        self.model.training()
 
     # ------------------------------------------------------------------
     def _optimize_once(self, mesh, n_dev) -> AbstractModule:

@@ -60,13 +60,18 @@ def _cached_eval_fwd(model, mesh: Optional[Mesh]):
 
 def evaluate_dataset(model, dataset, v_methods: Sequence[ValidationMethod],
                      batch_size: int = 128, mesh: Optional[Mesh] = None,
-                     params=None, buffers=None) -> List[ValidationResult]:
+                     params=None, buffers=None, fwd=None,
+                     n_shard: Optional[int] = None) -> List[ValidationResult]:
     """Shared eval loop; dataset may yield Samples or MiniBatches.
 
     ``mesh``: run the forward as a compiled shard_map over the data axis.
     ``params``/``buffers``: device-resident trees to evaluate with (skips
     the host pull from ``model`` — used by DistriOptimizer's validation
     trigger mid-training).
+    ``fwd``: override the compiled forward with a custom
+    ``(params, buffers, x) -> out`` (the multi-axis driver passes
+    parallel.spmd.make_eval_forward); ``n_shard`` is the batch-dim
+    padding multiple for that forward.
     """
     model.evaluate()
     if params is None:
@@ -74,11 +79,16 @@ def evaluate_dataset(model, dataset, v_methods: Sequence[ValidationMethod],
     if buffers is None:
         buffers = model.buffer_tree()
 
-    mesh = _data_mesh(mesh)
-    n_dev = mesh.shape["data"] if mesh is not None else 1
-    fwd = _cached_eval_fwd(model, mesh)
+    if fwd is not None:
+        n_dev = n_shard or 1
+        mesh = None
+    else:
+        mesh = _data_mesh(mesh)
+        n_dev = mesh.shape["data"] if mesh is not None else 1
+        fwd = _cached_eval_fwd(model, mesh)
 
-    last_eval_info.update({"sharded": mesh is not None, "n_devices": n_dev,
+    last_eval_info.update({"sharded": mesh is not None or n_dev > 1,
+                           "n_devices": n_dev,
                            "batches": 0})
 
     it = dataset.data(train=False)

@@ -59,6 +59,54 @@ def param_specs(module, model_axis: str = "model"):
     return jax.tree_util.tree_map(lambda _: P(), tree)
 
 
+def _resolve_axes(mesh, data_axis, seq_axis, model_axis):
+    """Keep only the axes the mesh actually has."""
+    axes = set(mesh.axis_names)
+    return (data_axis if data_axis in axes else None,
+            seq_axis if seq_axis in axes else None,
+            model_axis if model_axis in axes else None)
+
+
+def _in_spec_fn(data_axis, seq_axis, input_seq_dim):
+    """Rank → PartitionSpec: batch dim on ``data``, the sequence dim
+    (when present and the leaf has one) on ``seq``, rest replicated.
+    Shared by the train and eval builders so their layouts can never
+    diverge (eval reuses the train step's sharded params)."""
+    def in_spec(ndim):
+        parts = [data_axis]
+        if input_seq_dim is not None and seq_axis and ndim > input_seq_dim:
+            parts += [None] * (input_seq_dim - 1) + [seq_axis]
+        parts = parts[:ndim] + [None] * (ndim - len(parts))
+        return P(*parts)
+
+    return in_spec
+
+
+def _io_spec_fn(in_spec):
+    return lambda tree: jax.tree_util.tree_map(
+        lambda a: in_spec(getattr(a, "ndim", 0)), tree)
+
+
+def _cast_fwd(model, compute_dtype, upcast_out=True):
+    """Forward with the bf16-compute/f32-master cast scheme applied
+    (shared by the train loss_fn and the eval forward)."""
+    from ..optim.optimizer import _cast_floats, _restore_dtypes
+
+    def run(params, buf, x, training, rng):
+        p_c, x_c = params, x
+        if compute_dtype is not None:
+            p_c = _cast_floats(params, compute_dtype)
+            x_c = _cast_floats(x, compute_dtype)
+        out, nb = model.apply_fn(p_c, buf, x_c, training, rng)
+        if compute_dtype is not None:
+            if upcast_out:
+                out = _cast_floats(out, jnp.float32)
+            nb = _restore_dtypes(nb, buf)
+        return out, nb
+
+    return run
+
+
 def slot_specs(slots, pspecs):
     """Optimizer-state specs: subtrees shaped like the param tree inherit
     the param specs (momentum/Adam moments shard with their params);
@@ -91,10 +139,8 @@ def make_train_step(model, criterion, optim, mesh,
     no old+new copies in HBM; the caller must rebind them each call (the
     training drivers do; leave False for ad-hoc use).
     """
-    axes = set(mesh.axis_names)
-    data_axis = data_axis if data_axis in axes else None
-    seq_axis = seq_axis if seq_axis in axes else None
-    model_axis = model_axis if model_axis in axes else None
+    data_axis, seq_axis, model_axis = _resolve_axes(
+        mesh, data_axis, seq_axis, model_axis)
     batch_axes = tuple(a for a in (data_axis, seq_axis) if a)
 
     pspecs = param_specs(model, model_axis or "model")
@@ -102,20 +148,9 @@ def make_train_step(model, criterion, optim, mesh,
     sslots = slot_specs(optim.init_state(model.param_tree()), pspecs)
     bspecs = jax.tree_util.tree_map(lambda _: P(), buffers)
 
-    def in_spec(ndim):
-        parts = [data_axis]
-        if input_seq_dim is not None and seq_axis and ndim > input_seq_dim:
-            parts += [None] * (input_seq_dim - 1) + [seq_axis]
-        parts = parts[:ndim] + [None] * (ndim - len(parts))
-        return P(*parts)
-
-    def io_spec(tree):
-        """Rank-aware specs: batch dim on ``data``, the sequence dim (when
-        present and the leaf has one) on ``seq``, rest replicated."""
-        return jax.tree_util.tree_map(
-            lambda a: in_spec(getattr(a, "ndim", 0)), tree)
-
-    x_spec, y_spec = in_spec(2), in_spec(2)
+    in_spec = _in_spec_fn(data_axis, seq_axis, input_seq_dim)
+    io_spec = _io_spec_fn(in_spec)
+    x_spec = in_spec(2)
 
     all_axes = tuple(a for a in (data_axis, seq_axis, model_axis) if a)
     n_model = mesh.shape[model_axis] if model_axis else 1
@@ -140,11 +175,11 @@ def make_train_step(model, criterion, optim, mesh,
             return g / n_model
         return lax.pmean(g, all_axes) if all_axes else g
 
-    from ..optim.optimizer import _cast_floats, _restore_dtypes
     from ..optim.regularizer import (collect_regularizer_paths,
                                      regularizer_loss)
 
     upcast_out = not getattr(criterion, "accepts_low_precision", False)
+    cast_fwd = _cast_fwd(model, compute_dtype, upcast_out)
     reg_paths = list(collect_regularizer_paths(model))
     scale_tree = model.gradient_scale_tree()
     needs_scale = any(s != 1.0 for s in jax.tree_util.tree_leaves(scale_tree))
@@ -157,15 +192,7 @@ def make_train_step(model, criterion, optim, mesh,
                 rng = jax.random.fold_in(rng, lax.axis_index(a))
 
         def loss_fn(p):
-            p_c, x_c = p, x
-            if compute_dtype is not None:
-                p_c = _cast_floats(p, compute_dtype)
-                x_c = _cast_floats(x, compute_dtype)
-            out, nb = model.apply_fn(p_c, buf, x_c, True, rng)
-            if compute_dtype is not None:
-                if upcast_out:
-                    out = _cast_floats(out, jnp.float32)
-                nb = _restore_dtypes(nb, buf)
+            out, nb = cast_fwd(p, buf, x, True, rng)
             return criterion._loss(out, y), nb
 
         (loss, nb), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
@@ -227,3 +254,77 @@ def make_train_step(model, criterion, optim, mesh,
     step.slot_specs = sslots
     step.input_spec = x_spec
     return step
+
+
+def make_eval_forward(model, mesh, data_axis: Optional[str] = "data",
+                      seq_axis: Optional[str] = "seq",
+                      model_axis: Optional[str] = "model",
+                      input_seq_dim: Optional[int] = 1,
+                      compute_dtype=None):
+    """Compiled forward over the same multi-axis mesh/specs as
+    :func:`make_train_step` — validation/inference for models whose
+    eager forward needs bound mesh axes (ring attention, RowParallel
+    psum).  Assumes sequence models keep the sequence dim of their
+    outputs at ``input_seq_dim`` (true for TransformerLM logits); batch
+    dim shards over ``data``.  Returns ``fwd(params, buffers, x) ->
+    out`` with out gathered per-call semantics (fetching the result
+    reassembles the full array)."""
+    data_axis, seq_axis, model_axis = _resolve_axes(
+        mesh, data_axis, seq_axis, model_axis)
+
+    pspecs = param_specs(model, model_axis or "model")
+    buffers = model.buffer_tree()
+    bspecs = jax.tree_util.tree_map(lambda _: P(), buffers)
+    in_spec = _in_spec_fn(data_axis, seq_axis, input_seq_dim)
+    io_spec = _io_spec_fn(in_spec)
+    cast_fwd = _cast_fwd(model, compute_dtype)
+
+    def local_fwd(params, buf, x):
+        out, _ = cast_fwd(params, buf, x, False, None)
+        return out
+
+    _cache = {}
+    _ranks = {}  # input treedef -> output rank tree
+
+    def _probe_out_ranks(params, buf, x):
+        """Output ranks via a minimal shard_map whose outputs are rank
+        indicators only (an eager/eval_shape trace would hit the same
+        unbound-axis problem the whole helper exists to avoid).  Probes
+        on the smallest batch (one record per data shard) so the extra
+        compile is cheap."""
+        n_data = mesh.shape[data_axis] if data_axis else 1
+        tiny = jax.tree_util.tree_map(
+            lambda a: a[:n_data] if getattr(a, "ndim", 0) >= 1 else a, x)
+
+        def rank_fn(p, b, xx):
+            out = local_fwd(p, b, xx)
+            return jax.tree_util.tree_map(
+                lambda o: jnp.zeros((o.ndim,), jnp.float32), out)
+
+        probe = shard_map(rank_fn, mesh=mesh,
+                          in_specs=(pspecs, bspecs, io_spec(tiny)),
+                          out_specs=P(), check_vma=False)
+        rank_tree = jax.jit(probe)(params, buf, tiny)
+        return jax.tree_util.tree_map(lambda r: int(r.shape[0]), rank_tree)
+
+    def fwd(params, buf, x):
+        x = jax.tree_util.tree_map(jnp.asarray, x)
+        treedef = jax.tree_util.tree_structure(x)
+        # rank key includes input ndims: same treedef with different
+        # ranks can produce different OUTPUT ranks
+        rank_key = treedef, tuple(getattr(a, "ndim", 0)
+                                  for a in jax.tree_util.tree_leaves(x))
+        key = treedef, tuple(a.shape
+                             for a in jax.tree_util.tree_leaves(x))
+        if key not in _cache:
+            if rank_key not in _ranks:
+                _ranks[rank_key] = _probe_out_ranks(params, buf, x)
+            out_specs = jax.tree_util.tree_map(in_spec, _ranks[rank_key])
+            sharded = shard_map(local_fwd, mesh=mesh,
+                                in_specs=(pspecs, bspecs, io_spec(x)),
+                                out_specs=out_specs, check_vma=False)
+            _cache[key] = jax.jit(sharded)
+        return _cache[key](params, buf, x)
+
+    fwd.param_specs = pspecs
+    return fwd

@@ -119,6 +119,16 @@ class TestZooTrainer:
                       "--tensor-parallel", "2"])
         assert model is not None
 
+    def test_transformer_cli_three_axis(self):
+        # long-context extension workload: dp x sp x tp through the zoo
+        # CLI, ring attention + Megatron split + on-mesh validation
+        from bigdl_tpu.models.train import main
+
+        model = main(["--model", "transformer", "--max-epoch", "1",
+                      "--batch-size", "16", "--distributed",
+                      "--tensor-parallel", "2", "--seq-parallel", "2"])
+        assert model is not None
+
     def test_rnn_cli_builds(self):
         from bigdl_tpu.models.train import build
 
