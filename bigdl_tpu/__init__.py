@@ -7,4 +7,4 @@ TPU-first: one jitted train step, pjit/shard_map parallelism over a
 device mesh, XLA collectives instead of a block-manager all-reduce.
 """
 
-__version__ = "0.1.0"
+__version__ = "0.2.0"

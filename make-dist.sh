@@ -1,0 +1,48 @@
+#!/usr/bin/env bash
+# Build a distributable bigdl_tpu: native host runtime + wheel + env
+# script (the analogue of the reference's make-dist.sh, which assembles
+# dist/lib/bigdl-*-jar-with-dependencies.jar + bigdl.sh).
+set -euo pipefail
+cd "$(dirname "$0")"
+
+DIST=dist
+rm -rf "$DIST" build ./*.egg-info  # stale build trees leak old contents
+mkdir -p "$DIST/lib"
+
+# 1) native host runtime (crc32c, bf16 wire codec, batcher); the python
+#    loader falls back to pure python when the .so is absent
+if command -v "${CXX:-g++}" >/dev/null 2>&1; then
+    make -C native
+fi
+
+# 2) wheel (no build isolation: offline-friendly, setuptools is enough)
+#    + an unpacked site tree so the env script below can be SOURCED to
+#    get a working PYTHONPATH without pip (wheels are importable zips)
+python -m pip wheel --no-deps --no-build-isolation -w "$DIST/lib" .
+WHEEL="$(ls "$DIST"/lib/bigdl_tpu-*.whl | head -1)"
+python - "$WHEEL" "$DIST/lib/bigdl_tpu_site" <<'EOP'
+import sys, zipfile
+zipfile.ZipFile(sys.argv[1]).extractall(sys.argv[2])
+EOP
+
+# 3) native .so rides in dist/lib (NOT inside the 'any' wheel — it is
+#    platform-specific; the loader falls back to numpy without it)
+if [ -f bigdl_tpu/native/libbigdl_tpu_native.so ]; then
+    cp bigdl_tpu/native/libbigdl_tpu_native.so "$DIST/lib/"
+    cp bigdl_tpu/native/libbigdl_tpu_native.so \
+       "$DIST/lib/bigdl_tpu_site/bigdl_tpu/native/"
+fi
+
+# 4) env script (the reference's dist/bin/bigdl.sh analogue)
+cat > "$DIST/bigdl-tpu.sh" <<'EOS'
+#!/usr/bin/env bash
+# Source me: puts bigdl_tpu on PYTHONPATH from this dist directory
+# (same-platform native .so included); or pip install the wheel in lib/.
+HERE="$(cd "$(dirname "${BASH_SOURCE[0]}")" && pwd)"
+export PYTHONPATH="$HERE/lib/bigdl_tpu_site:${PYTHONPATH:-}"
+echo "PYTHONPATH now includes $HERE/lib/bigdl_tpu_site"
+EOS
+chmod +x "$DIST/bigdl-tpu.sh"
+
+echo "dist/ ready:"
+ls -l "$DIST" "$DIST/lib" | sed 's/^/  /'
