@@ -175,7 +175,6 @@ def test_make_eval_forward_ring_lm_matches_dense_eager():
     forward exactly (same weights, ring attention + Megatron split vs
     plain eager) — the numeric contract multi-axis validation rests on."""
     from jax.sharding import NamedSharding
-    from jax.sharding import PartitionSpec as P
 
     from bigdl_tpu.models.transformer import TransformerLM
     from bigdl_tpu.parallel.spmd import make_eval_forward, param_specs
