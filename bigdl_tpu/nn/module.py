@@ -223,6 +223,51 @@ class AbstractModule:
         g_leaves = jax.tree_util.tree_leaves(self.grad_tree())
         return p_leaves, g_leaves
 
+    def get_weights(self) -> List[np.ndarray]:
+        """Weights as numpy arrays, in ``parameters()`` order (reference
+        pyspark Layer.get_weights, nn/layer.py:308)."""
+        return [np.asarray(p) for p in
+                jax.tree_util.tree_leaves(self.param_tree())]
+
+    def set_weights(self, weights):
+        """Assign weights from a list of arrays in ``parameters()`` order
+        (reference pyspark Layer.set_weights, nn/layer.py:263)."""
+        tree = self.param_tree()
+        leaves, treedef = jax.tree_util.tree_flatten(tree)
+        if len(weights) != len(leaves):
+            raise ValueError(
+                f"expected {len(leaves)} weight arrays, got {len(weights)}")
+        new_leaves = []
+        for cur, w in zip(leaves, weights):
+            w = jnp.asarray(w, cur.dtype)
+            if w.shape != cur.shape:
+                raise ValueError(
+                    f"weight shape {w.shape} != expected {cur.shape}")
+            new_leaves.append(w)
+        self.set_param_tree(jax.tree_util.tree_unflatten(treedef,
+                                                         new_leaves))
+        return self
+
+    def update_parameters(self, learning_rate: float):
+        """Debug-only in-place SGD step from the eager grads (reference
+        pyspark Layer.update_parameters, nn/layer.py:201: 'for debug
+        only, please use optimizer.optimize() in production')."""
+        self.set_param_tree(jax.tree_util.tree_map(
+            lambda p, g: p - learning_rate * g,
+            self.param_tree(), self.grad_tree()))
+        return self
+
+    def test(self, dataset, batch_size: int = 128, v_methods=None):
+        """Model-quality benchmark (reference pyspark Layer.test →
+        modelTest): ``evaluate(dataset, v_methods, batch_size)`` with the
+        pyspark argument order."""
+        if not v_methods:
+            raise ValueError(
+                "test() needs at least one ValidationMethod (e.g. "
+                "[Top1Accuracy()]) — an empty list would run the full "
+                "eval forward and return no metrics")
+        return self.evaluate(dataset, v_methods, batch_size)
+
     def get_parameters(self) -> Tuple[jax.Array, jax.Array]:
         """Flattened (weight, grad) pair (reference Module.flatten:80).
 

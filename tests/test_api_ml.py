@@ -145,3 +145,58 @@ class TestGraphConstructionApi:
     def test_node_with_no_inputs_starts_free(self):
         node = api.createNode(nn.Linear(2, 2))
         assert node is not None
+
+
+class TestLayerWeightVerbs:
+    def test_get_set_weights_roundtrip(self):
+        import jax
+
+        m = nn.Sequential(nn.Linear(4, 3), nn.Linear(3, 2))
+        ws = m.get_weights()
+        # parameters() order = param-tree leaf order (bias before weight,
+        # dict-key sorted) — pinned here
+        assert [w.shape for w in ws] == [(3,), (3, 4), (2,), (2, 3)]
+        new = [np.full_like(w, i) for i, w in enumerate(ws)]
+        m.set_weights(new)
+        for got, want in zip(m.get_weights(), new):
+            np.testing.assert_allclose(got, want)
+        with pytest.raises(ValueError):
+            m.set_weights(new[:-1])
+        with pytest.raises(ValueError):
+            m.set_weights([np.zeros((9, 9))] * 4)
+
+    def test_update_parameters_applies_eager_grads(self):
+        import jax.numpy as jnp
+
+        m = nn.Linear(3, 2)
+        x = jnp.ones((2, 3), jnp.float32)
+        y = m.forward(x)
+        m.backward(x, jnp.ones_like(y))
+        before = m.get_weights()
+        m.update_parameters(0.5)
+        after = m.get_weights()
+        grads = [np.asarray(g) for g in
+                 __import__("jax").tree_util.tree_leaves(m.grad_tree())]
+        for b, a, g in zip(before, after, grads):
+            np.testing.assert_allclose(a, b - 0.5 * g, atol=1e-6)
+
+    def test_module_test_verb(self):
+        from bigdl_tpu.dataset import Sample
+        from bigdl_tpu.dataset.dataset import array
+        from bigdl_tpu.optim import Top1Accuracy
+
+        m = nn.Sequential(nn.Linear(4, 3), nn.LogSoftMax())
+        rng = np.random.RandomState(0)
+        ds = array([Sample(rng.rand(4).astype(np.float32), 1.0)
+                    for _ in range(6)])
+        res = m.test(ds, batch_size=3, v_methods=[Top1Accuracy()])
+        assert res and res[0][1] == "Top1Accuracy"
+
+    def test_module_test_requires_methods(self):
+        from bigdl_tpu.dataset import Sample
+        from bigdl_tpu.dataset.dataset import array
+
+        m = nn.Sequential(nn.Linear(4, 3), nn.LogSoftMax())
+        ds = array([Sample(np.zeros(4, np.float32), 1.0)])
+        with pytest.raises(ValueError, match="ValidationMethod"):
+            m.test(ds)
