@@ -32,3 +32,4 @@ def _seed_rng():
     RNG().set_seed(1)
     np.random.seed(1)
     yield
+

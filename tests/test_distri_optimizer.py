@@ -144,24 +144,12 @@ def test_checkpoint_retry_recovers(tmp_path):
     DistriOptimizer.scala:750-816, SURVEY §4.5).  The failure is injected
     at the data plane — under XLA a module can only throw at trace time,
     so the host-visible fault surface is the input pipeline."""
-    from bigdl_tpu.dataset.transformer import Transformer
-
-    class ExceptionTransformer(Transformer):
-        def __init__(self, fail_at: int):
-            self.fail_at = fail_at
-            self.count = 0
-
-        def apply(self, it):
-            for item in it:
-                self.count += 1
-                if self.count == self.fail_at:
-                    raise RuntimeError("injected failure")
-                yield item
-
     from bigdl_tpu.dataset import SampleToMiniBatch
 
-    ds = (array(xor_samples()) >> ExceptionTransformer(fail_at=200)
-          >> SampleToMiniBatch(64))
+    from _fault import ExceptionTransformer
+
+    fault = ExceptionTransformer(fail_at=200)
+    ds = array(xor_samples()) >> fault >> SampleToMiniBatch(64)
     model = xor_model()
     opt = DistriOptimizer(model, ds, nn.ClassNLLCriterion())
     opt.set_optim_method(SGD(learning_rate=0.3))
@@ -170,6 +158,7 @@ def test_checkpoint_retry_recovers(tmp_path):
 
     opt.set_checkpoint(str(tmp_path), several_iteration(1))
     trained = opt.optimize()  # must ride through the injected failure
+    assert fault.fired, "the injected fault never triggered"
     assert trained is model
     assert opt.optim_method.state["neval"] > 10
 
