@@ -56,52 +56,18 @@ def main(max_epoch_n: int = 30, depth: int = 20, target: float = 0.97,
 
     default_to_cpu()
 
-    from bigdl_tpu import nn
-    from bigdl_tpu.dataset import array
     from bigdl_tpu.models.resnet import ResNetCifar
-    from bigdl_tpu.optim import (SGD, Loss, Top1Accuracy, every_epoch,
-                                 max_epoch)
-    from bigdl_tpu.optim.distri_optimizer import DistriOptimizer
-    from bigdl_tpu.utils.engine import Engine
-    from bigdl_tpu.utils.rng import set_global_seed
 
-    set_global_seed(1)
-    Engine.init()
-    train, test = digits_as_cifar()
-    ckpt_dir = tempfile.mkdtemp(prefix="bigdl_resnet_ckpt_")
+    from ._distributed_proof import run_distributed_proof
 
-    model = ResNetCifar(depth=depth, class_num=10, shortcut_type="A")
-    opt = DistriOptimizer(model, array(train), nn.ClassNLLCriterion(),
-                          batch_size=batch_size)
     # reference ResNet training recipe: SGD + momentum + weight decay
-    opt.set_optim_method(SGD(learning_rate=0.1, momentum=0.9,
-                             weight_decay=1e-4, nesterov=True,
-                             dampening=0.0))
-    opt.set_end_when(max_epoch(max_epoch_n))
-    opt.set_validation(every_epoch(), array(test),
-                       [Top1Accuracy(), Loss()], batch_size=128)
-    opt.set_checkpoint(ckpt_dir, every_epoch())
-    trained = opt.optimize()
-
-    results = trained.evaluate(array(test), [Top1Accuracy()])
-    acc = results[0][0].result()[0]
-    n = results[0][0].result()[1] if len(results[0][0].result()) > 1 else 297
-    print(f"\nFinal distributed Top1Accuracy on held-out digits: "
-          f"{acc:.4f} (target {target}) over {len(test)} samples")
-
-    # restore the numerically-latest checkpoint; must reproduce exactly
-    from bigdl_tpu.utils.file_io import load
-
-    ckpts = [f for f in os.listdir(ckpt_dir) if f.startswith("model.")]
-    latest = max(ckpts, key=lambda f: int(f.rsplit(".", 1)[1]))
-    restored = load(os.path.join(ckpt_dir, latest))
-    racc = restored.evaluate(array(test), [Top1Accuracy()])[0][0].result()[0]
-    print(f"Restored checkpoint {latest} Top1Accuracy: {racc:.4f}")
-    assert abs(racc - acc) < 1e-9, "restore broke the model"
-
-    ok = acc >= target
-    print(("PASS" if ok else "FAIL") + f" accuracy={acc:.4f}")
-    return acc
+    return run_distributed_proof(
+        lambda: ResNetCifar(depth=depth, class_num=10,
+                            shortcut_type="A"), seed=1,
+        sgd_kwargs=dict(learning_rate=0.1, momentum=0.9, weight_decay=1e-4,
+                        nesterov=True, dampening=0.0),
+        max_epoch_n=max_epoch_n, target=target, batch_size=batch_size,
+        ckpt_prefix="bigdl_resnet_ckpt_", label="ResNet")
 
 
 if __name__ == "__main__":

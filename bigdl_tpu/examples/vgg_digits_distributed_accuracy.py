@@ -1,0 +1,47 @@
+"""DISTRIBUTED train-to-accuracy proof for the VGG/CIFAR-10 workload
+(BASELINE.md workload 2: "VGG/CIFAR-10 distributed sync-SGD"; reference
+models/vgg/Train.scala) — VggForCifar10 through DistriOptimizer on the
+8-device mesh: shard_mapped step, sharded momentum slots, pad-and-mask
+trailing batches, on-mesh validation, checkpoint + exact restore.
+
+Data caveat (same as docs/ACCURACY.md): no CIFAR blobs ship in this
+image, so the proof uses the 1797 genuine handwritten 8x8 scans upscaled
+to the model's 3x32x32 input contract.  With a CIFAR-10 folder,
+``bigdl_tpu.models.train --model vgg -f <dir> --distributed`` runs the
+same lifecycle on it.
+
+Measured run (docs/ACCURACY.md): 0.9865 Top1 after 8 epochs, restore
+exact.
+
+Run:  JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+        python -m bigdl_tpu.examples.vgg_digits_distributed_accuracy
+"""
+from __future__ import annotations
+
+import sys
+
+DEFAULT_TARGET = 0.97
+
+
+def main(max_epoch_n: int = 8, target: float = DEFAULT_TARGET,
+         batch_size: int = 64) -> float:
+    from . import default_to_cpu
+
+    default_to_cpu()
+
+    from bigdl_tpu.models.vgg import VggForCifar10
+
+    from ._distributed_proof import run_distributed_proof
+
+    # reference VGG recipe (models/vgg/Train.scala): SGD + momentum +
+    # weight decay
+    return run_distributed_proof(
+        lambda: VggForCifar10(10), seed=2,
+        sgd_kwargs=dict(learning_rate=0.01, momentum=0.9, weight_decay=5e-4,
+                        nesterov=True, dampening=0.0),
+        max_epoch_n=max_epoch_n, target=target, batch_size=batch_size,
+        ckpt_prefix="bigdl_vgg_ckpt_", label="VGG")
+
+
+if __name__ == "__main__":
+    sys.exit(0 if main() >= DEFAULT_TARGET else 1)
