@@ -134,6 +134,7 @@ def build(model_name: str, args):
             seq_strategy="ring" if sp else "dense",
             seq_axis="seq" if sp else None,
             model_axis="model" if tp else None,
+            remat=getattr(args, "remat", False),
             output="logits")
         crit = nn.TimeDistributedCriterion(nn.CrossEntropyCriterion(), True)
         # synthetic char-LM with learnable structure: next token is a
@@ -190,6 +191,10 @@ def main(argv=None):
                         help="seq-axis size for sequence models (ring "
                              "attention over the mesh's seq axis; "
                              "requires --distributed)")
+    parser.add_argument("--remat", action="store_true",
+                        help="rematerialize transformer-block activations "
+                             "in the backward pass (jax.checkpoint): HBM "
+                             "for FLOPs on long contexts; transformer only")
     args = parser.parse_args(argv)
     if ((args.tensor_parallel > 1 or args.seq_parallel > 1)
             and not args.distributed):
@@ -213,7 +218,9 @@ def main(argv=None):
     }[args.model]
     batch = args.batch_size or defaults[0]
     epochs = args.max_epoch or defaults[1]
-    lr = args.learning_rate or defaults[2]
+    # `is None` not `or`: an explicit --learning-rate 0 is a legitimate
+    # frozen-weights request, not a request for the default
+    lr = defaults[2] if args.learning_rate is None else args.learning_rate
 
     from .. import nn  # noqa: F401 — force registry
     from ..dataset.dataset import array
