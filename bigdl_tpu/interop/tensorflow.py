@@ -950,7 +950,9 @@ class TensorflowSaver:
 
         g = tfpb.GraphDef()
         g.versions.producer = 26
-        em = _SaveEmitter(g, nn)
+        pool_shapes, probe_err = _probe_pool_shapes(module, input_shape, nn)
+        em = _SaveEmitter(g, nn, pool_shapes=pool_shapes,
+                          pool_probe_error=probe_err)
 
         ph = g.node.add()
         ph.op = "Placeholder"
@@ -977,11 +979,73 @@ class TensorflowSaver:
         return out  # name of the output node
 
 
+def _walk_modules(module):
+    yield module
+    for child in getattr(module, "modules", ()) or ():
+        yield from _walk_modules(child)
+    for node in getattr(module, "sorted_nodes", ()) or ():  # Graph
+        elem = getattr(node, "element", None)
+        if elem is not None:
+            yield from _walk_modules(elem)
+
+
+def _probe_pool_shapes(module, input_shape, nn):
+    """Input shape at each ceil-mode pooling module via one ABSTRACT
+    forward (``jax.eval_shape`` — no FLOPs): a ceil-mode pool's exact TF
+    export needs the spatial extent at the pool, and the frozen graph
+    pins the Placeholder shape anyway, so the extent is known at save
+    time.  Returns ``({id(pool_module): (..., H, W) | None}, error)``:
+    a ``None`` entry marks an instance observed at CONFLICTING extents
+    (Torch-style module sharing) — the emitter refuses rather than
+    exporting one extent for both sites; ``error`` carries the probe
+    failure, if any, for the refusal message.  Skipped entirely (empty
+    map, no error) when the model has no ceil-mode pool."""
+    import jax
+    import jax.numpy as jnp
+
+    pool_classes = (nn.SpatialMaxPooling, nn.SpatialAveragePooling)
+    if not any(isinstance(m, pool_classes)
+               and getattr(m, "ceil_mode", False)
+               for m in _walk_modules(module)):
+        return {}, None
+
+    rec = {}
+    originals = [(cls, cls.__dict__["_apply"]) for cls in pool_classes]
+
+    def wrap(real):
+        def hooked(self, params, buffers, x, training, rng):
+            shape = tuple(int(d) for d in x.shape)
+            if rec.get(id(self), shape) != shape:
+                rec[id(self)] = None  # shared instance, differing extents
+            else:
+                rec[id(self)] = shape
+            return real(self, params, buffers, x, training, rng)
+        return hooked
+
+    for cls, real in originals:
+        cls._apply = wrap(real)
+    err = None
+    try:
+        dummy = jax.ShapeDtypeStruct(
+            tuple(int(d) for d in input_shape), jnp.float32)
+        jax.eval_shape(
+            lambda p, b, x: module.apply_fn(p, b, x, False, None),
+            module.param_tree(), module.buffer_tree(), dummy)
+    except Exception as e:
+        rec, err = {}, f"{type(e).__name__}: {e}"
+    finally:
+        for cls, real in originals:
+            cls._apply = real
+    return rec, err
+
+
 class _SaveEmitter:
-    def __init__(self, g, nn):
+    def __init__(self, g, nn, pool_shapes=None, pool_probe_error=None):
         self.g = g
         self.nn = nn
         self.idx = 0
+        self.pool_shapes = pool_shapes or {}
+        self.pool_probe_error = pool_probe_error
 
     def add(self, op, name, inputs=(), **attrs):
         n = self.g.node.add()
@@ -1121,26 +1185,62 @@ class _SaveEmitter:
                     "saveTF of global_pooling pools: the kernel extent "
                     "is input-dependent; use Mean or a fixed kernel")
             if (m.pad_w, m.pad_h) == (0, 0):
-                # TF has no ceil attr.  Unpadded ceil pools map to SAME
-                # (out = ceil(in/s); max pads -inf, TF SAME avg divides
-                # by the valid count like a truncated Torch ceil
-                # window).  Torch-ceil emits ceil((in-k)/s)+1: equal to
-                # SAME for every input only when k == s; for k <= 2s-1
-                # it needs the input extent ≡ 0 (mod s) — true of every
-                # zoo trace (224/112/56/28/14), so warn rather than
-                # reject; beyond that the shapes always differ.
-                if ceil and (m.kw > 2 * m.dw - 1 or m.kh > 2 * m.dh - 1):
-                    raise NotImplementedError(
-                        "saveTF of ceil-mode pooling with kernel > "
-                        "2*stride-1 has no TF equivalent")
-                if ceil and (m.kw != m.dw or m.kh != m.dh):
-                    import warnings
-
-                    warnings.warn(
-                        "ceil-mode pool exported as TF SAME: exact only "
-                        "when the input spatial extent is a multiple of "
-                        "the stride", stacklevel=2)
-                padding = b"SAME" if ceil else b"VALID"
+                # TF has no ceil attr.  The input extent at this pool is
+                # known from the save-time shape probe (the frozen graph
+                # pins the Placeholder shape anyway), so the extra
+                # right/bottom ceil window is emitted as an explicit
+                # PadV2 (-inf for max; 0 for avg, whose k*k divisor the
+                # padded VALID AvgPool reproduces exactly) + VALID pool —
+                # exact by construction, never approximated.
+                padding = b"VALID"
+                if ceil:
+                    shp = self.pool_shapes.get(id(m))
+                    if shp is None:
+                        # no probed extent: the abstract forward failed,
+                        # or this one instance was observed at
+                        # CONFLICTING extents (module sharing).  Max
+                        # with k == s is SAME for every input; anything
+                        # else cannot be exported exactly.
+                        if is_max and m.kw == m.dw and m.kh == m.dh:
+                            padding = b"SAME"
+                        else:
+                            why = ("this pool instance is reused at "
+                                   "different input extents"
+                                   if id(m) in self.pool_shapes else
+                                   "shape probe failed: "
+                                   + (self.pool_probe_error or "unknown"))
+                            raise NotImplementedError(
+                                "saveTF of ceil-mode pooling needs one "
+                                f"input extent per instance ({why}): "
+                                "Torch-ceil emits ceil((in-k)/s)+1 "
+                                "windows vs TF VALID's floor((in-k)/s)+1 "
+                                "— inexact export refused")
+                    else:
+                        from ..nn.pooling import _pool_pads
+                        _, pr_h = _pool_pads(shp[-2], m.kh, m.dh, 0, True)
+                        _, pr_w = _pool_pads(shp[-1], m.kw, m.dw, 0, True)
+                        if pr_h or pr_w:
+                            if not is_max and not (
+                                    m.count_include_pad
+                                    and getattr(m, "divide", True)):
+                                raise NotImplementedError(
+                                    "saveTF of ceil-mode AvgPool with a "
+                                    "valid-count divisor "
+                                    "(count_include_pad=False): TF "
+                                    "AvgPool divides explicitly padded "
+                                    "windows by k*k")
+                            pads = np.asarray(
+                                [[0, 0], [0, 0], [0, pr_h], [0, pr_w]],
+                                np.int32)
+                            cp = self.add("Const", nm + "/ceil_paddings",
+                                          value=pads, dtype=tfpb.DT_INT32)
+                            fill = np.float32(-np.inf if is_max else 0.0)
+                            cf = self.add("Const", nm + "/ceil_pad_value",
+                                          value=fill, dtype=tfpb.DT_FLOAT)
+                            prev = self.add("PadV2", nm + "/ceil_pad",
+                                            [prev, cp, cf])
+                        # else: extent - k divides the stride — VALID is
+                        # already exact
             elif m.pad_w == -1 or m.pad_h == -1:
                 padding = b"SAME"
             else:

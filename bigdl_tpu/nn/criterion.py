@@ -453,25 +453,31 @@ class SoftmaxWithCriterion(AbstractCriterion):
         # SoftmaxWithCriterion.scala:64-72), so any target shape with
         # N*H*W elements is legal — notably Caffe's (N, 1, H, W)
         logp = jax.nn.log_softmax(inp, axis=1)
-        # clamp the gather index: ignored labels (Caffe convention 255,
-        # usually >= C) must not poison the gather with NaN fills — the
-        # reference skips them before ever indexing
+        # clamp the gather index: out-of-range labels (Caffe ignore
+        # convention 255, usually >= C) must not poison the gather with
+        # NaN fills — the reference skips them before ever indexing
         # (SoftmaxWithCriterion.scala:72-76); the mask below then zeroes
-        # the clamped picks
-        t = jnp.clip(target.astype(jnp.int32) - 1, 0, inp.shape[1] - 1)
+        # the clamped picks.  With no ignore_label configured, an
+        # out-of-range label is ALSO masked out (zero contribution,
+        # excluded from the VALID count) rather than silently scored as
+        # the clamped class.
+        t0 = target.astype(jnp.int32) - 1
+        t = jnp.clip(t0, 0, inp.shape[1] - 1)
         if inp.ndim == 2:
             picked = jnp.take_along_axis(logp, t.reshape(-1, 1), axis=1)[:, 0]
         else:
             spatial = inp.shape[2:]
-            t = t.reshape(inp.shape[0], 1, *spatial)
-            picked = jnp.take_along_axis(logp, t, axis=1)[:, 0]
+            picked = jnp.take_along_axis(
+                logp, t.reshape(inp.shape[0], 1, *spatial), axis=1)[:, 0]
+        mask = (t0 >= 0) & (t0 < inp.shape[1])
         if self.ignore_label is not None:
-            mask = (target != self.ignore_label).astype(inp.dtype)
-            mask = mask.reshape(picked.shape)
-            picked = picked * mask
-            count = jnp.maximum(jnp.sum(mask), 1.0)
-        else:
-            count = picked.size
+            mask = mask & (target != self.ignore_label)
+        mask = mask.astype(inp.dtype).reshape(picked.shape)
+        picked = picked * mask
+        # VALID normalizes by the masked-in count in every configuration
+        # (with all-in-range labels and no ignore_label this is exactly
+        # picked.size, the pre-masking behavior)
+        count = jnp.maximum(jnp.sum(mask), 1.0)
         if self.normalize_mode == "VALID":
             return -jnp.sum(picked) / count
         if self.normalize_mode == "FULL":

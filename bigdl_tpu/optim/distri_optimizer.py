@@ -317,28 +317,39 @@ class DistriOptimizer(Optimizer):
             batch = next(data_iter)
             x, y = _device_batch(batch)
             n_records = batch.size()
+            mask_kw = {}
             if n_records % n_data != 0:
-                raise ValueError(
-                    f"multi-axis training needs every batch divisible by "
-                    f"the data-axis size {n_data}, got a {n_records}-record "
-                    "batch; size the dataset to a batch multiple (the "
-                    "pad-and-mask partial-batch path exists on the "
-                    "data-parallel mesh only)")
+                # trailing partial batch: pad whole records to the
+                # data-axis multiple and train the real ones via the
+                # per-record weight mask (every-record guarantee on the
+                # multi-axis mesh too; pad rows only touch the data
+                # axis, so seq/model sharding composes unchanged)
+                if not _maskable(y, n_records):
+                    raise ValueError(
+                        "multi-axis training got a trailing partial "
+                        f"batch of {n_records} records but the targets "
+                        "are not record-leading arrays for pad-and-mask; "
+                        "size the dataset to a batch multiple")
+                x, y, w = pad_batch(x, y, n_records,
+                                    round_up(n_records, n_data))
+                mask_kw = {"w": w, "total_w": float(n_records)}
             if n_seq > 1:
                 bad = [a.shape for a in jax.tree_util.tree_leaves(x)
                        if getattr(a, "ndim", 0) > 1
                        and a.shape[1] % n_seq != 0]
                 if bad:
                     raise ValueError(
-                        f"sequence dim of inputs {bad} must divide the "
-                        f"mesh's seq-axis size {n_seq}; pad sequences to "
-                        "a multiple")
+                        f"sequence dim of inputs {bad} must be divisible "
+                        f"by the mesh's seq-axis size {n_seq}; pad "
+                        "sequences to a multiple")
             infeed_time = time.time() - t_data0
 
             t0 = time.time()
             lr = optim.get_current_lr()
             loss, params, slots, buffers = step(params, slots, buffers,
-                                                lr, x, y, rng=next_jax_key())
+                                                lr, x, y,
+                                                rng=next_jax_key(),
+                                                **mask_kw)
             loss = float(loss)  # value fetch = execution barrier
             train_time = time.time() - t0
 
@@ -418,6 +429,9 @@ class DistriOptimizer(Optimizer):
         if self.validation_dataset is None:
             return
         if n_seq > 1:
+            # cheap fast-fail probe on the first sample; ragged LATER
+            # samples are caught by the except below, which re-raises
+            # the opaque shard_map shape error with this same hint
             probe = next(iter(self.validation_dataset.data(train=False)),
                          None)
             if probe is not None and not hasattr(probe, "size"):
@@ -425,13 +439,23 @@ class DistriOptimizer(Optimizer):
                 if arr.ndim >= 1 and arr.shape[0] % n_seq != 0:
                     raise ValueError(
                         f"validation sequence length {arr.shape[0]} must "
-                        f"divide the mesh's seq-axis size {n_seq}; pad "
-                        "sequences to a multiple")
-        results = evaluate_dataset(self.model, self.validation_dataset,
-                                   self.validation_methods,
-                                   batch_size=self.batch_size or 128,
-                                   params=params, buffers=buffers,
-                                   fwd=eval_fwd, n_shard=n_data)
+                        f"be divisible by the mesh's seq-axis size "
+                        f"{n_seq}; pad sequences to a multiple")
+        try:
+            results = evaluate_dataset(self.model, self.validation_dataset,
+                                       self.validation_methods,
+                                       batch_size=self.batch_size or 128,
+                                       params=params, buffers=buffers,
+                                       fwd=eval_fwd, n_shard=n_data)
+        except ValueError as e:
+            if n_seq > 1 and "shard" in str(e).lower():
+                raise ValueError(
+                    f"on-mesh validation failed to shard a batch over "
+                    f"the seq axis (size {n_seq}) — every validation "
+                    f"sequence length must be divisible by {n_seq}; pad "
+                    f"sequences to a multiple (underlying error: {e})"
+                ) from e
+            raise
         self.model.training()
         for method, result in zip(self.validation_methods, results):
             log.info("%s is %s", method.format(), result)

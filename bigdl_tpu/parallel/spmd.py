@@ -25,6 +25,7 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax, shard_map
 from jax.sharding import PartitionSpec as P
 
@@ -155,7 +156,12 @@ def make_train_step(model, criterion, optim, mesh,
     all_axes = tuple(a for a in (data_axis, seq_axis, model_axis) if a)
     n_model = mesh.shape[model_axis] if model_axis else 1
 
-    def _reduce_grad(g, spec):
+    def _spec_sharded(spec):
+        return model_axis is not None and any(
+            model_axis == ax or (isinstance(ax, tuple) and model_axis in ax)
+            for ax in spec if ax is not None)
+
+    def _make_reduce_grad(masked):
         """Tied-parameter chain rule over the mesh.
 
         A replicated param has one copy per device; the gradient of the
@@ -165,15 +171,28 @@ def make_train_step(model, criterion, optim, mesh,
         model-sharded param has copies over (data, seq) only, but its AD
         grad double-counts the model-axis' redundant loss copies — so:
         pmean over (data, seq), divided by the model-axis size.
+
+        ``masked`` (trailing partial batch): the local loss is already
+        normalized by the GLOBAL real-record count, so the data axis
+        contributes a SUM, not a mean; seq/model stay means.
         """
-        sharded = model_axis is not None and any(
-            model_axis == ax or (isinstance(ax, tuple) and model_axis in ax)
-            for ax in spec if ax is not None)
-        if sharded:
-            if batch_axes:
-                g = lax.pmean(g, batch_axes)
-            return g / n_model
-        return lax.pmean(g, all_axes) if all_axes else g
+        def _reduce_grad(g, spec):
+            sharded = _spec_sharded(spec)
+            if masked:
+                if seq_axis:
+                    g = lax.pmean(g, seq_axis)
+                if data_axis:
+                    g = lax.psum(g, data_axis)
+                if sharded:
+                    return g / n_model
+                return lax.pmean(g, model_axis) if model_axis else g
+            if sharded:
+                if batch_axes:
+                    g = lax.pmean(g, batch_axes)
+                return g / n_model
+            return lax.pmean(g, all_axes) if all_axes else g
+
+        return _reduce_grad
 
     from ..optim.regularizer import (collect_regularizer_paths,
                                      regularizer_loss)
@@ -183,58 +202,114 @@ def make_train_step(model, criterion, optim, mesh,
     reg_paths = list(collect_regularizer_paths(model))
     scale_tree = model.gradient_scale_tree()
     needs_scale = any(s != 1.0 for s in jax.tree_util.tree_leaves(scale_tree))
+    n_data = mesh.shape[data_axis] if data_axis else 1
 
-    def local_step(params, slots, buf, lr, rng, x, y):
-        if rng is not None and batch_axes:
-            # decorrelate dropout across batch shards; model-axis peers
-            # keep the SAME key (they hold slices of one logical model)
-            for a in batch_axes:
-                rng = jax.random.fold_in(rng, lax.axis_index(a))
+    def _spec_for_path(path):
+        node = pspecs
+        for k in path:
+            node = node[k]
+        return node
 
-        def loss_fn(p):
-            out, nb = cast_fwd(p, buf, x, True, rng)
-            return criterion._loss(out, y), nb
+    # split reg paths so the LOGGED loss can psum the model-sharded
+    # params' penalty over the model axis (each shard sees only its
+    # slice); gradients never need this — per-slice reg grads are exact
+    reg_sharded = [pr for pr in reg_paths
+                   if _spec_sharded(_spec_for_path(pr[0]))]
+    reg_repl = [pr for pr in reg_paths if pr not in reg_sharded]
 
-        (loss, nb), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
-        grads = jax.tree_util.tree_map(_reduce_grad, grads, pspecs)
-        if reg_paths:
-            # regularizer gradients in a SEPARATE pass added after the
-            # cross-shard reduction: each shard's reg grad for its own
-            # (slice of the) parameter is already exact, so it must not
-            # go through _reduce_grad's pmean/n_model scaling
-            reg_g = jax.grad(lambda p: regularizer_loss(p, reg_paths))(params)
-            grads = jax.tree_util.tree_map(lambda g, r: g + r, grads, reg_g)
-            # logged loss includes the reg term (local view: exact without
-            # a model axis; with one, sharded-param reg counts the local
-            # slice — gradients above are exact either way)
-            loss = loss + regularizer_loss(params, reg_paths)
-        if needs_scale:  # reference setScaleW/setScaleB semantics
-            grads = jax.tree_util.tree_map(lambda g, s: g * s,
-                                           grads, scale_tree)
-        if batch_axes:
-            loss = lax.pmean(loss, batch_axes)
-            # sync running stats (BatchNorm) across batch shards, as the
-            # data-parallel driver does (distri_optimizer.py:148)
-            nb = jax.tree_util.tree_map(
-                lambda b: (lax.pmean(b, batch_axes)
-                           if jnp.issubdtype(b.dtype, jnp.floating) else b),
-                nb)
-        new_params, new_slots = optim.step(grads, params, slots, lr)
-        return loss, new_params, new_slots, nb
+    def _reg_term(p):
+        term = regularizer_loss(p, reg_repl)
+        if reg_sharded:
+            term = term + lax.psum(regularizer_loss(p, reg_sharded),
+                                   model_axis)
+        return term
+
+    def _make_local_step(masked):
+        reduce_grad = _make_reduce_grad(masked)
+
+        def local_step(params, slots, buf, lr, rng, x, y, *mask_args):
+            if rng is not None and batch_axes:
+                # decorrelate dropout across batch shards; model-axis peers
+                # keep the SAME key (they hold slices of one logical model)
+                for a in batch_axes:
+                    rng = jax.random.fold_in(rng, lax.axis_index(a))
+
+            def loss_fn(p):
+                out, nb = cast_fwd(p, buf, x, True, rng)
+                if masked:
+                    # trailing partial batch: per-record loss weighted by
+                    # the 1-real/0-pad mask over the GLOBAL real count —
+                    # every record of an epoch trains exactly once at
+                    # static shape (reference DataSet.scala:255-288)
+                    w, total_w = mask_args
+                    add_axis = lambda v: jax.tree_util.tree_map(
+                        lambda a: a[None], v)
+                    per = jax.vmap(
+                        lambda o, t: criterion._loss(add_axis(o),
+                                                     add_axis(t)))(out, y)
+                    return jnp.sum(per * w) / total_w, nb
+                return criterion._loss(out, y), nb
+
+            (loss, nb), grads = jax.value_and_grad(loss_fn,
+                                                   has_aux=True)(params)
+            grads = jax.tree_util.tree_map(reduce_grad, grads, pspecs)
+            if reg_paths:
+                # regularizer gradients in a SEPARATE pass added after the
+                # cross-shard reduction: each shard's reg grad for its own
+                # (slice of the) parameter is already exact, so it must not
+                # go through _reduce_grad's pmean/n_model scaling
+                reg_g = jax.grad(
+                    lambda p: regularizer_loss(p, reg_paths))(params)
+                grads = jax.tree_util.tree_map(lambda g, r: g + r,
+                                               grads, reg_g)
+                reg = _reg_term(params)
+                # masked loss is data-psum'd below: pre-divide so the
+                # penalty isn't multiplied by the data-axis size
+                loss = loss + (reg / n_data if masked else reg)
+            if needs_scale:  # reference setScaleW/setScaleB semantics
+                grads = jax.tree_util.tree_map(lambda g, s: g * s,
+                                               grads, scale_tree)
+            if masked:
+                if data_axis:
+                    loss = lax.psum(loss, data_axis)
+                if seq_axis:
+                    loss = lax.pmean(loss, seq_axis)
+                # padded rows would pollute batch statistics (BatchNorm
+                # running mean/var): keep the pre-step buffers for the
+                # trailing partial batch (data driver does the same)
+                nb = buf
+            elif batch_axes:
+                loss = lax.pmean(loss, batch_axes)
+                # sync running stats (BatchNorm) across batch shards, as
+                # the data-parallel driver does (distri_optimizer.py:148)
+                nb = jax.tree_util.tree_map(
+                    lambda b: (lax.pmean(b, batch_axes)
+                               if jnp.issubdtype(b.dtype, jnp.floating)
+                               else b),
+                    nb)
+            new_params, new_slots = optim.step(grads, params, slots, lr)
+            return loss, new_params, new_slots, nb
+
+        return local_step
 
     _jitted_cache = {}
 
-    def _jitted_for(x, y):
+    def _jitted_for(x, y, masked):
         """shard_map specs are static: build (and cache) one executable
-        per input tree-structure/rank signature."""
-        key = jax.tree_util.tree_structure((x, y)), tuple(
+        per input tree-structure/rank signature (× masked variant)."""
+        key = (jax.tree_util.tree_structure((x, y)), tuple(
             getattr(a, "ndim", 0)
-            for a in jax.tree_util.tree_leaves((x, y)))
+            for a in jax.tree_util.tree_leaves((x, y))), masked)
         if key not in _jitted_cache:
+            in_specs = (pspecs, sslots, bspecs, P(), P(), io_spec(x),
+                        io_spec(y))
+            if masked:
+                # weight vector shards over data only (pad rows are
+                # whole records); the real count replicates
+                in_specs = in_specs + (P(data_axis), P())
             sharded = shard_map(
-                local_step, mesh=mesh,
-                in_specs=(pspecs, sslots, bspecs, P(), P(), io_spec(x),
-                          io_spec(y)),
+                _make_local_step(masked), mesh=mesh,
+                in_specs=in_specs,
                 out_specs=(P(), pspecs, sslots, bspecs),
                 check_vma=False)
             _jitted_cache[key] = jax.jit(
@@ -242,13 +317,16 @@ def make_train_step(model, criterion, optim, mesh,
                 static_argnums=())
         return _jitted_cache[key]
 
-    def step(params, slots, buf, lr, x, y, rng=None):
+    def step(params, slots, buf, lr, x, y, rng=None, w=None, total_w=None):
         x = jax.tree_util.tree_map(jnp.asarray, x)
         y = jax.tree_util.tree_map(jnp.asarray, y)
         if rng is None:  # deterministic default (ad-hoc/test use)
             rng = jax.random.PRNGKey(0)
-        return _jitted_for(x, y)(params, slots, buf, jnp.float32(lr), rng,
-                                 x, y)
+        args = (params, slots, buf, jnp.float32(lr), rng, x, y)
+        if w is not None:
+            args = args + (jnp.asarray(w, jnp.float32),
+                           jnp.float32(total_w))
+        return _jitted_for(x, y, w is not None)(*args)
 
     step.param_specs = pspecs
     step.slot_specs = sslots
@@ -256,19 +334,30 @@ def make_train_step(model, criterion, optim, mesh,
     return step
 
 
+_AUTO = "auto"
+
+
 def make_eval_forward(model, mesh, data_axis: Optional[str] = "data",
                       seq_axis: Optional[str] = "seq",
                       model_axis: Optional[str] = "model",
                       input_seq_dim: Optional[int] = 1,
-                      compute_dtype=None):
+                      compute_dtype=None, output_seq_dim=_AUTO):
     """Compiled forward over the same multi-axis mesh/specs as
     :func:`make_train_step` — validation/inference for models whose
     eager forward needs bound mesh axes (ring attention, RowParallel
-    psum).  Assumes sequence models keep the sequence dim of their
-    outputs at ``input_seq_dim`` (true for TransformerLM logits); batch
-    dim shards over ``data``.  Returns ``fwd(params, buffers, x) ->
-    out`` with out gathered per-call semantics (fetching the result
-    reassembles the full array)."""
+    psum).  Batch dim shards over ``data``.
+
+    ``output_seq_dim`` — which dim of each output leaf is the sequence
+    dim (sharded over ``seq`` on reassembly).  The default ``"auto"``
+    uses ``input_seq_dim`` and VALIDATES it against the probed local
+    output shapes: a rank>=2 output whose dim-1 extent is not the local
+    sequence extent (e.g. a pooled (B, C) classifier head) raises
+    instead of silently reassembling a wrong result.  Pass an explicit
+    int to override, or ``None`` for outputs with no sequence dim
+    (replicated across the seq axis — the model must reduce over it
+    internally).  Returns ``fwd(params, buffers, x) -> out`` with out
+    gathered per-call semantics (fetching the result reassembles the
+    full array)."""
     data_axis, seq_axis, model_axis = _resolve_axes(
         mesh, data_axis, seq_axis, model_axis)
 
@@ -284,11 +373,11 @@ def make_eval_forward(model, mesh, data_axis: Optional[str] = "data",
         return out
 
     _cache = {}
-    _ranks = {}  # input treedef -> output rank tree
+    _shapes = {}  # input treedef/shapes -> local output shape tree
 
-    def _probe_out_ranks(params, buf, x):
-        """Output ranks via a minimal shard_map whose outputs are rank
-        indicators only (an eager/eval_shape trace would hit the same
+    def _probe_out_shapes(params, buf, x):
+        """LOCAL output shapes via a minimal shard_map whose outputs are
+        shape vectors only (an eager/eval_shape trace would hit the same
         unbound-axis problem the whole helper exists to avoid).  Probes
         on the smallest batch (one record per data shard) so the extra
         compile is cheap."""
@@ -296,30 +385,67 @@ def make_eval_forward(model, mesh, data_axis: Optional[str] = "data",
         tiny = jax.tree_util.tree_map(
             lambda a: a[:n_data] if getattr(a, "ndim", 0) >= 1 else a, x)
 
-        def rank_fn(p, b, xx):
+        def shape_fn(p, b, xx):
             out = local_fwd(p, b, xx)
             return jax.tree_util.tree_map(
-                lambda o: jnp.zeros((o.ndim,), jnp.float32), out)
+                lambda o: jnp.asarray(o.shape, jnp.int32), out)
 
-        probe = shard_map(rank_fn, mesh=mesh,
+        probe = shard_map(shape_fn, mesh=mesh,
                           in_specs=(pspecs, bspecs, io_spec(tiny)),
                           out_specs=P(), check_vma=False)
-        rank_tree = jax.jit(probe)(params, buf, tiny)
-        return jax.tree_util.tree_map(lambda r: int(r.shape[0]), rank_tree)
+        shape_tree = jax.jit(probe)(params, buf, tiny)
+        return jax.tree_util.tree_map(
+            lambda s: tuple(int(v) for v in np.asarray(s)), shape_tree,
+            is_leaf=lambda s: hasattr(s, "shape"))
+
+    def _check_out_seq(local_shapes, x):
+        """auto mode: a rank>=2 output leaf is about to have its dim
+        ``input_seq_dim`` sharded over ``seq`` on reassembly — verify
+        that dim's local extent IS the local sequence extent."""
+        n_seq = mesh.shape[seq_axis]
+        seq_exts = {a.shape[input_seq_dim]
+                    for a in jax.tree_util.tree_leaves(x)
+                    if getattr(a, "ndim", 0) > input_seq_dim}
+        expect = {e // n_seq for e in seq_exts}
+        for shp in jax.tree_util.tree_leaves(
+                local_shapes, is_leaf=lambda s: isinstance(s, tuple)):
+            if (len(shp) > input_seq_dim
+                    and shp[input_seq_dim] not in expect):
+                raise ValueError(
+                    f"make_eval_forward: output leaf with local shape "
+                    f"{shp} does not carry the sequence dim at dim "
+                    f"{input_seq_dim} (local seq extent(s) "
+                    f"{sorted(expect)}); reassembling it over the "
+                    f"'{seq_axis}' axis would be wrong (e.g. a pooled "
+                    "(B, C) head).  Pass output_seq_dim=None if the "
+                    "output has no sequence dim (the model must reduce "
+                    "over the seq axis internally), or an explicit "
+                    "output_seq_dim int.")
+
+    osd = output_seq_dim
+    out_seq_dim = input_seq_dim if osd is _AUTO else osd
+    out_spec_fn = (in_spec if out_seq_dim == input_seq_dim
+                   else _in_spec_fn(data_axis, seq_axis, out_seq_dim))
 
     def fwd(params, buf, x):
         x = jax.tree_util.tree_map(jnp.asarray, x)
         treedef = jax.tree_util.tree_structure(x)
-        # rank key includes input ndims: same treedef with different
-        # ranks can produce different OUTPUT ranks
-        rank_key = treedef, tuple(getattr(a, "ndim", 0)
-                                  for a in jax.tree_util.tree_leaves(x))
+        # keyed by full input SHAPES (not just ranks): the seq-dim
+        # validation below compares probed local extents against THIS
+        # input's sequence length, so shapes probed for one length must
+        # never be reused for another (a (B, 8) and a (B, 16) batch have
+        # equal ranks but different local extents)
         key = treedef, tuple(a.shape
                              for a in jax.tree_util.tree_leaves(x))
         if key not in _cache:
-            if rank_key not in _ranks:
-                _ranks[rank_key] = _probe_out_ranks(params, buf, x)
-            out_specs = jax.tree_util.tree_map(in_spec, _ranks[rank_key])
+            if key not in _shapes:
+                _shapes[key] = _probe_out_shapes(params, buf, x)
+            local_shapes = _shapes[key]
+            if (osd is _AUTO and seq_axis and input_seq_dim is not None):
+                _check_out_seq(local_shapes, x)
+            out_specs = jax.tree_util.tree_map(
+                lambda shp: out_spec_fn(len(shp)), local_shapes,
+                is_leaf=lambda s: isinstance(s, tuple))
             sharded = shard_map(local_fwd, mesh=mesh,
                                 in_specs=(pspecs, bspecs, io_spec(x)),
                                 out_specs=out_specs, check_vma=False)

@@ -150,24 +150,62 @@ def test_partial_batch_divisible_by_data_axis_trains():
     assert np.isfinite(opt.optim_method.state["loss"])
 
 
-def test_partial_batch_rejected_with_clear_error():
-    # Sample streams wrap to full batches; only a MiniBatch-direct
-    # dataset can deliver an indivisible partial batch (same contract as
-    # the data path's pad-and-mask tests)
+def test_partial_batch_trains_every_record_on_tp_mesh():
+    """Every-record guarantee on the multi-axis mesh: an indivisible
+    trailing batch pads-and-masks (whole records, data axis only) and
+    the TP lifecycle matches the data-parallel dense twin — which runs
+    its own, independently-implemented masked path — exactly."""
     from bigdl_tpu.dataset import MiniBatch
 
-    mesh = Mesh(np.array(jax.devices()[:8]).reshape(2, 4), ("data", "model"))
-    model = _tp_model()
-    rng = np.random.RandomState(0)
-    mk = lambda m: MiniBatch(rng.rand(m, DIM).astype(np.float32),
-                             np.ones((m,), np.float32))
-    opt = DistriOptimizer(model, array([mk(16), mk(15)]),
-                          nn.ClassNLLCriterion(),
-                          batch_size=16, mesh=mesh)
-    opt.set_optim_method(SGD(learning_rate=0.1))
-    opt.set_end_when(max_iteration(3))
-    with pytest.raises(ValueError, match="multi-axis"):
+    def batches():
+        rng = np.random.RandomState(0)
+        xs = rng.rand(31, DIM).astype(np.float32)
+        ys = (1 + (xs.sum(1) > DIM / 2)).astype(np.float32)
+        return [MiniBatch(xs[:16], ys[:16]), MiniBatch(xs[16:], ys[16:])]
+
+    def drive(model, mesh_arg):
+        RNG().set_seed(123)
+        opt = DistriOptimizer(model, array(batches()),
+                              nn.ClassNLLCriterion(),
+                              batch_size=16, mesh=mesh_arg)
+        opt.set_optim_method(SGD(learning_rate=0.2, momentum=0.5))
+        opt.set_end_when(max_iteration(2))
         opt.optimize()
+        return model.param_tree()
+
+    mesh = Mesh(np.array(jax.devices()[:8]).reshape(2, 4), ("data", "model"))
+    got = drive(_tp_model(), mesh)  # 15-record batch: 15 % 2 != 0
+    want = drive(_dense_model(),
+                 Mesh(np.array(jax.devices()[:8]), ("data",)))
+    for a, b in zip(jax.tree_util.tree_leaves(got),
+                    jax.tree_util.tree_leaves(want)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-3)
+
+
+def test_partial_batch_trains_on_three_axis_mesh():
+    """Pad-and-mask composes with seq+model sharding: pad rows are whole
+    records, so only the data axis sees them."""
+    from bigdl_tpu.dataset import MiniBatch
+    from bigdl_tpu.models.transformer import TransformerLM
+
+    V, T = 11, 8
+    mesh = Mesh(np.array(jax.devices()[:8]).reshape(2, 2, 2),
+                ("data", "seq", "model"))
+    RNG().set_seed(4)
+    lm = TransformerLM(V, embed_dim=8, num_heads=2, num_layers=1, max_len=T,
+                       seq_strategy="ring", seq_axis="seq",
+                       model_axis="model")
+    rng = np.random.RandomState(2)
+    mk = lambda m: MiniBatch(
+        rng.randint(1, V, (m, T)).astype(np.float32),
+        rng.randint(1, V + 1, (m, T)).astype(np.float32))
+    crit = nn.TimeDistributedCriterion(nn.ClassNLLCriterion(), True)
+    opt = DistriOptimizer(lm, array([mk(8), mk(5)]), crit,
+                          batch_size=8, mesh=mesh)
+    opt.set_optim_method(SGD(learning_rate=0.5))
+    opt.set_end_when(max_iteration(4))
+    opt.optimize()
+    assert np.isfinite(opt.optim_method.state["loss"])
 
 
 def test_make_eval_forward_ring_lm_matches_dense_eager():
@@ -201,6 +239,32 @@ def test_make_eval_forward_ring_lm_matches_dense_eager():
     fwd = make_eval_forward(ring, mesh)
     got = np.asarray(fwd(params, ring.buffer_tree(), x))
     np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+def test_eval_forward_pooled_head_raises_on_seq_mesh():
+    """A rank>=2 output whose dim 1 is NOT the sequence dim must refuse
+    seq-axis reassembly instead of silently returning a wrong result
+    (advisor finding r3); output_seq_dim=None opts out explicitly."""
+    from jax.sharding import NamedSharding
+
+    from bigdl_tpu.parallel.spmd import make_eval_forward, param_specs
+
+    B, T, F = 4, 8, 5
+    mesh = Mesh(np.array(jax.devices()[:4]).reshape(2, 2), ("data", "seq"))
+    model = nn.Mean(dimension=2, squeeze=True)  # (B, T, F) -> (B, F)
+    x = jnp.asarray(np.random.RandomState(0).rand(B, T, F), jnp.float32)
+    params = jax.tree_util.tree_map(
+        lambda a, s: jax.device_put(a, NamedSharding(mesh, s)),
+        model.param_tree(), param_specs(model, "model"))
+
+    fwd = make_eval_forward(model, mesh)
+    with pytest.raises(ValueError, match="output_seq_dim"):
+        fwd(params, model.buffer_tree(), x)
+
+    # explicit opt-out compiles and returns the un-seq-sharded shape
+    fwd2 = make_eval_forward(model, mesh, output_seq_dim=None)
+    out = fwd2(params, model.buffer_tree(), x)
+    assert out.shape == (B, F)
 
 
 def test_multi_axis_retry_recovers_from_checkpoint(tmp_path):
