@@ -215,7 +215,12 @@ def _bench_resnet(batch, iters, warmup, compute_dtype, rng, spd=1,
 
 def _bench_transformer_lm(rng, iters=16, spd=2, seq_len=1024, batch=16):
     """Flagship LM: flash attention + fused xent, bf16.  Returns
-    (tokens_per_sec, model_flops_per_sec) with the standard 6ND count."""
+    (tokens_per_sec, model_flops_per_sec_6nd, flops_per_sec_attn_incl).
+
+    The 6ND convention counts NO attention-score FLOPs, which grow
+    linearly in T and are real MXU work — the attention-inclusive rate
+    adds 6·T·D·L per token (causal QK^T + PV, fwd×3) so long-context
+    rows stop hiding kernel time (VERDICT r3 #2)."""
     import jax
     import jax.numpy as jnp
     from bigdl_tpu import nn
@@ -233,7 +238,9 @@ def _bench_transformer_lm(rng, iters=16, spd=2, seq_len=1024, batch=16):
                          compute_dtype=jnp.bfloat16,
                          steps_per_dispatch=spd)
     tokens_per_sec = rps * T
-    return tokens_per_sec, 6.0 * n_params * tokens_per_sec
+    attn_flops_per_token = 6.0 * T * D * L  # causal, train (fwd x3)
+    return (tokens_per_sec, 6.0 * n_params * tokens_per_sec,
+            (6.0 * n_params + attn_flops_per_token) * tokens_per_sec)
 
 
 def _bench_resnet_adaptive(batch, iters, warmup, compute_dtype, rng, spd=1,
@@ -393,11 +400,13 @@ def run_worker(backend: str) -> None:
     # shows the framework's MFU ceiling next to the conv-bound ResNet)
     if on_tpu:
         try:
-            lm_tps, lm_fps = _bench_transformer_lm(rng)
+            lm_tps, lm_fps, lm_fps_attn = _bench_transformer_lm(rng)
             out["transformerlm_tokens_per_sec"] = round(lm_tps, 1)
             out["transformerlm_model_flops_per_sec"] = round(lm_fps, 1)
             if peak:
                 out["transformerlm_mfu"] = round(lm_fps / peak, 4)
+                out["transformerlm_mfu_attn_incl"] = round(
+                    lm_fps_attn / peak, 4)
         except Exception as e:
             out["transformerlm_error"] = f"{type(e).__name__}: {e}"[:300]
         # long-context: same model at T=4096 (dense attention OOMs here;
@@ -406,11 +415,13 @@ def run_worker(backend: str) -> None:
             out["transformerlm_T4096_skipped"] = "worker time budget"
         else:
             try:
-                long_tps, long_fps = _bench_transformer_lm(
+                long_tps, long_fps, long_fps_attn = _bench_transformer_lm(
                     rng, iters=8, spd=2, seq_len=4096, batch=4)
                 out["transformerlm_T4096_tokens_per_sec"] = round(long_tps, 1)
                 if peak:
                     out["transformerlm_T4096_mfu"] = round(long_fps / peak, 4)
+                    out["transformerlm_T4096_mfu_attn_incl"] = round(
+                        long_fps_attn / peak, 4)
             except Exception as e:
                 out["transformerlm_T4096_error"] = \
                     f"{type(e).__name__}: {e}"[:300]

@@ -1,0 +1,304 @@
+"""ResNet-50 conv-MFU lab (VERDICT r3 #1) — run on the TPU when up.
+
+Three experiments, each one JSON line to stdout (and appended to
+``MFU_LAB.jsonl`` in the repo root when writable):
+
+  python -m bigdl_tpu.models.resnet_mfu_lab --twin [--impl xla|gemm]
+      Independent plain-JAX NHWC ResNet-50 train step
+      (models/resnet_jax_twin.py) — proves whether the framework's 13.7%
+      is XLA's conv ceiling or this framework's graph/layouts.
+
+  python -m bigdl_tpu.models.resnet_mfu_lab --convshapes
+      Every distinct ResNet-50 conv shape microbenched fwd+bwd:
+      XLA native lowering vs the k²-matmul lowering (ops/conv_gemm),
+      TFLOP/s side by side.
+
+  python -m bigdl_tpu.models.resnet_mfu_lab --framework --impl gemm
+      The framework's own ResNet50 (NCHW) end-to-end with the chosen
+      conv lowering, via bench.py's bench_model timing contract.
+
+Timing uses the value-fetch barrier (the only sound barrier over the
+tunnel — docs/PERF.md "Tunnel semantics").
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import time
+
+RESNET50_FWD_FLOPS_PER_IMAGE = 4.09e9
+
+
+def _bench_module():
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "bench", os.path.join(os.path.dirname(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__)))), "bench.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+# distinct (cin, cout, k, stride, spatial_in) conv shapes of ResNet-50
+# at 224² with their per-image multiplicity
+RESNET50_CONV_SHAPES = [
+    (3, 64, 7, 2, 224, 1),
+    (64, 64, 1, 1, 56, 1), (64, 64, 3, 1, 56, 3), (64, 256, 1, 1, 56, 3),
+    (256, 64, 1, 1, 56, 2), (256, 128, 1, 2, 56, 1),
+    (128, 128, 3, 1, 28, 4), (128, 512, 1, 1, 28, 4),
+    (512, 128, 1, 1, 28, 3), (256, 512, 1, 2, 56, 1),
+    (512, 256, 1, 2, 28, 1), (256, 256, 3, 1, 14, 6),
+    (256, 1024, 1, 1, 14, 6), (1024, 256, 1, 1, 14, 5),
+    (512, 1024, 1, 2, 28, 1), (1024, 512, 1, 2, 14, 1),
+    (512, 512, 3, 1, 7, 3), (512, 2048, 1, 1, 7, 3),
+    (2048, 512, 1, 1, 7, 2), (1024, 2048, 1, 2, 14, 1),
+]
+
+
+def _peak():
+    import jax
+    kind = getattr(jax.devices()[0], "device_kind", "") or ""
+    return _bench_module().peak_flops_per_sec(kind)  # ONE peak table
+
+
+def _emit(rec):
+    line = json.dumps(rec)
+    print(line, flush=True)
+    try:
+        root = os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))))
+        with open(os.path.join(root, "MFU_LAB.jsonl"), "a") as f:
+            f.write(line + "\n")
+    except OSError:
+        pass
+
+
+def run_twin(impl, batches=(64, 128, 256), iters=20, warmup=4):
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from .resnet_jax_twin import init_params, make_train_step
+
+    peak = _peak()
+    out = {"exp": "twin", "impl": impl,
+           "device": str(jax.devices()[0]), "sweep": {}}
+    best = 0.0
+    for B in batches:
+        try:
+            params = init_params(jax.random.PRNGKey(0))
+            vel = jax.tree_util.tree_map(jnp.zeros_like, params)
+            step = make_train_step(impl=impl)
+            rng = np.random.RandomState(0)
+            x = jnp.asarray(rng.rand(B, 224, 224, 3), jnp.bfloat16)
+            y = jnp.asarray(rng.randint(0, 1000, B), jnp.int32)
+            for _ in range(warmup):
+                loss, params, vel = step(params, vel, x, y)
+            float(loss)
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                loss, params, vel = step(params, vel, x, y)
+            float(loss)
+            dt = time.perf_counter() - t0
+            ips = B * iters / dt
+            out["sweep"][str(B)] = round(ips, 2)
+            best = max(best, ips)
+        except Exception as e:
+            out["sweep"][str(B)] = f"{type(e).__name__}: {e}"[:200]
+    out["images_per_sec"] = round(best, 2)
+    if peak and best:
+        out["mfu"] = round(best * RESNET50_FWD_FLOPS_PER_IMAGE * 3 / peak,
+                           4)
+        out["peak_flops_per_sec"] = peak
+    _emit(out)
+
+
+def run_convshapes(batch=128, iters=10, warmup=2):
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax import lax
+
+    from ..ops.conv_gemm import conv2d_gemm_nhwc
+
+    peak = _peak()
+    rng = np.random.RandomState(0)
+    rows = []
+    for cin, cout, k, s, hw, mult in RESNET50_CONV_SHAPES:
+        pad = (k // 2, k // 2)
+        ho = hw // s
+        flops = 2.0 * batch * ho * ho * cin * cout * k * k
+        x = jnp.asarray(rng.rand(batch, hw, hw, cin), jnp.bfloat16)
+        w = jnp.asarray(rng.rand(k, k, cin, cout) * 0.01, jnp.bfloat16)
+        row = {"shape": f"{cin}x{cout} k{k} s{s} {hw}²", "mult": mult,
+               "flops_per_call": flops}
+
+        def xla_conv(x, w):
+            return lax.conv_general_dilated(
+                x, w, (s, s), (pad, pad),
+                dimension_numbers=("NHWC", "HWIO", "NHWC"))
+
+        def gemm_conv(x, w):
+            return conv2d_gemm_nhwc(x, w, stride=(s, s), padding=pad)
+
+        for name, fn in (("xla", xla_conv), ("gemm", gemm_conv)):
+            # fwd+bwd: grad of sum wrt both operands — the training cost
+            f = jax.jit(jax.grad(
+                lambda x, w: jnp.sum(fn(x, w).astype(jnp.float32)),
+                argnums=(0, 1)))
+            try:
+                for _ in range(warmup):
+                    gx, gw = f(x, w)
+                float(jnp.sum(gw.astype(jnp.float32)))
+                t0 = time.perf_counter()
+                for _ in range(iters):
+                    gx, gw = f(x, w)
+                float(jnp.sum(gw.astype(jnp.float32)))
+                dt = (time.perf_counter() - t0) / iters
+                row[name + "_tflops"] = round(3 * flops / dt / 1e12, 2)
+            except Exception as e:
+                row[name + "_tflops"] = f"{type(e).__name__}"[:60]
+        rows.append(row)
+        print(json.dumps(row), flush=True)
+    total = sum(r["flops_per_call"] * r["mult"]
+                for r in rows)
+
+    def model_tflops(key):
+        t = 0.0
+        for r in rows:
+            v = r.get(key)
+            if not isinstance(v, (int, float)) or v <= 0:
+                return None
+            t += r["flops_per_call"] * r["mult"] / (v * 1e12)
+        return total / t / 1e12
+
+    summary = {"exp": "convshapes", "batch": batch,
+               "xla_weighted_tflops": model_tflops("xla_tflops"),
+               "gemm_weighted_tflops": model_tflops("gemm_tflops"),
+               "peak_flops_per_sec": peak, "rows": rows}
+    _emit(summary)
+
+
+def run_framework(impl, batches=(64, 128, 256)):
+    import jax.numpy as jnp
+    import numpy as np
+
+    bench = _bench_module()
+
+    from .. import nn
+    from .resnet import ResNet50
+
+    os.environ["bigdl.conv.impl"] = impl
+    peak = _peak()
+    rng = np.random.RandomState(0)
+    out = {"exp": "framework", "impl": impl, "sweep": {}}
+    best = 0.0
+    for B in batches:
+        try:
+            x = rng.rand(B, 3, 224, 224).astype("bfloat16")
+            y = rng.randint(1, 1001, B).astype("float32")
+            ips, _ = bench.bench_model(
+                ResNet50(1000), nn.ClassNLLCriterion(), x, y,
+                iters=20, warmup=4, compute_dtype=jnp.bfloat16,
+                steps_per_dispatch=4)
+            out["sweep"][str(B)] = round(ips, 2)
+            best = max(best, ips)
+        except Exception as e:
+            out["sweep"][str(B)] = f"{type(e).__name__}: {e}"[:200]
+    out["images_per_sec"] = round(best, 2)
+    if peak and best:
+        out["mfu"] = round(best * RESNET50_FWD_FLOPS_PER_IMAGE * 3 / peak,
+                           4)
+    _emit(out)
+
+
+def run_flash(seq_lens=(1024, 4096, 8192), blocks=(256, 512, 1024),
+              iters=10, warmup=2):
+    """Flash kernel fwd+bwd timing per (T, block) — the VERDICT r3 #2
+    tuning matrix.  16 heads × 64 head-dim (the bench LM's shape),
+    causal, bf16, constant 16k tokens per step."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ..ops.flash_attention import flash_attention
+
+    peak = _peak()
+    rng = np.random.RandomState(0)
+    rows = []
+    for T in seq_lens:
+        B = max(16384 // T, 1)
+        H, D = 16, 64
+        q = jnp.asarray(rng.rand(B, H, T, D), jnp.bfloat16)
+        k = jnp.asarray(rng.rand(B, H, T, D), jnp.bfloat16)
+        v = jnp.asarray(rng.rand(B, H, T, D), jnp.bfloat16)
+        # causal attention FLOPs: QK^T + PV at T/2 average extent
+        flops_fwd = 2.0 * B * H * T * T * D  # 2 matmuls x (T²/2) x 2
+        for blk in blocks:
+            if blk > T:
+                continue
+            row = {"exp": "flash", "T": T, "B": B, "block": blk}
+
+            def f(q, k, v):
+                return jnp.sum(flash_attention(
+                    q, k, v, causal=True, block_q=blk,
+                    block_k=blk).astype(jnp.float32))
+
+            try:
+                fwd = jax.jit(f)
+                for _ in range(warmup):
+                    s = fwd(q, k, v)
+                float(s)
+                t0 = time.perf_counter()
+                for _ in range(iters):
+                    s = fwd(q, k, v)
+                float(s)
+                dt = (time.perf_counter() - t0) / iters
+                row["fwd_ms"] = round(dt * 1e3, 2)
+                row["fwd_tflops"] = round(flops_fwd / dt / 1e12, 2)
+
+                grad = jax.jit(jax.grad(f, argnums=(0, 1, 2)))
+                for _ in range(warmup):
+                    gs = grad(q, k, v)
+                float(jnp.sum(gs[0].astype(jnp.float32)))
+                t0 = time.perf_counter()
+                for _ in range(iters):
+                    gs = grad(q, k, v)
+                float(jnp.sum(gs[0].astype(jnp.float32)))
+                dt = (time.perf_counter() - t0) / iters
+                row["fwdbwd_ms"] = round(dt * 1e3, 2)
+                row["fwdbwd_tflops"] = round(3 * flops_fwd / dt / 1e12, 2)
+                if peak:
+                    row["fwdbwd_frac_of_peak"] = round(
+                        3 * flops_fwd / dt / peak, 4)
+            except Exception as e:
+                row["error"] = f"{type(e).__name__}: {e}"[:200]
+            rows.append(row)
+            print(json.dumps(row), flush=True)
+    _emit({"exp": "flash_summary", "rows": rows,
+           "peak_flops_per_sec": peak})
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--twin", action="store_true")
+    p.add_argument("--convshapes", action="store_true")
+    p.add_argument("--framework", action="store_true")
+    p.add_argument("--flash", action="store_true")
+    p.add_argument("--impl", default="xla", choices=["xla", "gemm"])
+    p.add_argument("--batch", type=int, default=128)
+    p.add_argument("--iters", type=int, default=20)
+    a = p.parse_args()
+    if a.twin:
+        run_twin(a.impl, iters=a.iters)
+    if a.convshapes:
+        run_convshapes(batch=a.batch)
+    if a.framework:
+        run_framework(a.impl)
+    if a.flash:
+        run_flash()
+
+
+if __name__ == "__main__":
+    main()

@@ -55,7 +55,15 @@ class SpatialConvolution(TensorModule):
         self.with_bias = with_bias
         self.w_regularizer = w_regularizer
         self.b_regularizer = b_regularizer
+        # conv lowering: None → the bigdl.conv.impl property ("xla"
+        # default).  "gemm" = k²-matmul decomposition (ops/conv_gemm) —
+        # the MXU-shaped alternative to XLA's native conv lowering.
+        self.conv_impl = None
         self.reset()
+
+    def set_conv_impl(self, impl: str):
+        self.conv_impl = impl
+        return self
 
     def reset(self):
         shape = (self.n_output_plane, self.n_input_plane // self.n_group,
@@ -74,6 +82,18 @@ class SpatialConvolution(TensorModule):
             padding = "SAME"
         else:
             padding = [(self.pad_h, self.pad_h), (self.pad_w, self.pad_w)]
+        # getattr: checkpoints pickled before this attribute existed
+        # restore via __setstate__ without running __init__
+        impl = getattr(self, "conv_impl", None)
+        if impl is None:
+            from ..utils.engine import get_property
+            impl = get_property("bigdl.conv.impl", "xla")
+        if impl == "gemm" and self.n_group == 1:
+            from ..ops.conv_gemm import conv2d_gemm_nchw
+            return conv2d_gemm_nchw(
+                x, w, stride=(self.stride_h, self.stride_w),
+                padding=padding if padding == "SAME"
+                else (self.pad_h, self.pad_w))
         return lax.conv_general_dilated(
             x, w,
             window_strides=(self.stride_h, self.stride_w),

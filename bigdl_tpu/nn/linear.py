@@ -42,9 +42,14 @@ class Linear(TensorModule):
 
     def _apply(self, params, buffers, x, training, rng):
         w = params["weight"]
-        # compute in the weight dtype, accumulate f32 on the MXU
-        y = jnp.dot(x.astype(w.dtype), w.T,
-                    preferred_element_type=jnp.float32).astype(w.dtype)
+        x = x.astype(w.dtype)
+        if jnp.dtype(w.dtype).itemsize < 8:
+            # f32/bf16 compute: accumulate f32 on the MXU
+            y = jnp.dot(x, w.T,
+                        preferred_element_type=jnp.float32).astype(w.dtype)
+        else:
+            # f64 (gradient-checker precision): never downcast silently
+            y = jnp.dot(x, w.T)
         if self.with_bias:
             y = y + params["bias"]
         return y, buffers

@@ -164,7 +164,10 @@ class SpatialSubtractiveNormalization(TensorModule):
 
     def _local_mean(self, x):
         kh, kw = self.kernel.shape
-        w = jnp.asarray(self.kernel).reshape(1, 1, kh, kw)
+        # kernel in the INPUT's dtype (lax conv requires matching
+        # dtypes; f64 inputs from the gradient checker included)
+        k = jnp.asarray(self.kernel, x.dtype)
+        w = k.reshape(1, 1, kh, kw)
         w = jnp.tile(w, (1, x.shape[1], 1, 1)) / x.shape[1]
         pad = [(kh // 2, (kh - 1) // 2), (kw // 2, (kw - 1) // 2)]
         mean = lax.conv_general_dilated(
@@ -172,7 +175,7 @@ class SpatialSubtractiveNormalization(TensorModule):
         # edge coefficient correction: convolve a ones image
         ones = jnp.ones_like(x[:1, :1])
         coef = lax.conv_general_dilated(
-            ones, jnp.asarray(self.kernel).reshape(1, 1, kh, kw), (1, 1), pad,
+            ones, k.reshape(1, 1, kh, kw), (1, 1), pad,
             dimension_numbers=("NCHW", "OIHW", "NCHW"))
         return mean / coef
 

@@ -285,11 +285,27 @@ class MaskedSelect(AbstractModule):
     """Table(src, mask) → masked flatten (reference nn/MaskedSelect.scala).
 
     Note: output size is data-dependent; usable eagerly, not under jit.
+    The backward is implemented directly (scatter grad_output into the
+    mask positions, reference MaskedSelect.scala:51) because the generic
+    vjp path cannot trace the data-dependent output shape.
     """
 
     def _apply(self, params, buffers, inp, training, rng):
         src, mask = np.asarray(inp[1]), np.asarray(inp[2]).astype(bool)
         return jnp.asarray(src[mask]), buffers
+
+    def update_grad_input(self, inp, grad_output):
+        from ..utils.table import T
+
+        src, mask = np.asarray(inp[1]), np.asarray(inp[2]).astype(bool)
+        g = np.zeros(src.shape, np.asarray(grad_output).dtype)
+        g[mask] = np.asarray(grad_output)
+        self.grad_input = T(jnp.asarray(g),
+                            jnp.zeros(mask.shape, src.dtype))
+        return self.grad_input
+
+    def backward(self, inp, grad_output):
+        return self.update_grad_input(inp, grad_output)
 
 
 class Padding(TensorModule):

@@ -1,0 +1,82 @@
+"""Convolution as a sum of shifted matmuls (the "k² GEMM" lowering).
+
+XLA's native TPU conv lowering for ResNet-scale shapes measured
+12-61 TFLOP/s on a v5e against 131-151 for equal-FLOP matmuls
+(docs/PERF.md round 3); an explicit im2col GEMM capped ~45 because the
+materialised [B·H·W, Cin·k²] patch matrix is pure HBM traffic.  This
+lowering never materialises patches: a k×k (stride s) conv is
+
+    y[b, ho, wo, :] = Σ_{dy, dx}  x[b, ho·s+dy, wo·s+dx, :] @ w[dy, dx]
+
+i.e. k² independent [B·Ho·Wo, Cin] × [Cin, Cout] matmuls on strided
+slices of the SAME input buffer, accumulated in f32.  Each matmul is
+MXU-shaped (M huge, K = Cin, N = Cout — K/N are the channel counts,
+≥64 throughout ResNet), XLA fuses the slice into the dot's operand
+read, and the only extra HBM traffic vs a perfect conv is re-reading
+the input ~k² times (bounded by VMEM reuse within a fused loop).
+
+No reference counterpart (the reference's conv is im2col + MKL gemm,
+nn/SpatialConvolution.scala:42 — same idea, CPU-shaped); this is the
+TPU-shaped reformulation.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def conv2d_gemm_nhwc(x, w, stride=(1, 1), padding=(0, 0)):
+    """NHWC conv via k² accumulated matmuls.
+
+    Args:
+      x: [B, H, W, Cin].
+      w: [kh, kw, Cin, Cout] (HWIO).
+      stride: (sh, sw).
+      padding: (ph, pw) symmetric, or "SAME".
+    Returns:
+      [B, Ho, Wo, Cout] in x.dtype (f32 accumulation).
+    """
+    kh, kw, cin, cout = w.shape
+    sh, sw = stride
+    if padding == "SAME":
+        ho = -(-x.shape[1] // sh)
+        wo = -(-x.shape[2] // sw)
+        pad_h = max((ho - 1) * sh + kh - x.shape[1], 0)
+        pad_w = max((wo - 1) * sw + kw - x.shape[2], 0)
+        pads = ((pad_h // 2, pad_h - pad_h // 2),
+                (pad_w // 2, pad_w - pad_w // 2))
+    else:
+        ph, pw = padding
+        pads = ((ph, ph), (pw, pw))
+    if any(p for pair in pads for p in pair):
+        x = jnp.pad(x, ((0, 0), pads[0], pads[1], (0, 0)))
+    B, H, W, _ = x.shape
+    ho = (H - kh) // sh + 1
+    wo = (W - kw) // sw + 1
+
+    acc_t = jnp.float64 if x.dtype == jnp.float64 else jnp.float32
+    acc = None
+    for dy in range(kh):
+        for dx in range(kw):
+            xs = lax.slice(x, (0, dy, dx, 0),
+                           (B, dy + (ho - 1) * sh + 1,
+                            dx + (wo - 1) * sw + 1, cin),
+                           (1, sh, sw, 1))
+            # [B, Ho, Wo, Cin] x [Cin, Cout] on the MXU, f32 accumulate
+            term = lax.dot_general(
+                xs, w[dy, dx],
+                (((3,), (0,)), ((), ())),
+                preferred_element_type=acc_t)
+            acc = term if acc is None else acc + term
+    return acc.astype(x.dtype)
+
+
+def conv2d_gemm_nchw(x, w, stride=(1, 1), padding=(0, 0)):
+    """NCHW/OIHW wrapper: one transpose sandwich around the NHWC core
+    (XLA folds the transposes into neighbouring ops; the accumulating
+    matmuls are identical)."""
+    y = conv2d_gemm_nhwc(jnp.transpose(x, (0, 2, 3, 1)),
+                         jnp.transpose(w, (2, 3, 1, 0)),
+                         stride=stride, padding=padding)
+    return jnp.transpose(y, (0, 3, 1, 2))

@@ -138,6 +138,11 @@ def _flash_fwd(q, k, v, causal: bool, sm_scale: float, block_q: int,
     out, lse = pl.pallas_call(
         kernel,
         grid=(B * H, T // bq, nk),
+        # bh/q-block programs are independent ("parallel" lets Mosaic
+        # pipeline across them); the k sweep carries the online-softmax
+        # accumulator and must stay sequential ("arbitrary")
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
         in_specs=[
             pl.BlockSpec((1, bq, D), lambda bh, i, j: (bh, i, 0),
                          memory_space=pltpu.VMEM),
@@ -299,6 +304,8 @@ def _flash_bwd(q, k, v, o, lse, g, causal: bool, sm_scale: float,
         functools.partial(_dkv_kernel, sm_scale=sm_scale, causal=causal,
                           block_q=bq, block_k=bk, num_q_blocks=nq),
         grid=(BH, nk, nq),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
         in_specs=[swap(s) for s in row_specs],
         out_specs=[
             pl.BlockSpec((1, bk, D), lambda bh, j, i: (bh, j, 0),
@@ -322,6 +329,8 @@ def _flash_bwd(q, k, v, o, lse, g, causal: bool, sm_scale: float,
         functools.partial(_dq_kernel, sm_scale=sm_scale, causal=causal,
                           block_q=bq, block_k=bk, num_k_blocks=nk),
         grid=(BH, nq, nk),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
         in_specs=row_specs,
         out_specs=pl.BlockSpec((1, bq, D), lambda bh, i, j: (bh, i, 0),
                                memory_space=pltpu.VMEM),
@@ -335,9 +344,17 @@ def _flash_bwd(q, k, v, o, lse, g, causal: bool, sm_scale: float,
 
 
 def _pick_block(n: int, target: int = 512) -> int:
-    """Largest 128-aligned block <= target dividing n (measured on v5e:
-    512x512 tiles run the grad 2.1x faster than 128x128 — fewer grid
-    revisits, fuller MXU); short sequences use one whole block."""
+    """Largest 128-aligned block <= target dividing n.
+
+    Roofline: per q-block the kernel streams the whole K/V (4·S·D bytes
+    bf16) from HBM while doing 4·bq·S·D MXU FLOPs → arithmetic
+    intensity = bq FLOP/byte.  v5e ridge point = 197 TFLOP/s ÷
+    ~820 GB/s ≈ 240 FLOP/byte, so bq ≥ 256 keeps the sweep
+    compute-bound; 512 doubles the margin while the f32 score tile
+    (512² · 4 B = 1 MB) still double-buffers comfortably in the ~16 MB
+    VMEM.  1024² quadruples the score tile for no intensity gain.
+    Measured (v5e, r3): 512² runs the T=1024 grad 2.1× faster than
+    128²; short sequences use one whole block."""
     if n <= target:
         return n
     b = target
@@ -348,25 +365,30 @@ def _pick_block(n: int, target: int = 512) -> int:
     return 128
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
-def _flash(q, k, v, causal, sm_scale, interpret):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash(q, k, v, causal, sm_scale, interpret, block_q, block_k):
     out, _ = _flash_fwd(q, k, v, causal, sm_scale,
-                        _pick_block(q.shape[2]), _pick_block(k.shape[2]),
+                        block_q or _pick_block(q.shape[2]),
+                        block_k or _pick_block(k.shape[2]),
                         interpret)
     return out
 
 
-def _flash_fwd_rule(q, k, v, causal, sm_scale, interpret):
+def _flash_fwd_rule(q, k, v, causal, sm_scale, interpret, block_q,
+                    block_k):
     out, lse = _flash_fwd(q, k, v, causal, sm_scale,
-                          _pick_block(q.shape[2]), _pick_block(k.shape[2]),
+                          block_q or _pick_block(q.shape[2]),
+                          block_k or _pick_block(k.shape[2]),
                           interpret)
     return out, (q, k, v, out, lse)
 
 
-def _flash_bwd_rule(causal, sm_scale, interpret, res, g):
+def _flash_bwd_rule(causal, sm_scale, interpret, block_q, block_k, res,
+                    g):
     q, k, v, o, lse = res
     return _flash_bwd(q, k, v, o, lse, g, causal, sm_scale,
-                      _pick_block(q.shape[2]), _pick_block(k.shape[2]),
+                      block_q or _pick_block(q.shape[2]),
+                      block_k or _pick_block(k.shape[2]),
                       interpret)
 
 
@@ -375,7 +397,9 @@ _flash.defvjp(_flash_fwd_rule, _flash_bwd_rule)
 
 def flash_attention(q, k, v, causal: bool = False,
                     sm_scale: Optional[float] = None,
-                    interpret: bool = False):
+                    interpret: bool = False,
+                    block_q: Optional[int] = None,
+                    block_k: Optional[int] = None):
     """Attention over (B, H, T, D) tensors without materializing scores.
 
     Uses the Pallas kernels on TPU (or under ``interpret=True``); plain
@@ -383,6 +407,8 @@ def flash_attention(q, k, v, causal: bool = False,
     that are 128-multiples, or short 8-aligned sequences that fit one
     block; anything else falls back (callers pad — the data layer's
     fixed-length contract already guarantees static shapes).
+    ``block_q``/``block_k`` override the roofline default (512-target;
+    see ``_pick_block``) — exposed for the on-hardware tuning sweeps.
     """
     if sm_scale is None:
         sm_scale = 1.0 / math.sqrt(q.shape[-1])
@@ -392,5 +418,6 @@ def flash_attention(q, k, v, causal: bool = False,
         return (n % 128 == 0) or (n < 128 and n % 8 == 0)
 
     if use_kernel(interpret) and blockable(T) and blockable(S):
-        return _flash(q, k, v, causal, sm_scale, interpret)
+        return _flash(q, k, v, causal, sm_scale, interpret,
+                      block_q, block_k)
     return _attention_reference(q, k, v, causal, sm_scale)

@@ -21,13 +21,20 @@ import jax
 import jax.numpy as jnp
 
 
+def _acc(dtype):
+    # f32 lane accumulation for f32/bf16 logits; f64 logits (gradient
+    # checker precision) must never be silently downcast
+    return jnp.float64 if dtype == jnp.float64 else jnp.float32
+
+
 def _rows(logits, t):
+    acc = _acc(logits.dtype)
     m = jnp.max(logits, axis=-1)
-    e = jnp.exp((logits - m[:, None]).astype(jnp.float32))
+    e = jnp.exp((logits - m[:, None]).astype(acc))
     s = jnp.sum(e, axis=-1)
-    lse = jnp.log(s) + m.astype(jnp.float32)
+    lse = jnp.log(s) + m.astype(acc)
     picked = jnp.take_along_axis(logits, t[:, None], axis=1)[:, 0]
-    return lse - picked.astype(jnp.float32), (m, s)
+    return lse - picked.astype(acc), (m, s)
 
 
 @jax.custom_vjp
@@ -52,8 +59,9 @@ def _bwd(res, g):
     logits, t, m, s = res
     # recompute softmax from the saved (m, s) row stats — no [N, V]
     # probability residual survives the forward
-    p = jnp.exp((logits - m[:, None]).astype(jnp.float32)) / s[:, None]
-    d = (p - jax.nn.one_hot(t, logits.shape[-1], dtype=jnp.float32)) \
+    acc = _acc(logits.dtype)
+    p = jnp.exp((logits - m[:, None]).astype(acc)) / s[:, None]
+    d = (p - jax.nn.one_hot(t, logits.shape[-1], dtype=acc)) \
         * g[:, None]
     return d.astype(logits.dtype), None
 

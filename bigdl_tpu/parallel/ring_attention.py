@@ -186,8 +186,11 @@ def attention(q, k, v, causal: bool = False):
         Tq, Tk = s.shape[-2:]
         s = jnp.where(jnp.arange(Tq)[:, None] >= jnp.arange(Tk)[None, :],
                       s, jnp.asarray(NEG_INF, s.dtype))
+    # softmax normalisation accumulates f32 (f64 inputs — the gradient
+    # checker's precision — keep f64 end-to-end)
+    acc = jnp.float64 if s.dtype == jnp.float64 else jnp.float32
     m = jnp.max(s, axis=-1, keepdims=True)
-    e = jnp.exp((s - m).astype(jnp.float32))
+    e = jnp.exp((s - m).astype(acc))
     p = (e / jnp.sum(e, axis=-1, keepdims=True)).astype(q.dtype)
     return jnp.einsum("bhqk,bhkd->bhqd", p, v)
 

@@ -1,0 +1,134 @@
+"""k²-matmul conv lowering (ops/conv_gemm) — exactness vs lax.conv and
+the framework/twin integration points (VERDICT r3 #1 groundwork)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax import lax
+
+from bigdl_tpu.ops.conv_gemm import conv2d_gemm_nchw, conv2d_gemm_nhwc
+
+R = np.random.RandomState(3)
+
+
+@pytest.mark.parametrize("k,s,pad", [
+    (1, 1, 0), (1, 2, 0), (3, 1, 1), (3, 2, 1), (7, 2, 3), (5, 1, 2),
+])
+def test_gemm_conv_matches_lax_nhwc(k, s, pad):
+    x = jnp.asarray(R.randn(2, 16, 16, 5), jnp.float32)
+    w = jnp.asarray(R.randn(k, k, 5, 7) * 0.1, jnp.float32)
+    got = conv2d_gemm_nhwc(x, w, stride=(s, s), padding=(pad, pad))
+    want = lax.conv_general_dilated(
+        x, w, (s, s), ((pad, pad), (pad, pad)),
+        dimension_numbers=("NHWC", "HWIO", "NHWC"))
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_gemm_conv_same_padding():
+    x = jnp.asarray(R.randn(2, 15, 15, 4), jnp.float32)
+    w = jnp.asarray(R.randn(3, 3, 4, 6) * 0.1, jnp.float32)
+    got = conv2d_gemm_nhwc(x, w, stride=(2, 2), padding="SAME")
+    want = lax.conv_general_dilated(
+        x, w, (2, 2), "SAME", dimension_numbers=("NHWC", "HWIO", "NHWC"))
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_gemm_conv_nchw_wrapper():
+    x = jnp.asarray(R.randn(2, 5, 12, 12), jnp.float32)
+    w = jnp.asarray(R.randn(7, 5, 3, 3) * 0.1, jnp.float32)  # OIHW
+    got = conv2d_gemm_nchw(x, w, stride=(1, 1), padding=(1, 1))
+    want = lax.conv_general_dilated(
+        x, w, (1, 1), ((1, 1), (1, 1)),
+        dimension_numbers=("NCHW", "OIHW", "NCHW"))
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_gemm_conv_grads_match_lax():
+    x = jnp.asarray(R.randn(2, 10, 10, 4), jnp.float32)
+    w = jnp.asarray(R.randn(3, 3, 4, 6) * 0.1, jnp.float32)
+
+    def loss_gemm(x, w):
+        return jnp.sum(conv2d_gemm_nhwc(x, w, (1, 1), (1, 1)) ** 2)
+
+    def loss_lax(x, w):
+        y = lax.conv_general_dilated(
+            x, w, (1, 1), ((1, 1), (1, 1)),
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))
+        return jnp.sum(y ** 2)
+
+    g1 = jax.grad(loss_gemm, argnums=(0, 1))(x, w)
+    g2 = jax.grad(loss_lax, argnums=(0, 1))(x, w)
+    for a, b in zip(g1, g2):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-4)
+
+
+def test_framework_conv_impl_gemm_matches_xla():
+    from bigdl_tpu import nn
+
+    m = nn.SpatialConvolution(3, 8, 3, 3, 2, 2, 1, 1)
+    x = jnp.asarray(R.randn(2, 3, 16, 16), jnp.float32)
+    want = np.asarray(m.forward(x))
+    m.set_conv_impl("gemm")
+    got = np.asarray(m.forward(x))
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+
+def test_framework_resnet_gemm_impl_matches_xla():
+    """Whole framework ResNet (CIFAR variant: fast on CPU) under the
+    gemm lowering must match the native lowering numerically."""
+    from bigdl_tpu.models.resnet import ResNetCifar
+    from bigdl_tpu.utils.rng import RNG
+
+    RNG().set_seed(5)
+    model = ResNetCifar(depth=20, class_num=10, shortcut_type="A")
+    model.evaluate()
+    x = jnp.asarray(R.randn(2, 3, 32, 32), jnp.float32)
+    want = np.asarray(model.forward(x))
+    for mod in _walk(model):
+        if hasattr(mod, "set_conv_impl"):
+            mod.set_conv_impl("gemm")
+    got = np.asarray(model.forward(x))
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+
+
+def _walk(m):
+    yield m
+    for c in getattr(m, "modules", ()) or ():
+        yield from _walk(c)
+    for node in getattr(m, "sorted_nodes", ()) or ():
+        if getattr(node, "element", None) is not None:
+            yield from _walk(node.element)
+
+
+def test_jax_twin_forward_and_step():
+    """The independent plain-JAX twin runs: forward shapes, one train
+    step, finite loss (perf numbers are measured on hardware by
+    models/resnet_mfu_lab.py)."""
+    from bigdl_tpu.models.resnet_jax_twin import (forward, init_params,
+                                                  make_train_step)
+
+    params = init_params(jax.random.PRNGKey(0), num_classes=10)
+    x = jnp.asarray(R.rand(2, 64, 64, 3), jnp.float32)
+    logits = forward(params, x, training=False)
+    assert logits.shape == (2, 10)
+    assert np.all(np.isfinite(np.asarray(logits)))
+
+    step = make_train_step(compute_dtype=None, lr=0.01)
+    vel = jax.tree_util.tree_map(jnp.zeros_like, params)
+    y = jnp.asarray([1, 7], jnp.int32)
+    loss, params, vel = step(params, vel, x, y)
+    assert np.isfinite(float(loss))
+
+
+def test_jax_twin_gemm_impl_matches_xla():
+    from bigdl_tpu.models.resnet_jax_twin import forward, init_params
+
+    params = init_params(jax.random.PRNGKey(1), num_classes=10)
+    x = jnp.asarray(R.rand(2, 64, 64, 3), jnp.float32)
+    a = np.asarray(forward(params, x, training=False, impl="xla"))
+    b = np.asarray(forward(params, x, training=False, impl="gemm"))
+    np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-4)
