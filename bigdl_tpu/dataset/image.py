@@ -163,13 +163,21 @@ class MTLabeledImgToBatch(Transformer):
     multithreaded normalize + layout + stack (reference
     dataset/image/MTLabeledBGRImgToBatch.scala:46 — one worker per image
     chunk assembling a shared batch buffer; here the chunked copy runs in
-    the C++ thread pool, bigdl_tpu/native batch_images)."""
+    the C++ thread pool, bigdl_tpu/native batch_images).
+
+    ``device_normalize=True`` moves normalize + NHWC→NCHW onto the
+    accelerator: the host emits a pure uint8 stack (memcpy speed) and
+    the model starts with ``nn.ImageNormalize(mean, std)``, which XLA
+    fuses into the stem conv.  Use when the host is infeed-bound
+    (docs/PERF.md round-4: a 1-core host tripled its pipeline rate)."""
 
     def __init__(self, batch_size: int, mean=(0.0, 0.0, 0.0),
-                 std=(1.0, 1.0, 1.0), drop_last: bool = False):
+                 std=(1.0, 1.0, 1.0), drop_last: bool = False,
+                 device_normalize: bool = False):
         self.batch_size = batch_size
         self.mean, self.std = mean, std
         self.drop_last = drop_last
+        self.device_normalize = device_normalize
 
     def apply(self, it):
         from .. import native
@@ -186,6 +194,11 @@ class MTLabeledImgToBatch(Transformer):
             yield self._make(native, MiniBatch, buf, labels)
 
     def _make(self, native, MiniBatch, buf, labels):
+        if self.device_normalize:
+            # uint8 NHWC stack only — normalization belongs to the
+            # device (nn.ImageNormalize at the head of the model)
+            return MiniBatch(np.stack(buf),
+                             np.asarray(labels, np.float32))
         batch = native.batch_images(np.stack(buf), self.mean, self.std)
         return MiniBatch(batch, np.asarray(labels, np.float32))
 

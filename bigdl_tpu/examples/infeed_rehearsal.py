@@ -74,12 +74,13 @@ class SampleToImgLabel:
         return self.apply(it)
 
 
-def measure(folder: str, crop: int, batch: int, budget_s: float = 30.0):
+def measure(folder: str, crop: int, batch: int, budget_s: float = 30.0,
+            device_normalize: bool = True):
     from ..dataset import SeqFileFolder
     from ..dataset.image import BGRImgRdmCropper, MTLabeledImgToBatch
     from ..dataset.ingest import read_records
 
-    out = {}
+    out = {"device_normalize": device_normalize}
 
     # 1. raw framed-record read (CRC-verified)
     paths = sorted(os.path.join(folder, p) for p in os.listdir(folder))
@@ -109,7 +110,8 @@ def measure(folder: str, crop: int, batch: int, budget_s: float = 30.0):
     chain = (ds >> SampleToImgLabel()
              >> BGRImgRdmCropper(crop, crop)
              >> MTLabeledImgToBatch(batch, mean=(104.0, 117.0, 124.0),
-                                    std=(58.0, 57.0, 57.0)))
+                                    std=(58.0, 57.0, 57.0),
+                                    device_normalize=device_normalize))
     t0, nimg, nb = time.perf_counter(), 0, 0
     for mb in chain.data(train=True):
         nimg += mb.size()
@@ -123,7 +125,8 @@ def measure(folder: str, crop: int, batch: int, budget_s: float = 30.0):
     return out
 
 
-def drive(folder: str, crop: int, batch: int, iters: int = 8):
+def drive(folder: str, crop: int, batch: int, iters: int = 8,
+          device_normalize: bool = True):
     """The driver-overlap leg: stream the shard set through
     DistriOptimizer on the 8-virtual-device mesh and report its own
     infeed/compute phase metrics."""
@@ -138,10 +141,15 @@ def drive(folder: str, crop: int, batch: int, iters: int = 8):
     ds = (SeqFileFolder(folder) >> SampleToImgLabel()
           >> BGRImgRdmCropper(crop, crop)
           >> MTLabeledImgToBatch(batch, mean=(104.0, 117.0, 124.0),
-                                 std=(58.0, 57.0, 57.0), drop_last=True))
+                                 std=(58.0, 57.0, 57.0), drop_last=True,
+                                 device_normalize=device_normalize))
     # deliberately light model: the rehearsal measures INFEED; on the
     # virtual-CPU mesh a ResNet step would swamp the clock
+    head = ([nn.ImageNormalize((104.0, 117.0, 124.0),
+                               (58.0, 57.0, 57.0))]
+            if device_normalize else [])
     model = nn.Sequential(
+        *head,
         nn.SpatialConvolution(3, 16, 7, 7, 8, 8),  # stride-8: cheap
         nn.ReLU(),
         nn.SpatialMaxPooling(4, 4, 4, 4),
@@ -177,17 +185,23 @@ def main():
     p.add_argument("--shards", type=int, default=16)
     p.add_argument("--skip-generate", action="store_true")
     p.add_argument("--skip-drive", action="store_true")
+    p.add_argument("--host-normalize", action="store_true",
+                   help="legacy comparison: normalize+transpose on the "
+                        "host (native thread pool) instead of on-device")
     a = p.parse_args()
 
+    dev_norm = not a.host_normalize
     out = {"n": a.n, "hw": a.hw, "crop": a.crop}
     if not a.skip_generate:
         t0 = time.perf_counter()
         out["gbytes_written"] = round(generate(a.folder, a.n, a.hw,
                                                a.shards), 2)
         out["generate_s"] = round(time.perf_counter() - t0, 1)
-    out.update(measure(a.folder, a.crop, a.batch))
+    out.update(measure(a.folder, a.crop, a.batch,
+                       device_normalize=dev_norm))
     if not a.skip_drive:
-        out.update(drive(a.folder, a.crop, a.batch))
+        out.update(drive(a.folder, a.crop, a.batch,
+                         device_normalize=dev_norm))
     out["target_images_per_sec"] = 3000
     out["pass"] = bool(out["pipeline_images_per_sec"] >= 3000)
     line = json.dumps(out)

@@ -154,18 +154,25 @@ def bf16_add(dst: np.ndarray, src: np.ndarray) -> np.ndarray:
 # record-file framing scan (ingest hot loop)
 # ---------------------------------------------------------------------------
 
-def parse_records(buf: bytes, verify: bool = True):
+def parse_records(buf, verify: bool = True):
     """Scan a TFRecord-framed buffer → list of (offset, length) payload
-    spans, CRC-verified natively.  Returns None when the native library
-    is unavailable (caller falls back to the python scanner); raises
-    IOError on corruption."""
+    spans, CRC-verified natively.  ``buf`` may be bytes OR any readable
+    buffer (memoryview over an mmap — the zero-copy ingest path).
+    Returns None when the native library is unavailable (caller falls
+    back to the python scanner); raises IOError on corruption."""
     lib = _get_lib()
     if lib is None:
         return None
     cap = max(1, len(buf) // 16)
     offsets = np.empty(cap, np.int64)
     lengths = np.empty(cap, np.int64)
-    n = lib.btpu_parse_records(buf, len(buf), offsets, lengths, cap,
+    if isinstance(buf, bytes):
+        ptr = buf
+    else:
+        arr = np.frombuffer(buf, np.uint8)
+        ptr = ctypes.cast(arr.ctypes.data_as(ctypes.c_void_p),
+                          ctypes.c_char_p)
+    n = lib.btpu_parse_records(ptr, len(buf), offsets, lengths, cap,
                                1 if verify else 0)
     if n < 0:
         raise IOError(f"corrupt record at byte {-n - 1}")

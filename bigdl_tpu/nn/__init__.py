@@ -34,7 +34,8 @@ from .pooling import (
 )
 from .normalization import (
     LayerNorm,
-    BatchNormalization, L1Penalty, Normalize, SpatialBatchNormalization,
+    BatchNormalization, ImageNormalize, L1Penalty, Normalize,
+    SpatialBatchNormalization,
     SpatialContrastiveNormalization, SpatialCrossMapLRN,
     SpatialDivisiveNormalization, SpatialSubtractiveNormalization,
 )

@@ -268,3 +268,49 @@ class LayerNorm(TensorModule):
         mean = x.mean(axis=-1, keepdims=True)
         var = ((x - mean) ** 2).mean(axis=-1, keepdims=True)
         return (x - mean) * lax.rsqrt(var + self.eps), buffers
+
+
+class ImageNormalize(TensorModule):
+    """Device-side image normalization + layout move.
+
+    Pairs with ``MTLabeledImgToBatch(..., device_normalize=True)``: the
+    host batch path becomes a pure uint8 memcpy (stack only) and THIS
+    module — placed first in the model — does cast → (x-mean)/std →
+    NHWC→NCHW on the accelerator, where XLA fuses all of it into the
+    stem conv's input read.  The normalize that cost the reference a
+    host thread pool (dataset/image/MTLabeledBGRImgToBatch.scala:46)
+    costs ~nothing on-device; on a starved host (1 core feeding a
+    2000+ img/s chip) this is the difference between infeed-bound and
+    compute-bound (docs/PERF.md round-4 infeed rehearsal).
+
+    ``from_layout``: "NHWC" (the memcpy batch layout) transposes to the
+    framework's NCHW; "NCHW" normalizes in place.
+    """
+
+    def __init__(self, mean, std, from_layout: str = "NHWC"):
+        super().__init__()
+        if from_layout not in ("NHWC", "NCHW"):
+            raise ValueError(f"from_layout {from_layout!r}")
+        self.mean = tuple(float(m) for m in np.atleast_1d(mean))
+        self.std = tuple(float(s) for s in np.atleast_1d(std))
+        self.from_layout = from_layout
+
+    def _apply(self, params, buffers, x, training, rng):
+        squeeze = x.ndim == 3
+        if squeeze:
+            x = x[None]
+        # uint8 infeed casts up to f32; float inputs keep their dtype
+        # (f64 under the gradient checker must not quantize)
+        dt = x.dtype if jnp.issubdtype(x.dtype, jnp.floating) \
+            else jnp.float32
+        mean = jnp.asarray(self.mean, dt)
+        std = jnp.asarray(self.std, dt)
+        x = x.astype(dt)
+        if self.from_layout == "NHWC":
+            x = (x - mean) / std          # broadcast over trailing C
+            x = jnp.transpose(x, (0, 3, 1, 2))
+        else:
+            x = (x - mean[:, None, None]) / std[:, None, None]
+        if squeeze:
+            x = x[0]
+        return x, buffers

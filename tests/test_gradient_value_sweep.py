@@ -239,6 +239,10 @@ MODULE_CASES = {
     "HardShrink": (lambda: nn.HardShrink(0.5), lambda: X, {}),
     "HardTanh": (lambda: nn.HardTanh(), lambda: X, {}),
     "Identity": (lambda: nn.Identity(), lambda: X, {}),
+    "ImageNormalize": (lambda: nn.ImageNormalize((0.4, 0.5, 0.6),
+                                                 (0.2, 0.25, 0.3)),
+                       lambda: R.randn(2, 6, 6, 3).astype(np.float32),
+                       {}),
     "Index": (lambda: nn.Index(1),
               lambda: T(X, np.array([2.0, 1.0], np.float32)),
               {"diff": [0]}),

@@ -102,3 +102,48 @@ def test_local_predictor_matches_predictor():
     local = LocalPredictor(model).predict_class(ds, batch_size=2)
     assert base == local and len(local) == 5
     assert all(1 <= c <= 3 for c in local)
+
+
+def test_device_normalize_path_matches_host_path():
+    """uint8 memcpy batch + nn.ImageNormalize (on-device, XLA-fused)
+    must equal the native host normalize+transpose path exactly."""
+    import jax.numpy as jnp
+
+    from bigdl_tpu import nn
+    from bigdl_tpu.dataset.image import MTLabeledImgToBatch
+
+    rng = np.random.RandomState(0)
+    imgs = [(rng.randint(0, 255, (8, 8, 3)).astype(np.uint8), float(i))
+            for i in range(4)]
+    mean, std = (104.0, 117.0, 124.0), (58.0, 57.0, 57.0)
+
+    host = next(MTLabeledImgToBatch(4, mean, std).apply(iter(imgs)))
+    dev = next(MTLabeledImgToBatch(4, mean, std,
+                                   device_normalize=True).apply(
+        iter(imgs)))
+    assert np.asarray(dev.inputs).dtype == np.uint8  # memcpy-only host
+    norm = nn.ImageNormalize(mean, std)
+    got = np.asarray(norm.forward(jnp.asarray(dev.inputs)))
+    np.testing.assert_allclose(got, np.asarray(host.inputs),
+                               rtol=1e-6, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(dev.targets),
+                               np.asarray(host.targets))
+
+
+def test_image_normalize_nchw_layout_and_3d():
+    import jax.numpy as jnp
+
+    from bigdl_tpu import nn
+
+    rng = np.random.RandomState(1)
+    x = rng.rand(2, 3, 5, 5).astype(np.float32)
+    m = nn.ImageNormalize((0.5, 0.4, 0.3), (0.2, 0.2, 0.2),
+                          from_layout="NCHW")
+    got = np.asarray(m.forward(jnp.asarray(x)))
+    want = (x - np.array([0.5, 0.4, 0.3], np.float32)[:, None, None]) \
+        / np.array([0.2, 0.2, 0.2], np.float32)[:, None, None]
+    np.testing.assert_allclose(got, want, rtol=1e-6)
+    # 3-D (no batch) NHWC
+    x3 = rng.rand(5, 5, 3).astype(np.float32)
+    m2 = nn.ImageNormalize((0.5, 0.4, 0.3), (0.2, 0.2, 0.2))
+    assert np.asarray(m2.forward(jnp.asarray(x3))).shape == (3, 5, 5)
