@@ -214,10 +214,12 @@ def run_framework(impl, batches=(64, 128, 256)):
 
 
 def run_flash(seq_lens=(1024, 4096, 8192), blocks=(256, 512, 1024),
-              iters=10, warmup=2):
-    """Flash kernel fwd+bwd timing per (T, block) — the VERDICT r3 #2
-    tuning matrix.  16 heads × 64 head-dim (the bench LM's shape),
-    causal, bf16, constant 16k tokens per step."""
+              iters=10, warmup=2, head_dims=(64, 128)):
+    """Flash kernel fwd+bwd timing per (T, block, head_dim) — the
+    VERDICT r3 #2 tuning matrix.  D=1024 total split 16×64 (the bench
+    LM's shape — half the MXU's 128 lanes in the QK/PV contractions)
+    vs 8×128 (full lanes), causal, bf16, constant 16k tokens per
+    step."""
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -229,55 +231,70 @@ def run_flash(seq_lens=(1024, 4096, 8192), blocks=(256, 512, 1024),
     rows = []
     for T in seq_lens:
         B = max(16384 // T, 1)
-        H, D = 16, 64
-        q = jnp.asarray(rng.rand(B, H, T, D), jnp.bfloat16)
-        k = jnp.asarray(rng.rand(B, H, T, D), jnp.bfloat16)
-        v = jnp.asarray(rng.rand(B, H, T, D), jnp.bfloat16)
-        # causal attention FLOPs: QK^T + PV at T/2 average extent
-        flops_fwd = 2.0 * B * H * T * T * D  # 2 matmuls x (T²/2) x 2
-        for blk in blocks:
-            if blk > T:
-                continue
-            row = {"exp": "flash", "T": T, "B": B, "block": blk}
-
-            def f(q, k, v):
-                return jnp.sum(flash_attention(
-                    q, k, v, causal=True, block_q=blk,
-                    block_k=blk).astype(jnp.float32))
-
-            try:
-                fwd = jax.jit(f)
-                for _ in range(warmup):
-                    s = fwd(q, k, v)
-                float(s)
-                t0 = time.perf_counter()
-                for _ in range(iters):
-                    s = fwd(q, k, v)
-                float(s)
-                dt = (time.perf_counter() - t0) / iters
-                row["fwd_ms"] = round(dt * 1e3, 2)
-                row["fwd_tflops"] = round(flops_fwd / dt / 1e12, 2)
-
-                grad = jax.jit(jax.grad(f, argnums=(0, 1, 2)))
-                for _ in range(warmup):
-                    gs = grad(q, k, v)
-                float(jnp.sum(gs[0].astype(jnp.float32)))
-                t0 = time.perf_counter()
-                for _ in range(iters):
-                    gs = grad(q, k, v)
-                float(jnp.sum(gs[0].astype(jnp.float32)))
-                dt = (time.perf_counter() - t0) / iters
-                row["fwdbwd_ms"] = round(dt * 1e3, 2)
-                row["fwdbwd_tflops"] = round(3 * flops_fwd / dt / 1e12, 2)
-                if peak:
-                    row["fwdbwd_frac_of_peak"] = round(
-                        3 * flops_fwd / dt / peak, 4)
-            except Exception as e:
-                row["error"] = f"{type(e).__name__}: {e}"[:200]
-            rows.append(row)
-            print(json.dumps(row), flush=True)
+        for D in head_dims:
+            H = 1024 // D
+            q = jnp.asarray(rng.rand(B, H, T, D), jnp.bfloat16)
+            k = jnp.asarray(rng.rand(B, H, T, D), jnp.bfloat16)
+            v = jnp.asarray(rng.rand(B, H, T, D), jnp.bfloat16)
+            # causal attention FLOPs: QK^T + PV at T/2 average extent
+            flops_fwd = 2.0 * B * H * T * T * D  # 2 matmuls x (T²/2) x 2
+            rows += _flash_rows(T, B, H, D, q, k, v, flops_fwd, blocks,
+                                iters, warmup, peak)
     _emit({"exp": "flash_summary", "rows": rows,
            "peak_flops_per_sec": peak})
+
+
+def _flash_rows(T, B, H, D, q, k, v, flops_fwd, blocks, iters, warmup,
+                peak):
+    import jax
+    import jax.numpy as jnp
+
+    from ..ops.flash_attention import flash_attention
+
+    rows = []
+    for blk in blocks:
+        if blk > T:
+            continue
+        row = {"exp": "flash", "T": T, "B": B, "H": H, "D": D,
+               "block": blk}
+
+        def f(q, k, v):
+            return jnp.sum(flash_attention(
+                q, k, v, causal=True, block_q=blk,
+                block_k=blk).astype(jnp.float32))
+
+        try:
+            fwd = jax.jit(f)
+            for _ in range(warmup):
+                s = fwd(q, k, v)
+            float(s)
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                s = fwd(q, k, v)
+            float(s)
+            dt = (time.perf_counter() - t0) / iters
+            row["fwd_ms"] = round(dt * 1e3, 2)
+            row["fwd_tflops"] = round(flops_fwd / dt / 1e12, 2)
+
+            grad = jax.jit(jax.grad(f, argnums=(0, 1, 2)))
+            for _ in range(warmup):
+                gs = grad(q, k, v)
+            float(jnp.sum(gs[0].astype(jnp.float32)))
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                gs = grad(q, k, v)
+            float(jnp.sum(gs[0].astype(jnp.float32)))
+            dt = (time.perf_counter() - t0) / iters
+            row["fwdbwd_ms"] = round(dt * 1e3, 2)
+            row["fwdbwd_tflops"] = round(3 * flops_fwd / dt / 1e12, 2)
+            if peak:
+                row["fwdbwd_frac_of_peak"] = round(
+                    3 * flops_fwd / dt / peak, 4)
+        except Exception as e:
+            row["error"] = f"{type(e).__name__}: {e}"[:200]
+        rows.append(row)
+        print(json.dumps(row), flush=True)
+    return rows
 
 
 def main():

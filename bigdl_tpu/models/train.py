@@ -195,7 +195,17 @@ def main(argv=None):
                         help="rematerialize transformer-block activations "
                              "in the backward pass (jax.checkpoint): HBM "
                              "for FLOPs on long contexts; transformer only")
+    parser.add_argument("--conv-impl", default=None,
+                        choices=("xla", "gemm"),
+                        help="conv lowering for spatial models: XLA's "
+                             "native conv, or the k²-matmul "
+                             "decomposition (ops/conv_gemm — MXU-shaped "
+                             "matmuls, no im2col materialization)")
     args = parser.parse_args(argv)
+    if args.conv_impl:
+        import os
+
+        os.environ["bigdl.conv.impl"] = args.conv_impl
     if ((args.tensor_parallel > 1 or args.seq_parallel > 1)
             and not args.distributed):
         parser.error("--tensor-parallel/--seq-parallel require "
