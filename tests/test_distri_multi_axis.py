@@ -174,8 +174,11 @@ def test_partial_batch_trains_every_record_on_tp_mesh():
         return model.param_tree()
 
     mesh = Mesh(np.array(jax.devices()[:8]).reshape(2, 4), ("data", "model"))
-    got = drive(_tp_model(), mesh)  # 15-record batch: 15 % 2 != 0
-    want = drive(_dense_model(),
+    # weight decay: the masked step's regularizer handling (per-shard reg
+    # grads added post-reduction; reg loss pre-divided by the data-axis
+    # psum) must match the data path's independent masked+reg math
+    got = drive(_tp_model(weight_decay=0.05), mesh)  # 15 % 2 != 0
+    want = drive(_dense_model(weight_decay=0.05),
                  Mesh(np.array(jax.devices()[:8]), ("data",)))
     for a, b in zip(jax.tree_util.tree_leaves(got),
                     jax.tree_util.tree_leaves(want)):
