@@ -31,6 +31,13 @@ STAGES = ((3, 64, 1), (4, 128, 2), (6, 256, 2), (3, 512, 2))
 
 
 def _conv(x, w, stride, padding, impl):
+    if (impl == "pallas" and w.shape[:2] == (3, 3) and stride == 1
+            and padding == (1, 1)):
+        from ..ops.conv3x3_pallas import conv3x3_s1_same
+
+        return conv3x3_s1_same(x, w)
+    if impl == "pallas":
+        impl = "xla"  # non-3×3/s1 shapes keep the native lowering
     if impl == "gemm":
         return conv2d_gemm_nhwc(x, w, stride=(stride, stride),
                                 padding=padding)

@@ -142,7 +142,13 @@ def run_convshapes(batch=128, iters=10, warmup=2):
         def gemm_conv(x, w):
             return conv2d_gemm_nhwc(x, w, stride=(s, s), padding=pad)
 
-        for name, fn in (("xla", xla_conv), ("gemm", gemm_conv)):
+        impls = [("xla", xla_conv), ("gemm", gemm_conv)]
+        if k == 3 and s == 1:
+            from ..ops.conv3x3_pallas import conv3x3_s1_same
+
+            impls.append(("pallas", conv3x3_s1_same))
+
+        for name, fn in impls:
             # fwd+bwd: grad of sum wrt both operands — the training cost
             f = jax.jit(jax.grad(
                 lambda x, w: jnp.sum(fn(x, w).astype(jnp.float32)),
@@ -303,7 +309,8 @@ def main():
     p.add_argument("--convshapes", action="store_true")
     p.add_argument("--framework", action="store_true")
     p.add_argument("--flash", action="store_true")
-    p.add_argument("--impl", default="xla", choices=["xla", "gemm"])
+    p.add_argument("--impl", default="xla",
+                   choices=["xla", "gemm", "pallas"])
     p.add_argument("--batch", type=int, default=128)
     p.add_argument("--iters", type=int, default=20)
     a = p.parse_args()

@@ -94,6 +94,16 @@ class SpatialConvolution(TensorModule):
                 x, w, stride=(self.stride_h, self.stride_w),
                 padding=padding if padding == "SAME"
                 else (self.pad_h, self.pad_w))
+        if (impl == "pallas" and self.n_group == 1
+                and (self.kernel_w, self.kernel_h) == (3, 3)
+                and (self.stride_w, self.stride_h) == (1, 1)
+                and (self.pad_w, self.pad_h) == (1, 1)):
+            # the hand kernel covers the ResNet workhorse shape; other
+            # shapes keep the native lowering
+            from ..ops.conv3x3_pallas import conv3x3_s1_same
+            y = conv3x3_s1_same(jnp.transpose(x, (0, 2, 3, 1)),
+                                jnp.transpose(w, (2, 3, 1, 0)))
+            return jnp.transpose(y, (0, 3, 1, 2))
         return lax.conv_general_dilated(
             x, w,
             window_strides=(self.stride_h, self.stride_w),
