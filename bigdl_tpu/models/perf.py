@@ -132,7 +132,14 @@ def main(argv=None):
                         help="data-parallel over all visible devices")
     parser.add_argument("--dtype", default="float32",
                         choices=("float32", "bfloat16"))
+    parser.add_argument("--conv-impl", default=None,
+                        choices=("xla", "gemm", "pallas"),
+                        help="conv lowering (bigdl.conv.impl property)")
     args = parser.parse_args(argv)
+    if args.conv_impl:
+        import os
+
+        os.environ["bigdl.conv.impl"] = args.conv_impl
     from ..utils.engine import Engine
 
     Engine.honor_jax_platforms_env()

@@ -196,11 +196,12 @@ def main(argv=None):
                              "in the backward pass (jax.checkpoint): HBM "
                              "for FLOPs on long contexts; transformer only")
     parser.add_argument("--conv-impl", default=None,
-                        choices=("xla", "gemm"),
+                        choices=("xla", "gemm", "pallas"),
                         help="conv lowering for spatial models: XLA's "
-                             "native conv, or the k²-matmul "
-                             "decomposition (ops/conv_gemm — MXU-shaped "
-                             "matmuls, no im2col materialization)")
+                             "native conv, the k²-matmul decomposition "
+                             "(ops/conv_gemm — MXU-shaped matmuls, no "
+                             "im2col materialization), or the Pallas "
+                             "slab kernel for 3×3/s1 shapes")
     args = parser.parse_args(argv)
     if args.conv_impl:
         import os

@@ -1,0 +1,38 @@
+#!/bin/bash
+# Runs the full TPU measurement battery once the tunnel is up.
+# Each step logs to /tmp/battery/; persists results into /root/repo.
+set -u
+mkdir -p /tmp/battery
+cd /root/repo
+log() { echo "$(date -u +%FT%TZ) $*" >> /tmp/battery/progress.log; }
+
+log "battery start"
+# 1. full bench (persists BENCH_TPU_MEASURED_latest.json itself)
+timeout 3600 python bench.py > /tmp/battery/bench.json 2> /tmp/battery/bench.err
+log "bench rc=$? $(tail -c 300 /tmp/battery/bench.json | head -c 300)"
+
+# 2. flash matrix (fast, highest value for VERDICT #2)
+timeout 1800 python -m bigdl_tpu.models.resnet_mfu_lab --flash > /tmp/battery/flash.log 2>&1
+log "flash rc=$?"
+
+# 3. twin xla (the ceiling proof)
+timeout 1800 python -m bigdl_tpu.models.resnet_mfu_lab --twin --impl xla > /tmp/battery/twin_xla.log 2>&1
+log "twin_xla rc=$?"
+
+# 4. conv shape matrix xla vs gemm
+timeout 1800 python -m bigdl_tpu.models.resnet_mfu_lab --convshapes > /tmp/battery/convshapes.log 2>&1
+log "convshapes rc=$?"
+
+# 5. twin gemm
+timeout 1800 python -m bigdl_tpu.models.resnet_mfu_lab --twin --impl gemm > /tmp/battery/twin_gemm.log 2>&1
+log "twin_gemm rc=$?"
+
+# 6. framework gemm end-to-end
+timeout 1800 python -m bigdl_tpu.models.resnet_mfu_lab --framework --impl gemm > /tmp/battery/framework_gemm.log 2>&1
+log "framework_gemm rc=$?"
+log "battery done"
+
+# 7. twin with the Pallas 3x3 kernel for the stride-1 convs
+timeout 1800 python -m bigdl_tpu.models.resnet_mfu_lab --twin --impl pallas > /tmp/battery/twin_pallas.log 2>&1
+log "twin_pallas rc=$?"
+log "battery fully done"

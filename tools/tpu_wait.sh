@@ -1,0 +1,20 @@
+#!/bin/bash
+# retry the TPU probe until it succeeds; log availability windows
+while true; do
+  ts=$(date -u +%FT%TZ)
+  out=$(timeout 300 python -c "
+import json, time
+t0=time.time()
+import jax
+ds = jax.devices()
+print('TPUPROBE ' + json.dumps({'devices':[str(d) for d in ds],'platform':ds[0].platform,'probe_s':round(time.time()-t0,1)}))
+" 2>/dev/null | grep TPUPROBE)
+  if [ -n "$out" ]; then
+    echo "$ts UP $out" >> /tmp/tpu_availability.log
+    echo "$out" > /tmp/tpu_up.flag
+    exit 0
+  else
+    echo "$ts DOWN" >> /tmp/tpu_availability.log
+  fi
+  sleep 60
+done
