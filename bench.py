@@ -333,6 +333,41 @@ def run_worker(backend: str) -> None:
         "n_devices": jax.device_count(),
     }
 
+    # The tunnel can die MID-worker (measured: a 35-minute hang inside a
+    # value fetch, then an RPC exception — the whole window's numbers
+    # lost).  Checkpoint the partial result dict after every section so
+    # the orchestrator can salvage whatever was measured before a crash
+    # or timeout.
+    sections_done = []
+
+    def flush(section):
+        sections_done.append(
+            "%s@%.0fs" % (section, time.time() - t_worker))
+        print("[worker] %s done t=%.0fs" % (section,
+                                            time.time() - t_worker),
+              file=sys.stderr, flush=True)
+        if not on_tpu:
+            return
+        snap = dict(out)
+        snap["partial"] = True
+        snap["sections_done"] = list(sections_done)
+        snap["measured_at"] = _utc_now()
+        if "value" not in snap:
+            ips = snap.get("resnet50_bf16_images_per_sec_per_chip") \
+                or snap.get("resnet50_images_per_sec_per_chip")
+            if ips:
+                snap["metric"] = "ResNet-50 train throughput" + (
+                    " (bf16)"
+                    if snap.get("resnet50_bf16_images_per_sec_per_chip")
+                    else " (f32)")
+                snap["value"] = ips
+                snap["unit"] = "images/sec/chip"
+        try:
+            with open(_worker_partial_path(), "w") as f:
+                json.dump(snap, f, indent=1)
+        except OSError:
+            pass
+
     # --- ResNet-50 ImageNet shapes: the north-star metric ---------------
     if on_tpu:
         bf16_ips, bf16_flops, bf16_batch, bf16_err, sweep = \
@@ -340,8 +375,17 @@ def run_worker(backend: str) -> None:
                                 spd=4)
         if sweep:
             out["resnet50_bf16_batch_sweep"] = sweep
+        if bf16_ips:
+            out["resnet50_bf16_images_per_sec_per_chip"] = round(
+                bf16_ips, 2)
+            out["resnet50_bf16_batch"] = bf16_batch
+        flush("resnet50_bf16_sweep")
         f32_ips, f32_flops, f32_batch, f32_err = _bench_resnet_adaptive(
             64, 10, 3, None, rng)
+        if f32_ips:
+            out["resnet50_images_per_sec_per_chip"] = round(f32_ips, 2)
+            out["resnet50_batch"] = f32_batch
+        flush("resnet50_f32")
     else:
         # 1-host-core fallback: compile time dominates; keep it tiny but
         # keep the 224^2 ImageNet shape so the unit stays honest.
@@ -375,6 +419,8 @@ def run_worker(backend: str) -> None:
                 out["resnet50_s2d_error"] = s2d_err
         except Exception as e:
             out["resnet50_s2d_error"] = f"{type(e).__name__}: {e}"[:300]
+    if on_tpu:
+        flush("resnet50_s2d")
 
     head_ips = bf16_ips if bf16_ips else f32_ips
     head_flops = bf16_flops if bf16_ips else f32_flops
@@ -407,15 +453,16 @@ def run_worker(backend: str) -> None:
                     f"{type(e).__name__}: {e}"[:200]
             if over_budget(0.7):
                 break
-    if f32_ips:
+        flush("resnet50_conv_impls")
+    # (bf16/f32 throughput keys were assigned right after each bench ran,
+    # so every partial checkpoint carries them; only the CPU-path f32 and
+    # the error keys remain to set here)
+    if f32_ips and not on_tpu:
         out["resnet50_images_per_sec_per_chip"] = round(f32_ips, 2)
         out["resnet50_batch"] = f32_batch
     if f32_err:
         out["resnet50_error"] = f32_err
-    if bf16_ips:
-        out["resnet50_bf16_images_per_sec_per_chip"] = round(bf16_ips, 2)
-        out["resnet50_bf16_batch"] = bf16_batch
-    elif bf16_err != "skipped on cpu":
+    if not bf16_ips and bf16_err != "skipped on cpu":
         out["resnet50_bf16_error"] = bf16_err
 
     if head_ips and head_batch:
@@ -446,6 +493,7 @@ def run_worker(backend: str) -> None:
                     lm_fps_attn / peak, 4)
         except Exception as e:
             out["transformerlm_error"] = f"{type(e).__name__}: {e}"[:300]
+        flush("transformerlm_T1024")
         # long-context: same model at T=4096 (dense attention OOMs here;
         # the flash kernels' O(T*block) memory is what makes it run)
         if over_budget(0.75):
@@ -462,6 +510,7 @@ def run_worker(backend: str) -> None:
             except Exception as e:
                 out["transformerlm_T4096_error"] = \
                     f"{type(e).__name__}: {e}"[:300]
+        flush("transformerlm_T4096")
 
     # --- SimpleRNN: the reference's published workload (batch 12) -------
     try:
@@ -482,6 +531,7 @@ def run_worker(backend: str) -> None:
     except Exception as e:
         rnn_rps = None
         out["simplernn_error"] = f"{type(e).__name__}: {e}"
+    flush("simplernn")
 
     # --- LeNet-5 MNIST shapes ------------------------------------------
     try:
@@ -575,6 +625,10 @@ def _log_availability(up: bool, secs: float, note) -> None:
         pass
 
 
+def _worker_partial_path() -> str:
+    return os.path.join(_here(), "BENCH_TPU_WORKER_PARTIAL.json")
+
+
 def _newest_tpu_measurement():
     """Most recent persisted on-TPU measurement (by its own
     ``measured_at`` stamp, falling back to file mtime)."""
@@ -606,6 +660,46 @@ def _persist_tpu_measurement(result: dict) -> None:
         pass
 
 
+def _salvage_partial(notes):
+    """Recover a mid-run worker checkpoint after a crash/timeout.
+
+    Returns a measurement dict (live fields from this window, earlier
+    complete-window fields carried with explicit provenance) or None if
+    the partial has no headline number.
+    """
+    try:
+        with open(_worker_partial_path()) as f:
+            part = json.load(f)
+    except (OSError, ValueError):
+        return None
+    if not (part.get("tpu") and part.get("value")):
+        return None
+    base = _newest_tpu_measurement()
+    merged = {}
+    carried = []
+    if base is not None:
+        prev, prev_src = base[0], base[1]
+        # Judged-artifact bookkeeping from the previous emit must not
+        # leak into a fresh measurement record.
+        drop = {"stale", "tpu_live", "live_probe", "cpu_fallback",
+                "probe_seconds", "probe_error", "measured_tpu_source",
+                "note", "partial", "sections_done", "tpu_bench_error",
+                "carried_fields"}
+        merged = {k: v for k, v in prev.items() if k not in drop}
+        carried = sorted(k for k in merged if k not in part
+                         and k != "measured_at")
+        if carried:
+            merged["carried_fields"] = {
+                "source": prev_src,
+                "measured_at": prev.get("measured_at"),
+                "keys": carried,
+            }
+    merged.update(part)
+    merged["partial"] = True
+    merged["tpu_bench_error"] = notes.get("tpu_bench_error")
+    return merged
+
+
 def main() -> None:
     t0 = time.time()
     ok, info, note = _run_sub(["--probe"], PROBE_TIMEOUT)
@@ -626,15 +720,28 @@ def main() -> None:
     if not tpu_up:
         notes["probe_error"] = note or "backend resolved to cpu"
     if tpu_up:
+        try:  # stale partials from a previous run must not be salvaged
+            os.unlink(_worker_partial_path())
+        except OSError:
+            pass
         ok, result, note = _run_sub(["--worker", "tpu"], TPU_TIMEOUT)
         if ok and result and result.get("tpu"):
             from_tpu = True
             result["measured_at"] = _utc_now()
             _persist_tpu_measurement(result)
+            try:
+                os.unlink(_worker_partial_path())
+            except OSError:
+                pass
         else:
-            if not ok:
-                notes["tpu_bench_error"] = note
-            result = None
+            notes["tpu_bench_error"] = note or "worker returned no TPU result"
+            # Salvage: the worker checkpoints its section-by-section
+            # partial dict; a tunnel that dies mid-run loses the tail of
+            # the battery, not the whole window's measurements.
+            result = _salvage_partial(notes)
+            if result is not None:
+                from_tpu = True
+                _persist_tpu_measurement(result)
     if result is None:
         ok, result, note = _run_sub(["--worker", "cpu"], CPU_TIMEOUT)
         if not ok:
@@ -670,6 +777,8 @@ def main() -> None:
                 "probe_error": notes.get("probe_error"),
                 "at": _utc_now(),
             }
+            if notes.get("tpu_bench_error"):
+                merged["tpu_bench_error"] = notes["tpu_bench_error"]
             merged["cpu_fallback"] = {
                 k: result.get(k)
                 for k in ("device", "device_kind", "value", "unit",

@@ -62,6 +62,11 @@ def _peak():
     return _bench_module().peak_flops_per_sec(kind)  # ONE peak table
 
 
+def _device_str():
+    import jax
+    return str(jax.devices()[0])
+
+
 def _emit(rec):
     line = json.dumps(rec)
     print(line, flush=True)
@@ -83,7 +88,7 @@ def run_twin(impl, batches=(64, 128, 256), iters=20, warmup=4):
 
     peak = _peak()
     out = {"exp": "twin", "impl": impl,
-           "device": str(jax.devices()[0]), "sweep": {}}
+           "device": _device_str(), "sweep": {}}
     best = 0.0
     for B in batches:
         try:
@@ -106,6 +111,9 @@ def run_twin(impl, batches=(64, 128, 256), iters=20, warmup=4):
             best = max(best, ips)
         except Exception as e:
             out["sweep"][str(B)] = f"{type(e).__name__}: {e}"[:200]
+        # per-point row so a tunnel death mid-sweep keeps earlier batches
+        _emit({"exp": "twin_point", "impl": impl, "batch": B,
+               "result": out["sweep"][str(B)]})
     out["images_per_sec"] = round(best, 2)
     if peak and best:
         out["mfu"] = round(best * RESNET50_FWD_FLOPS_PER_IMAGE * 3 / peak,
@@ -123,6 +131,8 @@ def run_convshapes(batch=128, iters=10, warmup=2):
     from ..ops.conv_gemm import conv2d_gemm_nhwc
 
     peak = _peak()
+    _emit({"exp": "convshapes_header", "batch": batch,
+           "device": _device_str()})
     rng = np.random.RandomState(0)
     rows = []
     for cin, cout, k, s, hw, mult in RESNET50_CONV_SHAPES:
@@ -166,7 +176,7 @@ def run_convshapes(batch=128, iters=10, warmup=2):
             except Exception as e:
                 row[name + "_tflops"] = f"{type(e).__name__}"[:60]
         rows.append(row)
-        print(json.dumps(row), flush=True)
+        _emit(row)
     total = sum(r["flops_per_call"] * r["mult"]
                 for r in rows)
 
@@ -198,7 +208,8 @@ def run_framework(impl, batches=(64, 128, 256)):
     os.environ["bigdl.conv.impl"] = impl
     peak = _peak()
     rng = np.random.RandomState(0)
-    out = {"exp": "framework", "impl": impl, "sweep": {}}
+    out = {"exp": "framework", "impl": impl, "device": _device_str(),
+           "sweep": {}}
     best = 0.0
     for B in batches:
         try:
@@ -212,6 +223,8 @@ def run_framework(impl, batches=(64, 128, 256)):
             best = max(best, ips)
         except Exception as e:
             out["sweep"][str(B)] = f"{type(e).__name__}: {e}"[:200]
+        _emit({"exp": "framework_point", "impl": impl, "batch": B,
+               "result": out["sweep"][str(B)]})
     out["images_per_sec"] = round(best, 2)
     if peak and best:
         out["mfu"] = round(best * RESNET50_FWD_FLOPS_PER_IMAGE * 3 / peak,
@@ -233,6 +246,7 @@ def run_flash(seq_lens=(1024, 4096, 8192), blocks=(256, 512, 1024),
     from ..ops.flash_attention import flash_attention
 
     peak = _peak()
+    _emit({"exp": "flash_header", "device": _device_str()})
     rng = np.random.RandomState(0)
     rows = []
     for T in seq_lens:
@@ -299,7 +313,7 @@ def _flash_rows(T, B, H, D, q, k, v, flops_fwd, blocks, iters, warmup,
         except Exception as e:
             row["error"] = f"{type(e).__name__}: {e}"[:200]
         rows.append(row)
-        print(json.dumps(row), flush=True)
+        _emit(row)
     return rows
 
 

@@ -93,3 +93,47 @@ def test_probe_mode_emits_json():
     info = json.loads(line)
     assert info["platform"] == "cpu"
     assert info["n_devices"] >= 1
+
+
+def test_salvage_partial_merges_with_provenance(monkeypatch, tmp_path):
+    """A worker killed mid-run leaves a section checkpoint; the
+    orchestrator must promote it to a live measurement, carrying
+    earlier-window fields only with explicit provenance."""
+    bench = _bench()
+    partial = {
+        "tpu": True, "device": "TPU v5 lite0", "value": 2200.0,
+        "metric": "ResNet-50 train throughput (bf16)",
+        "unit": "images/sec/chip",
+        "resnet50_bf16_images_per_sec_per_chip": 2200.0,
+        "partial": True, "sections_done": ["resnet50_bf16_sweep@300s"],
+        "measured_at": "2026-07-31T09:00:00Z",
+    }
+    previous = {
+        "tpu": True, "value": 2192.34, "measured_at": "2026-07-30T06:09:44Z",
+        "transformerlm_mfu": 0.6169, "stale": True, "tpu_live": False,
+        "note": "old-emit bookkeeping that must not leak",
+    }
+    (tmp_path / "BENCH_TPU_WORKER_PARTIAL.json").write_text(
+        json.dumps(partial))
+    (tmp_path / "BENCH_TPU_MEASURED_old.json").write_text(
+        json.dumps(previous))
+    monkeypatch.setattr(bench, "_here", lambda: str(tmp_path))
+    out = bench._salvage_partial({"tpu_bench_error": "timeout after 2700s"})
+    assert out is not None
+    assert out["value"] == 2200.0                      # live field wins
+    assert out["measured_at"] == "2026-07-31T09:00:00Z"
+    assert out["partial"] is True
+    assert out["tpu_bench_error"] == "timeout after 2700s"
+    assert out["transformerlm_mfu"] == 0.6169          # carried...
+    carried = out["carried_fields"]                    # ...with provenance
+    assert "transformerlm_mfu" in carried["keys"]
+    assert carried["measured_at"] == "2026-07-30T06:09:44Z"
+    assert "note" not in out and "stale" not in out    # bookkeeping dropped
+
+
+def test_salvage_partial_requires_headline(monkeypatch, tmp_path):
+    bench = _bench()
+    (tmp_path / "BENCH_TPU_WORKER_PARTIAL.json").write_text(
+        json.dumps({"tpu": True, "device": "TPU v5 lite0"}))
+    monkeypatch.setattr(bench, "_here", lambda: str(tmp_path))
+    assert bench._salvage_partial({}) is None
