@@ -343,18 +343,24 @@ def _flash_bwd(q, k, v, o, lse, g, causal: bool, sm_scale: float,
             dv.reshape(B, H, S, D))
 
 
-def _pick_block(n: int, target: int = 512) -> int:
+def _pick_block(n: int, target: int = 1024) -> int:
     """Largest 128-aligned block <= target dividing n.
 
     Roofline: per q-block the kernel streams the whole K/V (4·S·D bytes
     bf16) from HBM while doing 4·bq·S·D MXU FLOPs → arithmetic
     intensity = bq FLOP/byte.  v5e ridge point = 197 TFLOP/s ÷
-    ~820 GB/s ≈ 240 FLOP/byte, so bq ≥ 256 keeps the sweep
-    compute-bound; 512 doubles the margin while the f32 score tile
-    (512² · 4 B = 1 MB) still double-buffers comfortably in the ~16 MB
-    VMEM.  1024² quadruples the score tile for no intensity gain.
-    Measured (v5e, r3): 512² runs the T=1024 grad 2.1× faster than
-    128²; short sequences use one whole block."""
+    ~820 GB/s ≈ 240 FLOP/byte, so bq ≥ 256 already keeps the sweep
+    compute-bound — but the measured on-chip matrix (r4, v5e, MFU_LAB
+    flash rows) shows throughput keeps climbing past the ridge:
+    block=1024 beats 512 at every (T, D) tried, fwd and fwd+bwd
+    (T=8192 D=128 fwd+bwd 62.5 vs 40.7 TFLOP/s; T=4096 D=64 27.5 vs
+    17.9).  Past the ridge the win comes from grid overhead: fewer,
+    longer-running programs amortize prologue/epilogue and revisit the
+    accumulators fewer times.  1024 is the VMEM ceiling — the f32
+    score tile is 1024²·4 B = 4 MB, which still double-buffers in the
+    ~16 MB VMEM; 2048² (16 MB) does not fit.  Measured (v5e, r3): 512²
+    runs the T=1024 grad 2.1× faster than 128²; short sequences use
+    one whole block."""
     if n <= target:
         return n
     b = target
@@ -407,7 +413,7 @@ def flash_attention(q, k, v, causal: bool = False,
     that are 128-multiples, or short 8-aligned sequences that fit one
     block; anything else falls back (callers pad — the data layer's
     fixed-length contract already guarantees static shapes).
-    ``block_q``/``block_k`` override the roofline default (512-target;
+    ``block_q``/``block_k`` override the measured default (1024-target;
     see ``_pick_block``) — exposed for the on-hardware tuning sweeps.
     """
     if sm_scale is None:

@@ -1,0 +1,132 @@
+#!/bin/bash
+# Probe-gated, resumable TPU measurement battery (v3).
+#
+# v2 lesson (2026-08-01 window): the tunnel died right as twin_xla
+# started; the step hung in device init with zero host CPU and would
+# have burned its whole 40-minute timeout — a third of a typical
+# window.  v3 adds a stall watchdog: a step whose process tree burns
+# ~no CPU AND whose activity files (step log, MFU_LAB.jsonl, bench
+# checkpoints) don't grow for STALL consecutive seconds is killed, so
+# the loop falls back to probing within minutes of a mid-window death.
+# A legit axon remote-compile holds the host idle too, but measured
+# compiles this project have never exceeded ~2 min; STALL=480 leaves
+# 4x margin.
+#
+# Priority order (v3): the not-yet-captured VERDICT #1 evidence first
+# (twin_xla, convshapes), then a bench re-run so the judged artifact
+# reflects the flash block=1024 default the first window's matrix
+# picked, then the alternate conv lowerings.
+#
+#   bash tools/tpu_battery3.sh            # run until all steps done
+#   rm /tmp/battery3/<step>.done          # force a step to rerun
+set -u
+B=/tmp/battery3
+mkdir -p "$B"
+cd /root/repo
+log() { echo "$(date -u +%FT%TZ) $*" >> "$B/progress.log"; }
+
+STALL=${STALL:-480}
+ACTIVITY="MFU_LAB.jsonl BENCH_TPU_WORKER_PARTIAL.json BENCH_TPU_MEASURED_latest.json"
+
+tree_ticks() { # cumulative utime+stime of a pid and its descendants
+    local p=$1 t=0 c
+    [ -r "/proc/$p/stat" ] && \
+        t=$(awk '{print $14+$15}' "/proc/$p/stat" 2>/dev/null || echo 0)
+    for c in $(pgrep -P "$p" 2>/dev/null); do
+        t=$((t + $(tree_ticks "$c")))
+    done
+    echo "${t:-0}"
+}
+
+activity_sig() { # size+mtime fingerprint of the activity files + step log
+    stat -c '%n:%s:%Y' $ACTIVITY "$1" 2>/dev/null | md5sum | cut -d' ' -f1
+}
+
+run_guarded() { # logfile timeout_s cmd...
+    local lf=$1 tmo=$2
+    shift 2
+    timeout "$tmo" "$@" > "$lf" 2>&1 &
+    local tp=$! idle=0 ticks0 sig0 ticks1 sig1
+    ticks0=$(tree_ticks "$tp"); sig0=$(activity_sig "$lf")
+    while kill -0 "$tp" 2>/dev/null; do
+        sleep 60
+        kill -0 "$tp" 2>/dev/null || break
+        ticks1=$(tree_ticks "$tp"); sig1=$(activity_sig "$lf")
+        # <2s CPU over the minute and no file growth => one idle tick
+        if [ $((ticks1 - ticks0)) -lt 200 ] && [ "$sig1" = "$sig0" ]; then
+            idle=$((idle + 60))
+        else
+            idle=0
+        fi
+        ticks0=$ticks1; sig0=$sig1
+        if [ "$idle" -ge "$STALL" ]; then
+            log "STALL: no CPU + no output for ${idle}s — killing"
+            kill "$tp" 2>/dev/null; sleep 3
+            pkill -9 -P "$tp" 2>/dev/null; kill -9 "$tp" 2>/dev/null
+            wait "$tp" 2>/dev/null
+            return 91
+        fi
+    done
+    wait "$tp"
+}
+
+probe_up() {
+    local out
+    out=$(timeout 100 python bench.py --probe 2>/dev/null | tail -1)
+    case "$out" in
+    *'"platform"'*)
+        if echo "$out" | grep -q '"platform": "cpu"'; then
+            return 1
+        fi
+        return 0 ;;
+    esac
+    return 1
+}
+
+bench_step() { # done only on a full live-TPU run (salvaged partials retry)
+    [ -f "$B/bench.done" ] && return 0
+    log "start bench"
+    BENCH_CPU_TIMEOUT=300 run_guarded "$B/bench.json" 3600 python bench.py
+    local rc=$?
+    if [ $rc -eq 0 ] && grep -q '"tpu_live": true' "$B/bench.json" \
+            && ! grep -q '"partial": true' "$B/bench.json"; then
+        touch "$B/bench.done"
+        log "bench DONE (full live-TPU run)"
+        return 0
+    fi
+    log "bench rc=$rc incomplete"
+    return 1
+}
+
+lab_step() { # name timeout args...
+    local name=$1 tmo=$2
+    shift 2
+    [ -f "$B/$name.done" ] && return 0
+    log "start $name"
+    run_guarded "$B/$name.log" "$tmo" python -m bigdl_tpu.models.resnet_mfu_lab "$@"
+    local rc=$?
+    log "$name rc=$rc"
+    if [ $rc -eq 0 ]; then
+        touch "$B/$name.done"
+        return 0
+    fi
+    return 1
+}
+
+log "battery3 start"
+while :; do
+    if ! probe_up; then
+        log "probe DOWN"
+        sleep 120
+        continue
+    fi
+    log "probe UP"
+    lab_step twin_xla 2400 --twin --impl xla || { sleep 10; continue; }
+    lab_step convshapes 2400 --convshapes || { sleep 10; continue; }
+    bench_step || { sleep 10; continue; }
+    lab_step twin_gemm 2400 --twin --impl gemm || { sleep 10; continue; }
+    lab_step twin_pallas 2400 --twin --impl pallas || { sleep 10; continue; }
+    lab_step framework_gemm 2400 --framework --impl gemm || { sleep 10; continue; }
+    log "battery3 ALL DONE"
+    break
+done
