@@ -11,7 +11,7 @@ import tempfile
 def run_distributed_proof(model_fn, seed: int, sgd_kwargs: dict,
                           max_epoch_n: int, target: float,
                           batch_size: int, ckpt_prefix: str,
-                          label: str) -> float:
+                          label: str, data_fn=None) -> float:
     from bigdl_tpu import nn
     from bigdl_tpu.dataset import array
     from bigdl_tpu.optim import (SGD, Loss, Top1Accuracy, every_epoch,
@@ -20,7 +20,9 @@ def run_distributed_proof(model_fn, seed: int, sgd_kwargs: dict,
     from bigdl_tpu.utils.engine import Engine
     from bigdl_tpu.utils.rng import set_global_seed
 
-    from .resnet_digits_distributed_accuracy import digits_as_cifar
+    if data_fn is None:
+        from .resnet_digits_distributed_accuracy import digits_as_cifar
+        data_fn = digits_as_cifar
 
     # seed BEFORE model construction: layer inits consume global-RNG
     # draws, and the documented runs are reproducible only if the
@@ -28,7 +30,7 @@ def run_distributed_proof(model_fn, seed: int, sgd_kwargs: dict,
     set_global_seed(seed)
     model = model_fn()
     Engine.init()
-    train, test = digits_as_cifar()
+    train, test = data_fn()
     ckpt_dir = tempfile.mkdtemp(prefix=ckpt_prefix)
 
     opt = DistriOptimizer(model, array(train), nn.ClassNLLCriterion(),

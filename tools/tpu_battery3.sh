@@ -113,6 +113,21 @@ lab_step() { # name timeout args...
     return 1
 }
 
+cmd_step() { # name timeout cmd...
+    local name=$1 tmo=$2
+    shift 2
+    [ -f "$B/$name.done" ] && return 0
+    log "start $name"
+    run_guarded "$B/$name.log" "$tmo" "$@"
+    local rc=$?
+    log "$name rc=$rc"
+    if [ $rc -eq 0 ]; then
+        touch "$B/$name.done"
+        return 0
+    fi
+    return 1
+}
+
 log "battery3 start"
 while :; do
     if ! probe_up; then
@@ -124,6 +139,9 @@ while :; do
     lab_step twin_xla 2400 --twin --impl xla || { sleep 10; continue; }
     lab_step convshapes 2400 --convshapes || { sleep 10; continue; }
     bench_step || { sleep 10; continue; }
+    BIGDL_EXAMPLES_PLATFORM=device cmd_step inception_acc 2400 \
+        python -m bigdl_tpu.examples.inception_digits_accuracy \
+        || { sleep 10; continue; }
     lab_step twin_gemm 2400 --twin --impl gemm || { sleep 10; continue; }
     lab_step twin_pallas 2400 --twin --impl pallas || { sleep 10; continue; }
     lab_step framework_gemm 2400 --framework --impl gemm || { sleep 10; continue; }
