@@ -50,3 +50,61 @@ def test_two_process_distributed_init_and_collective():
         assert p.returncode == 0, f"child {pid} failed:\n{out}"
         assert f"MULTIHOST_OK pid={pid} processes=2 devices=4" in out, out
         assert "sum=3.0" in out, out
+
+
+def test_two_process_distri_optimizer_matches_single_process():
+    """The full data-parallel DistriOptimizer lifecycle across an OS
+    process boundary (global 8-device mesh = 2 processes x 4 local CPU
+    devices, global-semantics device_put batches, psum_scatter over the
+    process boundary, masked trailing batch) — and the process topology
+    must be invisible: a single-process run over the same 8-device mesh
+    must produce the same trained parameters."""
+    child = os.path.join(os.path.dirname(__file__),
+                         "_multihost_train_child.py")
+    repo_root = os.path.dirname(os.path.dirname(child))
+
+    def run(n_proc, local_devices, pids):
+        port = _free_port()
+        env = dict(os.environ,
+                   JAX_PLATFORMS="cpu",
+                   XLA_FLAGS="--xla_force_host_platform_device_count="
+                   + str(local_devices),
+                   PYTHONPATH=repo_root + os.pathsep
+                   + os.environ.get("PYTHONPATH", ""))
+        procs = [
+            subprocess.Popen(
+                [sys.executable, child, f"127.0.0.1:{port}",
+                 str(n_proc), str(pid)],
+                stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+                text=True, env=env, cwd=repo_root)
+            for pid in pids
+        ]
+        outs = []
+        try:
+            for p in procs:
+                out, _ = p.communicate(timeout=420)
+                outs.append(out)
+        except subprocess.TimeoutExpired:
+            for p in procs:
+                p.kill()
+            pytest.fail(f"multihost train children hung; partial: {outs}")
+        for pid, (p, out) in enumerate(zip(procs, outs)):
+            assert p.returncode == 0, f"child {pid} failed:\n{out}"
+        return outs
+
+    def params_sum(out):
+        for line in out.splitlines():
+            if line.startswith("PARAMS_SUM"):
+                return float(line.split()[-1])
+        raise AssertionError(f"no PARAMS_SUM in:\n{out}")
+
+    two = run(2, 2, (0, 1))
+    for pid, out in enumerate(two):
+        assert f"TRAIN_OK pid={pid} processes=2 devices=4" in out, out
+    single = run(1, 4, (0,))
+    assert "TRAIN_OK pid=0 processes=1 devices=4" in single[0], single[0]
+
+    s2a, s2b, s1 = params_sum(two[0]), params_sum(two[1]), params_sum(
+        single[0])
+    assert s2a == s2b, (s2a, s2b)
+    assert abs(s2a - s1) < 1e-4, (s2a, s1)
