@@ -515,6 +515,23 @@ def run_worker(backend: str) -> None:
                 out["transformerlm_T4096_error"] = \
                     f"{type(e).__name__}: {e}"[:300]
         flush("transformerlm_T4096")
+        # T=8192: where the block=1024 flash tuning pays the most
+        # (r4 matrix: 62.5 vs 40.7 TFLOP/s fwd+bwd at D=128)
+        if over_budget(0.85):
+            out["transformerlm_T8192_skipped"] = "worker time budget"
+        else:
+            try:
+                l8_tps, l8_fps, l8_fps_attn = _bench_transformer_lm(
+                    rng, iters=6, spd=2, seq_len=8192, batch=2)
+                out["transformerlm_T8192_tokens_per_sec"] = round(l8_tps, 1)
+                if peak:
+                    out["transformerlm_T8192_mfu"] = round(l8_fps / peak, 4)
+                    out["transformerlm_T8192_mfu_attn_incl"] = round(
+                        l8_fps_attn / peak, 4)
+            except Exception as e:
+                out["transformerlm_T8192_error"] = \
+                    f"{type(e).__name__}: {e}"[:300]
+        flush("transformerlm_T8192")
 
     # --- SimpleRNN: the reference's published workload (batch 12) -------
     try:
