@@ -32,20 +32,27 @@ import numpy as np
 def digits_as_cifar():
     """(train_samples, test_samples): 8x8 digit scans upscaled to the
     ResNet-CIFAR (3, 32, 32) input contract, 1-based labels."""
+    return digits_upscaled(4)
+
+
+def digits_upscaled(factor: int, n_train: int = 1500):
+    """Shared data pipeline for the train-to-accuracy proofs: the 1797
+    real 8x8 digit scans, nearest-upscaled by ``factor``, replicated to
+    3 channels (CHW), normalized, seed-0 shuffled, split
+    ``n_train``/rest.  Labels 1-based."""
     from sklearn.datasets import load_digits
 
     from bigdl_tpu.dataset import Sample
 
     d = load_digits()
     imgs = d.images.astype(np.float32) / 16.0              # (N, 8, 8)
-    up = np.repeat(np.repeat(imgs, 4, axis=1), 4, axis=2)  # (N, 32, 32)
-    chw = np.repeat(up[:, None, :, :], 3, axis=1)          # (N, 3, 32, 32)
+    up = np.repeat(np.repeat(imgs, factor, axis=1), factor, axis=2)
+    chw = np.repeat(up[:, None, :, :], 3, axis=1)          # (N, 3, s, s)
     chw = (chw - chw.mean()) / (chw.std() + 1e-7)
     labels = d.target.astype(np.float32) + 1               # 1-based
     rng = np.random.RandomState(0)
     order = rng.permutation(len(chw))
     chw, labels = chw[order], labels[order]
-    n_train = 1500
     mk = lambda lo, hi: [Sample(chw[i], labels[i]) for i in range(lo, hi)]
     return mk(0, n_train), mk(n_train, len(chw))
 

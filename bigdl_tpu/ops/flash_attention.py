@@ -343,8 +343,8 @@ def _flash_bwd(q, k, v, o, lse, g, causal: bool, sm_scale: float,
             dv.reshape(B, H, S, D))
 
 
-def _pick_block(n: int, target: int = 1024) -> int:
-    """Largest 128-aligned block <= target dividing n.
+def _pick_block(n: int, d: int = 64) -> int:
+    """Largest 128-aligned block <= a measured target dividing n.
 
     Roofline: per q-block the kernel streams the whole K/V (4·S·D bytes
     bf16) from HBM while doing 4·bq·S·D MXU FLOPs → arithmetic
@@ -352,15 +352,19 @@ def _pick_block(n: int, target: int = 1024) -> int:
     ~820 GB/s ≈ 240 FLOP/byte, so bq ≥ 256 already keeps the sweep
     compute-bound — but the measured on-chip matrix (r4, v5e, MFU_LAB
     flash rows) shows throughput keeps climbing past the ridge:
-    block=1024 beats 512 at every (T, D) tried, fwd and fwd+bwd
+    block=1024 beats 512 at every swept point but one, fwd and fwd+bwd
     (T=8192 D=128 fwd+bwd 62.5 vs 40.7 TFLOP/s; T=4096 D=64 27.5 vs
-    17.9).  Past the ridge the win comes from grid overhead: fewer,
-    longer-running programs amortize prologue/epilogue and revisit the
-    accumulators fewer times.  1024 is the VMEM ceiling — the f32
-    score tile is 1024²·4 B = 4 MB, which still double-buffers in the
-    ~16 MB VMEM; 2048² (16 MB) does not fit.  Measured (v5e, r3): 512²
-    runs the T=1024 grad 2.1× faster than 128²; short sequences use
-    one whole block."""
+    17.9; the exception is T=1024 D=128, where 512 edges 1024 by ~2%
+    fwd+bwd and ~30% fwd — the whole-sequence block leaves too few
+    programs to hide the pipeline at the short length, so wide heads at
+    T<=1024 keep the 512 target).  Past the ridge the win comes from
+    grid overhead: fewer, longer-running programs amortize
+    prologue/epilogue and revisit the accumulators fewer times.  1024
+    is the VMEM ceiling — the f32 score tile is 1024²·4 B = 4 MB,
+    which still double-buffers in the ~16 MB VMEM; 2048² (16 MB) does
+    not fit.  Measured (v5e, r3): 512² runs the T=1024 grad 2.1×
+    faster than 128²; short sequences use one whole block."""
+    target = 512 if (n <= 1024 and d >= 128) else 1024
     if n <= target:
         return n
     b = target
@@ -374,8 +378,8 @@ def _pick_block(n: int, target: int = 1024) -> int:
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
 def _flash(q, k, v, causal, sm_scale, interpret, block_q, block_k):
     out, _ = _flash_fwd(q, k, v, causal, sm_scale,
-                        block_q or _pick_block(q.shape[2]),
-                        block_k or _pick_block(k.shape[2]),
+                        block_q or _pick_block(q.shape[2], q.shape[3]),
+                        block_k or _pick_block(k.shape[2], k.shape[3]),
                         interpret)
     return out
 
@@ -383,8 +387,8 @@ def _flash(q, k, v, causal, sm_scale, interpret, block_q, block_k):
 def _flash_fwd_rule(q, k, v, causal, sm_scale, interpret, block_q,
                     block_k):
     out, lse = _flash_fwd(q, k, v, causal, sm_scale,
-                          block_q or _pick_block(q.shape[2]),
-                          block_k or _pick_block(k.shape[2]),
+                          block_q or _pick_block(q.shape[2], q.shape[3]),
+                          block_k or _pick_block(k.shape[2], k.shape[3]),
                           interpret)
     return out, (q, k, v, out, lse)
 
@@ -393,8 +397,8 @@ def _flash_bwd_rule(causal, sm_scale, interpret, block_q, block_k, res,
                     g):
     q, k, v, o, lse = res
     return _flash_bwd(q, k, v, o, lse, g, causal, sm_scale,
-                      block_q or _pick_block(q.shape[2]),
-                      block_k or _pick_block(k.shape[2]),
+                      block_q or _pick_block(q.shape[2], q.shape[3]),
+                      block_k or _pick_block(k.shape[2], k.shape[3]),
                       interpret)
 
 

@@ -54,10 +54,10 @@ def test_two_process_distributed_init_and_collective():
 
 def test_two_process_distri_optimizer_matches_single_process():
     """The full data-parallel DistriOptimizer lifecycle across an OS
-    process boundary (global 8-device mesh = 2 processes x 4 local CPU
+    process boundary (global 4-device mesh = 2 processes x 2 local CPU
     devices, global-semantics device_put batches, psum_scatter over the
     process boundary, masked trailing batch) — and the process topology
-    must be invisible: a single-process run over the same 8-device mesh
+    must be invisible: a single-process run over the same 4-device mesh
     must produce the same trained parameters."""
     child = os.path.join(os.path.dirname(__file__),
                          "_multihost_train_child.py")

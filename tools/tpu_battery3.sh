@@ -60,9 +60,18 @@ run_guarded() { # logfile timeout_s cmd...
         fi
         ticks0=$ticks1; sig0=$sig1
         if [ "$idle" -ge "$STALL" ]; then
-            log "STALL: no CPU + no output for ${idle}s — killing"
-            kill "$tp" 2>/dev/null; sleep 3
-            pkill -9 -P "$tp" 2>/dev/null; kill -9 "$tp" 2>/dev/null
+            log "STALL: no CPU + no output for ${idle}s — killing tree"
+            # collect the WHOLE descendant tree first: killing timeout
+            # alone orphans bench.py's hung worker grandchild to init,
+            # where pkill -P can no longer find it
+            local victims="$tp" frontier="$tp" nxt
+            while :; do
+                nxt=$(for c in $frontier; do pgrep -P "$c"; done 2>/dev/null)
+                [ -z "$nxt" ] && break
+                victims="$victims $nxt"; frontier="$nxt"
+            done
+            kill $victims 2>/dev/null; sleep 3
+            kill -9 $victims 2>/dev/null
             wait "$tp" 2>/dev/null
             return 91
         fi
