@@ -157,3 +157,34 @@ class TestFlashInAttentionLayer:
         np.testing.assert_allclose(np.asarray(mha_flash.forward(x)),
                                    np.asarray(mha_dense.forward(x)),
                                    rtol=1e-4, atol=1e-5)
+
+
+class TestPickBlock:
+    """Pin the measured block-target rule (r4 on-chip matrix,
+    MFU_LAB.jsonl flash rows): target 1024 everywhere except wide heads
+    (D>=128) at short sequences (T<=1024), where 512 measured faster."""
+
+    def test_long_sequences_target_1024(self):
+        from bigdl_tpu.ops.flash_attention import _pick_block
+
+        assert _pick_block(4096, 64) == 1024
+        assert _pick_block(4096, 128) == 1024
+        assert _pick_block(8192, 128) == 1024
+
+    def test_short_wide_heads_keep_512(self):
+        from bigdl_tpu.ops.flash_attention import _pick_block
+
+        assert _pick_block(1024, 128) == 512
+        assert _pick_block(1024, 64) == 1024  # narrow heads: 1024 won
+
+    def test_short_sequences_whole_block(self):
+        from bigdl_tpu.ops.flash_attention import _pick_block
+
+        assert _pick_block(256, 64) == 256
+        assert _pick_block(384, 128) == 384
+
+    def test_non_divisible_falls_to_divisor(self):
+        from bigdl_tpu.ops.flash_attention import _pick_block
+
+        # 1536 = 1024 + 512: largest pow2-halved divisor <= target
+        assert _pick_block(1536, 64) == 512
