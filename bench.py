@@ -207,7 +207,7 @@ def _bench_resnet(batch, iters, warmup, compute_dtype, rng, spd=1,
     y = rng.randint(1, 1001, batch).astype("float32")
     model = ResNet50(1000, stem=stem)
     if conv_impl:
-        for m in _walk_modules(model):
+        for m in model.modules_iter():
             if hasattr(m, "set_conv_impl"):
                 m.set_conv_impl(conv_impl)
     ips, flops = bench_model(model,
@@ -216,15 +216,6 @@ def _bench_resnet(batch, iters, warmup, compute_dtype, rng, spd=1,
                              compute_dtype=compute_dtype,
                              steps_per_dispatch=spd)
     return ips, flops
-
-
-def _walk_modules(m):
-    yield m
-    for c in getattr(m, "modules", ()) or ():
-        yield from _walk_modules(c)
-    for node in getattr(m, "sorted_nodes", ()) or ():
-        if getattr(node, "element", None) is not None:
-            yield from _walk_modules(node.element)
 
 
 def _bench_transformer_lm(rng, iters=16, spd=2, seq_len=1024, batch=16):

@@ -115,8 +115,10 @@ def read_records(path: str, verify: bool = True,
         for off, length in spans:
             yield buf[off:off + length]
         return
-    if isinstance(buf, memoryview):
-        buf = bytes(buf)  # native lib vanished mid-call: bytes fallback
+    # pure-Python frame walk (no native lib): operates directly on the
+    # memoryview — struct.unpack_from and the table CRC both accept it,
+    # so the zero-copy borrow semantics match the native path and no
+    # whole-shard bytes() materialization happens
     pos = 0
     while pos + 12 <= len(buf):
         (length,) = struct.unpack_from("<Q", buf, pos)

@@ -82,14 +82,20 @@ def measure(folder: str, crop: int, batch: int, budget_s: float = 30.0,
 
     out = {"device_normalize": device_normalize}
 
-    # 1. raw framed-record read (CRC-verified)
+    # 1. raw framed-record read (CRC-verified); budget checked inside
+    # the record loop — one cold shard can take minutes, and a
+    # between-shards check would blow far past the budget
     paths = sorted(os.path.join(folder, p) for p in os.listdir(folder))
     t0, nrec, nbytes = time.perf_counter(), 0, 0
+    over = False
     for p in paths:
         for rec in read_records(p):
             nrec += 1
             nbytes += len(rec)
-        if time.perf_counter() - t0 > budget_s:
+            if nrec % 256 == 0 and time.perf_counter() - t0 > budget_s:
+                over = True
+                break
+        if over:
             break
     dt = time.perf_counter() - t0
     out["raw_read_records_per_sec"] = round(nrec / dt, 1)
@@ -156,20 +162,33 @@ def drive(folder: str, crop: int, batch: int, iters: int = 8,
         nn.View(16 * ((crop // 8) // 4) ** 2),
         nn.Linear(16 * ((crop // 8) // 4) ** 2, 1000),
         nn.LogSoftMax())
-    opt = DistriOptimizer(model, ds, nn.ClassNLLCriterion(),
-                          batch_size=batch)
-    opt.set_optim_method(SGD(learning_rate=0.01))
-    opt.set_end_when(max_iteration(iters))
-    t0 = time.perf_counter()
-    opt.optimize()
-    wall = time.perf_counter() - t0
+    def run(n_iters):
+        opt = DistriOptimizer(model, ds, nn.ClassNLLCriterion(),
+                              batch_size=batch)
+        opt.set_optim_method(SGD(learning_rate=0.01))
+        opt.set_end_when(max_iteration(n_iters))
+        t0 = time.perf_counter()
+        opt.optimize()
+        return opt, time.perf_counter() - t0
+
+    # warmup dispatch first: the jit compile (dominant on the virtual
+    # mesh) must not be amortized into the steady-state throughput —
+    # every other harness in the repo warms up before timing
+    run(1)
+    opt, wall = run(iters)
     m = opt.metrics
+    # Metrics accumulates SUMS over the run; emit totals under honest
+    # names plus the derived per-iteration figures
+    gw = m.get("get weights average") or 0.0
+    ct = m.get("computing time average") or 0.0
     return {
         "driver_iters": iters,
         "driver_wall_s": round(wall, 2),
         "driver_images_per_sec": round(batch * iters / wall, 1),
-        "get_weights_average_s": m.get("get weights average"),
-        "computing_time_average_s": m.get("computing time average"),
+        "get_weights_total_s": round(gw, 3),
+        "get_weights_per_iter_s": round(gw / iters, 4),
+        "computing_time_total_s": round(ct, 3),
+        "computing_time_per_iter_s": round(ct / iters, 4),
         "n_devices": jax.device_count(),
     }
 

@@ -979,16 +979,6 @@ class TensorflowSaver:
         return out  # name of the output node
 
 
-def _walk_modules(module):
-    yield module
-    for child in getattr(module, "modules", ()) or ():
-        yield from _walk_modules(child)
-    for node in getattr(module, "sorted_nodes", ()) or ():  # Graph
-        elem = getattr(node, "element", None)
-        if elem is not None:
-            yield from _walk_modules(elem)
-
-
 def _probe_pool_shapes(module, input_shape, nn):
     """Input shape at each ceil-mode pooling module via one ABSTRACT
     forward (``jax.eval_shape`` — no FLOPs): a ceil-mode pool's exact TF
@@ -1006,7 +996,7 @@ def _probe_pool_shapes(module, input_shape, nn):
     pool_classes = (nn.SpatialMaxPooling, nn.SpatialAveragePooling)
     if not any(isinstance(m, pool_classes)
                and getattr(m, "ceil_mode", False)
-               for m in _walk_modules(module)):
+               for m in module.modules_iter()):
         return {}, None
 
     rec = {}

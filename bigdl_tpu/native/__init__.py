@@ -97,6 +97,10 @@ def num_threads() -> int:
 def crc32c(data: bytes, crc: int = 0) -> int:
     lib = _get_lib()
     if lib is not None:
+        if not isinstance(data, (bytes, bytearray)):
+            # ctypes c_char_p takes bytes only; memoryview callers (the
+            # zero-copy record walk) pay one slice-local copy here
+            data = bytes(data)
         return lib.btpu_crc32c(data, len(data), crc)
     from ..visualization.crc32c import crc32c as py_crc
 

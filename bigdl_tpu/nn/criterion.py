@@ -458,10 +458,25 @@ class SoftmaxWithCriterion(AbstractCriterion):
         # NaN fills — the reference skips them before ever indexing
         # (SoftmaxWithCriterion.scala:72-76); the mask below then zeroes
         # the clamped picks.  With no ignore_label configured, an
-        # out-of-range label is ALSO masked out (zero contribution,
-        # excluded from the VALID count) rather than silently scored as
-        # the clamped class.
+        # out-of-range label is ALSO masked out of the traced loss
+        # (zero contribution, excluded from the VALID count) — and, so a
+        # label bug (e.g. accidentally 0-based targets) cannot silently
+        # train on nothing, the EAGER path validates and raises; inside
+        # jit the values are tracers and only the masking semantics can
+        # apply.
         t0 = target.astype(jnp.int32) - 1
+        if self.ignore_label is None and not isinstance(t0, jax.core.Tracer):
+            import numpy as _np
+
+            bad = _np.asarray((t0 < 0) | (t0 >= inp.shape[1]))
+            if bad.any():
+                raise ValueError(
+                    f"SoftmaxWithCriterion: {int(bad.sum())} target "
+                    f"label(s) outside the 1-based range [1, "
+                    f"{inp.shape[1]}] and no ignore_label configured "
+                    "(labels are 1-based; 0 usually means 0-based "
+                    "inputs).  Set ignore_label to skip them "
+                    "deliberately.")
         t = jnp.clip(t0, 0, inp.shape[1] - 1)
         if inp.ndim == 2:
             picked = jnp.take_along_axis(logp, t.reshape(-1, 1), axis=1)[:, 0]

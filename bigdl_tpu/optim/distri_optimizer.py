@@ -399,7 +399,8 @@ class DistriOptimizer(Optimizer):
                     eval_fwd = make_eval_forward(
                         model, mesh,
                         input_seq_dim=1 if n_seq > 1 else None,
-                        compute_dtype=self.compute_dtype)
+                        compute_dtype=self.compute_dtype,
+                        output_seq_dim=self.validation_output_seq_dim)
                 self._validate_multi_axis(state, eval_fwd, params, buffers,
                                           n_data, n_seq)
             if do_checkpoint:

@@ -60,6 +60,7 @@ class Optimizer:
         self.validation_trigger: Optional[Trigger] = None
         self.validation_dataset = None
         self.validation_methods: Sequence[ValidationMethod] = ()
+        self.validation_output_seq_dim = "auto"
         self.train_summary = None
         self.validation_summary = None
         self.metrics = Metrics()
@@ -83,12 +84,20 @@ class Optimizer:
         return self
 
     def set_validation(self, trigger: Trigger, dataset, v_methods,
-                       batch_size: Optional[int] = None):
+                       batch_size: Optional[int] = None,
+                       output_seq_dim="auto"):
+        """``output_seq_dim`` is forwarded to the on-mesh eval forward
+        when validation runs on a mesh with a ``seq`` axis: which dim of
+        each output leaf carries the sequence (``"auto"`` probes and
+        validates against the input seq dim; ``None`` declares the
+        outputs seq-free, e.g. a pooled classifier head; an int names
+        the dim explicitly).  Ignored on seq-free meshes."""
         if batch_size is not None and not _yields_minibatch(dataset):
             dataset = dataset.transform(SampleToMiniBatch(batch_size))
         self.validation_trigger = trigger
         self.validation_dataset = dataset
         self.validation_methods = list(v_methods)
+        self.validation_output_seq_dim = output_seq_dim
         return self
 
     def set_checkpoint(self, path: str, trigger: Trigger):

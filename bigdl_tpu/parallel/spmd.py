@@ -423,7 +423,11 @@ def make_eval_forward(model, mesh, data_axis: Optional[str] = "data",
                     "output_seq_dim int.")
 
     osd = output_seq_dim
-    out_seq_dim = input_seq_dim if osd is _AUTO else osd
+    # equality, not identity: callers pass the plain string "auto"
+    # (e.g. Optimizer.set_validation's default) and interning is not a
+    # contract
+    out_seq_dim = (input_seq_dim
+                   if isinstance(osd, str) and osd == _AUTO else osd)
     out_spec_fn = (in_spec if out_seq_dim == input_seq_dim
                    else _in_spec_fn(data_axis, seq_axis, out_seq_dim))
 
@@ -441,7 +445,8 @@ def make_eval_forward(model, mesh, data_axis: Optional[str] = "data",
             if key not in _shapes:
                 _shapes[key] = _probe_out_shapes(params, buf, x)
             local_shapes = _shapes[key]
-            if (osd is _AUTO and seq_axis and input_seq_dim is not None):
+            if (isinstance(osd, str) and osd == _AUTO and seq_axis
+                    and input_seq_dim is not None):
                 _check_out_seq(local_shapes, x)
             out_specs = jax.tree_util.tree_map(
                 lambda shp: out_spec_fn(len(shp)), local_shapes,

@@ -294,3 +294,42 @@ def test_multi_axis_retry_recovers_from_checkpoint(tmp_path):
     assert fault.fired, "the injected fault never triggered"
     assert trained is model
     assert opt.optim_method.state["neval"] > 8
+
+
+def test_driver_validation_pooled_head_output_seq_dim(tmp_path):
+    """set_validation(output_seq_dim=...) reaches the on-mesh eval
+    forward: a pooled (B, C) head on a seq mesh hard-errors under the
+    default probe (r4 review finding — the opt-out used to be
+    unreachable from the driver API) and validates cleanly once the
+    caller declares the outputs seq-free."""
+    T, F = 8, 6
+
+    def seq_samples(n=16, seed=3):
+        rng = np.random.RandomState(seed)
+        xs = rng.rand(n, T, F).astype(np.float32)
+        ys = (1 + (xs.mean((1, 2)) > 0.5)).astype(np.float32)
+        return [Sample(x, y) for x, y in zip(xs, ys)]
+
+    def drive(output_seq_dim):
+        RNG().set_seed(11)
+        model = nn.Sequential(nn.Mean(dimension=2, squeeze=True),
+                              nn.Linear(F, 2), nn.LogSoftMax())
+        mesh = Mesh(np.array(jax.devices()[:4]).reshape(2, 2),
+                    ("data", "seq"))
+        opt = DistriOptimizer(model, array(seq_samples()),
+                              nn.ClassNLLCriterion(),
+                              batch_size=8, mesh=mesh)
+        opt.set_optim_method(SGD(learning_rate=0.1))
+        opt.set_end_when(max_iteration(2))
+        kw = {} if output_seq_dim == "default" else {
+            "output_seq_dim": output_seq_dim}
+        opt.set_validation(every_epoch(), array(seq_samples(8, seed=4)),
+                           [Top1Accuracy()], batch_size=8, **kw)
+        opt.optimize()
+        return opt
+
+    with pytest.raises(ValueError, match="output_seq_dim"):
+        drive("default")
+
+    opt = drive(None)
+    assert np.isfinite(opt.optim_method.state["loss"])

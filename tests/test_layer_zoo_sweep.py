@@ -342,3 +342,18 @@ def test_init_methods_apply():
     deconv.reset()
     w = np.asarray(deconv.params["weight"])
     assert np.all(np.isfinite(w)) and w.max() <= 1.0 + 1e-6
+
+
+def test_softmax_with_criterion_out_of_range_raises_eagerly():
+    """No ignore_label configured + out-of-range labels = a data bug
+    (usually 0-based targets); the eager path raises instead of
+    silently masking the rows to zero contribution (r4 review finding).
+    Inside jit the values are tracers and the masking semantics apply."""
+    logits = jnp.asarray(R.randn(4, 3).astype(np.float32))
+    with pytest.raises(ValueError, match="1-based"):
+        nn.SoftmaxWithCriterion().forward(
+            logits, np.array([0, 1, 2, 3], np.float32))
+    # the same labels under an explicit ignore_label are deliberate
+    loss = crit_finite(nn.SoftmaxWithCriterion(ignore_label=0), logits,
+                       jnp.asarray([0., 1., 2., 3.]))
+    assert np.isfinite(loss)
