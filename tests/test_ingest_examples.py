@@ -236,4 +236,5 @@ class TestInfeedRehearsal:
         assert out["pipeline_images_per_sec"] > 0
         d = drive(str(tmp_path), 32, 64, iters=2)
         assert d["driver_images_per_sec"] > 0
-        assert d["get_weights_average_s"] is not None
+        assert d["get_weights_total_s"] >= 0
+        assert d["computing_time_per_iter_s"] > 0
