@@ -258,13 +258,19 @@ def run_flash(seq_lens=(1024, 4096, 8192), blocks=(256, 512, 1024),
             v = jnp.asarray(rng.rand(B, H, T, D), jnp.bfloat16)
             # causal attention FLOPs: QK^T + PV at T/2 average extent
             flops_fwd = 2.0 * B * H * T * T * D  # 2 matmuls x (T²/2) x 2
-            rows += _flash_rows(T, B, H, D, q, k, v, flops_fwd, blocks,
+            pairs = [(b, b) for b in blocks]
+            if T >= 2048:
+                # asymmetric follow-up (r4 window 2): the tied sweep
+                # found 1024² best; check whether a smaller streamed-K
+                # block pipelines better against the 1024 q block
+                pairs += [(1024, 512), (512, 1024)]
+            rows += _flash_rows(T, B, H, D, q, k, v, flops_fwd, pairs,
                                 iters, warmup, peak)
     _emit({"exp": "flash_summary", "rows": rows,
            "peak_flops_per_sec": peak})
 
 
-def _flash_rows(T, B, H, D, q, k, v, flops_fwd, blocks, iters, warmup,
+def _flash_rows(T, B, H, D, q, k, v, flops_fwd, pairs, iters, warmup,
                 peak):
     import jax
     import jax.numpy as jnp
@@ -272,16 +278,17 @@ def _flash_rows(T, B, H, D, q, k, v, flops_fwd, blocks, iters, warmup,
     from ..ops.flash_attention import flash_attention
 
     rows = []
-    for blk in blocks:
-        if blk > T:
+    for bq, bk in pairs:
+        if bq > T or bk > T:
             continue
         row = {"exp": "flash", "T": T, "B": B, "H": H, "D": D,
-               "block": blk}
+               "block": bq if bq == bk else f"{bq}q/{bk}k",
+               "block_q": bq, "block_k": bk}
 
-        def f(q, k, v):
+        def f(q, k, v, bq=bq, bk=bk):
             return jnp.sum(flash_attention(
-                q, k, v, causal=True, block_q=blk,
-                block_k=blk).astype(jnp.float32))
+                q, k, v, causal=True, block_q=bq,
+                block_k=bk).astype(jnp.float32))
 
         try:
             fwd = jax.jit(f)
