@@ -71,6 +71,12 @@ def _emit(rec):
     line = json.dumps(rec)
     print(line, flush=True)
     try:
+        import jax
+
+        # the persisted artifact carries ON-CHIP rows only — a CPU
+        # smoke run must not append junk to the judged JSONL
+        if jax.default_backend() == "cpu":
+            return
         root = os.path.dirname(os.path.dirname(os.path.dirname(
             os.path.abspath(__file__))))
         with open(os.path.join(root, "MFU_LAB.jsonl"), "a") as f:
