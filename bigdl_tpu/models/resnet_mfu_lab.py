@@ -283,18 +283,27 @@ def _flash_rows(T, B, H, D, q, k, v, flops_fwd, pairs, iters, warmup,
 
     from ..ops.flash_attention import flash_attention
 
+    # chain several data-dependent kernel applications inside ONE jit:
+    # each dispatch over the tunnel costs ~5-10 ms of round trip, which
+    # at long-T's small per-call work dominated the window-1 rows (the
+    # "backward is almost free" artifact: fwd 11.7 ms vs fwd+bwd 13.2 ms
+    # at T=8192 — both carried the same constant).  4 chained calls cut
+    # the per-call overhead 4x; rows carry "chain" for provenance.
+    CHAIN = 4
     rows = []
     for bq, bk in pairs:
         if bq > T or bk > T:
             continue
         row = {"exp": "flash", "T": T, "B": B, "H": H, "D": D,
                "block": bq if bq == bk else f"{bq}q/{bk}k",
-               "block_q": bq, "block_k": bk}
+               "block_q": bq, "block_k": bk, "chain": CHAIN}
 
         def f(q, k, v, bq=bq, bk=bk):
-            return jnp.sum(flash_attention(
-                q, k, v, causal=True, block_q=bq,
-                block_k=bk).astype(jnp.float32))
+            o = q
+            for _ in range(CHAIN):  # data-dependent: no XLA dedup
+                o = flash_attention(o, k, v, causal=True, block_q=bq,
+                                    block_k=bk)
+            return jnp.sum(o.astype(jnp.float32))
 
         try:
             fwd = jax.jit(f)
@@ -305,7 +314,8 @@ def _flash_rows(T, B, H, D, q, k, v, flops_fwd, pairs, iters, warmup,
             for _ in range(iters):
                 s = fwd(q, k, v)
             float(s)
-            dt = (time.perf_counter() - t0) / iters
+            # per-application figures (dt covers CHAIN applications)
+            dt = (time.perf_counter() - t0) / iters / CHAIN
             row["fwd_ms"] = round(dt * 1e3, 2)
             row["fwd_tflops"] = round(flops_fwd / dt / 1e12, 2)
 
@@ -317,7 +327,7 @@ def _flash_rows(T, B, H, D, q, k, v, flops_fwd, pairs, iters, warmup,
             for _ in range(iters):
                 gs = grad(q, k, v)
             float(jnp.sum(gs[0].astype(jnp.float32)))
-            dt = (time.perf_counter() - t0) / iters
+            dt = (time.perf_counter() - t0) / iters / CHAIN
             row["fwdbwd_ms"] = round(dt * 1e3, 2)
             row["fwdbwd_tflops"] = round(3 * flops_fwd / dt / 1e12, 2)
             if peak:
