@@ -131,8 +131,9 @@ def forward(params, x, training=True, impl="xla"):
 
 
 def make_train_step(impl="xla", compute_dtype=jnp.bfloat16, lr=0.1,
-                    momentum=0.9):
-    """One jitted donated SGD-momentum step on f32 master weights."""
+                    momentum=0.9, steps_per_dispatch=1):
+    """One jitted donated SGD-momentum step on f32 master weights
+    (``steps_per_dispatch > 1`` chains K steps per program)."""
 
     def cast(tree, dt):
         return jax.tree_util.tree_map(
@@ -147,8 +148,7 @@ def make_train_step(impl="xla", compute_dtype=jnp.bfloat16, lr=0.1,
         return -jnp.mean(
             jnp.take_along_axis(logp, y[:, None], axis=1))
 
-    @partial(jax.jit, donate_argnums=(0, 1))
-    def step(params, vel, x, y):
+    def one(params, vel, x, y):
         loss, grads = jax.value_and_grad(loss_fn)(params, x, y)
         vel = jax.tree_util.tree_map(lambda v, g: momentum * v + g,
                                      vel, grads)
@@ -156,4 +156,22 @@ def make_train_step(impl="xla", compute_dtype=jnp.bfloat16, lr=0.1,
                                         params, vel)
         return loss, params, vel
 
-    return step
+    if steps_per_dispatch <= 1:
+        return partial(jax.jit, donate_argnums=(0, 1))(one)
+
+    # chain K steps in ONE program (same fixed batch, like the
+    # framework bench's steps_per_dispatch=4): the ~5-10 ms tunnel
+    # round trip per dispatch is 8-15% of a single ResNet step, and the
+    # twin-vs-framework ceiling comparison must carry the same
+    # amortization on both sides
+    @partial(jax.jit, donate_argnums=(0, 1))
+    def multi(params, vel, x, y):
+        def body(i, carry):
+            p, v = carry
+            _, p, v = one(p, v, x, y)
+            return (p, v)
+        params, vel = jax.lax.fori_loop(
+            0, steps_per_dispatch - 1, body, (params, vel))
+        return one(params, vel, x, y)
+
+    return multi
