@@ -138,13 +138,23 @@ cmd_step() { # name timeout cmd...
 }
 
 log "battery3 start"
+AVAIL=docs/TPU_AVAILABILITY.log
+LAST_STATE=""
+note_state() { # log only TRANSITIONS to the repo availability log
+    if [ "$1" != "$LAST_STATE" ]; then
+        echo "$(date -u +%FT%TZ) $1 (battery3 probe)" >> "$AVAIL"
+        LAST_STATE=$1
+    fi
+}
 while :; do
     if ! probe_up; then
         log "probe DOWN"
+        note_state DOWN
         sleep 120
         continue
     fi
     log "probe UP"
+    note_state UP
     lab_step twin_xla 2400 --twin --impl xla || { sleep 10; continue; }
     lab_step convshapes 2400 --convshapes || { sleep 10; continue; }
     bench_step || { sleep 10; continue; }
