@@ -1,0 +1,104 @@
+"""Generate docs/api-reference.md from the LIVE registry.
+
+The reference's user-facing API surface is its layer/criterion class
+list (nn/, 142 classes) plus optim methods, triggers, validation
+methods, data transforms and the create* Python bridge
+(pyspark PythonBigDL.scala).  This walks the same live objects the
+``bigdl_tpu.api`` reflection facade serves, so the generated page can
+never drift from the code.
+
+Run:  JAX_PLATFORMS=cpu python tools/gen_api_reference.py
+"""
+import inspect
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+
+def first_line(obj):
+    doc = inspect.getdoc(obj) or ""
+    line = doc.split("\n", 1)[0].strip()
+    return line
+
+
+def sig(cls):
+    try:
+        s = str(inspect.signature(cls.__init__))
+        s = s.replace("(self, ", "(").replace("(self)", "()")
+        return s if len(s) <= 90 else s[:87] + "...)"
+    except (TypeError, ValueError):
+        return "(...)"
+
+
+def table(names, lookup):
+    out = ["| Name | Constructor | Summary |", "|---|---|---|"]
+    for n in names:
+        cls = lookup(n)
+        out.append(f"| `{n}` | `{sig(cls)}` | {first_line(cls)} |")
+    return "\n".join(out)
+
+
+def main():
+    from bigdl_tpu import api, nn
+    from bigdl_tpu import optim
+    from bigdl_tpu.nn.module import AbstractModule
+    from bigdl_tpu.nn.criterion import AbstractCriterion
+    from bigdl_tpu.optim.optim_method import OptimMethod
+    from bigdl_tpu.optim.validation import ValidationMethod
+
+    reg = {n: api._REGISTRY[n] for n in api.layer_names()}
+    layers = sorted(n for n, c in reg.items()
+                    if isinstance(c, type) and issubclass(c, AbstractModule))
+    crits = sorted(n for n, c in reg.items()
+                   if isinstance(c, type) and issubclass(c, AbstractCriterion))
+    other = sorted(set(reg) - set(layers) - set(crits))
+
+    optims = sorted(n for n in dir(optim)
+                    if isinstance(getattr(optim, n), type)
+                    and issubclass(getattr(optim, n), OptimMethod)
+                    and getattr(optim, n) is not OptimMethod)
+    vmethods = sorted(
+        n for n in dir(optim)
+        if isinstance(getattr(optim, n), type)
+        and issubclass(getattr(optim, n), ValidationMethod)
+        and getattr(optim, n) is not ValidationMethod)
+
+    doc = ["# API reference (generated — do not edit)",
+           "",
+           "Regenerate with `python tools/gen_api_reference.py`.  Every",
+           "name below is constructible three ways, matching the",
+           "reference Python bridge: `bigdl_tpu.nn.Linear(...)`,",
+           "`api.create('Linear', ...)`, `api.createLinear(...)`.",
+           "",
+           f"## Layers ({len(layers)})", "",
+           table(layers, lambda n: reg[n]), "",
+           f"## Criterions ({len(crits)})", "",
+           table(crits, lambda n: reg[n]), ""]
+    if other:
+        doc += [f"## Other registry entries ({len(other)})", "",
+                table(other, lambda n: reg[n]), ""]
+    doc += [f"## Optimization methods ({len(optims)})", "",
+            table(optims, lambda n: getattr(optim, n)), "",
+            f"## Validation methods ({len(vmethods)})", "",
+            table(vmethods, lambda n: getattr(optim, n)), "",
+            "## Triggers", "",
+            "`every_epoch()`, `every_iteration()`, `several_iteration(n)`,",
+            "`max_epoch(n)`, `max_iteration(n)`, `min_loss(x)`,",
+            "`max_score(x)`, `and_(..)`, `or_(..)` —",
+            "see `bigdl_tpu.optim.trigger`.", ""]
+
+    out_path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "docs", "api-reference.md")
+    with open(out_path, "w") as f:
+        f.write("\n".join(doc))
+    print(f"wrote {out_path}: {len(layers)} layers, {len(crits)} "
+          f"criterions, {len(optims)} optim methods")
+
+
+if __name__ == "__main__":
+    main()
