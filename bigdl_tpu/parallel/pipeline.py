@@ -1,0 +1,384 @@
+"""GPipe-style pipeline parallelism over a ``pipe`` mesh axis.
+
+The fourth parallel axis of the rebuild (alongside ``data``/``seq``/
+``model`` in parallel/spmd.py).  The reference has no pipeline story —
+its only strategy is synchronous data parallelism (SURVEY §2.2) — so
+this is a forward-looking extension shaped by how the hardware wants
+it: the repeated transformer blocks of a :class:`~bigdl_tpu.models.
+transformer.TransformerLM` are stacked into one leading-``L`` pytree,
+sharded over the ``pipe`` axis (each stage owns ``L/S`` layers AND
+their optimizer state), and the microbatched GPipe schedule is a
+``lax.scan`` over ``M + S - 1`` ticks whose inter-stage hop is a single
+``ppermute`` riding the ICI.  JAX AD differentiates straight through
+the scan + ppermute, so the backward pipeline (reverse schedule,
+reverse permutation) is derived, not hand-written.
+
+Layout of one tick (S stages, M microbatches):
+
+    stage 0 feeds microbatch ``t`` into the ring; every stage applies
+    its local layer stack (an inner ``lax.scan`` over ``L/S`` blocks);
+    stage S-1 banks finished microbatch ``t-(S-1)``; ``ppermute``
+    shifts activations one stage right.  Bubble fraction is the
+    textbook ``(S-1)/(M+S-1)``.
+
+Embedding/positions and the LN+head tail run replicated on every pipe
+shard (their FLOPs are negligible next to the block stack; replication
+buys zero extra collectives).  Gradient reduction follows the same
+convention as spmd.py's model axis: pipe-sharded leaves see the
+``S×`` cotangent amplification of the replicated-loss psum and are
+divided by ``S``; replicated leaves are pmean'd over (data, pipe).
+
+Composes with the ``data`` axis (batch sharding) in the same mesh.
+``seq``/``model`` axes inside the pipelined region are out of scope
+(and rejected loudly) — use spmd.make_train_step for those meshes.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax, shard_map
+from jax.sharding import PartitionSpec as P
+
+
+def _block_run(model):
+    """Locate the maximal run of structurally identical transformer
+    blocks in ``model.modules`` (same param treedef + leaf shapes).
+    Returns (first_index, count)."""
+    sig = []
+    for m in model.modules:
+        t = m.param_tree()
+        leaves, treedef = jax.tree_util.tree_flatten(t)
+        sig.append((treedef, tuple(getattr(a, "shape", ()) for a in leaves),
+                    type(m).__name__))
+    best = (0, 0)
+    i = 0
+    while i < len(sig):
+        j = i + 1
+        while j < len(sig) and sig[j] == sig[i]:
+            j += 1
+        if j - i > best[1]:
+            best = (i, j - i)
+        i = j
+    return best
+
+
+def _check_layout(model):
+    """Validate the [embed, blocks..., ln, head] layout; return
+    (first, count).  Shared by pack/unpack and the step builders."""
+    from ..models.transformer import TransformerLM
+
+    if not isinstance(model, TransformerLM):
+        raise TypeError(
+            "pipeline parallelism currently supports TransformerLM "
+            f"(got {type(model).__name__}); the pipelined region must be "
+            "a run of structurally identical blocks")
+    first, count = _block_run(model)
+    if first != 1 or count != len(model.modules) - 3:
+        raise ValueError(
+            "TransformerLM layout changed: expected [embed, blocks..., "
+            f"ln, head], found block run at {first} len {count}")
+    return first, count
+
+
+def _check_model(model, n_pipe):
+    from .tensor_parallel import ColumnParallelLinear, RowParallelLinear
+
+    first, count = _check_layout(model)
+    if model.seq_strategy in ("ring", "ulysses"):
+        raise ValueError(
+            "pipeline parallelism composes with data parallelism only; "
+            f"seq_strategy {model.seq_strategy!r} needs a bound seq axis "
+            "— use parallel.spmd.make_train_step for seq/model meshes")
+    for m in model.modules_iter():
+        if (isinstance(m, (ColumnParallelLinear, RowParallelLinear))
+                and m.axis_name):
+            raise ValueError(
+                "pipeline parallelism does not compose with tensor "
+                f"parallelism yet: {type(m).__name__} is bound to mesh "
+                f"axis {m.axis_name!r} (build the TransformerLM with "
+                "model_axis=None for the pipeline path)")
+    if count % n_pipe != 0:
+        raise ValueError(
+            f"num_layers {count} not divisible by pipe-axis size {n_pipe}")
+    if jax.tree_util.tree_leaves(model.buffer_tree()):
+        raise ValueError(
+            "pipelined model must be buffer-free (no BatchNorm running "
+            "stats inside the pipeline)")
+    return first, count
+
+
+def pack_params(model, n_pipe: int):
+    """Model param tree → pipeline tree: the L block subtrees stacked
+    into leading-``L`` leaves (sharded P('pipe') over stages), the rest
+    verbatim.  Inverse: :func:`unpack_params`."""
+    first, count = _check_model(model, n_pipe)
+    t = model.param_tree()
+    blocks = [t[str(i)] for i in range(first, first + count)]
+    stacked = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *blocks)
+    return {"embed": t["0"], "pos": t["pos"], "blocks": stacked,
+            "ln": t[str(first + count)], "head": t[str(first + count + 1)]}
+
+
+def unpack_params(packed, model):
+    """Write a pipeline param tree back into ``model`` (checkpointing /
+    ``get_parameters`` interop).  Validates that the model's block count
+    matches the packed stack — JAX's clamping gather would otherwise
+    silently duplicate the last layer into any extras."""
+    first, count = _check_layout(model)
+    stacked_l = jax.tree_util.tree_leaves(packed["blocks"])
+    if stacked_l and stacked_l[0].shape[0] != count:
+        raise ValueError(
+            f"packed tree carries {stacked_l[0].shape[0]} block layers "
+            f"but the model has {count}")
+    tree = {"0": packed["embed"], "pos": packed["pos"],
+            str(first + count): packed["ln"],
+            str(first + count + 1): packed["head"]}
+    for i in range(count):
+        tree[str(first + i)] = jax.tree_util.tree_map(
+            lambda a, _i=i: a[_i], packed["blocks"])
+    model.set_param_tree(tree)
+    return model
+
+
+def param_specs(packed, pipe_axis: str = "pipe"):
+    """PartitionSpec tree for a packed pipeline tree: stacked block
+    leaves shard their leading (layer) dim over ``pipe``; the rest
+    replicate."""
+    return {
+        "embed": jax.tree_util.tree_map(lambda _: P(), packed["embed"]),
+        "pos": P(),
+        "blocks": jax.tree_util.tree_map(lambda _: P(pipe_axis),
+                                         packed["blocks"]),
+        "ln": jax.tree_util.tree_map(lambda _: P(), packed["ln"]),
+        "head": jax.tree_util.tree_map(lambda _: P(), packed["head"]),
+    }
+
+
+def _make_local_forward(model, first, count, S, M, pipe_axis,
+                        compute_dtype, remat):
+    """The pipelined local forward shared by the train and eval builders
+    (one implementation so their schedules can never diverge —
+    spmd.py's ``_cast_fwd`` rule).
+
+    Returns ``local_fwd(packed_master, x, training, rng, upcast) -> out``
+    for use INSIDE shard_map: the bf16 cast happens within, so its vjp
+    returns f32 master-weight gradients on the train path."""
+    from ..optim.optimizer import _cast_floats
+
+    Lp = count // S
+    block = model.modules[first]
+    block_bufs = block.buffer_tree()
+    embed = model.modules[0]
+    ln = model.modules[first + count]
+    head = model.modules[first + count + 1]
+    perm = [(i, i + 1) for i in range(S - 1)]
+
+    def stage_fn(blocks_local, act, rng, training):
+        def body(h, xs):
+            lp, li = xs
+            key = (jax.random.fold_in(rng, li)
+                   if rng is not None else None)
+            h, _ = block.apply_fn(lp, block_bufs, h, training, key)
+            return h, None
+
+        act, _ = lax.scan(body, act, (blocks_local, jnp.arange(Lp)))
+        return act
+
+    if remat:
+        stage_fn = jax.checkpoint(stage_fn, static_argnums=(3,))
+
+    def local_fwd(packed, x, training, rng, upcast):
+        pc = (_cast_floats(packed, compute_dtype)
+              if compute_dtype is not None else packed)
+        xc = (_cast_floats(x, compute_dtype)
+              if compute_dtype is not None else x)
+        h, _ = embed.apply_fn(pc["embed"], embed.buffer_tree(), xc,
+                              training, None)
+        h = h + model._positions(pc["pos"], h.shape[1])
+        B = h.shape[0]
+        if B % M:
+            raise ValueError(
+                f"local batch {B} not divisible by n_microbatch {M}")
+        mb = B // M
+        hmb = h.reshape((M, mb) + h.shape[1:])
+        stage = lax.axis_index(pipe_axis)
+
+        def tick(carry, t):
+            act, store = carry
+            feed = lax.dynamic_index_in_dim(
+                hmb, jnp.clip(t, 0, M - 1), 0, keepdims=False)
+            act_in = jnp.where(stage == 0, feed, act)
+            # key unique per (tick, stage); stage_fn folds the local
+            # layer index on top — no two (tick, layer) reuse a key
+            key = (jax.random.fold_in(jax.random.fold_in(rng, t), stage)
+                   if rng is not None else None)
+            act_out = stage_fn(pc["blocks"], act_in, key, training)
+            slot = t - (S - 1)
+            upd = lax.dynamic_update_index_in_dim(
+                store, act_out, jnp.clip(slot, 0, M - 1), 0)
+            store = jnp.where((stage == S - 1) & (slot >= 0), upd, store)
+            act = lax.ppermute(act_out, pipe_axis, perm)
+            return (act, store), None
+
+        (_, store), _ = lax.scan(tick, (jnp.zeros_like(hmb[0]),
+                                        jnp.zeros_like(hmb)),
+                                 jnp.arange(M + S - 1))
+        # only the last stage banked real outputs; broadcast them to
+        # every pipe shard (the psum transpose is where the S× cotangent
+        # amplification that the train path's reduce_grad divides out
+        # comes from)
+        store = lax.psum(
+            jnp.where(stage == S - 1, store, jnp.zeros_like(store)),
+            pipe_axis)
+        h = store.reshape((B,) + store.shape[2:])
+        h, _ = ln.apply_fn(pc["ln"], ln.buffer_tree(), h, training, None)
+        h, _ = head.apply_fn(pc["head"], head.buffer_tree(), h, training,
+                             None)
+        if model._output_mode == "log_probs":
+            h = jax.nn.log_softmax(h, axis=-1)
+        if compute_dtype is not None and upcast:
+            h = _cast_floats(h, jnp.float32)
+        return h
+
+    return local_fwd
+
+
+def make_pipeline_train_step(model, criterion, optim, mesh,
+                             n_microbatch: int,
+                             data_axis: Optional[str] = "data",
+                             pipe_axis: str = "pipe",
+                             compute_dtype=None, donate: bool = False,
+                             remat: Optional[bool] = None):
+    """Build the jitted data×pipe train step.
+
+    Returns ``step(packed_params, slots, lr, x, y, rng=None) ->
+    (loss, packed_params, slots)`` with ``.param_specs`` /
+    ``.slot_specs`` / ``.pack`` / ``.unpack`` attached.  ``slots`` come
+    from ``optim.init_state(packed_params)`` — stage-owned layers keep
+    stage-owned optimizer state (the ZeRO-flavored layout the data
+    driver's slice-owned update already established).
+
+    ``remat`` — rematerialize each tick's stage computation in the
+    backward pass (GPipe's activation stash shrinks from
+    ``(M+S-1)·L/S`` block activations to the tick boundaries).  Default
+    ``None`` inherits ``model.remat`` (the flag spmd/apply_fn honor), so
+    a ``TransformerLM(remat=True)`` remats here too.
+    """
+    from ..optim.regularizer import collect_regularizer_paths
+
+    if pipe_axis not in mesh.axis_names:
+        raise ValueError(f"mesh has no {pipe_axis!r} axis")
+    data_axis = data_axis if data_axis in mesh.axis_names else None
+    S = mesh.shape[pipe_axis]
+    M = int(n_microbatch)
+    first, count = _check_model(model, S)
+    if list(collect_regularizer_paths(model)):
+        raise NotImplementedError(
+            "regularizers are not supported on the pipeline path yet")
+    if any(s != 1.0 for s in
+           jax.tree_util.tree_leaves(model.gradient_scale_tree())):
+        raise NotImplementedError(
+            "scaleW/scaleB are not supported on the pipeline path yet")
+    if remat is None:
+        remat = bool(getattr(model, "remat", False))
+    upcast_out = not getattr(criterion, "accepts_low_precision", False)
+    local_fwd = _make_local_forward(model, first, count, S, M, pipe_axis,
+                                    compute_dtype, remat)
+
+    packed0 = pack_params(model, S)
+    pspecs = param_specs(packed0, pipe_axis)
+    from .spmd import slot_specs as _slot_specs
+
+    sslots = _slot_specs(optim.init_state(packed0), pspecs)
+
+    def local_step(packed, slots, lr, rng, x, y):
+        if rng is not None and data_axis:
+            # decorrelate dropout across batch shards (spmd.py does the
+            # same); pipe peers keep the same base key — they hold
+            # slices of one logical model and already fold (tick, stage)
+            rng = jax.random.fold_in(rng, lax.axis_index(data_axis))
+
+        def loss_fn(p_master):
+            out = local_fwd(p_master, x, True, rng, upcast_out)
+            return criterion._loss(out, y)
+
+        loss, grads = jax.value_and_grad(loss_fn)(packed)
+
+        def reduce_grad(g, spec):
+            piped = any(ax == pipe_axis
+                        or (isinstance(ax, tuple) and pipe_axis in ax)
+                        for ax in spec if ax is not None)
+            if piped:
+                if data_axis:
+                    g = lax.pmean(g, data_axis)
+                return g / S
+            return lax.pmean(g, tuple(a for a in (data_axis, pipe_axis)
+                                      if a))
+
+        grads = jax.tree_util.tree_map(reduce_grad, grads, pspecs)
+        if data_axis:
+            loss = lax.pmean(loss, data_axis)
+        new_p, new_slots = optim.step(grads, packed, slots, lr)
+        return loss, new_p, new_slots
+
+    in_batch = P(data_axis) if data_axis else P()
+    sharded = shard_map(
+        local_step, mesh=mesh,
+        in_specs=(pspecs, sslots, P(), P(), in_batch, in_batch),
+        out_specs=(P(), pspecs, sslots), check_vma=False)
+    jitted = jax.jit(sharded, donate_argnums=(0, 1) if donate else ())
+
+    def step(packed, slots, lr, x, y, rng=None):
+        return jitted(packed, slots, jnp.float32(lr),
+                      rng if rng is not None else jax.random.PRNGKey(0),
+                      jnp.asarray(x), jnp.asarray(y))
+
+    step.param_specs = pspecs
+    step.slot_specs = sslots
+    step.n_stages = S
+    step.n_microbatch = M
+    step.pack = lambda: pack_params(model, S)
+    step.unpack = lambda packed: unpack_params(packed, model)
+    return step
+
+
+def make_pipeline_eval_forward(model, mesh, n_microbatch: int,
+                               data_axis: Optional[str] = "data",
+                               pipe_axis: str = "pipe",
+                               compute_dtype=None):
+    """Compiled pipelined forward for validation/inference over the same
+    mesh/specs as :func:`make_pipeline_train_step` (reuses its sharded
+    params and the SAME schedule implementation).  Returns
+    ``fwd(packed_params, x) -> out`` with the batch dim sharded over
+    ``data``."""
+    if pipe_axis not in mesh.axis_names:
+        raise ValueError(f"mesh has no {pipe_axis!r} axis")
+    data_axis = data_axis if data_axis in mesh.axis_names else None
+    S = mesh.shape[pipe_axis]
+    M = int(n_microbatch)
+    first, count = _check_model(model, S)
+    local_fwd = _make_local_forward(model, first, count, S, M, pipe_axis,
+                                    compute_dtype, remat=False)
+    pspecs = param_specs(pack_params(model, S), pipe_axis)
+
+    def local_eval(packed, x):
+        return local_fwd(packed, x, False, None, True)
+
+    in_batch = P(data_axis) if data_axis else P()
+    sharded = shard_map(local_eval, mesh=mesh, in_specs=(pspecs, in_batch),
+                        out_specs=in_batch, check_vma=False)
+    jitted = jax.jit(sharded)
+
+    def fwd(packed, x):
+        n_data = mesh.shape[data_axis] if data_axis else 1
+        if x.shape[0] % (n_data * M):
+            raise ValueError(
+                f"batch {x.shape[0]} must be divisible by data-axis × "
+                f"n_microbatch = {n_data} × {M} = {n_data * M}")
+        return jitted(packed, jnp.asarray(x))
+
+    fwd.param_specs = pspecs
+    return fwd

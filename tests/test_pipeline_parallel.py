@@ -1,0 +1,180 @@
+"""Pipeline parallelism (parallel/pipeline.py): GPipe microbatch
+schedule over a ``pipe`` mesh axis, composed with data parallelism.
+
+Correctness is pinned by exact equivalence with a dense single-device
+twin: the pipelined step (S stages x M microbatches, ppermute ring,
+derived backward) must produce the same loss and the same updated
+parameters as differentiating the plain TransformerLM forward on the
+full batch.  Beyond reference parity (the reference is data-parallel
+only, SURVEY §2.2)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh
+
+from bigdl_tpu import nn
+from bigdl_tpu.models.transformer import TransformerLM
+from bigdl_tpu.optim import SGD
+from bigdl_tpu.parallel.pipeline import (make_pipeline_eval_forward,
+                                         make_pipeline_train_step,
+                                         pack_params, unpack_params)
+from bigdl_tpu.utils.rng import RNG
+
+VOCAB, EMBED, HEADS, MLP, LAYERS, T = 11, 16, 2, 32, 4, 8
+
+
+def _model(num_layers=LAYERS):
+    RNG().set_seed(7)
+    return TransformerLM(VOCAB, embed_dim=EMBED, num_heads=HEADS,
+                         mlp_dim=MLP, num_layers=num_layers, max_len=T)
+
+
+def _batch(n, seed=0):
+    rng = np.random.RandomState(seed)
+    x = rng.randint(1, VOCAB + 1, size=(n, T)).astype(np.int32)
+    y = rng.randint(1, VOCAB + 1, size=(n, T)).astype(np.float32)
+    return x, y
+
+
+def _dense_steps(model, criterion, optim, lr, batches):
+    """Oracle: differentiate the plain forward, step the same optimizer."""
+    params = model.param_tree()
+    bufs = model.buffer_tree()
+    slots = optim.init_state(params)
+
+    def loss_fn(p, x, y):
+        out, _ = model.apply_fn(p, bufs, x, True, None)
+        return criterion._loss(out, y)
+
+    losses = []
+    for x, y in batches:
+        loss, grads = jax.value_and_grad(loss_fn)(params, jnp.asarray(x),
+                                                  jnp.asarray(y))
+        params, slots = optim.step(grads, params, slots, lr)
+        losses.append(float(loss))
+    return losses, params
+
+
+def _assert_tree_close(a, b, atol=2e-5):
+    fa = jax.tree_util.tree_leaves_with_path(a)
+    fb = dict(jax.tree_util.tree_leaves_with_path(b))
+    assert len(fa) == len(fb)
+    for path, la in fa:
+        np.testing.assert_allclose(np.asarray(la), np.asarray(fb[path]),
+                                   atol=atol,
+                                   err_msg=jax.tree_util.keystr(path))
+
+
+@pytest.mark.parametrize("shape,axes,n_mb", [
+    ((2, 4), ("data", "pipe"), 2),
+    ((4,), ("pipe",), 4),
+    ((2, 2), ("data", "pipe"), 1),
+])
+def test_pipeline_matches_dense_twin(shape, axes, n_mb):
+    n_dev = int(np.prod(shape))
+    mesh = Mesh(np.array(jax.devices()[:n_dev]).reshape(shape), axes)
+    model = _model()
+    criterion = nn.TimeDistributedCriterion(nn.ClassNLLCriterion())
+    lr = 0.2
+    batches = [_batch(8, seed=s) for s in (0, 1)]
+
+    losses_ref, params_ref = _dense_steps(
+        model, criterion, SGD(learning_rate=lr, momentum=0.5), lr, batches)
+
+    step = make_pipeline_train_step(
+        model, criterion, SGD(learning_rate=lr, momentum=0.5), mesh,
+        n_microbatch=n_mb)
+    packed = step.pack()
+    slots = SGD(learning_rate=lr, momentum=0.5).init_state(packed)
+    for (x, y), ref in zip(batches, losses_ref):
+        loss, packed, slots = step(packed, slots, lr, x, y)
+        assert abs(float(loss) - ref) < 2e-5
+    unpack_params(packed, model)
+    _assert_tree_close(model.param_tree(), params_ref)
+
+
+def test_pipeline_remat_matches_dense_twin():
+    mesh = Mesh(np.array(jax.devices()[:8]).reshape(2, 4), ("data", "pipe"))
+    model = _model()
+    criterion = nn.TimeDistributedCriterion(nn.ClassNLLCriterion())
+    lr = 0.1
+    batches = [_batch(8, seed=3)]
+    losses_ref, params_ref = _dense_steps(
+        model, criterion, SGD(learning_rate=lr), lr, batches)
+    step = make_pipeline_train_step(
+        model, criterion, SGD(learning_rate=lr), mesh, n_microbatch=2,
+        remat=True)
+    packed = step.pack()
+    slots = SGD(learning_rate=lr).init_state(packed)
+    loss, packed, slots = step(packed, slots, lr, *batches[0])
+    assert abs(float(loss) - losses_ref[0]) < 2e-5
+    unpack_params(packed, model)
+    _assert_tree_close(model.param_tree(), params_ref)
+
+
+def test_pipeline_eval_forward_matches_dense():
+    mesh = Mesh(np.array(jax.devices()[:8]).reshape(2, 4), ("data", "pipe"))
+    model = _model()
+    x, _ = _batch(8, seed=5)
+    out_ref, _ = model.apply_fn(model.param_tree(), model.buffer_tree(),
+                                jnp.asarray(x), False, None)
+    fwd = make_pipeline_eval_forward(model, mesh, n_microbatch=2)
+    out = fwd(pack_params(model, 4), x)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(out_ref),
+                               atol=2e-5)
+
+
+def test_pack_unpack_roundtrip():
+    model = _model()
+    before = jax.tree_util.tree_leaves_with_path(model.param_tree())
+    packed = pack_params(model, 2)
+    unpack_params(packed, model)
+    after = dict(jax.tree_util.tree_leaves_with_path(model.param_tree()))
+    for path, leaf in before:
+        np.testing.assert_array_equal(np.asarray(leaf),
+                                      np.asarray(after[path]))
+
+
+def test_pipeline_rejects_bad_configs():
+    mesh = Mesh(np.array(jax.devices()[:8]).reshape(2, 4), ("data", "pipe"))
+    crit = nn.TimeDistributedCriterion(nn.ClassNLLCriterion())
+    with pytest.raises(ValueError, match="not divisible"):
+        make_pipeline_train_step(_model(num_layers=3), crit, SGD(), mesh,
+                                 n_microbatch=2)
+    RNG().set_seed(7)
+    ring = TransformerLM(VOCAB, embed_dim=EMBED, num_heads=HEADS,
+                         mlp_dim=MLP, num_layers=4, max_len=T,
+                         seq_strategy="ring")
+    with pytest.raises(ValueError, match="seq_strategy"):
+        make_pipeline_train_step(ring, crit, SGD(), mesh, n_microbatch=2)
+    with pytest.raises(TypeError, match="TransformerLM"):
+        make_pipeline_train_step(nn.Sequential(nn.Linear(4, 4)), crit,
+                                 SGD(), mesh, n_microbatch=2)
+    RNG().set_seed(7)
+    tp = TransformerLM(VOCAB, embed_dim=EMBED, num_heads=HEADS,
+                       mlp_dim=MLP, num_layers=4, max_len=T,
+                       model_axis="model")
+    with pytest.raises(ValueError, match="tensor parallelism"):
+        make_pipeline_train_step(tp, crit, SGD(), mesh, n_microbatch=2)
+
+
+def test_unpack_rejects_layer_count_mismatch():
+    packed = pack_params(_model(num_layers=4), 2)
+    with pytest.raises(ValueError, match="block layers"):
+        unpack_params(packed, _model(num_layers=8))
+
+
+def test_model_remat_flag_inherited():
+    mesh = Mesh(np.array(jax.devices()[:4]).reshape(2, 2), ("data", "pipe"))
+    RNG().set_seed(7)
+    model = TransformerLM(VOCAB, embed_dim=EMBED, num_heads=HEADS,
+                          mlp_dim=MLP, num_layers=4, max_len=T, remat=True)
+    crit = nn.TimeDistributedCriterion(nn.ClassNLLCriterion())
+    step = make_pipeline_train_step(model, crit, SGD(learning_rate=0.1),
+                                    mesh, n_microbatch=2)
+    packed = step.pack()
+    slots = SGD(learning_rate=0.1).init_state(packed)
+    x, y = _batch(8, seed=9)
+    loss, packed, slots = step(packed, slots, 0.1, x, y)
+    assert np.isfinite(float(loss))
