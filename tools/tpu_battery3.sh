@@ -156,8 +156,11 @@ while :; do
     log "probe UP"
     note_state UP
     lab_step twin_xla 2400 --twin --impl xla || { sleep 10; continue; }
-    lab_step convshapes 2400 --convshapes || { sleep 10; continue; }
+    # window-2 reorder: twin captured 08:28Z window; the judged bench
+    # re-run (retuned flash defaults) now outranks the diagnostic
+    # conv-shape matrix on whatever window comes next
     bench_step || { sleep 10; continue; }
+    lab_step convshapes 2400 --convshapes || { sleep 10; continue; }
     BIGDL_EXAMPLES_PLATFORM=device cmd_step inception_acc 2400 \
         python -m bigdl_tpu.examples.inception_digits_accuracy \
         || { sleep 10; continue; }
