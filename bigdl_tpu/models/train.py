@@ -191,6 +191,17 @@ def main(argv=None):
                         help="seq-axis size for sequence models (ring "
                              "attention over the mesh's seq axis; "
                              "requires --distributed)")
+    parser.add_argument("--pipeline-parallel", type=positive_int, default=1,
+                        metavar="N",
+                        help="pipe-axis size: GPipe pipeline over N "
+                             "stages (transformer only; N must divide "
+                             "num_layers; requires --distributed; "
+                             "excludes --tensor-parallel/--seq-parallel)")
+    parser.add_argument("--pipeline-microbatch", type=positive_int,
+                        default=None, metavar="M",
+                        help="GPipe microbatches per step (default: the "
+                             "pipe-axis size); batch size must be "
+                             "divisible by data-shards x M")
     parser.add_argument("--remat", action="store_true",
                         help="rematerialize transformer-block activations "
                              "in the backward pass (jax.checkpoint): HBM "
@@ -207,10 +218,19 @@ def main(argv=None):
         import os
 
         os.environ["bigdl.conv.impl"] = args.conv_impl
-    if ((args.tensor_parallel > 1 or args.seq_parallel > 1)
-            and not args.distributed):
-        parser.error("--tensor-parallel/--seq-parallel require "
-                     "--distributed")
+    if ((args.tensor_parallel > 1 or args.seq_parallel > 1
+         or args.pipeline_parallel > 1) and not args.distributed):
+        parser.error("--tensor-parallel/--seq-parallel/--pipeline-parallel "
+                     "require --distributed")
+    if args.pipeline_parallel > 1 and (args.tensor_parallel > 1
+                                       or args.seq_parallel > 1):
+        parser.error("--pipeline-parallel composes with data parallelism "
+                     "only (not --tensor-parallel/--seq-parallel)")
+    if args.pipeline_parallel > 1 and args.model != "transformer":
+        parser.error("--pipeline-parallel supports --model transformer")
+    if args.pipeline_microbatch and args.pipeline_parallel < 2:
+        parser.error("--pipeline-microbatch needs --pipeline-parallel >= 2 "
+                     "(it configures the GPipe schedule)")
 
     from ..utils.engine import Engine as _Engine
 
@@ -246,11 +266,15 @@ def main(argv=None):
         from ..optim.distri_optimizer import DistriOptimizer
 
         # Engine.create_mesh validates divisibility; model/seq > 1 route
-        # DistriOptimizer onto the multi-axis SPMD path
+        # DistriOptimizer onto the multi-axis SPMD path, pipe > 1 onto
+        # the GPipe pipeline path
         mesh = Engine.create_mesh(model=args.tensor_parallel,
-                                  seq=args.seq_parallel)
+                                  seq=args.seq_parallel,
+                                  pipe=args.pipeline_parallel)
         opt = DistriOptimizer(model, array(train_s), criterion,
                               batch_size=batch, mesh=mesh)
+        if args.pipeline_microbatch:
+            opt.set_pipeline_microbatch(args.pipeline_microbatch)
     else:
         opt = LocalOptimizer(model, array(train_s), criterion,
                              batch_size=batch)

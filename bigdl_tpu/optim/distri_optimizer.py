@@ -207,6 +207,18 @@ class DistriOptimizer(Optimizer):
         # step (parallel/spmd.py: tensor + sequence parallelism composed
         # with data parallelism in one program); a pure-data mesh keeps
         # the reference-shaped AllReduceParameter path below
+        # a mesh with a real pipe axis routes to the GPipe pipeline
+        # driver (parallel/pipeline.py: stage-sharded block stack,
+        # microbatch schedule, derived backward)
+        if "pipe" in mesh.axis_names and mesh.shape["pipe"] > 1:
+            extra = [a for a in ("model", "seq")
+                     if a in mesh.axis_names and mesh.shape[a] > 1]
+            if extra:
+                raise ValueError(
+                    f"the pipeline driver composes with the data axis "
+                    f"only; mesh also has {extra} (>1). Use a data x "
+                    "pipe mesh, or a seq/model mesh without pipe.")
+            return self._optimize_pipeline(mesh)
         extra_axes = [a for a in ("model", "seq")
                       if a in mesh.axis_names and mesh.shape[a] > 1]
         if extra_axes:
@@ -417,6 +429,155 @@ class DistriOptimizer(Optimizer):
         model.evaluate()
         return model
 
+    # ------------------------------------------------------------------
+    # pipeline (data x pipe) GPipe path
+    # ------------------------------------------------------------------
+    def _optimize_pipeline(self, mesh) -> AbstractModule:
+        """Full Optimizer lifecycle over a data x pipe mesh: the step is
+        ``parallel.pipeline.make_pipeline_train_step`` (stage-sharded
+        transformer blocks, GPipe microbatch schedule, derived backward);
+        triggers, canonical log line, summaries, checkpoint and
+        retry-from-checkpoint keep the same contract as the other mesh
+        paths.  Exceeds reference parity (SURVEY §2.2: the reference is
+        data-parallel only)."""
+        n_data = mesh.shape.get("data", 1)
+        n_mb = self.pipeline_microbatch or mesh.shape["pipe"]
+        if (self.batch_size is not None
+                and self.batch_size % (n_data * n_mb) != 0):
+            raise ValueError(
+                f"batch size {self.batch_size} must be divisible by "
+                f"data-axis x pipeline microbatches = {n_data} x {n_mb} "
+                f"= {n_data * n_mb}")
+        return self._with_retry(lambda: self._optimize_pipeline_once(mesh))
+
+    def _optimize_pipeline_once(self, mesh) -> AbstractModule:
+        from jax.sharding import NamedSharding
+
+        from ..parallel.pipeline import (make_pipeline_eval_forward,
+                                         make_pipeline_train_step,
+                                         pack_params, unpack_params)
+        from .optimizer import _epoch_records, _resume_slots
+
+        model, optim = self.model, self.optim_method
+        model.training()
+        n_data = mesh.shape.get("data", 1)
+        n_pipe = mesh.shape["pipe"]
+        n_mb = self.pipeline_microbatch or n_pipe
+
+        step = make_pipeline_train_step(model, self.criterion, optim, mesh,
+                                        n_microbatch=n_mb,
+                                        compute_dtype=self.compute_dtype,
+                                        donate=True)
+        eval_fwd = None  # built lazily on the first validation trigger
+        put = lambda tree, specs: jax.tree_util.tree_map(
+            lambda a, s: jax.device_put(a, NamedSharding(mesh, s)),
+            tree, specs)
+        packed = put(pack_params(model, n_pipe), step.param_specs)
+        slots = _resume_slots(optim, optim.init_state(packed))
+        slots = put(slots, step.slot_specs)
+
+        state = optim.state
+        state["epoch"] = state.get("epoch", 1)
+        state["neval"] = state.get("neval", 1)
+        state["epoch_finished"] = False
+        records_this_epoch = 0
+        epoch_size = _epoch_records(self.dataset)
+        data_iter = self.dataset.data(train=True)
+        wall_start = time.time()
+        pad_multiple = n_data * n_mb
+
+        def _sync_to_model():
+            unpack_params(jax.device_get(packed), model)
+            optim._slots = jax.device_get(slots)
+
+        while not self.end_when(state):
+            state["epoch_finished"] = False
+            t_data0 = time.time()
+            batch = next(data_iter)
+            x, y = _device_batch(batch)
+            n_records = batch.size()
+            mask_kw = {}
+            if n_records % pad_multiple != 0:
+                # trailing partial batch: pad whole records to the
+                # data x microbatch multiple and train the real ones via
+                # the per-record weight mask (every-record guarantee on
+                # the pipeline mesh too)
+                if not _maskable(y, n_records):
+                    raise ValueError(
+                        "pipeline training got a trailing partial batch "
+                        f"of {n_records} records but the targets are not "
+                        "record-leading arrays for pad-and-mask; size "
+                        "the dataset to a batch multiple")
+                x, y, w = pad_batch(x, y, n_records,
+                                    round_up(n_records, pad_multiple))
+                mask_kw = {"w": w, "total_w": float(n_records)}
+            infeed_time = time.time() - t_data0
+
+            t0 = time.time()
+            lr = optim.get_current_lr()
+            loss, packed, slots = step(packed, slots, lr, x, y,
+                                       rng=next_jax_key(), **mask_kw)
+            loss = float(loss)  # value fetch = execution barrier
+            train_time = time.time() - t0
+
+            records_this_epoch += n_records
+            state["loss"] = loss
+            # metric-name contract (reference DistriOptimizer.scala:146-151)
+            self.metrics.add("computing time average", train_time)
+            self.metrics.add("aggregate gradient time", 0.0)
+            self.metrics.add("get weights average", infeed_time)
+            log.info(
+                "[Epoch %d %d/%d][Iteration %d][Wall Clock %.3fs] "
+                "Train %d in %.4f seconds. Throughput is %.1f "
+                "records/second. Loss is %.5f.",
+                state["epoch"], records_this_epoch, epoch_size,
+                state["neval"], time.time() - wall_start, n_records,
+                train_time + infeed_time,
+                n_records / max(train_time + infeed_time, 1e-9), loss)
+            if self.train_summary is not None:
+                self.train_summary.add_scalar("Loss", loss, state["neval"])
+                self.train_summary.add_scalar(
+                    "Throughput",
+                    n_records / max(train_time + infeed_time, 1e-9),
+                    state["neval"])
+
+            state["neval"] += 1
+            optim.state = state
+            if records_this_epoch >= epoch_size:
+                state["epoch"] += 1
+                state["epoch_finished"] = True
+                records_this_epoch = 0
+                self.dataset.shuffle()
+                data_iter = self.dataset.data(train=True)
+
+            do_validate = (self.validation_trigger is not None
+                           and self.validation_trigger(state))
+            do_checkpoint = (self.checkpoint_trigger is not None
+                             and self.checkpoint_trigger(state))
+            if do_validate and self.validation_dataset is not None:
+                if eval_fwd is None:
+                    pfwd = make_pipeline_eval_forward(
+                        model, mesh, n_microbatch=n_mb,
+                        compute_dtype=self.compute_dtype)
+                    eval_fwd = lambda p, b, xx: pfwd(p, xx)
+                from .evaluator import evaluate_dataset
+
+                results = evaluate_dataset(
+                    model, self.validation_dataset,
+                    self.validation_methods,
+                    batch_size=self.batch_size or 128,
+                    params=packed, buffers=model.buffer_tree(),
+                    fwd=eval_fwd, n_shard=n_data * n_mb)
+                model.training()
+                self._report_validation(state, results)
+            if do_checkpoint:
+                _sync_to_model()
+                self._checkpoint(state)
+
+        _sync_to_model()
+        model.evaluate()
+        return model
+
     def _validate_multi_axis(self, state, eval_fwd, params, buffers,
                              n_data, n_seq=1):
         """On-mesh validation for the multi-axis path: the compiled
@@ -458,6 +619,11 @@ class DistriOptimizer(Optimizer):
                 ) from e
             raise
         self.model.training()
+        self._report_validation(state, results)
+
+    def _report_validation(self, state, results):
+        """Log + summarize validation results and update the trigger
+        score — the one copy shared by every mesh path's validation."""
         for method, result in zip(self.validation_methods, results):
             log.info("%s is %s", method.format(), result)
             if self.validation_summary is not None:
@@ -685,13 +851,7 @@ class DistriOptimizer(Optimizer):
             results = evaluate_dataset(self.model, self.validation_dataset,
                                        self.validation_methods, mesh=mesh,
                                        params=params, buffers=buffers)
-            for method, result in zip(self.validation_methods, results):
-                log.info("%s is %s", method.format(), result)
-                if self.validation_summary is not None:
-                    self.validation_summary.add_scalar(
-                        method.format(), result.result()[0], state["neval"] - 1)
-                if method.format() in ("Top1Accuracy", "Top5Accuracy"):
-                    state["score"] = result.result()[0]
+            self._report_validation(state, results)
             self.model.training()
 
     def _checkpoint(self, state):

@@ -73,6 +73,9 @@ class Optimizer:
         # FP16CompressedTensor.scala:26 — on TPU the precision knob moves
         # from the wire to the MXU)
         self.compute_dtype = None
+        # GPipe microbatch count for meshes with a 'pipe' axis (None:
+        # the driver defaults to the pipe-axis size)
+        self.pipeline_microbatch = None
 
     # -- fluent config (Optimizer.scala:98-243) -------------------------
     def set_optim_method(self, method: OptimMethod):
@@ -123,6 +126,17 @@ class Optimizer:
         and a float32 optimizer update.  Gradients arrive float32 through
         the cast's vjp.  Pass ``None`` to restore full precision."""
         self.compute_dtype = jnp.dtype(dtype) if dtype is not None else None
+        return self
+
+    def set_pipeline_microbatch(self, n: int):
+        """GPipe microbatch count M for training over a mesh with a
+        ``pipe`` axis (parallel/pipeline.py).  Larger M shrinks the
+        pipeline bubble (``(S-1)/(M+S-1)``) at the cost of smaller
+        per-microbatch matmuls; the per-device batch must be divisible
+        by M.  Default: the pipe-axis size."""
+        if int(n) < 1:
+            raise ValueError(f"pipeline microbatch must be >= 1, got {n}")
+        self.pipeline_microbatch = int(n)
         return self
 
     def set_drop_module_property(self, drop_percentage, max_drop_percentage,

@@ -294,47 +294,87 @@ def make_pipeline_train_step(model, criterion, optim, mesh,
 
     sslots = _slot_specs(optim.init_state(packed0), pspecs)
 
-    def local_step(packed, slots, lr, rng, x, y):
-        if rng is not None and data_axis:
-            # decorrelate dropout across batch shards (spmd.py does the
-            # same); pipe peers keep the same base key — they hold
-            # slices of one logical model and already fold (tick, stage)
-            rng = jax.random.fold_in(rng, lax.axis_index(data_axis))
+    def _make_local_step(masked):
+        def local_step(packed, slots, lr, rng, x, y, *mask_args):
+            if rng is not None and data_axis:
+                # decorrelate dropout across batch shards (spmd.py does
+                # the same); pipe peers keep the same base key — they
+                # hold slices of one logical model and already fold
+                # (tick, stage)
+                rng = jax.random.fold_in(rng, lax.axis_index(data_axis))
 
-        def loss_fn(p_master):
-            out = local_fwd(p_master, x, True, rng, upcast_out)
-            return criterion._loss(out, y)
+            def loss_fn(p_master):
+                out = local_fwd(p_master, x, True, rng, upcast_out)
+                if masked:
+                    # trailing partial batch: per-record loss weighted
+                    # by the 1-real/0-pad mask over the GLOBAL real
+                    # count — every record trains exactly once at static
+                    # shape (same contract as spmd.py's masked step;
+                    # pad rows are whole records, so they only touch the
+                    # batch dim and compose with microbatching freely)
+                    w, total_w = mask_args
+                    add_axis = lambda v: jax.tree_util.tree_map(
+                        lambda a: a[None], v)
+                    per = jax.vmap(
+                        lambda o, t: criterion._loss(add_axis(o),
+                                                     add_axis(t)))(out, y)
+                    return jnp.sum(per * w) / total_w
+                return criterion._loss(out, y)
 
-        loss, grads = jax.value_and_grad(loss_fn)(packed)
+            loss, grads = jax.value_and_grad(loss_fn)(packed)
 
-        def reduce_grad(g, spec):
-            piped = any(ax == pipe_axis
-                        or (isinstance(ax, tuple) and pipe_axis in ax)
-                        for ax in spec if ax is not None)
-            if piped:
-                if data_axis:
-                    g = lax.pmean(g, data_axis)
-                return g / S
-            return lax.pmean(g, tuple(a for a in (data_axis, pipe_axis)
-                                      if a))
+            def reduce_grad(g, spec):
+                piped = any(ax == pipe_axis
+                            or (isinstance(ax, tuple) and pipe_axis in ax)
+                            for ax in spec if ax is not None)
+                if masked:
+                    # local loss is normalized by the GLOBAL real count:
+                    # the data axis contributes a SUM
+                    if data_axis:
+                        g = lax.psum(g, data_axis)
+                    return g / S if piped else lax.pmean(g, pipe_axis)
+                if piped:
+                    if data_axis:
+                        g = lax.pmean(g, data_axis)
+                    return g / S
+                return lax.pmean(g, tuple(a for a in (data_axis, pipe_axis)
+                                          if a))
 
-        grads = jax.tree_util.tree_map(reduce_grad, grads, pspecs)
-        if data_axis:
-            loss = lax.pmean(loss, data_axis)
-        new_p, new_slots = optim.step(grads, packed, slots, lr)
-        return loss, new_p, new_slots
+            grads = jax.tree_util.tree_map(reduce_grad, grads, pspecs)
+            if data_axis:
+                loss = (lax.psum(loss, data_axis) if masked
+                        else lax.pmean(loss, data_axis))
+            new_p, new_slots = optim.step(grads, packed, slots, lr)
+            return loss, new_p, new_slots
+
+        return local_step
 
     in_batch = P(data_axis) if data_axis else P()
-    sharded = shard_map(
-        local_step, mesh=mesh,
-        in_specs=(pspecs, sslots, P(), P(), in_batch, in_batch),
-        out_specs=(P(), pspecs, sslots), check_vma=False)
-    jitted = jax.jit(sharded, donate_argnums=(0, 1) if donate else ())
+    _jitted = {}
 
-    def step(packed, slots, lr, x, y, rng=None):
-        return jitted(packed, slots, jnp.float32(lr),
-                      rng if rng is not None else jax.random.PRNGKey(0),
-                      jnp.asarray(x), jnp.asarray(y))
+    def _jitted_for(masked):
+        if masked not in _jitted:
+            in_specs = (pspecs, sslots, P(), P(), in_batch, in_batch)
+            if masked:
+                # weight vector shards over data only (pad rows are
+                # whole records); the real count replicates
+                in_specs = in_specs + (P(data_axis) if data_axis else P(),
+                                       P())
+            sharded = shard_map(
+                _make_local_step(masked), mesh=mesh, in_specs=in_specs,
+                out_specs=(P(), pspecs, sslots), check_vma=False)
+            _jitted[masked] = jax.jit(
+                sharded, donate_argnums=(0, 1) if donate else ())
+        return _jitted[masked]
+
+    def step(packed, slots, lr, x, y, rng=None, w=None, total_w=None):
+        args = (packed, slots, jnp.float32(lr),
+                rng if rng is not None else jax.random.PRNGKey(0),
+                jnp.asarray(x), jnp.asarray(y))
+        if w is not None:
+            args = args + (jnp.asarray(w, jnp.float32),
+                           jnp.float32(total_w))
+        return _jitted_for(w is not None)(*args)
 
     step.param_specs = pspecs
     step.slot_specs = sslots
