@@ -129,13 +129,19 @@ def build(model_name: str, args):
         # logits output: the fused CrossEntropyCriterion computes its own
         # log-sum-exp, so a log_softmax head would be pure wasted [B,T,V]
         # bandwidth at the hottest layer (models/transformer.py docstring)
+        moe = getattr(args, "moe_experts", 0)
         lm = TransformerLM(
             V, embed_dim=64, num_heads=4, num_layers=2, max_len=T,
             seq_strategy="ring" if sp else "dense",
             seq_axis="seq" if sp else None,
             model_axis="model" if tp else None,
             remat=getattr(args, "remat", False),
-            output="logits")
+            output="logits",
+            moe_experts=moe,
+            # expert parallelism rides the data axis; local training
+            # keeps the dense dispatch (same function, one shard)
+            moe_axis="data" if (moe and getattr(args, "distributed",
+                                                False)) else None)
         crit = nn.TimeDistributedCriterion(nn.CrossEntropyCriterion(), True)
         # synthetic char-LM with learnable structure: next token is a
         # fixed permutation of the current one, plus noise tokens
@@ -202,6 +208,20 @@ def main(argv=None):
                         help="GPipe microbatches per step (default: the "
                              "pipe-axis size); batch size must be "
                              "divisible by data-shards x M")
+    def nonneg_int(v):
+        v = int(v)
+        if v < 0:
+            raise argparse.ArgumentTypeError(f"must be >= 0, got {v}")
+        return v
+
+    parser.add_argument("--moe-experts", type=nonneg_int, default=0,
+                        metavar="E",
+                        help="swap the transformer MLP for a Switch-style "
+                             "mixture of E experts (transformer only); "
+                             "with --distributed the experts shard over "
+                             "the data axis (expert parallelism, "
+                             "all_to_all dispatch) and E must be "
+                             "divisible by the data-shard count")
     parser.add_argument("--remat", action="store_true",
                         help="rematerialize transformer-block activations "
                              "in the backward pass (jax.checkpoint): HBM "
@@ -231,6 +251,13 @@ def main(argv=None):
     if args.pipeline_microbatch and args.pipeline_parallel < 2:
         parser.error("--pipeline-microbatch needs --pipeline-parallel >= 2 "
                      "(it configures the GPipe schedule)")
+    if args.moe_experts and args.model != "transformer":
+        parser.error("--moe-experts supports --model transformer")
+    if args.moe_experts and (args.tensor_parallel > 1
+                             or args.seq_parallel > 1
+                             or args.pipeline_parallel > 1):
+        parser.error("--moe-experts composes with data parallelism only "
+                     "(expert parallelism rides the data axis)")
 
     from ..utils.engine import Engine as _Engine
 

@@ -27,20 +27,43 @@ from ..utils.rng import next_jax_key
 
 
 class TransformerBlock(Container):
-    """Pre-norm residual block: x + MHA(LN(x)); x + MLP(LN(x))."""
+    """Pre-norm residual block: x + MHA(LN(x)); x + MLP(LN(x)).
+
+    ``moe_experts > 0`` swaps the dense MLP for a Switch-style
+    mixture-of-experts FFN (parallel/moe.py) — expert-parallel over
+    ``moe_axis`` when set (the token-sharding mesh axis), dense
+    otherwise.  Dropped-over-capacity tokens ride the residual."""
 
     def __init__(self, embed_dim: int, num_heads: int, mlp_dim: int,
                  causal: bool = True, seq_strategy: str = "dense",
-                 seq_axis: str = "seq", model_axis: Optional[str] = None):
-        super().__init__(
+                 seq_axis: str = "seq", model_axis: Optional[str] = None,
+                 moe_experts: int = 0, moe_axis: Optional[str] = None,
+                 moe_capacity_factor: float = 1.25):
+        mods = [
             nn.LayerNorm(embed_dim),
             nn.MultiHeadAttention(embed_dim, num_heads, causal=causal,
                                   seq_strategy=seq_strategy,
                                   seq_axis=seq_axis),
             nn.LayerNorm(embed_dim),
-            ColumnParallelLinear(embed_dim, mlp_dim, axis_name=model_axis),
-            RowParallelLinear(mlp_dim, embed_dim, axis_name=model_axis),
-        )
+        ]
+        if moe_experts:
+            if model_axis is not None:
+                raise ValueError(
+                    "moe_experts replaces the Column/RowParallel MLP — "
+                    "tensor parallelism of the FFN would be silently "
+                    "dropped; pass model_axis=None with MoE")
+            from ..parallel.moe import MoEFFN
+
+            mods.append(MoEFFN(embed_dim, mlp_dim, moe_experts,
+                               capacity_factor=moe_capacity_factor,
+                               axis_name=moe_axis))
+        else:
+            mods += [ColumnParallelLinear(embed_dim, mlp_dim,
+                                          axis_name=model_axis),
+                     RowParallelLinear(mlp_dim, embed_dim,
+                                       axis_name=model_axis)]
+        super().__init__(*mods)
+        self.is_moe = bool(moe_experts)
 
     def apply_fn(self, params, buffers, x, training, rng):
         def sub(i):
@@ -56,9 +79,12 @@ class TransformerBlock(Container):
             params["2"], buffers["2"], x, training, sub(2))
         h, nb["3"] = self.modules[3].apply_fn(
             params["3"], buffers["3"], h, training, sub(3))
-        h = jax.nn.gelu(h)
-        h, nb["4"] = self.modules[4].apply_fn(
-            params["4"], buffers["4"], h, training, sub(4))
+        if not self.is_moe:
+            # dense MLP: gelu between the column/row pair; the MoE FFN
+            # applies its own gelu between the expert matmuls
+            h = jax.nn.gelu(h)
+            h, nb["4"] = self.modules[4].apply_fn(
+                params["4"], buffers["4"], h, training, sub(4))
         return x + h, nb
 
 
@@ -76,7 +102,9 @@ class TransformerLM(Container):
                  num_layers: int = 4, max_len: int = 2048,
                  causal: bool = True, seq_strategy: str = "dense",
                  seq_axis: str = "seq", model_axis: Optional[str] = None,
-                 remat: bool = False, output: str = "log_probs"):
+                 remat: bool = False, output: str = "log_probs",
+                 moe_experts: int = 0, moe_axis: Optional[str] = None,
+                 moe_capacity_factor: float = 1.25):
         if output not in ("log_probs", "logits"):
             raise ValueError(f"output {output!r} not in (log_probs, logits)")
         mlp_dim = mlp_dim or 4 * embed_dim
@@ -93,7 +121,10 @@ class TransformerLM(Container):
         self.seq_strategy = seq_strategy
         self.remat = remat
         blocks = [TransformerBlock(embed_dim, num_heads, mlp_dim, causal,
-                                   seq_strategy, seq_axis, model_axis)
+                                   seq_strategy, seq_axis, model_axis,
+                                   moe_experts=moe_experts,
+                                   moe_axis=moe_axis,
+                                   moe_capacity_factor=moe_capacity_factor)
                   for _ in range(num_layers)]
         super().__init__(
             nn.LookupTable(vocab_size, embed_dim),

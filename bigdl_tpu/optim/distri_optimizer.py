@@ -221,7 +221,14 @@ class DistriOptimizer(Optimizer):
             return self._optimize_pipeline(mesh)
         extra_axes = [a for a in ("model", "seq")
                       if a in mesh.axis_names and mesh.shape[a] > 1]
-        if extra_axes:
+        # an expert-parallel model (bound MoEFFN) needs the SPMD path
+        # even on a pure-data mesh: its expert stacks are sharded, which
+        # the replicated AllReduceParameter plane cannot express
+        from ..parallel.moe import MoEFFN
+
+        has_ep = any(isinstance(m, MoEFFN) and m.axis_name
+                     for m in self.model.modules_iter())
+        if extra_axes or has_ep:
             return self._optimize_multi_axis(mesh)
         # collapse to a pure-data mesh if caller handed the 4-axis default
         mesh = data_mesh(mesh)

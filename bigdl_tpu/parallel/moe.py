@@ -1,0 +1,165 @@
+"""Mixture-of-Experts FFN with expert parallelism — the ``ep`` axis.
+
+No reference counterpart (SURVEY §2.2: the reference's only axis is
+data parallelism); this is the TPU rebuild's expert-parallel extension,
+built the way the hardware wants it (GShard/Switch): top-1 routing
+with a STATIC per-expert capacity (XLA needs static shapes — dropped
+tokens pass through on the residual), dispatch/combine as one-hot
+einsums that lower to MXU matmuls, and — under ``shard_map`` — one
+``all_to_all`` each way over the axis that shards the tokens, so each
+device keeps ``n_experts / n_shards`` experts' weights AND their
+optimizer state.
+
+Like the tensor-parallel layers, the module stores FULL ``[E, ...]``
+expert weights on the host; sharding happens at trace time via param
+specs (``parallel.spmd.param_specs`` shards the leading expert dim over
+``axis_name``, router weights stay replicated).  ``axis_name=None`` (or
+an unbound axis — eager use) runs the dense dispatch.
+
+Capacity semantics differ between the two paths when capacity binds:
+the dense path budgets ``C = ceil(f·N/E)`` slots per expert globally,
+while the parallel path budgets ``C_local = ceil(f·N_local/E)`` per
+(source shard, expert) pair — GShard's convention; a shard that routes
+an unusually large fraction of ITS tokens to one expert drops some the
+dense path would have kept.  With capacity loose enough that nothing
+drops, the two paths compute exactly the same function (pinned in
+tests/test_moe.py).
+
+Not yet included: an auxiliary load-balance loss (the activation-
+dependent penalty does not fit the param-regularizer seam); balance in
+v1 comes from capacity drops + optional router jitter.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from ..nn.initialization import IN_OUT, ONE_D, Xavier, Zeros
+from ..nn.module import TensorModule
+
+
+class MoEFFN(TensorModule):
+    """Switch-style top-1 MoE feed-forward over [batch, seq, embed].
+
+    ``n_experts`` expert MLPs (``embed -> hidden -> embed``, gelu); a
+    linear router picks one expert per token, scaled by its softmax
+    gate.  ``capacity_factor`` sizes the static per-expert buffer:
+    ``C = ceil(capacity_factor * n_tokens / n_experts)`` — tokens over
+    capacity are dropped (contribute zero; the transformer block's
+    residual carries them through).  ``jitter`` multiplies router
+    logits by uniform noise in [1-jitter, 1+jitter] during training
+    (Switch Transformer's load-balance nudge).
+
+    ``axis_name`` names the mesh axis that shards BOTH the tokens and
+    the experts (expert parallelism rides the data axis); inside
+    ``shard_map`` the dispatch becomes an ``all_to_all`` to the expert
+    owners and back.  Unbound/None degrades to the dense dispatch —
+    the same function, computed locally.
+    """
+
+    def __init__(self, embed_dim: int, hidden_dim: int, n_experts: int,
+                 capacity_factor: float = 1.25, jitter: float = 0.0,
+                 axis_name: Optional[str] = None):
+        super().__init__()
+        if n_experts < 1:
+            raise ValueError(f"n_experts must be >= 1, got {n_experts}")
+        self.embed_dim = embed_dim
+        self.hidden_dim = hidden_dim
+        self.n_experts = n_experts
+        self.capacity_factor = float(capacity_factor)
+        self.jitter = float(jitter)
+        self.axis_name = axis_name
+        self.reset()
+
+    def reset(self):
+        w_init = self._init_methods.get("weight", (Xavier(), None))[0]
+        b_init = self._init_methods.get("bias", (Zeros(), None))[0]
+        E, D, H = self.n_experts, self.embed_dim, self.hidden_dim
+        self._register_param("router_w", w_init.init((E, D), IN_OUT))
+        self._register_param("router_b", b_init.init((E,), ONE_D))
+        wi = np.stack([np.asarray(w_init.init((H, D), IN_OUT)).T
+                       for _ in range(E)])
+        wo = np.stack([np.asarray(w_init.init((D, H), IN_OUT)).T
+                       for _ in range(E)])
+        self._register_param("wi", jnp.asarray(wi))       # [E, D, H]
+        self._register_param("bi", jnp.zeros((E, self.hidden_dim)))
+        self._register_param("wo", jnp.asarray(wo))       # [E, H, D]
+        self._register_param("bo", jnp.zeros((E, self.embed_dim)))
+        return self
+
+    # -- helpers -------------------------------------------------------
+    def _n_shards(self):
+        """Bound-axis size, or 1 when eager/unbound (RowParallelLinear's
+        detection pattern)."""
+        if self.axis_name is None:
+            return 1
+        try:
+            return lax.psum(1, self.axis_name)
+        except NameError:
+            return 1
+
+    def _route(self, x2d, params, training, rng):
+        """Top-1 routing: gates [N], expert one-hot [N, E], position-in-
+        expert one-hot [N, E, C] (capacity-masked)."""
+        logits = jnp.dot(x2d, params["router_w"].T) + params["router_b"]
+        if training and self.jitter > 0.0 and rng is not None:
+            noise = jax.random.uniform(
+                rng, logits.shape, logits.dtype,
+                1.0 - self.jitter, 1.0 + self.jitter)
+            logits = logits * noise
+        probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+        idx = jnp.argmax(probs, axis=-1)                      # [N]
+        gate = jnp.max(probs, axis=-1)                        # [N]
+        onehot = jax.nn.one_hot(idx, self.n_experts,
+                                dtype=jnp.float32)            # [N, E]
+        pos = jnp.cumsum(onehot, axis=0) * onehot             # 1-based
+        C = self._capacity(x2d.shape[0])
+        keep = (pos <= C) & (onehot > 0)                      # [N, E]
+        gate = gate * jnp.sum(keep, axis=-1)                  # 0 if dropped
+        # [N, E, C]: token n occupies slot pos-1 of its expert
+        disp = (jax.nn.one_hot((pos - 1).astype(jnp.int32), C,
+                               dtype=jnp.float32)
+                * keep[..., None])
+        return gate.astype(x2d.dtype), disp.astype(x2d.dtype)
+
+    def _capacity(self, n_tokens: int) -> int:
+        return max(1, int(np.ceil(self.capacity_factor * n_tokens
+                                  / self.n_experts)))
+
+    def _expert_mlp(self, inp, params):
+        """inp [e, c, D] through the (possibly expert-sharded) stacked
+        weights — the leading dims of ``inp`` and ``params['wi']``
+        always agree (full E dense, E/n under shard_map)."""
+        wi, bi = params["wi"], params["bi"]
+        wo, bo = params["wo"], params["bo"]
+        h = jnp.einsum("ecd,edh->ech", inp, wi.astype(inp.dtype))
+        h = jax.nn.gelu(h + bi[:, None].astype(inp.dtype))
+        out = jnp.einsum("ech,ehd->ecd", h, wo.astype(inp.dtype))
+        return out + bo[:, None].astype(inp.dtype)
+
+    # -- forward -------------------------------------------------------
+    def _apply(self, params, buffers, x, training, rng):
+        B, T, D = x.shape
+        x2d = x.reshape(B * T, D)
+        gate, disp = self._route(x2d, params, training, rng)
+        n = self._n_shards()
+        # expert_in[e, c] = the token dispatched to expert e slot c
+        expert_in = jnp.einsum("nec,nd->ecd", disp, x2d)
+        if n == 1:
+            out_e = self._expert_mlp(expert_in, params)
+        else:
+            # to the expert owners: split the expert dim over the axis,
+            # concat the shards' buffers along capacity -> each owner
+            # sees [E/n, n*C, D]
+            recv = lax.all_to_all(expert_in, self.axis_name,
+                                  split_axis=0, concat_axis=1, tiled=True)
+            out = self._expert_mlp(recv, params)
+            # and back: split capacity, concat experts -> [E, C, D]
+            out_e = lax.all_to_all(out, self.axis_name,
+                                   split_axis=1, concat_axis=0, tiled=True)
+        y = jnp.einsum("nec,ecd->nd", disp, out_e) * gate[:, None]
+        return y.reshape(B, T, D), buffers

@@ -92,6 +92,8 @@ def _check_model(model, n_pipe):
             "pipeline parallelism composes with data parallelism only; "
             f"seq_strategy {model.seq_strategy!r} needs a bound seq axis "
             "— use parallel.spmd.make_train_step for seq/model meshes")
+    from .moe import MoEFFN
+
     for m in model.modules_iter():
         if (isinstance(m, (ColumnParallelLinear, RowParallelLinear))
                 and m.axis_name):
@@ -100,6 +102,12 @@ def _check_model(model, n_pipe):
                 f"parallelism yet: {type(m).__name__} is bound to mesh "
                 f"axis {m.axis_name!r} (build the TransformerLM with "
                 "model_axis=None for the pipeline path)")
+        if isinstance(m, MoEFFN) and m.axis_name:
+            raise ValueError(
+                "pipeline parallelism does not compose with expert "
+                "parallelism yet: MoEFFN is bound to mesh axis "
+                f"{m.axis_name!r} (build with moe_axis=None for dense "
+                "MoE inside the pipeline)")
     if count % n_pipe != 0:
         raise ValueError(
             f"num_layers {count} not divisible by pipe-axis size {n_pipe}")

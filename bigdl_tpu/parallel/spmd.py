@@ -33,10 +33,14 @@ from jax.sharding import PartitionSpec as P
 def param_specs(module, model_axis: str = "model"):
     """PartitionSpec pytree matching ``module.param_tree()``.
 
-    Column/RowParallelLinear weights shard over ``model_axis``; every
-    other parameter is replicated.
+    Column/RowParallelLinear weights shard over ``model_axis``;
+    ``MoEFFN`` expert stacks shard their leading expert dim over the
+    layer's own ``axis_name`` (expert parallelism rides the token-
+    sharding axis, router weights replicated); every other parameter is
+    replicated.
     """
     from ..nn.module import Container
+    from .moe import MoEFFN
     from .tensor_parallel import ColumnParallelLinear, RowParallelLinear
 
     tree = module.param_tree()
@@ -50,6 +54,10 @@ def param_specs(module, model_axis: str = "model"):
         if "bias" in tree:
             specs["bias"] = P()
         return specs
+    if isinstance(module, MoEFFN) and module.axis_name:
+        ax = module.axis_name
+        return {"router_w": P(), "router_b": P(),
+                "wi": P(ax), "bi": P(ax), "wo": P(ax), "bo": P(ax)}
     if isinstance(module, Container):
         specs = {str(i): param_specs(m, model_axis)
                  for i, m in enumerate(module.modules)}
@@ -66,6 +74,39 @@ def _resolve_axes(mesh, data_axis, seq_axis, model_axis):
     return (data_axis if data_axis in axes else None,
             seq_axis if seq_axis in axes else None,
             model_axis if model_axis in axes else None)
+
+
+def _check_moe(model, mesh, data_axis, seq_axis):
+    """Expert-parallel constraints, validated loudly at build time:
+    every bound ``MoEFFN`` must ride the mesh's token-sharding (data)
+    axis, and MoE does not compose with sequence parallelism yet."""
+    from .moe import MoEFFN
+
+    moe = [m for m in model.modules_iter()
+           if isinstance(m, MoEFFN) and m.axis_name]
+    if not moe:
+        return
+    for m in moe:
+        if m.axis_name not in mesh.axis_names:
+            raise ValueError(
+                f"MoEFFN is bound to mesh axis {m.axis_name!r} which the "
+                f"mesh {mesh.axis_names} does not have; build with "
+                "axis_name=None for dense (single-shard) MoE")
+        if m.axis_name != data_axis:
+            raise ValueError(
+                f"expert parallelism rides the token-sharding axis: "
+                f"MoEFFN.axis_name {m.axis_name!r} must equal the data "
+                f"axis {data_axis!r}")
+        if mesh.shape[m.axis_name] > 1 and m.n_experts % mesh.shape[
+                m.axis_name] != 0:
+            raise ValueError(
+                f"n_experts {m.n_experts} not divisible by the "
+                f"{m.axis_name!r} axis size {mesh.shape[m.axis_name]}")
+    if seq_axis is not None and mesh.shape[seq_axis] > 1:
+        raise ValueError(
+            "MoE + sequence parallelism is not supported yet: expert "
+            "dispatch would only mix tokens within one seq shard; use a "
+            "mesh without a >1 seq axis")
 
 
 def _in_spec_fn(data_axis, seq_axis, input_seq_dim):
@@ -143,6 +184,7 @@ def make_train_step(model, criterion, optim, mesh,
     data_axis, seq_axis, model_axis = _resolve_axes(
         mesh, data_axis, seq_axis, model_axis)
     batch_axes = tuple(a for a in (data_axis, seq_axis) if a)
+    _check_moe(model, mesh, data_axis, seq_axis)
 
     pspecs = param_specs(model, model_axis or "model")
     buffers = model.buffer_tree()
@@ -156,10 +198,13 @@ def make_train_step(model, criterion, optim, mesh,
     all_axes = tuple(a for a in (data_axis, seq_axis, model_axis) if a)
     n_model = mesh.shape[model_axis] if model_axis else 1
 
-    def _spec_sharded(spec):
-        return model_axis is not None and any(
-            model_axis == ax or (isinstance(ax, tuple) and model_axis in ax)
+    def _spec_has(spec, axis):
+        return axis is not None and any(
+            axis == ax or (isinstance(ax, tuple) and axis in ax)
             for ax in spec if ax is not None)
+
+    def _spec_sharded(spec):
+        return _spec_has(spec, model_axis)
 
     def _make_reduce_grad(masked):
         """Tied-parameter chain rule over the mesh.
@@ -177,6 +222,15 @@ def make_train_step(model, criterion, optim, mesh,
         contributes a SUM, not a mean; seq/model stay means.
         """
         def _reduce_grad(g, spec):
+            if _spec_has(spec, data_axis):
+                # expert-parallel params (MoE stacks ride the data
+                # axis): the all_to_all transpose already accumulated
+                # every shard's token contributions — the grad of the
+                # SUM of local losses.  No pmean over data (each shard
+                # holds different experts); mean-convention divide only.
+                if not masked:
+                    g = g / n_data
+                return lax.pmean(g, model_axis) if model_axis else g
             sharded = _spec_sharded(spec)
             if masked:
                 if seq_axis:
@@ -360,6 +414,7 @@ def make_eval_forward(model, mesh, data_axis: Optional[str] = "data",
     full array)."""
     data_axis, seq_axis, model_axis = _resolve_axes(
         mesh, data_axis, seq_axis, model_axis)
+    _check_moe(model, mesh, data_axis, seq_axis)
 
     pspecs = param_specs(model, model_axis or "model")
     buffers = model.buffer_tree()

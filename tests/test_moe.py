@@ -1,0 +1,262 @@
+"""MoEFFN (parallel/moe.py): Switch-style top-1 routing with static
+capacity, dense dispatch vs a per-token oracle, expert-parallel
+all_to_all path pinned by exact equivalence with the dense path, and
+the spmd train step's expert gradient-reduction rule pinned against a
+single-device twin.  Beyond reference parity (SURVEY §2.2)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh
+from jax.sharding import PartitionSpec as P
+
+from bigdl_tpu import nn
+from bigdl_tpu.models.transformer import TransformerLM
+from bigdl_tpu.optim import SGD
+from bigdl_tpu.parallel.moe import MoEFFN
+from bigdl_tpu.utils.rng import RNG
+
+D, H, E = 8, 16, 4
+
+
+def _moe(axis_name=None, capacity_factor=8.0, n_experts=E):
+    RNG().set_seed(3)
+    return MoEFFN(D, H, n_experts, capacity_factor=capacity_factor,
+                  axis_name=axis_name)
+
+
+def _tokens(b, t, seed=0):
+    return np.random.RandomState(seed).randn(b, t, D).astype(np.float32)
+
+
+def test_dense_matches_per_token_oracle():
+    """Generous capacity: every token goes through exactly its argmax
+    expert scaled by the softmax gate."""
+    moe = _moe()
+    p = moe.param_tree()
+    x = _tokens(2, 6)
+    out, _ = moe.apply_fn(p, moe.buffer_tree(), jnp.asarray(x), False,
+                          None)
+    x2d = x.reshape(-1, D)
+    logits = x2d @ np.asarray(p["router_w"]).T + np.asarray(p["router_b"])
+    probs = np.exp(logits - logits.max(-1, keepdims=True))
+    probs /= probs.sum(-1, keepdims=True)
+    want = np.empty_like(x2d)
+    for n in range(x2d.shape[0]):
+        e = int(np.argmax(probs[n]))
+        h = x2d[n] @ np.asarray(p["wi"])[e] + np.asarray(p["bi"])[e]
+        h = np.asarray(jax.nn.gelu(jnp.asarray(h)))
+        y = h @ np.asarray(p["wo"])[e] + np.asarray(p["bo"])[e]
+        want[n] = probs[n, e] * y
+    np.testing.assert_allclose(np.asarray(out).reshape(-1, D), want,
+                               atol=2e-5)
+
+
+def test_capacity_drops_pass_through_as_zero():
+    """capacity_factor small enough that only the first token per expert
+    fits: later same-expert tokens contribute exactly zero (the block's
+    residual carries them)."""
+    moe = _moe(capacity_factor=1e-6, n_experts=2)  # C = 1
+    p = moe.param_tree()
+    x = np.tile(_tokens(1, 1, seed=4), (1, 5, 1))  # 5 identical tokens
+    out, _ = moe.apply_fn(p, moe.buffer_tree(), jnp.asarray(x), False,
+                          None)
+    out = np.asarray(out)[0]
+    assert np.abs(out[0]).max() > 1e-4          # first token served
+    np.testing.assert_allclose(out[1:], 0.0, atol=1e-7)  # rest dropped
+
+
+def test_expert_parallel_matches_dense():
+    """The all_to_all dispatch over 4 shards computes the same function
+    as the dense path (capacity generous on both sides)."""
+    mesh = Mesh(np.array(jax.devices()[:4]), ("data",))
+    moe = _moe(axis_name="data", capacity_factor=4.0)
+    dense = _moe(axis_name=None, capacity_factor=4.0)
+    p = moe.param_tree()
+    for a, b in zip(jax.tree_util.tree_leaves(p),
+                    jax.tree_util.tree_leaves(dense.param_tree())):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    x = _tokens(8, 4, seed=1)
+    want, _ = dense.apply_fn(p, dense.buffer_tree(), jnp.asarray(x),
+                             False, None)
+
+    from bigdl_tpu.parallel.spmd import param_specs
+
+    pspecs = param_specs(moe, "model")
+    from jax import shard_map
+
+    def local(pp, xx):
+        out, _ = moe.apply_fn(pp, moe.buffer_tree(), xx, False, None)
+        return out
+
+    fwd = jax.jit(shard_map(local, mesh=mesh,
+                            in_specs=(pspecs, P("data")),
+                            out_specs=P("data"), check_vma=False))
+    got = fwd(p, jnp.asarray(x))
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=2e-5)
+
+
+def _lm(moe_axis, seed=11):
+    RNG().set_seed(seed)
+    return TransformerLM(17, embed_dim=D, num_heads=2, mlp_dim=H,
+                         num_layers=2, max_len=6, moe_experts=E,
+                         moe_axis=moe_axis, moe_capacity_factor=4.0)
+
+
+def _lm_batch(n, seed=0):
+    r = np.random.RandomState(seed)
+    return (r.randint(1, 18, (n, 6)).astype(np.int32),
+            r.randint(1, 18, (n, 6)).astype(np.float32))
+
+
+def test_spmd_train_step_expert_grads_match_dense_twin():
+    """spmd.make_train_step over a data mesh with expert-sharded MoE
+    stacks: loss and updated params (router AND expert weights) must
+    match a single-device dense twin — pins the expert grad-reduction
+    rule (all_to_all transpose sum, /n_data, no pmean)."""
+    from bigdl_tpu.parallel.spmd import make_train_step
+
+    mesh = Mesh(np.array(jax.devices()[:4]), ("data",))
+    crit = nn.TimeDistributedCriterion(nn.ClassNLLCriterion())
+    lr = 0.2
+
+    dense = _lm(None)
+    ep = _lm("data")
+    params0 = dense.param_tree()
+    for a, b in zip(jax.tree_util.tree_leaves(params0),
+                    jax.tree_util.tree_leaves(ep.param_tree())):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    x, y = _lm_batch(8, seed=2)
+
+    def dense_step(model):
+        p = model.param_tree()
+        sgd = SGD(learning_rate=lr)
+        slots = sgd.init_state(p)
+
+        def loss_fn(pp):
+            out, _ = model.apply_fn(pp, model.buffer_tree(),
+                                    jnp.asarray(x), True, None)
+            return crit._loss(out, jnp.asarray(y))
+
+        loss, grads = jax.value_and_grad(loss_fn)(p)
+        p, _ = sgd.step(grads, p, slots, lr)
+        return float(loss), p
+
+    loss_ref, params_ref = dense_step(dense)
+
+    sgd = SGD(learning_rate=lr)
+    step = make_train_step(ep, crit, sgd, mesh)
+    params = ep.param_tree()
+    slots = sgd.init_state(params)
+    loss, params, slots, _ = step(params, slots, ep.buffer_tree(), lr,
+                                  x, y)
+    assert abs(float(loss) - loss_ref) < 2e-5
+    flat = dict(jax.tree_util.tree_leaves_with_path(params_ref))
+    for path, leaf in jax.tree_util.tree_leaves_with_path(
+            jax.device_get(params)):
+        np.testing.assert_allclose(np.asarray(leaf),
+                                   np.asarray(flat[path]), atol=2e-5,
+                                   err_msg=jax.tree_util.keystr(path))
+
+
+def test_spmd_masked_expert_step_matches_dense_twin():
+    """Trailing partial batch on the EP mesh: pad-and-mask trains
+    exactly the real records (expert grads take the no-correction
+    masked rule)."""
+    from bigdl_tpu.parallel.spmd import make_train_step
+
+    mesh = Mesh(np.array(jax.devices()[:4]), ("data",))
+    crit = nn.TimeDistributedCriterion(nn.ClassNLLCriterion())
+    lr = 0.2
+    x, y = _lm_batch(5, seed=7)
+
+    dense = _lm(None)
+
+    def loss_fn(pp):
+        out, _ = dense.apply_fn(pp, dense.buffer_tree(), jnp.asarray(x),
+                                True, None)
+        return crit._loss(out, jnp.asarray(y))
+
+    p0 = dense.param_tree()
+    loss_ref, grads_ref = jax.value_and_grad(loss_fn)(p0)
+    sgd = SGD(learning_rate=lr)
+    params_ref, _ = sgd.step(grads_ref, p0, sgd.init_state(p0), lr)
+
+    ep = _lm("data")
+    sgd2 = SGD(learning_rate=lr)
+    step = make_train_step(ep, crit, sgd2, mesh)
+    pad = 8 - 5
+    xp = np.concatenate([x, np.ones((pad, 6), x.dtype)])
+    yp = np.concatenate([y, np.ones((pad, 6), y.dtype)])
+    w = np.array([1.0] * 5 + [0.0] * pad, np.float32)
+    params = ep.param_tree()
+    slots = sgd2.init_state(params)
+    loss, params, slots, _ = step(params, slots, ep.buffer_tree(), lr,
+                                  xp, yp, w=w, total_w=5.0)
+    assert abs(float(loss) - float(loss_ref)) < 2e-5
+    flat = dict(jax.tree_util.tree_leaves_with_path(params_ref))
+    for path, leaf in jax.tree_util.tree_leaves_with_path(
+            jax.device_get(params)):
+        np.testing.assert_allclose(np.asarray(leaf),
+                                   np.asarray(flat[path]), atol=2e-5,
+                                   err_msg=jax.tree_util.keystr(path))
+
+
+def test_distri_optimizer_routes_ep_model():
+    """The product driver sends a bound-MoE model through the SPMD path
+    even on a pure-data mesh (the AllReduceParameter plane cannot hold
+    sharded expert stacks)."""
+    from bigdl_tpu.dataset.dataset import array
+    from bigdl_tpu.dataset.sample import MiniBatch
+    from bigdl_tpu.optim import max_iteration
+    from bigdl_tpu.optim.distri_optimizer import DistriOptimizer
+
+    mesh = Mesh(np.array(jax.devices()[:4]), ("data",))
+    lm = _lm("data")
+    crit = nn.TimeDistributedCriterion(nn.ClassNLLCriterion())
+    batches = [MiniBatch(*_lm_batch(8, seed=s)) for s in (0, 1)]
+    opt = DistriOptimizer(lm, array(batches), crit, mesh=mesh)
+    opt.set_optim_method(SGD(learning_rate=0.1))
+    opt.set_end_when(max_iteration(2))
+    opt.optimize()
+    assert np.isfinite(opt.optim_method.state["loss"])
+
+
+def test_block_rejects_moe_plus_model_axis():
+    with pytest.raises(ValueError, match="model_axis=None"):
+        TransformerLM(17, embed_dim=D, num_heads=2, mlp_dim=H,
+                      num_layers=2, max_len=6, moe_experts=4,
+                      model_axis="model")
+
+
+def test_moe_guards():
+    from bigdl_tpu.parallel.spmd import make_train_step
+
+    crit = nn.TimeDistributedCriterion(nn.ClassNLLCriterion())
+    # bound axis missing from the mesh
+    mesh1 = Mesh(np.array(jax.devices()[:4]), ("data",))
+    with pytest.raises(ValueError, match="does not have"):
+        make_train_step(_lm("expert"), crit, SGD(), mesh1)
+    # MoE + seq parallelism rejected
+    mesh2 = Mesh(np.array(jax.devices()[:4]).reshape(2, 2),
+                 ("data", "seq"))
+    with pytest.raises(ValueError, match="sequence parallelism"):
+        make_train_step(_lm("data"), crit, SGD(), mesh2)
+    # experts must divide the axis
+    mesh3 = Mesh(np.array(jax.devices()[:8]), ("data",))
+    RNG().set_seed(1)
+    lm3 = TransformerLM(17, embed_dim=D, num_heads=2, mlp_dim=H,
+                        num_layers=2, max_len=6, moe_experts=6,
+                        moe_axis="data")
+    with pytest.raises(ValueError, match="not divisible"):
+        make_train_step(lm3, crit, SGD(), mesh3)
+    # pipeline + bound MoE rejected
+    from bigdl_tpu.parallel.pipeline import make_pipeline_train_step
+
+    mesh4 = Mesh(np.array(jax.devices()[:4]).reshape(2, 2),
+                 ("data", "pipe"))
+    with pytest.raises(ValueError, match="expert"):
+        make_pipeline_train_step(_lm("data"), crit, SGD(), mesh4,
+                                 n_microbatch=2)
