@@ -141,7 +141,8 @@ def build(model_name: str, args):
             # expert parallelism rides the data axis; local training
             # keeps the dense dispatch (same function, one shard)
             moe_axis="data" if (moe and getattr(args, "distributed",
-                                                False)) else None)
+                                                False)) else None,
+            moe_aux_coef=getattr(args, "moe_aux_coef", 0.0))
         crit = nn.TimeDistributedCriterion(nn.CrossEntropyCriterion(), True)
         # synthetic char-LM with learnable structure: next token is a
         # fixed permutation of the current one, plus noise tokens
@@ -222,6 +223,11 @@ def main(argv=None):
                              "the data axis (expert parallelism, "
                              "all_to_all dispatch) and E must be "
                              "divisible by the data-shard count")
+    parser.add_argument("--moe-aux-coef", type=float, default=0.0,
+                        metavar="C",
+                        help="Switch load-balance auxiliary loss "
+                             "coefficient (0 disables; 0.01 is the "
+                             "Switch Transformer default)")
     parser.add_argument("--remat", action="store_true",
                         help="rematerialize transformer-block activations "
                              "in the backward pass (jax.checkpoint): HBM "

@@ -38,7 +38,8 @@ class TransformerBlock(Container):
                  causal: bool = True, seq_strategy: str = "dense",
                  seq_axis: str = "seq", model_axis: Optional[str] = None,
                  moe_experts: int = 0, moe_axis: Optional[str] = None,
-                 moe_capacity_factor: float = 1.25):
+                 moe_capacity_factor: float = 1.25,
+                 moe_aux_coef: float = 0.0):
         mods = [
             nn.LayerNorm(embed_dim),
             nn.MultiHeadAttention(embed_dim, num_heads, causal=causal,
@@ -56,7 +57,8 @@ class TransformerBlock(Container):
 
             mods.append(MoEFFN(embed_dim, mlp_dim, moe_experts,
                                capacity_factor=moe_capacity_factor,
-                               axis_name=moe_axis))
+                               axis_name=moe_axis,
+                               aux_loss_coef=moe_aux_coef))
         else:
             mods += [ColumnParallelLinear(embed_dim, mlp_dim,
                                           axis_name=model_axis),
@@ -104,7 +106,8 @@ class TransformerLM(Container):
                  seq_axis: str = "seq", model_axis: Optional[str] = None,
                  remat: bool = False, output: str = "log_probs",
                  moe_experts: int = 0, moe_axis: Optional[str] = None,
-                 moe_capacity_factor: float = 1.25):
+                 moe_capacity_factor: float = 1.25,
+                 moe_aux_coef: float = 0.0):
         if output not in ("log_probs", "logits"):
             raise ValueError(f"output {output!r} not in (log_probs, logits)")
         mlp_dim = mlp_dim or 4 * embed_dim
@@ -124,7 +127,8 @@ class TransformerLM(Container):
                                    seq_strategy, seq_axis, model_axis,
                                    moe_experts=moe_experts,
                                    moe_axis=moe_axis,
-                                   moe_capacity_factor=moe_capacity_factor)
+                                   moe_capacity_factor=moe_capacity_factor,
+                                   moe_aux_coef=moe_aux_coef)
                   for _ in range(num_layers)]
         super().__init__(
             nn.LookupTable(vocab_size, embed_dim),

@@ -77,7 +77,10 @@ class DistriOptimizer(Optimizer):
         (reference trains every record, DataSet.scala:255-288).
         """
         model, criterion, optim = self.model, self.criterion, self.optim_method
+        from ..parallel.moe import aux_loss_term, collect_aux_paths
+
         reg_paths = list(collect_regularizer_paths(model))
+        aux_paths = list(collect_aux_paths(model))
         scale_tree = model.gradient_scale_tree()
         needs_scale = any(s != 1.0
                           for s in jax.tree_util.tree_leaves(scale_tree))
@@ -118,10 +121,14 @@ class DistriOptimizer(Optimizer):
                     loss = jnp.sum(per * w) / total_w
                     if reg_paths:
                         loss = loss + regularizer_loss(p, reg_paths) / n_dev
+                    if aux_paths:  # MoE balance term, same /n_dev rule
+                        loss = loss + aux_loss_term(nb, aux_paths) / n_dev
                 else:
                     loss = criterion._loss(out, y)
                     if reg_paths:
                         loss = loss + regularizer_loss(p, reg_paths)
+                    if aux_paths:
+                        loss = loss + aux_loss_term(nb, aux_paths)
                 return loss, nb
 
             (loss, new_buffers), grads = jax.value_and_grad(
@@ -169,8 +176,11 @@ class DistriOptimizer(Optimizer):
         to split step time into compute vs gradient-aggregation — fills
         the reference's per-phase Metrics contract with measured numbers
         (Metrics.scala:103-121, DistriOptimizer.scala:146-151)."""
+        from ..parallel.moe import aux_loss_term, collect_aux_paths
+
         model, criterion = self.model, self.criterion
         reg_paths = list(collect_regularizer_paths(model))
+        aux_paths = list(collect_aux_paths(model))
         axis = "data"
 
         def grad_only(params, buffers, rng, x, y):
@@ -181,6 +191,8 @@ class DistriOptimizer(Optimizer):
                 loss = criterion._loss(out, y)
                 if reg_paths:
                     loss = loss + regularizer_loss(p, reg_paths)
+                if aux_paths:  # mirror the real step's backward exactly
+                    loss = loss + aux_loss_term(nb, aux_paths)
                 return loss, nb
 
             (loss, _), grads = jax.value_and_grad(

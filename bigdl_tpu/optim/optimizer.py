@@ -224,7 +224,10 @@ class LocalOptimizer(Optimizer):
     def optimize(self) -> AbstractModule:
         model, criterion, optim = self.model, self.criterion, self.optim_method
         model.training()
+        from ..parallel.moe import aux_loss_term, collect_aux_paths
+
         reg_paths = list(collect_regularizer_paths(model))
+        aux_paths = list(collect_aux_paths(model))
         scale_tree = model.gradient_scale_tree()
         needs_scale = any(s != 1.0
                           for s in jax.tree_util.tree_leaves(scale_tree))
@@ -251,6 +254,8 @@ class LocalOptimizer(Optimizer):
                 loss = criterion._loss(out, y)
                 if reg_paths:  # regularize the f32 master weights
                     loss = loss + regularizer_loss(p, reg_paths)
+                if aux_paths:  # MoE balance term off the buffer thread
+                    loss = loss + aux_loss_term(nb, aux_paths)
                 return loss, nb
             (loss, new_buffers), grads = jax.value_and_grad(
                 loss_fn, has_aux=True)(params)

@@ -25,9 +25,15 @@ dense path would have kept.  With capacity loose enough that nothing
 drops, the two paths compute exactly the same function (pinned in
 tests/test_moe.py).
 
-Not yet included: an auxiliary load-balance loss (the activation-
-dependent penalty does not fit the param-regularizer seam); balance in
-v1 comes from capacity drops + optional router jitter.
+Load balancing: ``aux_loss_coef > 0`` enables the Switch auxiliary
+loss ``E · Σ_e f_e · P_e`` (f_e = fraction of tokens routed to expert
+e pre-capacity, P_e = mean router probability).  The activation-
+dependent term travels on the framework's buffer thread — the layer
+writes it to an ``aux_loss`` buffer, which the train-step builders
+read back INSIDE the differentiated loss function and add to the
+criterion loss, so its gradient falls out of autodiff
+(:func:`collect_aux_paths` / :func:`aux_loss_term`).  Optional router
+``jitter`` adds Switch's multiplicative noise on top.
 """
 from __future__ import annotations
 
@@ -63,7 +69,8 @@ class MoEFFN(TensorModule):
 
     def __init__(self, embed_dim: int, hidden_dim: int, n_experts: int,
                  capacity_factor: float = 1.25, jitter: float = 0.0,
-                 axis_name: Optional[str] = None):
+                 axis_name: Optional[str] = None,
+                 aux_loss_coef: float = 0.0):
         super().__init__()
         if n_experts < 1:
             raise ValueError(f"n_experts must be >= 1, got {n_experts}")
@@ -73,6 +80,7 @@ class MoEFFN(TensorModule):
         self.capacity_factor = float(capacity_factor)
         self.jitter = float(jitter)
         self.axis_name = axis_name
+        self.aux_loss_coef = float(aux_loss_coef)
         self.reset()
 
     def reset(self):
@@ -89,6 +97,10 @@ class MoEFFN(TensorModule):
         self._register_param("bi", jnp.zeros((E, self.hidden_dim)))
         self._register_param("wo", jnp.asarray(wo))       # [E, H, D]
         self._register_param("bo", jnp.zeros((E, self.embed_dim)))
+        if getattr(self, "aux_loss_coef", 0.0) > 0.0:
+            # registered only when enabled so aux-free MoE stays
+            # buffer-free (the pipeline path requires that)
+            self._register_buffer("aux_loss", jnp.zeros((), jnp.float32))
         return self
 
     # -- helpers -------------------------------------------------------
@@ -124,7 +136,11 @@ class MoEFFN(TensorModule):
         disp = (jax.nn.one_hot((pos - 1).astype(jnp.int32), C,
                                dtype=jnp.float32)
                 * keep[..., None])
-        return gate.astype(x2d.dtype), disp.astype(x2d.dtype)
+        # Switch aux loss (pre-capacity): E * sum_e f_e * P_e, where
+        # f_e = fraction of tokens argmax-routed to e, P_e = mean prob
+        aux = self.n_experts * jnp.sum(jnp.mean(onehot, axis=0)
+                                       * jnp.mean(probs, axis=0))
+        return gate.astype(x2d.dtype), disp.astype(x2d.dtype), aux
 
     def _capacity(self, n_tokens: int) -> int:
         return max(1, int(np.ceil(self.capacity_factor * n_tokens
@@ -145,7 +161,10 @@ class MoEFFN(TensorModule):
     def _apply(self, params, buffers, x, training, rng):
         B, T, D = x.shape
         x2d = x.reshape(B * T, D)
-        gate, disp = self._route(x2d, params, training, rng)
+        gate, disp, aux = self._route(x2d, params, training, rng)
+        if self.aux_loss_coef > 0.0:
+            buffers = dict(buffers)
+            buffers["aux_loss"] = aux.astype(jnp.float32)
         n = self._n_shards()
         # expert_in[e, c] = the token dispatched to expert e slot c
         expert_in = jnp.einsum("nec,nd->ecd", disp, x2d)
@@ -163,3 +182,31 @@ class MoEFFN(TensorModule):
                                    split_axis=1, concat_axis=0, tiled=True)
         y = jnp.einsum("nec,ecd->nd", disp, out_e) * gate[:, None]
         return y.reshape(B, T, D), buffers
+
+
+def collect_aux_paths(module, prefix=()):
+    """Yield (buffer_tree_path, coef) for every MoEFFN with
+    ``aux_loss_coef > 0`` — the same path addressing as
+    ``Container.buffer_tree`` (children keyed by str(index)).  The
+    train-step builders read these leaves from the forward's returned
+    buffers INSIDE the loss function, where they are differentiable
+    intermediates of the params."""
+    from ..nn.module import Container
+
+    if isinstance(module, MoEFFN):
+        if module.aux_loss_coef > 0.0:
+            yield prefix + ("aux_loss",), module.aux_loss_coef
+    elif isinstance(module, Container):
+        for i, child in enumerate(module.modules):
+            yield from collect_aux_paths(child, prefix + (str(i),))
+
+
+def aux_loss_term(buffers, paths):
+    """Sum ``coef * buffers[path]`` over collected aux paths."""
+    total = 0.0
+    for path, coef in paths:
+        node = buffers
+        for k in path:
+            node = node[k]
+        total = total + coef * node
+    return total

@@ -113,8 +113,9 @@ def _check_model(model, n_pipe):
             f"num_layers {count} not divisible by pipe-axis size {n_pipe}")
     if jax.tree_util.tree_leaves(model.buffer_tree()):
         raise ValueError(
-            "pipelined model must be buffer-free (no BatchNorm running "
-            "stats inside the pipeline)")
+            "pipelined model must be buffer-free — the pipeline does not "
+            "thread the buffer pytree (BatchNorm running stats, or an "
+            "MoE aux_loss buffer: pass moe_aux_coef=0 for pipelined MoE)")
     return first, count
 
 

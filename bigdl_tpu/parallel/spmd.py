@@ -251,9 +251,12 @@ def make_train_step(model, criterion, optim, mesh,
     from ..optim.regularizer import (collect_regularizer_paths,
                                      regularizer_loss)
 
+    from .moe import collect_aux_paths, aux_loss_term
+
     upcast_out = not getattr(criterion, "accepts_low_precision", False)
     cast_fwd = _cast_fwd(model, compute_dtype, upcast_out)
     reg_paths = list(collect_regularizer_paths(model))
+    aux_paths = list(collect_aux_paths(model))
     scale_tree = model.gradient_scale_tree()
     needs_scale = any(s != 1.0 for s in jax.tree_util.tree_leaves(scale_tree))
     n_data = mesh.shape[data_axis] if data_axis else 1
@@ -290,6 +293,13 @@ def make_train_step(model, criterion, optim, mesh,
 
             def loss_fn(p):
                 out, nb = cast_fwd(p, buf, x, True, rng)
+                # MoE load-balance penalty: a differentiable intermediate
+                # of p riding the buffer thread (collect_aux_paths).  On
+                # masked steps pad rows slightly dilute the local f_e/P_e
+                # statistics — accepted (they vanish as real records
+                # dominate); pre-divide by n_data so the data-psum below
+                # averages instead of multiplying (the reg-term rule).
+                aux = aux_loss_term(nb, aux_paths) if aux_paths else 0.0
                 if masked:
                     # trailing partial batch: per-record loss weighted by
                     # the 1-real/0-pad mask over the GLOBAL real count —
@@ -301,8 +311,8 @@ def make_train_step(model, criterion, optim, mesh,
                     per = jax.vmap(
                         lambda o, t: criterion._loss(add_axis(o),
                                                      add_axis(t)))(out, y)
-                    return jnp.sum(per * w) / total_w, nb
-                return criterion._loss(out, y), nb
+                    return jnp.sum(per * w) / total_w + aux / n_data, nb
+                return criterion._loss(out, y) + aux, nb
 
             (loss, nb), grads = jax.value_and_grad(loss_fn,
                                                    has_aux=True)(params)

@@ -224,6 +224,78 @@ def test_distri_optimizer_routes_ep_model():
     assert np.isfinite(opt.optim_method.state["loss"])
 
 
+def test_aux_loss_value_matches_hand_formula():
+    """Switch aux = E * sum_e f_e * P_e over the pre-capacity top-1
+    assignment, written to the aux_loss buffer."""
+    RNG().set_seed(3)
+    moe = MoEFFN(D, H, E, capacity_factor=8.0, aux_loss_coef=0.5)
+    p = moe.param_tree()
+    x = _tokens(2, 6, seed=8)
+    _, nb = moe.apply_fn(p, moe.buffer_tree(), jnp.asarray(x), True, None)
+    x2d = x.reshape(-1, D)
+    logits = x2d @ np.asarray(p["router_w"]).T + np.asarray(p["router_b"])
+    probs = np.exp(logits - logits.max(-1, keepdims=True))
+    probs /= probs.sum(-1, keepdims=True)
+    onehot = np.eye(E)[probs.argmax(-1)]
+    want = E * float(np.sum(onehot.mean(0) * probs.mean(0)))
+    np.testing.assert_allclose(float(nb["aux_loss"]), want, atol=1e-5)
+
+
+def test_aux_loss_enters_the_spmd_step_loss():
+    """With identical params/inputs, the step loss with coef c exceeds
+    the coef-0 loss by exactly c * sum-of-layer-aux (and the router
+    receives a different gradient)."""
+    from bigdl_tpu.parallel.moe import aux_loss_term, collect_aux_paths
+    from bigdl_tpu.parallel.spmd import make_train_step
+
+    mesh = Mesh(np.array(jax.devices()[:1]), ("data",))
+    crit = nn.TimeDistributedCriterion(nn.ClassNLLCriterion())
+    x, y = _lm_batch(4, seed=3)
+
+    def run(coef):
+        RNG().set_seed(11)
+        lm = TransformerLM(17, embed_dim=D, num_heads=2, mlp_dim=H,
+                           num_layers=2, max_len=6, moe_experts=E,
+                           moe_axis="data", moe_capacity_factor=4.0,
+                           moe_aux_coef=coef)
+        sgd = SGD(learning_rate=0.1)
+        step = make_train_step(lm, crit, sgd, mesh)
+        params = lm.param_tree()
+        loss, new_p, _, nb = step(params, sgd.init_state(params),
+                                  lm.buffer_tree(), 0.1, x, y)
+        return lm, float(loss), jax.device_get(new_p), nb
+
+    lm0, loss0, p0, _ = run(0.0)
+    lm1, loss1, p1, nb1 = run(0.5)
+    aux_total = float(aux_loss_term(jax.device_get(nb1),
+                                    list(collect_aux_paths(lm1)))) / 0.5
+    assert aux_total > 0
+    np.testing.assert_allclose(loss1 - loss0, 0.5 * aux_total, atol=1e-5)
+    # the balance term reshapes the router update
+    r0 = np.asarray(p0["1"]["3"]["router_w"])
+    r1 = np.asarray(p1["1"]["3"]["router_w"])
+    assert np.abs(r0 - r1).max() > 1e-7
+
+
+def test_aux_loss_local_optimizer_smoke():
+    from bigdl_tpu.dataset.dataset import array
+    from bigdl_tpu.dataset.sample import MiniBatch
+    from bigdl_tpu.optim import max_iteration
+    from bigdl_tpu.optim.optimizer import LocalOptimizer
+
+    RNG().set_seed(5)
+    lm = TransformerLM(17, embed_dim=D, num_heads=2, mlp_dim=H,
+                       num_layers=2, max_len=6, moe_experts=E,
+                       moe_aux_coef=0.01)
+    crit = nn.TimeDistributedCriterion(nn.ClassNLLCriterion())
+    opt = LocalOptimizer(lm, array([MiniBatch(*_lm_batch(8, seed=s))
+                                    for s in (0, 1)]), crit)
+    opt.set_optim_method(SGD(learning_rate=0.1))
+    opt.set_end_when(max_iteration(2))
+    opt.optimize()
+    assert np.isfinite(opt.optim_method.state["loss"])
+
+
 def test_block_rejects_moe_plus_model_axis():
     with pytest.raises(ValueError, match="model_axis=None"):
         TransformerLM(17, embed_dim=D, num_heads=2, mlp_dim=H,
