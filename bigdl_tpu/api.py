@@ -31,14 +31,17 @@ def _registry() -> Dict[str, type]:
     from .nn.module import AbstractModule
     from .nn.initialization import InitializationMethod
 
+    from . import parallel
+
     reg = {}
-    for name in dir(nn):
-        obj = getattr(nn, name)
-        if (isinstance(obj, type) and not name.startswith("_")
-                and name not in _BASES
-                and issubclass(obj, (AbstractModule, AbstractCriterion,
-                                     InitializationMethod))):
-            reg[name] = obj
+    for ns in (nn, parallel):  # parallel: the TPU extension layers
+        for name in dir(ns):
+            obj = getattr(ns, name)
+            if (isinstance(obj, type) and not name.startswith("_")
+                    and name not in _BASES
+                    and issubclass(obj, (AbstractModule, AbstractCriterion,
+                                         InitializationMethod))):
+                reg[name] = obj
     return reg
 
 

@@ -137,9 +137,20 @@ class MoEFFN(TensorModule):
                                dtype=jnp.float32)
                 * keep[..., None])
         # Switch aux loss (pre-capacity): E * sum_e f_e * P_e, where
-        # f_e = fraction of tokens argmax-routed to e, P_e = mean prob
-        aux = self.n_experts * jnp.sum(jnp.mean(onehot, axis=0)
-                                       * jnp.mean(probs, axis=0))
+        # f_e = fraction of tokens argmax-routed to e, P_e = mean prob.
+        # Under expert parallelism the statistics are pmean'd over the
+        # axis FIRST so the term is the documented GLOBAL formula —
+        # mean-of-products of shard-local stats would silently differ
+        # from the dense twin (product of global means).
+        f_vec = jnp.mean(onehot, axis=0)
+        p_vec = jnp.mean(probs, axis=0)
+        if self.axis_name is not None:
+            try:
+                f_vec = lax.pmean(f_vec, self.axis_name)
+                p_vec = lax.pmean(p_vec, self.axis_name)
+            except NameError:  # axis not bound: eager/unsharded call
+                pass
+        aux = self.n_experts * jnp.sum(f_vec * p_vec)
         return gate.astype(x2d.dtype), disp.astype(x2d.dtype), aux
 
     def _capacity(self, n_tokens: int) -> int:

@@ -277,6 +277,53 @@ def test_aux_loss_enters_the_spmd_step_loss():
     assert np.abs(r0 - r1).max() > 1e-7
 
 
+def test_aux_loss_ep_matches_dense_twin_multi_shard():
+    """The EP aux term uses GLOBAL routing statistics (pmean'd over the
+    axis), so loss AND params after one step match the dense twin
+    exactly on a 4-shard mesh with aux enabled."""
+    from bigdl_tpu.parallel.moe import aux_loss_term, collect_aux_paths
+    from bigdl_tpu.parallel.spmd import make_train_step
+
+    mesh = Mesh(np.array(jax.devices()[:4]), ("data",))
+    crit = nn.TimeDistributedCriterion(nn.ClassNLLCriterion())
+    lr, coef = 0.2, 0.3
+    x, y = _lm_batch(8, seed=6)
+
+    def build(axis):
+        RNG().set_seed(13)
+        return TransformerLM(17, embed_dim=D, num_heads=2, mlp_dim=H,
+                             num_layers=2, max_len=6, moe_experts=E,
+                             moe_axis=axis, moe_capacity_factor=4.0,
+                             moe_aux_coef=coef)
+
+    dense = build(None)
+
+    def loss_fn(pp):
+        out, nb = dense.apply_fn(pp, dense.buffer_tree(), jnp.asarray(x),
+                                 True, None)
+        return (crit._loss(out, jnp.asarray(y))
+                + aux_loss_term(nb, list(collect_aux_paths(dense))))
+
+    p0 = dense.param_tree()
+    loss_ref, grads_ref = jax.value_and_grad(loss_fn)(p0)
+    sgd = SGD(learning_rate=lr)
+    params_ref, _ = sgd.step(grads_ref, p0, sgd.init_state(p0), lr)
+
+    ep = build("data")
+    sgd2 = SGD(learning_rate=lr)
+    step = make_train_step(ep, crit, sgd2, mesh)
+    params = ep.param_tree()
+    loss, params, _, _ = step(params, sgd2.init_state(params),
+                              ep.buffer_tree(), lr, x, y)
+    assert abs(float(loss) - float(loss_ref)) < 2e-5
+    flat = dict(jax.tree_util.tree_leaves_with_path(params_ref))
+    for path, leaf in jax.tree_util.tree_leaves_with_path(
+            jax.device_get(params)):
+        np.testing.assert_allclose(np.asarray(leaf),
+                                   np.asarray(flat[path]), atol=2e-5,
+                                   err_msg=jax.tree_util.keystr(path))
+
+
 def test_aux_loss_local_optimizer_smoke():
     from bigdl_tpu.dataset.dataset import array
     from bigdl_tpu.dataset.sample import MiniBatch
