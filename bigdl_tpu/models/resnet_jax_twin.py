@@ -30,7 +30,20 @@ from ..ops.conv_gemm import conv2d_gemm_nhwc
 STAGES = ((3, 64, 1), (4, 128, 2), (6, 256, 2), (3, 512, 2))
 
 
-def _conv(x, w, stride, padding, impl):
+def _conv(x, w, stride, padding, impl, layout="nhwc"):
+    if layout == "nchw":
+        # the layout-decomposition probe: identical math, activations
+        # flowing NCHW like the framework — isolates how much of the
+        # twin-vs-framework gap is logical layout vs facade
+        if padding == "SAME":
+            pads = "SAME"
+        else:
+            pads = ((padding[0], padding[0]), (padding[1], padding[1]))
+        return lax.conv_general_dilated(
+            x, w, (stride, stride), pads,
+            dimension_numbers=("NCHW", "HWIO", "NCHW"),
+            preferred_element_type=jnp.float32 if x.dtype == jnp.float32
+            else None)
     if (impl == "pallas" and w.shape[:2] == (3, 3) and stride == 1
             and padding == (1, 1)):
         from ..ops.conv3x3_pallas import conv3x3_s1_same
@@ -52,14 +65,17 @@ def _conv(x, w, stride, padding, impl):
         else None)
 
 
-def _bn(x, p, training, eps=1e-5):
+def _bn(x, p, training, eps=1e-5, layout="nhwc"):
+    red = (0, 1, 2) if layout == "nhwc" else (0, 2, 3)
+    shp = (1, -1, 1, 1) if layout == "nchw" else (-1,)
     if training:
-        mean = jnp.mean(x, axis=(0, 1, 2))
-        var = jnp.var(x, axis=(0, 1, 2))
+        mean = jnp.mean(x, axis=red)
+        var = jnp.var(x, axis=red)
     else:
         mean, var = p["mean"], p["var"]
     inv = lax.rsqrt(var.astype(jnp.float32) + eps).astype(x.dtype)
-    return (x - mean) * inv * p["gamma"] + p["beta"]
+    return ((x - mean.reshape(shp)) * inv.reshape(shp)
+            * p["gamma"].reshape(shp) + p["beta"].reshape(shp))
 
 
 def _init_conv(key, kh, kw, cin, cout):
@@ -102,36 +118,48 @@ def init_params(key, num_classes=1000):
     return p
 
 
-def _bottleneck(x, blk, stride, training, impl):
-    y = _conv(x, blk["w1"], 1, (0, 0), impl)
-    y = jax.nn.relu(_bn(y, blk["bn1"], training))
-    y = _conv(y, blk["w2"], stride, (1, 1), impl)
-    y = jax.nn.relu(_bn(y, blk["bn2"], training))
-    y = _conv(y, blk["w3"], 1, (0, 0), impl)
-    y = _bn(y, blk["bn3"], training)
+def _bottleneck(x, blk, stride, training, impl, layout="nhwc"):
+    y = _conv(x, blk["w1"], 1, (0, 0), impl, layout)
+    y = jax.nn.relu(_bn(y, blk["bn1"], training, layout=layout))
+    y = _conv(y, blk["w2"], stride, (1, 1), impl, layout)
+    y = jax.nn.relu(_bn(y, blk["bn2"], training, layout=layout))
+    y = _conv(y, blk["w3"], 1, (0, 0), impl, layout)
+    y = _bn(y, blk["bn3"], training, layout=layout)
     if "wd" in blk:
-        x = _bn(_conv(x, blk["wd"], stride, (0, 0), impl), blk["bnd"],
-                training)
+        x = _bn(_conv(x, blk["wd"], stride, (0, 0), impl, layout),
+                blk["bnd"], training, layout=layout)
     return jax.nn.relu(y + x)
 
 
-def forward(params, x, training=True, impl="xla"):
-    """x: [B, 224, 224, 3] NHWC → logits [B, classes]."""
-    y = _conv(x, params["stem"]["w"].astype(x.dtype), 2, (3, 3), impl)
-    y = jax.nn.relu(_bn(y, params["stem"]["bn"], training))
-    y = lax.reduce_window(y, -jnp.inf, lax.max, (1, 3, 3, 1),
-                          (1, 2, 2, 1), ((0, 0), (1, 1), (1, 1), (0, 0)))
+def forward(params, x, training=True, impl="xla", layout="nhwc"):
+    """x: [B, 224, 224, 3] NHWC → logits [B, classes].  ``layout=
+    "nchw"`` transposes once at entry and flows NCHW throughout (the
+    layout-decomposition probe)."""
+    if layout == "nchw":
+        x = x.transpose(0, 3, 1, 2)
+        pool_win, pool_str = (1, 1, 3, 3), (1, 1, 2, 2)
+        pool_pad = ((0, 0), (0, 0), (1, 1), (1, 1))
+        spatial = (2, 3)
+    else:
+        pool_win, pool_str = (1, 3, 3, 1), (1, 2, 2, 1)
+        pool_pad = ((0, 0), (1, 1), (1, 1), (0, 0))
+        spatial = (1, 2)
+    y = _conv(x, params["stem"]["w"].astype(x.dtype), 2, (3, 3), impl,
+              layout)
+    y = jax.nn.relu(_bn(y, params["stem"]["bn"], training, layout=layout))
+    y = lax.reduce_window(y, -jnp.inf, lax.max, pool_win, pool_str,
+                          pool_pad)
     for si, (blocks, _, stride) in enumerate(STAGES):
         for bi in range(blocks):
             blk = params[f"stage{si}"][bi]
             y = _bottleneck(y, blk, stride if bi == 0 else 1, training,
-                            impl)
-    y = jnp.mean(y, axis=(1, 2))
+                            impl, layout)
+    y = jnp.mean(y, axis=spatial)
     return jnp.dot(y, params["fc"]["w"].astype(y.dtype)) + params["fc"]["b"]
 
 
 def make_train_step(impl="xla", compute_dtype=jnp.bfloat16, lr=0.1,
-                    momentum=0.9, steps_per_dispatch=1):
+                    momentum=0.9, steps_per_dispatch=1, layout="nhwc"):
     """One jitted donated SGD-momentum step on f32 master weights
     (``steps_per_dispatch > 1`` chains K steps per program)."""
 
@@ -143,7 +171,7 @@ def make_train_step(impl="xla", compute_dtype=jnp.bfloat16, lr=0.1,
     def loss_fn(params, x, y):
         p_c = cast(params, compute_dtype) if compute_dtype else params
         logits = forward(p_c, x.astype(compute_dtype or x.dtype),
-                         training=True, impl=impl)
+                         training=True, impl=impl, layout=layout)
         logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
         return -jnp.mean(
             jnp.take_along_axis(logp, y[:, None], axis=1))

@@ -85,7 +85,8 @@ def _emit(rec):
         pass
 
 
-def run_twin(impl, batches=(64, 128, 256), iters=20, warmup=4):
+def run_twin(impl, batches=(64, 128, 256), iters=20, warmup=4,
+             layout="nhwc"):
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -93,7 +94,7 @@ def run_twin(impl, batches=(64, 128, 256), iters=20, warmup=4):
     from .resnet_jax_twin import init_params, make_train_step
 
     peak = _peak()
-    out = {"exp": "twin", "impl": impl,
+    out = {"exp": "twin", "impl": impl, "layout": layout,
            "device": _device_str(), "sweep": {}}
     best = 0.0
     for B in batches:
@@ -101,7 +102,8 @@ def run_twin(impl, batches=(64, 128, 256), iters=20, warmup=4):
             SPD = 4  # match the framework bench's dispatch amortization
             params = init_params(jax.random.PRNGKey(0))
             vel = jax.tree_util.tree_map(jnp.zeros_like, params)
-            step = make_train_step(impl=impl, steps_per_dispatch=SPD)
+            step = make_train_step(impl=impl, steps_per_dispatch=SPD,
+                                   layout=layout)
             rng = np.random.RandomState(0)
             x = jnp.asarray(rng.rand(B, 224, 224, 3), jnp.bfloat16)
             y = jnp.asarray(rng.randint(0, 1000, B), jnp.int32)
@@ -119,8 +121,8 @@ def run_twin(impl, batches=(64, 128, 256), iters=20, warmup=4):
         except Exception as e:
             out["sweep"][str(B)] = f"{type(e).__name__}: {e}"[:200]
         # per-point row so a tunnel death mid-sweep keeps earlier batches
-        _emit({"exp": "twin_point", "impl": impl, "batch": B,
-               "result": out["sweep"][str(B)]})
+        _emit({"exp": "twin_point", "impl": impl, "layout": layout,
+               "batch": B, "result": out["sweep"][str(B)]})
     out["images_per_sec"] = round(best, 2)
     if peak and best:
         out["mfu"] = round(best * RESNET50_FWD_FLOPS_PER_IMAGE * 3 / peak,
@@ -349,11 +351,14 @@ def main():
     p.add_argument("--flash", action="store_true")
     p.add_argument("--impl", default="xla",
                    choices=["xla", "gemm", "pallas"])
+    p.add_argument("--layout", default="nhwc", choices=["nhwc", "nchw"],
+                   help="twin activation layout (nchw = the framework-"
+                        "matching layout-decomposition probe)")
     p.add_argument("--batch", type=int, default=128)
     p.add_argument("--iters", type=int, default=20)
     a = p.parse_args()
     if a.twin:
-        run_twin(a.impl, iters=a.iters)
+        run_twin(a.impl, iters=a.iters, layout=a.layout)
     if a.convshapes:
         run_convshapes(batch=a.batch)
     if a.framework:

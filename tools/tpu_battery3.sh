@@ -160,6 +160,10 @@ while :; do
     # re-run (retuned flash defaults) now outranks the diagnostic
     # conv-shape matrix on whatever window comes next
     bench_step || { sleep 10; continue; }
+    # the layout-decomposition probe: twin in the framework's NCHW
+    # layout — splits the twin-vs-framework gap into layout vs facade
+    lab_step twin_nchw 2400 --twin --impl xla --layout nchw \
+        || { sleep 10; continue; }
     lab_step convshapes 2400 --convshapes || { sleep 10; continue; }
     BIGDL_EXAMPLES_PLATFORM=device cmd_step inception_acc 2400 \
         python -m bigdl_tpu.examples.inception_digits_accuracy \
