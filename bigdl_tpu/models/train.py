@@ -203,7 +203,9 @@ def main(argv=None):
                         help="pipe-axis size: GPipe pipeline over N "
                              "stages (transformer only; N must divide "
                              "num_layers; requires --distributed; "
-                             "excludes --tensor-parallel/--seq-parallel)")
+                             "composes with --tensor-parallel for 3-D "
+                             "data x pipe x model; excludes "
+                             "--seq-parallel)")
     parser.add_argument("--pipeline-microbatch", type=positive_int,
                         default=None, metavar="M",
                         help="GPipe microbatches per step (default: the "
@@ -248,10 +250,9 @@ def main(argv=None):
          or args.pipeline_parallel > 1) and not args.distributed):
         parser.error("--tensor-parallel/--seq-parallel/--pipeline-parallel "
                      "require --distributed")
-    if args.pipeline_parallel > 1 and (args.tensor_parallel > 1
-                                       or args.seq_parallel > 1):
-        parser.error("--pipeline-parallel composes with data parallelism "
-                     "only (not --tensor-parallel/--seq-parallel)")
+    if args.pipeline_parallel > 1 and args.seq_parallel > 1:
+        parser.error("--pipeline-parallel composes with data/tensor "
+                     "parallelism, not --seq-parallel")
     if args.pipeline_parallel > 1 and args.model != "transformer":
         parser.error("--pipeline-parallel supports --model transformer")
     if args.pipeline_microbatch and args.pipeline_parallel < 2:

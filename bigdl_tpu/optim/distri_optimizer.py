@@ -223,13 +223,12 @@ class DistriOptimizer(Optimizer):
         # driver (parallel/pipeline.py: stage-sharded block stack,
         # microbatch schedule, derived backward)
         if "pipe" in mesh.axis_names and mesh.shape["pipe"] > 1:
-            extra = [a for a in ("model", "seq")
-                     if a in mesh.axis_names and mesh.shape[a] > 1]
-            if extra:
+            if "seq" in mesh.axis_names and mesh.shape["seq"] > 1:
                 raise ValueError(
-                    f"the pipeline driver composes with the data axis "
-                    f"only; mesh also has {extra} (>1). Use a data x "
-                    "pipe mesh, or a seq/model mesh without pipe.")
+                    "the pipeline driver composes with data and model "
+                    "axes; a >1 seq axis is not supported with pipe — "
+                    "use a data x pipe [x model] mesh, or a seq mesh "
+                    "without pipe.")
             return self._optimize_pipeline(mesh)
         extra_axes = [a for a in ("model", "seq")
                       if a in mesh.axis_names and mesh.shape[a] > 1]
@@ -482,16 +481,21 @@ class DistriOptimizer(Optimizer):
         n_data = mesh.shape.get("data", 1)
         n_pipe = mesh.shape["pipe"]
         n_mb = self.pipeline_microbatch or n_pipe
+        # a >1 model axis composes: blocks' Column/Row weights shard
+        # over BOTH pipe and model (3-D parallelism)
+        model_axis = ("model" if mesh.shape.get("model", 1) > 1 else None)
 
         step = make_pipeline_train_step(model, self.criterion, optim, mesh,
                                         n_microbatch=n_mb,
+                                        model_axis=model_axis,
                                         compute_dtype=self.compute_dtype,
                                         donate=True)
         eval_fwd = None  # built lazily on the first validation trigger
         put = lambda tree, specs: jax.tree_util.tree_map(
             lambda a, s: jax.device_put(a, NamedSharding(mesh, s)),
             tree, specs)
-        packed = put(pack_params(model, n_pipe), step.param_specs)
+        packed = put(pack_params(model, n_pipe, model_axis),
+                     step.param_specs)
         slots = _resume_slots(optim, optim.init_state(packed))
         slots = put(slots, step.slot_specs)
 
@@ -577,6 +581,7 @@ class DistriOptimizer(Optimizer):
                 if eval_fwd is None:
                     pfwd = make_pipeline_eval_forward(
                         model, mesh, n_microbatch=n_mb,
+                        model_axis=model_axis,
                         compute_dtype=self.compute_dtype)
                     eval_fwd = lambda p, b, xx: pfwd(p, xx)
                 from .evaluator import evaluate_dataset

@@ -28,9 +28,12 @@ convention as spmd.py's model axis: pipe-sharded leaves see the
 ``S×`` cotangent amplification of the replicated-loss psum and are
 divided by ``S``; replicated leaves are pmean'd over (data, pipe).
 
-Composes with the ``data`` axis (batch sharding) in the same mesh.
-``seq``/``model`` axes inside the pipelined region are out of scope
-(and rejected loudly) — use spmd.make_train_step for those meshes.
+Composes with the ``data`` axis (batch sharding) and — via
+``model_axis`` — with Megatron tensor parallelism inside each stage:
+the stacked Column/Row weights shard over BOTH pipe (layer dim) and
+model (feature dim), giving 3-D data × pipe × model parallelism.  A
+``seq`` axis inside the pipelined region is out of scope (rejected
+loudly) — use spmd.make_train_step for sequence-parallel meshes.
 """
 from __future__ import annotations
 
@@ -83,31 +86,43 @@ def _check_layout(model):
     return first, count
 
 
-def _check_model(model, n_pipe):
+def _check_model(model, n_pipe, model_axis=None):
     from .tensor_parallel import ColumnParallelLinear, RowParallelLinear
 
     first, count = _check_layout(model)
     if model.seq_strategy in ("ring", "ulysses"):
         raise ValueError(
-            "pipeline parallelism composes with data parallelism only; "
+            "pipeline parallelism composes with data/model axes only; "
             f"seq_strategy {model.seq_strategy!r} needs a bound seq axis "
-            "— use parallel.spmd.make_train_step for seq/model meshes")
+            "— use parallel.spmd.make_train_step for seq meshes")
     from .moe import MoEFFN
 
+    bound = 0
     for m in model.modules_iter():
         if (isinstance(m, (ColumnParallelLinear, RowParallelLinear))
                 and m.axis_name):
-            raise ValueError(
-                "pipeline parallelism does not compose with tensor "
-                f"parallelism yet: {type(m).__name__} is bound to mesh "
-                f"axis {m.axis_name!r} (build the TransformerLM with "
-                "model_axis=None for the pipeline path)")
+            if m.axis_name != model_axis:
+                raise ValueError(
+                    f"{type(m).__name__} is bound to mesh axis "
+                    f"{m.axis_name!r} but the pipeline builder was given "
+                    f"model_axis={model_axis!r}; pass model_axis="
+                    f"{m.axis_name!r} to compose pipeline with tensor "
+                    "parallelism, or build with model_axis=None")
+            bound += 1
         if isinstance(m, MoEFFN) and m.axis_name:
             raise ValueError(
                 "pipeline parallelism does not compose with expert "
                 "parallelism yet: MoEFFN is bound to mesh axis "
                 f"{m.axis_name!r} (build with moe_axis=None for dense "
                 "MoE inside the pipeline)")
+    if model_axis is not None and bound == 0:
+        raise ValueError(
+            f"pipeline builder was given model_axis={model_axis!r} but "
+            "no Column/RowParallelLinear in the model is bound to it — "
+            "the >1 model mesh axis would be pure replication (half the "
+            f"devices doing redundant work); build the TransformerLM "
+            f"with model_axis={model_axis!r}, or use a mesh whose model "
+            "axis is 1")
     if count % n_pipe != 0:
         raise ValueError(
             f"num_layers {count} not divisible by pipe-axis size {n_pipe}")
@@ -119,11 +134,11 @@ def _check_model(model, n_pipe):
     return first, count
 
 
-def pack_params(model, n_pipe: int):
+def pack_params(model, n_pipe: int, model_axis=None):
     """Model param tree → pipeline tree: the L block subtrees stacked
     into leading-``L`` leaves (sharded P('pipe') over stages), the rest
     verbatim.  Inverse: :func:`unpack_params`."""
-    first, count = _check_model(model, n_pipe)
+    first, count = _check_model(model, n_pipe, model_axis)
     t = model.param_tree()
     blocks = [t[str(i)] for i in range(first, first + count)]
     stacked = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *blocks)
@@ -152,15 +167,28 @@ def unpack_params(packed, model):
     return model
 
 
-def param_specs(packed, pipe_axis: str = "pipe"):
+def param_specs(packed, pipe_axis: str = "pipe", block=None,
+                model_axis=None):
     """PartitionSpec tree for a packed pipeline tree: stacked block
-    leaves shard their leading (layer) dim over ``pipe``; the rest
-    replicate."""
+    leaves shard their leading (layer) dim over ``pipe``; with
+    ``block``/``model_axis`` given, each leaf's single-block tensor-
+    parallel spec (spmd.param_specs) is appended after the pipe dim —
+    Column/Row weights shard over BOTH axes.  Everything else
+    replicates."""
+    if block is not None and model_axis is not None:
+        from .spmd import param_specs as _block_specs
+
+        bspec = _block_specs(block, model_axis)
+        blocks = jax.tree_util.tree_map(
+            lambda s: P(pipe_axis, *s), bspec,
+            is_leaf=lambda s: isinstance(s, P))
+    else:
+        blocks = jax.tree_util.tree_map(lambda _: P(pipe_axis),
+                                        packed["blocks"])
     return {
         "embed": jax.tree_util.tree_map(lambda _: P(), packed["embed"]),
         "pos": P(),
-        "blocks": jax.tree_util.tree_map(lambda _: P(pipe_axis),
-                                         packed["blocks"]),
+        "blocks": blocks,
         "ln": jax.tree_util.tree_map(lambda _: P(), packed["ln"]),
         "head": jax.tree_util.tree_map(lambda _: P(), packed["head"]),
     }
@@ -259,6 +287,7 @@ def make_pipeline_train_step(model, criterion, optim, mesh,
                              n_microbatch: int,
                              data_axis: Optional[str] = "data",
                              pipe_axis: str = "pipe",
+                             model_axis: Optional[str] = None,
                              compute_dtype=None, donate: bool = False,
                              remat: Optional[bool] = None):
     """Build the jitted data×pipe train step.
@@ -281,9 +310,12 @@ def make_pipeline_train_step(model, criterion, optim, mesh,
     if pipe_axis not in mesh.axis_names:
         raise ValueError(f"mesh has no {pipe_axis!r} axis")
     data_axis = data_axis if data_axis in mesh.axis_names else None
+    if model_axis is not None and model_axis not in mesh.axis_names:
+        raise ValueError(f"mesh has no {model_axis!r} axis")
     S = mesh.shape[pipe_axis]
+    n_model = mesh.shape[model_axis] if model_axis else 1
     M = int(n_microbatch)
-    first, count = _check_model(model, S)
+    first, count = _check_model(model, S, model_axis)
     if list(collect_regularizer_paths(model)):
         raise NotImplementedError(
             "regularizers are not supported on the pipeline path yet")
@@ -297,8 +329,9 @@ def make_pipeline_train_step(model, criterion, optim, mesh,
     local_fwd = _make_local_forward(model, first, count, S, M, pipe_axis,
                                     compute_dtype, remat)
 
-    packed0 = pack_params(model, S)
-    pspecs = param_specs(packed0, pipe_axis)
+    packed0 = pack_params(model, S, model_axis)
+    pspecs = param_specs(packed0, pipe_axis,
+                         block=model.modules[first], model_axis=model_axis)
     from .spmd import slot_specs as _slot_specs
 
     sslots = _slot_specs(optim.init_state(packed0), pspecs)
@@ -307,9 +340,9 @@ def make_pipeline_train_step(model, criterion, optim, mesh,
         def local_step(packed, slots, lr, rng, x, y, *mask_args):
             if rng is not None and data_axis:
                 # decorrelate dropout across batch shards (spmd.py does
-                # the same); pipe peers keep the same base key — they
-                # hold slices of one logical model and already fold
-                # (tick, stage)
+                # the same); pipe/model peers keep the same base key —
+                # they hold slices of one logical model (the stage
+                # already folds tick+stage)
                 rng = jax.random.fold_in(rng, lax.axis_index(data_axis))
 
             def loss_fn(p_master):
@@ -332,22 +365,30 @@ def make_pipeline_train_step(model, criterion, optim, mesh,
 
             loss, grads = jax.value_and_grad(loss_fn)(packed)
 
+            def _has(spec, axis):
+                return axis is not None and any(
+                    ax == axis or (isinstance(ax, tuple) and axis in ax)
+                    for ax in spec if ax is not None)
+
             def reduce_grad(g, spec):
-                piped = any(ax == pipe_axis
-                            or (isinstance(ax, tuple) and pipe_axis in ax)
-                            for ax in spec if ax is not None)
-                if masked:
-                    # local loss is normalized by the GLOBAL real count:
-                    # the data axis contributes a SUM
-                    if data_axis:
-                        g = lax.psum(g, data_axis)
-                    return g / S if piped else lax.pmean(g, pipe_axis)
+                piped = _has(spec, pipe_axis)
+                modeled = _has(spec, model_axis)
+                # data axis: pmean by the mean-loss convention, or a
+                # SUM when the masked loss is already normalized by the
+                # global real count
+                if data_axis:
+                    g = (lax.psum(g, data_axis) if masked
+                         else lax.pmean(g, data_axis))
+                # sharded axes divide out the replicated-loss cotangent
+                # amplification; replicated-over axes pmean the copies
                 if piped:
-                    if data_axis:
-                        g = lax.pmean(g, data_axis)
-                    return g / S
-                return lax.pmean(g, tuple(a for a in (data_axis, pipe_axis)
-                                          if a))
+                    g = g / S
+                else:
+                    g = lax.pmean(g, pipe_axis)
+                if model_axis:
+                    g = g / n_model if modeled else lax.pmean(g,
+                                                              model_axis)
+                return g
 
             grads = jax.tree_util.tree_map(reduce_grad, grads, pspecs)
             if data_axis:
@@ -389,7 +430,7 @@ def make_pipeline_train_step(model, criterion, optim, mesh,
     step.slot_specs = sslots
     step.n_stages = S
     step.n_microbatch = M
-    step.pack = lambda: pack_params(model, S)
+    step.pack = lambda: pack_params(model, S, model_axis)
     step.unpack = lambda packed: unpack_params(packed, model)
     return step
 
@@ -397,6 +438,7 @@ def make_pipeline_train_step(model, criterion, optim, mesh,
 def make_pipeline_eval_forward(model, mesh, n_microbatch: int,
                                data_axis: Optional[str] = "data",
                                pipe_axis: str = "pipe",
+                               model_axis: Optional[str] = None,
                                compute_dtype=None):
     """Compiled pipelined forward for validation/inference over the same
     mesh/specs as :func:`make_pipeline_train_step` (reuses its sharded
@@ -406,12 +448,15 @@ def make_pipeline_eval_forward(model, mesh, n_microbatch: int,
     if pipe_axis not in mesh.axis_names:
         raise ValueError(f"mesh has no {pipe_axis!r} axis")
     data_axis = data_axis if data_axis in mesh.axis_names else None
+    if model_axis is not None and model_axis not in mesh.axis_names:
+        raise ValueError(f"mesh has no {model_axis!r} axis")
     S = mesh.shape[pipe_axis]
     M = int(n_microbatch)
-    first, count = _check_model(model, S)
+    first, count = _check_model(model, S, model_axis)
     local_fwd = _make_local_forward(model, first, count, S, M, pipe_axis,
                                     compute_dtype, remat=False)
-    pspecs = param_specs(pack_params(model, S), pipe_axis)
+    pspecs = param_specs(pack_params(model, S, model_axis), pipe_axis,
+                         block=model.modules[first], model_axis=model_axis)
 
     def local_eval(packed, x):
         return local_fwd(packed, x, False, None, True)

@@ -159,6 +159,82 @@ def test_pipeline_rejects_bad_configs():
         make_pipeline_train_step(tp, crit, SGD(), mesh, n_microbatch=2)
 
 
+def _tp_model(model_axis):
+    """TransformerLM whose block MLPs are Column/Row-bound (3-D runs)
+    — same RNG consumption as _model(), so params match it exactly."""
+    RNG().set_seed(7)
+    return TransformerLM(VOCAB, embed_dim=EMBED, num_heads=HEADS,
+                         mlp_dim=MLP, num_layers=LAYERS, max_len=T,
+                         model_axis=model_axis)
+
+
+def test_pipeline_tp_3d_matches_dense_twin():
+    """data x pipe x model (2x2x2): blocks' Column/Row weights sharded
+    over BOTH pipe and model; loss and every updated parameter must
+    match the dense single-device twin."""
+    mesh = Mesh(np.array(jax.devices()[:8]).reshape(2, 2, 2),
+                ("data", "pipe", "model"))
+    dense = _model()  # plain Linears, same init stream as _tp_model
+    criterion = nn.TimeDistributedCriterion(nn.ClassNLLCriterion())
+    lr = 0.2
+    batches = [_batch(8, seed=s) for s in (0, 1)]
+    losses_ref, params_ref = _dense_steps(
+        dense, criterion, SGD(learning_rate=lr, momentum=0.5), lr,
+        batches)
+
+    tp = _tp_model("model")
+    for a, b in zip(jax.tree_util.tree_leaves(tp.param_tree()),
+                    jax.tree_util.tree_leaves(dense.param_tree())):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    step = make_pipeline_train_step(
+        tp, criterion, SGD(learning_rate=lr, momentum=0.5), mesh,
+        n_microbatch=2, model_axis="model")
+    packed = step.pack()
+    slots = SGD(learning_rate=lr, momentum=0.5).init_state(packed)
+    for (x, y), ref in zip(batches, losses_ref):
+        loss, packed, slots = step(packed, slots, lr, x, y)
+        assert abs(float(loss) - ref) < 2e-5
+    unpack_params(packed, tp)
+    _assert_tree_close(tp.param_tree(), params_ref)
+
+    # the pipelined TP eval forward agrees with the dense twin's eval
+    x = _batch(8, seed=5)[0]
+    out_ref, _ = dense.apply_fn(params_ref, dense.buffer_tree(),
+                                jnp.asarray(x), False, None)
+    fwd = make_pipeline_eval_forward(tp, mesh, n_microbatch=2,
+                                     model_axis="model")
+    np.testing.assert_allclose(np.asarray(fwd(packed, x)),
+                               np.asarray(out_ref), atol=2e-5)
+
+
+def test_pipeline_tp_masked_matches_dense():
+    """3-D mesh + trailing partial batch: pad-and-mask trains exactly
+    the real records."""
+    mesh = Mesh(np.array(jax.devices()[:8]).reshape(2, 2, 2),
+                ("data", "pipe", "model"))
+    dense = _model()
+    criterion = nn.TimeDistributedCriterion(nn.ClassNLLCriterion())
+    lr = 0.2
+    x, y = _batch(5, seed=21)
+    losses_ref, params_ref = _dense_steps(
+        dense, criterion, SGD(learning_rate=lr), lr, [(x, y)])
+    tp = _tp_model("model")
+    step = make_pipeline_train_step(
+        tp, criterion, SGD(learning_rate=lr), mesh, n_microbatch=2,
+        model_axis="model")
+    packed = step.pack()
+    slots = SGD(learning_rate=lr).init_state(packed)
+    pad = 8 - 5
+    xp = np.concatenate([x, np.ones((pad, T), x.dtype)])
+    yp = np.concatenate([y, np.ones((pad, T), y.dtype)])
+    w = np.array([1.0] * 5 + [0.0] * pad, np.float32)
+    loss, packed, slots = step(packed, slots, lr, xp, yp, w=w,
+                               total_w=5.0)
+    assert abs(float(loss) - losses_ref[0]) < 2e-5
+    unpack_params(packed, tp)
+    _assert_tree_close(tp.param_tree(), params_ref)
+
+
 def test_pipeline_masked_partial_batch_matches_dense():
     """Every-record guarantee on the pipe mesh: a padded+masked step
     over 5 real records must match the dense twin training exactly
@@ -225,6 +301,17 @@ def test_distri_optimizer_pipeline_lifecycle(tmp_path):
                               jnp.asarray(_batch(4, seed=5)[0]), False,
                               None)
     assert np.isfinite(np.asarray(out)).all()
+
+
+def test_pipeline_rejects_unbound_model_axis():
+    """A >1 model mesh axis with a TP-unbound model must raise (pure
+    replication would silently waste half the devices)."""
+    mesh = Mesh(np.array(jax.devices()[:8]).reshape(2, 2, 2),
+                ("data", "pipe", "model"))
+    crit = nn.TimeDistributedCriterion(nn.ClassNLLCriterion())
+    with pytest.raises(ValueError, match="pure replication"):
+        make_pipeline_train_step(_model(), crit, SGD(), mesh,
+                                 n_microbatch=2, model_axis="model")
 
 
 def test_unpack_rejects_layer_count_mismatch():
