@@ -1,0 +1,248 @@
+"""Autoregressive generation for TransformerLM — KV-cache decode.
+
+The reference predates autoregressive LMs entirely (its sequence story
+is Recurrent/TimeDistributed, SURVEY §5.7), so this is a TPU-native
+extension: one jitted program containing a **batched prefill** (the
+whole prompt in one causal pass that fills the per-layer KV caches —
+MXU-sized matmuls, not a token loop) followed by a ``lax.scan`` over
+decode steps at static shapes, with the caches (``[B, H, T_max, Dh]``)
+updated in place via ``lax.dynamic_update_slice``.  No Python-level
+loop over tokens, no recompilation per length.
+
+Built from the model's OWN parameter tree and modules (the
+parallel/pipeline.py pattern): LN/MLP sublayers run through their
+module ``apply_fn``; attention re-derives the q/k/v/o projections from
+the MultiHeadAttention parameter names (wq/wk/wv/wo + biases) because
+cached decode attention is a different computation from the module's
+full-sequence forward.  Greedy decode is pinned against the full dense
+forward by a teacher-forcing oracle in tests/test_generate.py, which
+keeps the two implementations from drifting.
+
+MoE models decode through a capacity-FREE gather dispatch (each token
+simply uses its argmax expert): at inference nothing should be
+dropped — training-time capacity drops are a static-shape batching
+artifact, not part of the learned function.  The teacher-forcing
+equivalence with the training forward therefore holds whenever the
+training forward's capacity does not bind.
+
+Sampling: ``temperature=0`` → greedy argmax; ``temperature>0`` →
+categorical over ``logits/temperature`` (optionally ``top_k``) and
+REQUIRES an explicit ``rng`` key — a silent fixed-seed default would
+return the identical "sample" every call.
+"""
+from __future__ import annotations
+
+import weakref
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+# compiled generators per model instance (weak: dies with the model),
+# keyed by build config.  NOT stored on the module itself — a jitted
+# closure attribute would break the pickle-based checkpoint verbs.
+_GEN_CACHE = weakref.WeakKeyDictionary()
+
+
+def _check_model(model):
+    from .transformer import TransformerLM
+
+    if not isinstance(model, TransformerLM):
+        raise TypeError(
+            f"generation supports TransformerLM (got "
+            f"{type(model).__name__})")
+    if model.seq_strategy in ("ring", "ulysses"):
+        raise ValueError(
+            "generation runs single-shard attention; build the model "
+            "with a dense/flash seq_strategy for decode")
+    return 1, len(model.modules) - 3
+
+
+def _proj(x, params, w, b, with_bias):
+    y = jnp.dot(x, params[w].T)
+    return y + params[b] if with_bias else y
+
+
+def _moe_ffn_nodrop(moe, params, x):
+    """Capacity-free top-1 dispatch for decode: gather each token's
+    argmax expert weights and apply its MLP.  [B, Tq, D] -> [B, Tq, D].
+    (Prefill materializes [N, D, H] gathered weights — fine for decode
+    windows; very long prompts on tiny-HBM chips may prefer the
+    training dispatch.)"""
+    B, Tq, D = x.shape
+    x2 = x.reshape(B * Tq, D)
+    logits = jnp.dot(x2, params["router_w"].T) + params["router_b"]
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    idx = jnp.argmax(probs, axis=-1)
+    gate = jnp.max(probs, axis=-1).astype(x.dtype)
+    wi, bi = params["wi"][idx], params["bi"][idx]      # [N, D, H], [N, H]
+    wo, bo = params["wo"][idx], params["bo"][idx]      # [N, H, D], [N, D]
+    h = jax.nn.gelu(jnp.einsum("nd,ndh->nh", x2, wi.astype(x.dtype))
+                    + bi.astype(x.dtype))
+    y = jnp.einsum("nh,nhd->nd", h, wo.astype(x.dtype)) + bo.astype(
+        x.dtype)
+    return (gate[:, None] * y).reshape(B, Tq, D)
+
+
+def make_generate(model, max_len: Optional[int] = None,
+                  compute_dtype=None):
+    """Build ``generate(params, prompt_ids, max_new, rng=None,
+    temperature=0.0, top_k=0) -> [B, prompt+max_new] ids``.
+
+    ``params`` is ``model.param_tree()`` (1-based token ids, like the
+    training path).  ``max_len`` bounds prompt+generated (default: the
+    model's positional table length).  One compiled program per
+    (prompt_shape, max_new, top_k); the decode loop itself is a scan —
+    no per-token dispatch.
+    """
+    from ..optim.optimizer import _cast_floats
+    from ..parallel.moe import MoEFFN
+
+    first, count = _check_model(model)
+    blocks = model.modules[first:first + count]
+    ln_f = model.modules[first + count]
+    head = model.modules[first + count + 1]
+    embed = model.modules[0]
+    T_max = int(max_len or model.max_len)
+    mha0 = blocks[0].modules[1]
+    H, Dh = mha0.num_heads, mha0.head_dim
+
+    def _split(x, B):
+        return x.reshape(B, -1, H, Dh).transpose(0, 2, 1, 3)
+
+    def _attend(q, k_cache, v_cache, pos):
+        """Causal attention of Tq queries (absolute positions
+        pos..pos+Tq-1) against the cache."""
+        Tq, Tm = q.shape[2], k_cache.shape[2]
+        scale = 1.0 / jnp.sqrt(jnp.float32(Dh)).astype(q.dtype)
+        scores = jnp.einsum("bhqd,bhkd->bhqk", q, k_cache) * scale
+        qpos = pos + jnp.arange(Tq)
+        mask = jnp.arange(Tm)[None, :] <= qpos[:, None]   # [Tq, Tm]
+        scores = jnp.where(mask[None, None], scores, -jnp.inf)
+        probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1)
+        return jnp.einsum("bhqk,bhkd->bhqd", probs.astype(q.dtype),
+                          v_cache)
+
+    def _block_step(block, bp, h, k_cache, v_cache, pos):
+        """One block on Tq tokens (prefill: Tq=T0 at pos 0; decode:
+        Tq=1) against the caches; returns (h, k_cache, v_cache)."""
+        mha = block.modules[1]
+        B = h.shape[0]
+        ln1, _ = block.modules[0].apply_fn(bp["0"], {}, h, False, None)
+        ap = bp["1"]
+        q = _split(_proj(ln1, ap, "wq", "bq", mha.with_bias), B)
+        k = _split(_proj(ln1, ap, "wk", "bk", mha.with_bias), B)
+        v = _split(_proj(ln1, ap, "wv", "bv", mha.with_bias), B)
+        k_cache = lax.dynamic_update_slice(k_cache, k, (0, 0, pos, 0))
+        v_cache = lax.dynamic_update_slice(v_cache, v, (0, 0, pos, 0))
+        o = _attend(q, k_cache, v_cache, pos)
+        o = o.transpose(0, 2, 1, 3).reshape(B, o.shape[2], H * Dh)
+        h = h + _proj(o, ap, "wo", "bo", mha.with_bias)
+        ln2, _ = block.modules[2].apply_fn(bp["2"], {}, h, False, None)
+        if block.is_moe:
+            ffn = _moe_ffn_nodrop(block.modules[3], bp["3"], ln2)
+        else:
+            mid, _ = block.modules[3].apply_fn(bp["3"], {}, ln2, False,
+                                               None)
+            out, _ = block.modules[4].apply_fn(bp["4"], {},
+                                               jax.nn.gelu(mid), False,
+                                               None)
+            ffn = out
+        return h + ffn, k_cache, v_cache
+
+    def _logits_last(p, h):
+        """Head on the LAST position of h only."""
+        h = h[:, -1:, :]
+        h, _ = ln_f.apply_fn(p[str(first + count)], {}, h, False, None)
+        h, _ = head.apply_fn(p[str(first + count + 1)], {}, h, False,
+                             None)
+        return h[:, 0, :].astype(jnp.float32)  # [B, V]
+
+    def _sample(logits, temperature, top_k, key):
+        greedy = jnp.argmax(logits, axis=-1)
+        if top_k:
+            kth = jnp.sort(logits, axis=-1)[:, -top_k][:, None]
+            logits = jnp.where(logits < kth, -jnp.inf, logits)
+        sampled = jax.random.categorical(
+            key, logits / jnp.maximum(temperature, 1e-6), axis=-1)
+        return jnp.where(temperature > 0, sampled, greedy)
+
+    @partial(jax.jit, static_argnums=(2, 5))
+    def _run(p, prompt, max_new, key, temperature, top_k):
+        pc = _cast_floats(p, compute_dtype) if compute_dtype else p
+        B, T0 = prompt.shape
+        if T0 + max_new > T_max:
+            raise ValueError(
+                f"prompt {T0} + max_new {max_new} exceeds max_len {T_max}")
+        dt = (compute_dtype
+              or jax.tree_util.tree_leaves(pc)[0].dtype)
+        pos_table = pc["pos"]
+
+        # ---- batched prefill: the whole prompt in one causal pass ----
+        h, _ = embed.apply_fn(pc["0"], {}, prompt, False, None)
+        h = h + lax.dynamic_slice_in_dim(pos_table, 0, T0)
+        caches = []
+        for bi, block in enumerate(blocks):
+            kc = jnp.zeros((B, H, T_max, Dh), dt)
+            vc = jnp.zeros((B, H, T_max, Dh), dt)
+            h, kc, vc = _block_step(block, pc[str(first + bi)], h, kc,
+                                    vc, 0)
+            caches.append((kc, vc))
+        key, sub = jax.random.split(key)
+        nxt = (_sample(_logits_last(pc, h), temperature, top_k, sub)
+               + 1)  # 1-based ids
+        ids = jnp.zeros((B, T0 + max_new), prompt.dtype)
+        ids = lax.dynamic_update_slice(ids, prompt, (0, 0))
+        ids = lax.dynamic_update_slice(ids, nxt[:, None].astype(
+            ids.dtype), (0, T0))
+
+        # ---- decode loop: one token per scan step ----
+        def one_token(carry, _):
+            caches, ids, pos, key = carry
+            tok = lax.dynamic_slice(ids, (0, pos), (B, 1))
+            h, _ = embed.apply_fn(pc["0"], {}, tok, False, None)
+            h = h + lax.dynamic_slice_in_dim(pos_table, pos, 1)
+            new_caches = []
+            for bi, block in enumerate(blocks):
+                h, kc, vc = _block_step(block, pc[str(first + bi)], h,
+                                        caches[bi][0], caches[bi][1],
+                                        pos)
+                new_caches.append((kc, vc))
+            key, sub = jax.random.split(key)
+            nxt = (_sample(_logits_last(pc, h), temperature, top_k, sub)
+                   + 1)
+            ids = lax.dynamic_update_slice(
+                ids, nxt[:, None].astype(ids.dtype), (0, pos + 1))
+            return (new_caches, ids, pos + 1, key), None
+
+        if max_new > 1:
+            (caches, ids, _, _), _ = lax.scan(
+                one_token, (caches, ids, T0, key), None,
+                length=max_new - 1)
+        return ids
+
+    def generate(params, prompt_ids, max_new: int, rng=None,
+                 temperature: float = 0.0, top_k: int = 0):
+        if temperature > 0 and rng is None:
+            raise ValueError(
+                "temperature > 0 requires an explicit rng key "
+                "(jax.random.PRNGKey) — a fixed default would return "
+                "the identical sample every call")
+        key = rng if rng is not None else jax.random.PRNGKey(0)
+        return _run(params, jnp.asarray(prompt_ids, jnp.int32),
+                    int(max_new), key, jnp.float32(temperature),
+                    int(top_k))
+
+    return generate
+
+
+def cached_generate(model, compute_dtype=None):
+    """The per-model compiled generator (built once per
+    (max_len, compute_dtype) config, weakly cached)."""
+    cfg = (model.max_len, compute_dtype)
+    slot = _GEN_CACHE.setdefault(model, {})
+    if cfg not in slot:
+        slot[cfg] = make_generate(model, compute_dtype=compute_dtype)
+    return slot[cfg]

@@ -1,0 +1,118 @@
+"""Autoregressive generation (models/generate.py): the KV-cache decode
+loop is pinned against the full dense forward by teacher forcing —
+every greedily decoded token must equal the argmax of the model's
+full-sequence output at the previous position.  Beyond reference
+parity (the reference predates autoregressive LMs, SURVEY §5.7)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from bigdl_tpu import nn  # noqa: F401 — registry
+from bigdl_tpu.models.generate import make_generate
+from bigdl_tpu.models.transformer import TransformerLM
+from bigdl_tpu.utils.rng import RNG
+
+VOCAB, EMBED, HEADS, MLP, LAYERS, TMAX = 23, 16, 2, 32, 2, 24
+
+
+def _model(**kw):
+    RNG().set_seed(4)
+    return TransformerLM(VOCAB, embed_dim=EMBED, num_heads=HEADS,
+                         mlp_dim=MLP, num_layers=LAYERS, max_len=TMAX,
+                         **kw)
+
+
+def _teacher_force_check(model, ids, prompt_len):
+    """ids[:, t] for t >= prompt_len must equal 1 + argmax of the full
+    forward's log-probs at position t-1."""
+    out, _ = model.apply_fn(model.param_tree(), model.buffer_tree(),
+                            jnp.asarray(ids), False, None)
+    pred = 1 + np.argmax(np.asarray(out), axis=-1)  # 1-based ids
+    ids = np.asarray(ids)
+    np.testing.assert_array_equal(ids[:, prompt_len:],
+                                  pred[:, prompt_len - 1:-1])
+
+
+@pytest.mark.parametrize("kw", [{}, {"seq_strategy": "flash"},
+                                {"moe_experts": 4,
+                                 "moe_capacity_factor": 8.0}])
+def test_greedy_decode_matches_dense_forward(kw):
+    model = _model(**kw)
+    gen = make_generate(model)
+    rng = np.random.RandomState(0)
+    prompt = rng.randint(1, VOCAB + 1, (2, 5)).astype(np.int32)
+    ids = gen(model.param_tree(), prompt, max_new=7)
+    assert ids.shape == (2, 12)
+    np.testing.assert_array_equal(np.asarray(ids)[:, :5], prompt)
+    assert np.asarray(ids).min() >= 1 and np.asarray(ids).max() <= VOCAB
+    _teacher_force_check(model, ids, prompt_len=5)
+
+
+def test_moe_decode_batch_rows_independent():
+    """Decode uses the capacity-FREE dispatch: batch rows can never
+    interfere (a capacity-bound dispatch would let one row's tokens
+    evict another's expert slots).  Default tight capacity on purpose."""
+    model = _model(moe_experts=2)  # default capacity_factor 1.25
+    rng = np.random.RandomState(3)
+    prompts = rng.randint(1, VOCAB + 1, (2, 4)).astype(np.int32)
+    both = np.asarray(model.generate(prompts, max_new=6))
+    for b in range(2):
+        alone = np.asarray(model.generate(prompts[b:b + 1], max_new=6))
+        np.testing.assert_array_equal(both[b], alone[0])
+
+
+def test_sampling_without_rng_raises():
+    model = _model()
+    with pytest.raises(ValueError, match="rng"):
+        model.generate(np.ones((1, 2), np.int32), max_new=2,
+                       temperature=1.0)
+
+
+def test_sampled_decode_valid_and_seeded():
+    model = _model()
+    gen = make_generate(model)
+    rng = np.random.RandomState(1)
+    prompt = rng.randint(1, VOCAB + 1, (3, 4)).astype(np.int32)
+    a = gen(model.param_tree(), prompt, max_new=6,
+            rng=jax.random.PRNGKey(7), temperature=1.0, top_k=5)
+    b = gen(model.param_tree(), prompt, max_new=6,
+            rng=jax.random.PRNGKey(7), temperature=1.0, top_k=5)
+    c = gen(model.param_tree(), prompt, max_new=6,
+            rng=jax.random.PRNGKey(8), temperature=1.0, top_k=5)
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    assert not np.array_equal(np.asarray(a), np.asarray(c))
+    arr = np.asarray(a)
+    assert arr.min() >= 1 and arr.max() <= VOCAB
+
+
+def test_model_generate_method_and_checkpoint_after(tmp_path):
+    """The convenience method decodes greedily, and the model still
+    pickles through the save verb afterwards (no jitted closure stuck
+    on the instance)."""
+    model = _model()
+    rng = np.random.RandomState(2)
+    prompt = rng.randint(1, VOCAB + 1, (1, 3)).astype(np.int32)
+    ids = model.generate(prompt, max_new=5)
+    assert ids.shape == (1, 8)
+    _teacher_force_check(model, ids, prompt_len=3)
+    from bigdl_tpu.api import load_bigdl
+
+    model.save(str(tmp_path / "lm.bigdl"), overwrite=True)
+    restored = load_bigdl(str(tmp_path / "lm.bigdl"))
+    ids2 = restored.generate(prompt, max_new=5)
+    np.testing.assert_array_equal(np.asarray(ids), np.asarray(ids2))
+
+
+def test_generate_rejects_overflow_and_ring():
+    model = _model()
+    gen = make_generate(model)
+    prompt = np.ones((1, 20), np.int32)
+    with pytest.raises(ValueError, match="max_len"):
+        gen(model.param_tree(), prompt, max_new=10)
+    RNG().set_seed(4)
+    ring = TransformerLM(VOCAB, embed_dim=EMBED, num_heads=HEADS,
+                         mlp_dim=MLP, num_layers=2, max_len=TMAX,
+                         seq_strategy="ring")
+    with pytest.raises(ValueError, match="dense/flash"):
+        make_generate(ring)
