@@ -569,6 +569,39 @@ def run_worker(backend: str) -> None:
                     f"{type(e).__name__}: {e}"[:300]
         flush("moe_transformerlm")
 
+        # KV-cache decode throughput (round-4 generation path): batched
+        # prefill + scan decode, the standard serving metric
+        if over_budget(0.95):
+            out["decode_skipped"] = "worker time budget"
+        else:
+            try:
+                from bigdl_tpu.models.generate import make_generate
+                from bigdl_tpu.models.transformer import TransformerLM
+                from bigdl_tpu.utils.rng import set_global_seed
+
+                set_global_seed(42)
+                V, D, L, B, T0, NEW = 32000, 1024, 8, 8, 128, 128
+                glm = TransformerLM(V, embed_dim=D, num_heads=8,
+                                    num_layers=L, max_len=T0 + NEW,
+                                    output="logits")
+                gen = make_generate(glm, compute_dtype=jnp.bfloat16)
+                gp = glm.param_tree()
+                prompt = rng.randint(1, V, (B, T0)).astype("int32")
+                ids = gen(gp, prompt, NEW)
+                _ = int(jax.device_get(ids)[0, -1])  # compile+barrier
+                t0 = time.time()
+                reps = 3
+                for _ in range(reps):
+                    ids = gen(gp, prompt, NEW)
+                _ = int(jax.device_get(ids)[0, -1])
+                dt = time.time() - t0
+                out["decode_tokens_per_sec"] = round(
+                    B * NEW * reps / dt, 1)
+                out["decode_config"] = f"B{B} prompt{T0} new{NEW} D{D} L{L}"
+            except Exception as e:
+                out["decode_error"] = f"{type(e).__name__}: {e}"[:300]
+        flush("decode")
+
     # --- SimpleRNN: the reference's published workload (batch 12) -------
     try:
         V, H, T, B = 4001, 40, 25, 12
