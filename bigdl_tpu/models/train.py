@@ -261,10 +261,10 @@ def main(argv=None):
     if args.moe_experts and args.model != "transformer":
         parser.error("--moe-experts supports --model transformer")
     if args.moe_experts and (args.tensor_parallel > 1
-                             or args.seq_parallel > 1
                              or args.pipeline_parallel > 1):
-        parser.error("--moe-experts composes with data parallelism only "
-                     "(expert parallelism rides the data axis)")
+        parser.error("--moe-experts composes with data and sequence "
+                     "parallelism (expert parallelism rides the data "
+                     "axis), not --tensor-parallel/--pipeline-parallel")
 
     from ..utils.engine import Engine as _Engine
 

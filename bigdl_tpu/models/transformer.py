@@ -58,7 +58,13 @@ class TransformerBlock(Container):
             mods.append(MoEFFN(embed_dim, mlp_dim, moe_experts,
                                capacity_factor=moe_capacity_factor,
                                axis_name=moe_axis,
-                               aux_loss_coef=moe_aux_coef))
+                               aux_loss_coef=moe_aux_coef,
+                               # under sequence parallelism the tokens
+                               # are seq-sharded too: aux routing stats
+                               # must pmean over that axis as well
+                               stat_axes=((seq_axis,) if seq_strategy
+                                          in ("ring", "ulysses")
+                                          and seq_axis else ())))
         else:
             mods += [ColumnParallelLinear(embed_dim, mlp_dim,
                                           axis_name=model_axis),

@@ -70,7 +70,8 @@ class MoEFFN(TensorModule):
     def __init__(self, embed_dim: int, hidden_dim: int, n_experts: int,
                  capacity_factor: float = 1.25, jitter: float = 0.0,
                  axis_name: Optional[str] = None,
-                 aux_loss_coef: float = 0.0):
+                 aux_loss_coef: float = 0.0,
+                 stat_axes: tuple = ()):
         super().__init__()
         if n_experts < 1:
             raise ValueError(f"n_experts must be >= 1, got {n_experts}")
@@ -81,6 +82,12 @@ class MoEFFN(TensorModule):
         self.jitter = float(jitter)
         self.axis_name = axis_name
         self.aux_loss_coef = float(aux_loss_coef)
+        # extra mesh axes the tokens are sharded over beyond axis_name
+        # (e.g. a 'seq' axis): routing statistics for the aux loss are
+        # pmean'd over them too, so the term stays the GLOBAL formula
+        if isinstance(stat_axes, str):  # tuple("seq") == ('s','e','q')
+            stat_axes = (stat_axes,)
+        self.stat_axes = tuple(stat_axes)
         self.reset()
 
     def reset(self):
@@ -144,10 +151,12 @@ class MoEFFN(TensorModule):
         # from the dense twin (product of global means).
         f_vec = jnp.mean(onehot, axis=0)
         p_vec = jnp.mean(probs, axis=0)
-        if self.axis_name is not None:
+        for ax in (self.axis_name,) + self.stat_axes:
+            if ax is None:
+                continue
             try:
-                f_vec = lax.pmean(f_vec, self.axis_name)
-                p_vec = lax.pmean(p_vec, self.axis_name)
+                f_vec = lax.pmean(f_vec, ax)
+                p_vec = lax.pmean(p_vec, ax)
             except NameError:  # axis not bound: eager/unsharded call
                 pass
         aux = self.n_experts * jnp.sum(f_vec * p_vec)

@@ -79,7 +79,8 @@ def _resolve_axes(mesh, data_axis, seq_axis, model_axis):
 def _check_moe(model, mesh, data_axis, seq_axis):
     """Expert-parallel constraints, validated loudly at build time:
     every bound ``MoEFFN`` must ride the mesh's token-sharding (data)
-    axis, and MoE does not compose with sequence parallelism yet."""
+    axis; on a >1 seq mesh the layer must carry the seq axis in
+    ``stat_axes`` so its aux-loss routing statistics stay global."""
     from .moe import MoEFFN
 
     moe = [m for m in model.modules_iter()
@@ -102,11 +103,13 @@ def _check_moe(model, mesh, data_axis, seq_axis):
             raise ValueError(
                 f"n_experts {m.n_experts} not divisible by the "
                 f"{m.axis_name!r} axis size {mesh.shape[m.axis_name]}")
-    if seq_axis is not None and mesh.shape[seq_axis] > 1:
-        raise ValueError(
-            "MoE + sequence parallelism is not supported yet: expert "
-            "dispatch would only mix tokens within one seq shard; use a "
-            "mesh without a >1 seq axis")
+        if (seq_axis is not None and mesh.shape[seq_axis] > 1
+                and seq_axis not in m.stat_axes):
+            raise ValueError(
+                f"MoE on a >1 {seq_axis!r} mesh needs the seq axis in "
+                f"MoEFFN.stat_axes (got {m.stat_axes}) so the aux-loss "
+                "routing statistics stay global — TransformerLM wires "
+                "this automatically when built with a seq strategy")
 
 
 def _in_spec_fn(data_axis, seq_axis, input_seq_dim):
@@ -225,11 +228,16 @@ def make_train_step(model, criterion, optim, mesh,
             if _spec_has(spec, data_axis):
                 # expert-parallel params (MoE stacks ride the data
                 # axis): the all_to_all transpose already accumulated
-                # every shard's token contributions — the grad of the
-                # SUM of local losses.  No pmean over data (each shard
-                # holds different experts); mean-convention divide only.
+                # every data shard's token contributions — the grad of
+                # the SUM of local losses.  No pmean over data (each
+                # shard holds different experts); mean-convention
+                # divide only.  Seq copies each saw a DIFFERENT token
+                # slice whose loss terms carry 1/n_seq weight in the
+                # pmean'd loss — pmean over seq composes the slices.
                 if not masked:
                     g = g / n_data
+                if seq_axis:
+                    g = lax.pmean(g, seq_axis)
                 return lax.pmean(g, model_axis) if model_axis else g
             sharded = _spec_sharded(spec)
             if masked:

@@ -343,6 +343,112 @@ def test_aux_loss_local_optimizer_smoke():
     assert np.isfinite(opt.optim_method.state["loss"])
 
 
+def _long_lm(moe_axis, seq_strategy="dense", seed=17, aux=0.3):
+    RNG().set_seed(seed)
+    return TransformerLM(17, embed_dim=D, num_heads=2, mlp_dim=H,
+                         num_layers=2, max_len=8, moe_experts=E,
+                         moe_axis=moe_axis, moe_capacity_factor=8.0,
+                         moe_aux_coef=aux, seq_strategy=seq_strategy)
+
+
+def test_moe_seq_parallel_matches_dense_twin():
+    """EP x SP (long-context MoE): ring attention over the seq axis +
+    expert dispatch over the data axis; loss and every updated param
+    must match the dense single-device twin (incl. the aux term, whose
+    statistics pmean over BOTH axes)."""
+    from bigdl_tpu.parallel.moe import aux_loss_term, collect_aux_paths
+    from bigdl_tpu.parallel.spmd import make_train_step
+
+    mesh = Mesh(np.array(jax.devices()[:4]).reshape(2, 2),
+                ("data", "seq"))
+    # sizeAverage=True: the seq-axis pmean convention needs a time-MEAN
+    # criterion (a time-sum would halve per shard)
+    crit = nn.TimeDistributedCriterion(nn.ClassNLLCriterion(), True)
+    lr = 0.2
+    r = np.random.RandomState(5)
+    x = r.randint(1, 18, (4, 8)).astype(np.int32)
+    y = r.randint(1, 18, (4, 8)).astype(np.float32)
+
+    dense = _long_lm(None)
+
+    def loss_fn(pp):
+        out, nb = dense.apply_fn(pp, dense.buffer_tree(), jnp.asarray(x),
+                                 True, None)
+        return (crit._loss(out, jnp.asarray(y))
+                + aux_loss_term(nb, list(collect_aux_paths(dense))))
+
+    p0 = dense.param_tree()
+    loss_ref, grads_ref = jax.value_and_grad(loss_fn)(p0)
+    sgd = SGD(learning_rate=lr)
+    params_ref, _ = sgd.step(grads_ref, p0, sgd.init_state(p0), lr)
+
+    ep = _long_lm("data", seq_strategy="ring")
+    for a, b in zip(jax.tree_util.tree_leaves(p0),
+                    jax.tree_util.tree_leaves(ep.param_tree())):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    sgd2 = SGD(learning_rate=lr)
+    step = make_train_step(ep, crit, sgd2, mesh)
+    params = ep.param_tree()
+    loss, params, _, _ = step(params, sgd2.init_state(params),
+                              ep.buffer_tree(), lr, x, y)
+    assert abs(float(loss) - float(loss_ref)) < 2e-5
+    flat = dict(jax.tree_util.tree_leaves_with_path(params_ref))
+    for path, leaf in jax.tree_util.tree_leaves_with_path(
+            jax.device_get(params)):
+        np.testing.assert_allclose(np.asarray(leaf),
+                                   np.asarray(flat[path]), atol=3e-5,
+                                   err_msg=jax.tree_util.keystr(path))
+
+
+def test_moe_seq_parallel_masked_matches_dense_twin():
+    """EP x SP with a trailing partial batch: pad-and-mask trains
+    exactly the real records (expert grads take pmean(seq), no data
+    correction)."""
+    from bigdl_tpu.parallel.moe import aux_loss_term, collect_aux_paths
+    from bigdl_tpu.parallel.spmd import make_train_step
+
+    mesh = Mesh(np.array(jax.devices()[:4]).reshape(2, 2),
+                ("data", "seq"))
+    # sizeAverage=True: the seq-axis pmean convention needs a time-MEAN
+    # criterion (a time-sum would halve per shard)
+    crit = nn.TimeDistributedCriterion(nn.ClassNLLCriterion(), True)
+    lr = 0.2
+    r = np.random.RandomState(6)
+    x = r.randint(1, 18, (3, 8)).astype(np.int32)
+    y = r.randint(1, 18, (3, 8)).astype(np.float32)
+
+    dense = _long_lm(None, aux=0.0)
+
+    def loss_fn(pp):
+        out, _ = dense.apply_fn(pp, dense.buffer_tree(), jnp.asarray(x),
+                                True, None)
+        return crit._loss(out, jnp.asarray(y))
+
+    p0 = dense.param_tree()
+    loss_ref, grads_ref = jax.value_and_grad(loss_fn)(p0)
+    sgd = SGD(learning_rate=lr)
+    params_ref, _ = sgd.step(grads_ref, p0, sgd.init_state(p0), lr)
+
+    ep = _long_lm("data", seq_strategy="ring", aux=0.0)
+    sgd2 = SGD(learning_rate=lr)
+    step = make_train_step(ep, crit, sgd2, mesh)
+    pad = 4 - 3
+    xp = np.concatenate([x, np.ones((pad, 8), x.dtype)])
+    yp = np.concatenate([y, np.ones((pad, 8), y.dtype)])
+    w = np.array([1.0] * 3 + [0.0] * pad, np.float32)
+    params = ep.param_tree()
+    loss, params, _, _ = step(params, sgd2.init_state(params),
+                              ep.buffer_tree(), lr, xp, yp, w=w,
+                              total_w=3.0)
+    assert abs(float(loss) - float(loss_ref)) < 2e-5
+    flat = dict(jax.tree_util.tree_leaves_with_path(params_ref))
+    for path, leaf in jax.tree_util.tree_leaves_with_path(
+            jax.device_get(params)):
+        np.testing.assert_allclose(np.asarray(leaf),
+                                   np.asarray(flat[path]), atol=3e-5,
+                                   err_msg=jax.tree_util.keystr(path))
+
+
 def test_block_rejects_moe_plus_model_axis():
     with pytest.raises(ValueError, match="model_axis=None"):
         TransformerLM(17, embed_dim=D, num_heads=2, mlp_dim=H,
@@ -358,10 +464,10 @@ def test_moe_guards():
     mesh1 = Mesh(np.array(jax.devices()[:4]), ("data",))
     with pytest.raises(ValueError, match="does not have"):
         make_train_step(_lm("expert"), crit, SGD(), mesh1)
-    # MoE + seq parallelism rejected
+    # MoE on a seq mesh without seq-aware routing stats rejected
     mesh2 = Mesh(np.array(jax.devices()[:4]).reshape(2, 2),
                  ("data", "seq"))
-    with pytest.raises(ValueError, match="sequence parallelism"):
+    with pytest.raises(ValueError, match="stat_axes"):
         make_train_step(_lm("data"), crit, SGD(), mesh2)
     # experts must divide the axis
     mesh3 = Mesh(np.array(jax.devices()[:8]), ("data",))
