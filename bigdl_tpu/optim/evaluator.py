@@ -49,8 +49,25 @@ def _cached_eval_fwd(model, mesh: Optional[Mesh]):
         return out
 
     if mesh is not None:
+        # an expert-parallel model's MoE stacks must arrive sharded
+        # over the data axis (the bound all_to_all expects E/n local
+        # experts); everything else replicates as before
+        from ..parallel.moe import MoEFFN
+
+        if any(isinstance(m, MoEFFN) and m.axis_name
+               for m in model.modules_iter()):
+            from ..parallel.spmd import _check_moe, param_specs
+
+            _check_moe(model, mesh, "data", None)
+            # model_axis=None: this mesh is data-only, so any bound TP
+            # layer degrades to replicated specs (matching its forward's
+            # unbound-axis NameError degrade) instead of referencing a
+            # nonexistent 'model' axis
+            pspec = param_specs(model, None)
+        else:
+            pspec = P()
         fwd = jax.jit(shard_map(fwd_local, mesh=mesh,
-                                in_specs=(P(), P(), P("data")),
+                                in_specs=(pspec, P(), P("data")),
                                 out_specs=P("data")))
     else:
         fwd = jax.jit(fwd_local)

@@ -449,6 +449,27 @@ def test_moe_seq_parallel_masked_matches_dense_twin():
                                    err_msg=jax.tree_util.keystr(path))
 
 
+def test_predictor_handles_ep_model():
+    """The standalone sharded Predictor shards the expert stacks over
+    the data axis (a replicated spec would feed full [E,...] weights to
+    the bound all_to_all); outputs match the dense local twin."""
+    from bigdl_tpu.dataset.dataset import array
+    from bigdl_tpu.dataset.sample import Sample
+    from bigdl_tpu.optim.predictor import LocalPredictor, Predictor
+
+    mesh = Mesh(np.array(jax.devices()[:4]), ("data",))
+    ep = _lm("data")
+    dense = _lm(None)
+    x, _ = _lm_batch(8, seed=4)
+    samples = [Sample(r, np.float32(1)) for r in x]
+    got = Predictor(ep, mesh).predict(array(samples), batch_size=4)
+    want = LocalPredictor(dense).predict(array(samples), batch_size=4)
+    assert len(got) == len(want) == 8
+    for g, w in zip(got, want):
+        np.testing.assert_allclose(np.asarray(g), np.asarray(w),
+                                   atol=2e-5)
+
+
 def test_block_rejects_moe_plus_model_axis():
     with pytest.raises(ValueError, match="model_axis=None"):
         TransformerLM(17, embed_dim=D, num_heads=2, mlp_dim=H,
