@@ -1,4 +1,6 @@
-"""Model interop: Caffe / TensorFlow GraphDef / Torch .t7 loaders and
-savers (reference utils/caffe/*, utils/tf/*, utils/TorchFile.scala)."""
+"""Model interop: Caffe / TensorFlow GraphDef / Torch .t7 / Hugging
+Face GPT-2 loaders and savers (reference utils/caffe/*, utils/tf/*,
+utils/TorchFile.scala; HF is the modern-family extension)."""
 from .caffe import CaffeLoader, CaffePersister
+from .huggingface import load_gpt2
 from .tensorflow import TensorflowLoader, TensorflowSaver

@@ -1,0 +1,107 @@
+"""Hugging Face GPT-2 interop — load transformer weights into
+TransformerLM.
+
+The rebuild's flagship block IS the GPT-2 block (pre-norm LN→attention
+→residual, LN→gelu-MLP→residual, learned positions, final LN, tied
+head), so a GPT-2 checkpoint maps onto :class:`TransformerLM`
+parameter-for-parameter.  This gives the modern model family the same
+external-artifact interop story the Caffe/TF loaders give the classic
+zoo (reference utils/caffe/CaffeLoader.scala:47, utils/tf/
+TensorflowLoader.scala:38) — weights produced by ANOTHER framework,
+verified against that framework's own forward (tests/test_huggingface.py
+pins our logits against the torch GPT-2 forward).
+
+Mapping notes:
+
+* HF Conv1D stores ``y = x @ W + b`` with ``W [in, out]``; our Linear
+  computes ``y = x @ W.T`` with ``W [out, in]`` — every weight
+  transposes.
+* ``c_attn`` packs q/k/v as one ``[E, 3E]``; split column-wise.
+* Token ids here are 1-based (LookupTable gathers ``id - 1``), so feed
+  ``hf_ids + 1``; the embedding rows copy verbatim.
+* ``gelu_new`` (tanh approximation) is exactly ``jax.nn.gelu``'s
+  default.
+* The LM head ties ``wte``; our head Linear gets the tied matrix and a
+  zero bias.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+
+def _t(a):
+    return np.ascontiguousarray(np.asarray(a).T)
+
+
+def load_gpt2(hf_model):
+    """Build a :class:`TransformerLM` carrying the weights of a
+    ``transformers`` GPT-2 model (``GPT2LMHeadModel`` or ``GPT2Model``).
+
+    Returns the model in eval mode with ``output="logits"`` — its
+    forward matches ``hf_model(input_ids).logits`` on ``input_ids + 1``
+    (1-based ids).
+    """
+    import jax.numpy as jnp
+
+    from ..models.transformer import TransformerLM
+
+    cfg = hf_model.config
+    if getattr(cfg, "model_type", "gpt2") != "gpt2":
+        raise ValueError(f"expected a GPT-2 config, got {cfg.model_type!r}")
+    if cfg.activation_function not in ("gelu_new", "gelu_pytorch_tanh"):
+        raise ValueError(
+            f"activation {cfg.activation_function!r} is not the tanh "
+            "gelu TransformerLM computes")
+    # config flags that change the attention math itself must hold the
+    # stock values or the 'matches torch forward' contract breaks
+    for flag, want in (("scale_attn_weights", True),
+                       ("scale_attn_by_inverse_layer_idx", False),
+                       ("reorder_and_upcast_attn", False)):
+        if getattr(cfg, flag, want) != want:
+            raise ValueError(
+                f"GPT2Config.{flag}={getattr(cfg, flag)!r} changes the "
+                f"attention computation; TransformerLM implements the "
+                f"stock {flag}={want} form")
+    base = getattr(hf_model, "transformer", hf_model)
+    sd = {k: v.detach().cpu().numpy() for k, v in base.state_dict().items()}
+    E = cfg.n_embd
+    H = cfg.n_inner or 4 * E
+    L = cfg.n_layer
+
+    lm = TransformerLM(cfg.vocab_size, embed_dim=E, num_heads=cfg.n_head,
+                       mlp_dim=H, num_layers=L,
+                       max_len=cfg.n_positions, output="logits")
+    tree = lm.param_tree()
+    tree["0"] = {"weight": jnp.asarray(sd["wte.weight"])}
+    tree["pos"] = jnp.asarray(sd["wpe.weight"])
+    for i in range(L):
+        p = f"h.{i}."
+        W = sd[p + "attn.c_attn.weight"]          # [E, 3E]
+        b = sd[p + "attn.c_attn.bias"]            # [3E]
+        blk = {
+            "0": {"weight": jnp.asarray(sd[p + "ln_1.weight"]),
+                  "bias": jnp.asarray(sd[p + "ln_1.bias"])},
+            "1": {"wq": jnp.asarray(_t(W[:, :E])),
+                  "wk": jnp.asarray(_t(W[:, E:2 * E])),
+                  "wv": jnp.asarray(_t(W[:, 2 * E:])),
+                  "wo": jnp.asarray(_t(sd[p + "attn.c_proj.weight"])),
+                  "bq": jnp.asarray(b[:E]),
+                  "bk": jnp.asarray(b[E:2 * E]),
+                  "bv": jnp.asarray(b[2 * E:]),
+                  "bo": jnp.asarray(sd[p + "attn.c_proj.bias"])},
+            "2": {"weight": jnp.asarray(sd[p + "ln_2.weight"]),
+                  "bias": jnp.asarray(sd[p + "ln_2.bias"])},
+            "3": {"weight": jnp.asarray(_t(sd[p + "mlp.c_fc.weight"])),
+                  "bias": jnp.asarray(sd[p + "mlp.c_fc.bias"])},
+            "4": {"weight": jnp.asarray(_t(sd[p + "mlp.c_proj.weight"])),
+                  "bias": jnp.asarray(sd[p + "mlp.c_proj.bias"])},
+        }
+        tree[str(1 + i)] = blk
+    tree[str(1 + L)] = {"weight": jnp.asarray(sd["ln_f.weight"]),
+                        "bias": jnp.asarray(sd["ln_f.bias"])}
+    # tied head: wte, zero bias (GPT-2's lm_head has no bias)
+    tree[str(2 + L)] = {"weight": jnp.asarray(sd["wte.weight"]),
+                        "bias": jnp.zeros((cfg.vocab_size,), jnp.float32)}
+    lm.set_param_tree(tree)
+    lm.evaluate()
+    return lm
