@@ -99,9 +99,88 @@ def load_gpt2(hf_model):
         tree[str(1 + i)] = blk
     tree[str(1 + L)] = {"weight": jnp.asarray(sd["ln_f.weight"]),
                         "bias": jnp.asarray(sd["ln_f.bias"])}
-    # tied head: wte, zero bias (GPT-2's lm_head has no bias)
-    tree[str(2 + L)] = {"weight": jnp.asarray(sd["wte.weight"]),
+    # head: the model's own lm_head when present (tied models share the
+    # wte storage, untied exports carry their own); bias-free in GPT-2
+    head_w = (hf_model.lm_head.weight.detach().cpu().numpy()
+              if hasattr(hf_model, "lm_head") else sd["wte.weight"])
+    tree[str(2 + L)] = {"weight": jnp.asarray(head_w),
                         "bias": jnp.zeros((cfg.vocab_size,), jnp.float32)}
     lm.set_param_tree(tree)
     lm.evaluate()
     return lm
+
+
+def save_gpt2(lm):
+    """Inverse of :func:`load_gpt2`: build a ``transformers``
+    ``GPT2LMHeadModel`` carrying this :class:`TransformerLM`'s weights.
+
+    Framework-trained heads are independent (not tied to the
+    embedding), so the exported config sets
+    ``tie_word_embeddings=False`` and fills ``lm_head`` separately.
+    GPT-2's head is bias-free — a nonzero head bias cannot be
+    represented and refuses loudly (zero it, or fold it elsewhere,
+    before export).  Round-trip and torch-forward equivalence are
+    pinned in tests/test_huggingface.py.
+    """
+    import torch
+    from transformers import GPT2Config, GPT2LMHeadModel
+
+    from ..models.transformer import TransformerBlock, TransformerLM
+    from ..parallel.moe import MoEFFN
+
+    if not isinstance(lm, TransformerLM):
+        raise TypeError(f"expected TransformerLM, got {type(lm).__name__}")
+    blocks = [m for m in lm.modules if isinstance(m, TransformerBlock)]
+    if any(isinstance(mm, MoEFFN) for b in blocks for mm in b.modules):
+        raise ValueError("GPT-2 has no MoE blocks; export a dense model")
+    tree = lm.param_tree()
+    L = len(blocks)
+    head = tree[str(1 + L + 1)]
+    if float(np.abs(np.asarray(head["bias"])).max()) > 0:
+        raise ValueError(
+            "GPT-2's lm_head is bias-free; this model's head bias is "
+            "nonzero and cannot be represented — zero it before export")
+    E = lm.embed_dim
+    Hm = blocks[0].modules[3].params["weight"].shape[0]  # mlp hidden
+    cfg = GPT2Config(
+        vocab_size=lm.vocab_size, n_positions=lm.max_len, n_embd=E,
+        n_layer=L, n_head=blocks[0].modules[1].num_heads, n_inner=Hm,
+        attn_pdrop=0.0, embd_pdrop=0.0, resid_pdrop=0.0,
+        tie_word_embeddings=False)
+    hf = GPT2LMHeadModel(cfg).eval()
+    sd = {}
+    t = lambda a: torch.tensor(np.ascontiguousarray(np.asarray(a)))
+    sd["transformer.wte.weight"] = t(tree["0"]["weight"])
+    sd["transformer.wpe.weight"] = t(tree["pos"])
+    for i in range(L):
+        blk = tree[str(1 + i)]
+        p = f"transformer.h.{i}."
+        sd[p + "ln_1.weight"] = t(blk["0"]["weight"])
+        sd[p + "ln_1.bias"] = t(blk["0"]["bias"])
+        ap = blk["1"]
+        W = np.concatenate([_t(ap["wq"]), _t(ap["wk"]), _t(ap["wv"])],
+                           axis=1)                       # [E, 3E]
+        sd[p + "attn.c_attn.weight"] = t(W)
+        sd[p + "attn.c_attn.bias"] = t(np.concatenate(
+            [np.asarray(ap["bq"]), np.asarray(ap["bk"]),
+             np.asarray(ap["bv"])]))
+        sd[p + "attn.c_proj.weight"] = t(_t(ap["wo"]))
+        sd[p + "attn.c_proj.bias"] = t(ap["bo"])
+        sd[p + "ln_2.weight"] = t(blk["2"]["weight"])
+        sd[p + "ln_2.bias"] = t(blk["2"]["bias"])
+        sd[p + "mlp.c_fc.weight"] = t(_t(blk["3"]["weight"]))
+        sd[p + "mlp.c_fc.bias"] = t(blk["3"]["bias"])
+        sd[p + "mlp.c_proj.weight"] = t(_t(blk["4"]["weight"]))
+        sd[p + "mlp.c_proj.bias"] = t(blk["4"]["bias"])
+    sd["transformer.ln_f.weight"] = t(tree[str(1 + L)]["weight"])
+    sd["transformer.ln_f.bias"] = t(tree[str(1 + L)]["bias"])
+    sd["lm_head.weight"] = t(head["weight"])
+    missing, unexpected = hf.load_state_dict(sd, strict=False)
+    # attn.bias/masked_bias are derived causal-mask buffers, not params
+    real_missing = [k for k in missing
+                    if not k.endswith((".attn.bias", ".attn.masked_bias"))]
+    if real_missing or unexpected:
+        raise RuntimeError(
+            f"GPT-2 export mismatch: missing={real_missing} "
+            f"unexpected={unexpected}")
+    return hf

@@ -49,6 +49,58 @@ def test_gpt2_greedy_generation_matches_torch():
     np.testing.assert_array_equal(got, want)
 
 
+def test_save_gpt2_torch_forward_matches_and_roundtrips():
+    """Export: a framework TransformerLM becomes a torch GPT-2 whose
+    forward matches ours; loading it back reproduces the param tree."""
+    import jax.numpy as jnp
+
+    from bigdl_tpu.interop.huggingface import save_gpt2
+    from bigdl_tpu.models.transformer import TransformerLM
+    from bigdl_tpu.utils.rng import RNG
+
+    RNG().set_seed(6)
+    lm = TransformerLM(41, embed_dim=16, num_heads=2, mlp_dim=32,
+                       num_layers=2, max_len=20, output="logits")
+    # GPT-2's head is bias-free: zero ours for an exact export
+    tree = lm.param_tree()
+    tree["4"]["bias"] = jnp.zeros_like(tree["4"]["bias"])
+    lm.set_param_tree(tree)
+
+    hf = save_gpt2(lm)
+    rng = np.random.RandomState(2)
+    ids = rng.randint(0, 41, (2, 7))
+    with torch.no_grad():
+        want = hf(torch.tensor(ids)).logits.numpy()
+    got, _ = lm.apply_fn(lm.param_tree(), lm.buffer_tree(),
+                         np.asarray(ids + 1), False, None)
+    np.testing.assert_allclose(np.asarray(got), want, atol=2e-4)
+
+    back = load_gpt2(hf)
+    import jax
+
+    flat = dict(jax.tree_util.tree_leaves_with_path(lm.param_tree()))
+    for path, leaf in jax.tree_util.tree_leaves_with_path(
+            back.param_tree()):
+        np.testing.assert_allclose(np.asarray(leaf),
+                                   np.asarray(flat[path]), atol=1e-6,
+                                   err_msg=jax.tree_util.keystr(path))
+
+
+def test_save_gpt2_refuses_nonzero_head_bias():
+    from bigdl_tpu.interop.huggingface import save_gpt2
+    from bigdl_tpu.models.transformer import TransformerLM
+    from bigdl_tpu.utils.rng import RNG
+
+    RNG().set_seed(6)
+    lm = TransformerLM(11, embed_dim=8, num_heads=2, mlp_dim=16,
+                       num_layers=1, max_len=8)
+    tree = lm.param_tree()
+    tree["3"]["bias"] = np.ones_like(np.asarray(tree["3"]["bias"]))
+    lm.set_param_tree(tree)
+    with pytest.raises(ValueError, match="bias-free"):
+        save_gpt2(lm)
+
+
 def test_gpt2_rejects_wrong_activation():
     cfg = transformers.GPT2Config(vocab_size=20, n_positions=8, n_embd=8,
                                   n_layer=1, n_head=1,
