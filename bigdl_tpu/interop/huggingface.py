@@ -126,13 +126,17 @@ def save_gpt2(lm):
     from transformers import GPT2Config, GPT2LMHeadModel
 
     from ..models.transformer import TransformerBlock, TransformerLM
-    from ..parallel.moe import MoEFFN
 
     if not isinstance(lm, TransformerLM):
         raise TypeError(f"expected TransformerLM, got {type(lm).__name__}")
     blocks = [m for m in lm.modules if isinstance(m, TransformerBlock)]
-    if any(isinstance(mm, MoEFFN) for b in blocks for mm in b.modules):
+    if any(b.is_moe for b in blocks):
         raise ValueError("GPT-2 has no MoE blocks; export a dense model")
+    if any(not b.modules[1].causal for b in blocks):
+        raise ValueError(
+            "GPT-2 attention is unconditionally causal; this model was "
+            "built with causal=False and its forward cannot be "
+            "represented")
     tree = lm.param_tree()
     L = len(blocks)
     head = tree[str(1 + L + 1)]

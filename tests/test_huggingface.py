@@ -101,6 +101,18 @@ def test_save_gpt2_refuses_nonzero_head_bias():
         save_gpt2(lm)
 
 
+def test_save_gpt2_refuses_non_causal():
+    from bigdl_tpu.interop.huggingface import save_gpt2
+    from bigdl_tpu.models.transformer import TransformerLM
+    from bigdl_tpu.utils.rng import RNG
+
+    RNG().set_seed(6)
+    lm = TransformerLM(11, embed_dim=8, num_heads=2, mlp_dim=16,
+                       num_layers=1, max_len=8, causal=False)
+    with pytest.raises(ValueError, match="causal"):
+        save_gpt2(lm)
+
+
 def test_gpt2_rejects_wrong_activation():
     cfg = transformers.GPT2Config(vocab_size=20, n_positions=8, n_embd=8,
                                   n_layer=1, n_head=1,
