@@ -26,8 +26,8 @@ equivalence with the training forward therefore holds whenever the
 training forward's capacity does not bind.
 
 Sampling: ``temperature=0`` → greedy argmax; ``temperature>0`` →
-categorical over ``logits/temperature`` (optionally ``top_k``) and
-REQUIRES an explicit ``rng`` key — a silent fixed-seed default would
+categorical over ``logits/temperature`` (optionally within ``top_k``
+and/or the ``top_p`` nucleus) and REQUIRES an explicit ``rng`` key — a silent fixed-seed default would
 return the identical "sample" every call.
 """
 from __future__ import annotations
@@ -160,17 +160,30 @@ def make_generate(model, max_len: Optional[int] = None,
                              None)
         return h[:, 0, :].astype(jnp.float32)  # [B, V]
 
-    def _sample(logits, temperature, top_k, key):
+    def _sample(logits, temperature, top_k, top_p, key):
         greedy = jnp.argmax(logits, axis=-1)
         if top_k:
             kth = jnp.sort(logits, axis=-1)[:, -top_k][:, None]
             logits = jnp.where(logits < kth, -jnp.inf, logits)
+        # nucleus: drop tokens outside the smallest set whose prob mass
+        # reaches top_p (computed at the sampling temperature)
+        scaled = logits / jnp.maximum(temperature, 1e-6)
+        probs = jax.nn.softmax(scaled, axis=-1)
+        order = jnp.argsort(-probs, axis=-1)
+        csum = jnp.cumsum(jnp.take_along_axis(probs, order, -1), axis=-1)
+        # keep ranks whose PRECEDING mass < top_p (always keeps rank 0)
+        keep_sorted = jnp.concatenate(
+            [jnp.zeros_like(csum[:, :1]), csum[:, :-1]], axis=-1) < top_p
+        keep = jnp.zeros_like(keep_sorted).at[
+            jnp.arange(logits.shape[0])[:, None], order].set(keep_sorted)
+        nucleus = jnp.where(keep, scaled, -jnp.inf)
+        use_nucleus = (top_p > 0) & (top_p < 1)
         sampled = jax.random.categorical(
-            key, logits / jnp.maximum(temperature, 1e-6), axis=-1)
+            key, jnp.where(use_nucleus, nucleus, scaled), axis=-1)
         return jnp.where(temperature > 0, sampled, greedy)
 
     @partial(jax.jit, static_argnums=(2, 5))
-    def _run(p, prompt, max_new, key, temperature, top_k):
+    def _run(p, prompt, max_new, key, temperature, top_k, top_p):
         pc = _cast_floats(p, compute_dtype) if compute_dtype else p
         B, T0 = prompt.shape
         if T0 + max_new > T_max:
@@ -191,8 +204,8 @@ def make_generate(model, max_len: Optional[int] = None,
                                     vc, 0)
             caches.append((kc, vc))
         key, sub = jax.random.split(key)
-        nxt = (_sample(_logits_last(pc, h), temperature, top_k, sub)
-               + 1)  # 1-based ids
+        nxt = (_sample(_logits_last(pc, h), temperature, top_k, top_p,
+                       sub) + 1)  # 1-based ids
         ids = jnp.zeros((B, T0 + max_new), prompt.dtype)
         ids = lax.dynamic_update_slice(ids, prompt, (0, 0))
         ids = lax.dynamic_update_slice(ids, nxt[:, None].astype(
@@ -211,8 +224,8 @@ def make_generate(model, max_len: Optional[int] = None,
                                         pos)
                 new_caches.append((kc, vc))
             key, sub = jax.random.split(key)
-            nxt = (_sample(_logits_last(pc, h), temperature, top_k, sub)
-                   + 1)
+            nxt = (_sample(_logits_last(pc, h), temperature, top_k,
+                           top_p, sub) + 1)
             ids = lax.dynamic_update_slice(
                 ids, nxt[:, None].astype(ids.dtype), (0, pos + 1))
             return (new_caches, ids, pos + 1, key), None
@@ -224,7 +237,8 @@ def make_generate(model, max_len: Optional[int] = None,
         return ids
 
     def generate(params, prompt_ids, max_new: int, rng=None,
-                 temperature: float = 0.0, top_k: int = 0):
+                 temperature: float = 0.0, top_k: int = 0,
+                 top_p: float = 1.0):
         if temperature > 0 and rng is None:
             raise ValueError(
                 "temperature > 0 requires an explicit rng key "
@@ -233,7 +247,7 @@ def make_generate(model, max_len: Optional[int] = None,
         key = rng if rng is not None else jax.random.PRNGKey(0)
         return _run(params, jnp.asarray(prompt_ids, jnp.int32),
                     int(max_new), key, jnp.float32(temperature),
-                    int(top_k))
+                    int(top_k), jnp.float32(top_p))
 
     return generate
 

@@ -182,17 +182,18 @@ class TransformerLM(Container):
 
     def generate(self, prompt_ids, max_new: int, rng=None,
                  temperature: float = 0.0, top_k: int = 0,
-                 compute_dtype=None):
+                 top_p: float = 1.0, compute_dtype=None):
         """Autoregressive decode with a KV cache (models/generate.py):
         prefill + ``lax.scan`` decode at static shapes.  ``temperature=0``
         is greedy (pinned against the dense forward by teacher forcing);
-        ``>0`` samples, optionally within ``top_k``.  The compiled
-        generator is cached per (max_len, compute_dtype)."""
+        ``>0`` samples, optionally within ``top_k`` and/or the ``top_p``
+        nucleus.  The compiled generator is cached per
+        (max_len, compute_dtype)."""
         from .generate import cached_generate
 
         return cached_generate(self, compute_dtype)(
             self.param_tree(), prompt_ids, max_new, rng=rng,
-            temperature=temperature, top_k=top_k)
+            temperature=temperature, top_k=top_k, top_p=top_p)
 
     def _positions(self, pos_table, T):
         if self.seq_strategy in ("ring", "ulysses"):

@@ -104,6 +104,24 @@ def test_model_generate_method_and_checkpoint_after(tmp_path):
     np.testing.assert_array_equal(np.asarray(ids), np.asarray(ids2))
 
 
+def test_top_p_nucleus_restricts_support():
+    """With a tiny nucleus the sampled tokens collapse onto the greedy
+    argmax (rank 0 is always kept; everything else is cut)."""
+    model = _model()
+    rng = np.random.RandomState(4)
+    prompt = rng.randint(1, VOCAB + 1, (2, 4)).astype(np.int32)
+    greedy = np.asarray(model.generate(prompt, max_new=5))
+    nucleus = np.asarray(model.generate(
+        prompt, max_new=5, rng=jax.random.PRNGKey(3), temperature=1.0,
+        top_p=1e-6))
+    np.testing.assert_array_equal(nucleus, greedy)
+    # a wide-open nucleus (top_p=1) still samples valid ids
+    open_p = np.asarray(model.generate(
+        prompt, max_new=5, rng=jax.random.PRNGKey(3), temperature=1.0,
+        top_p=1.0))
+    assert open_p.min() >= 1 and open_p.max() <= VOCAB
+
+
 def test_generate_rejects_overflow_and_ring():
     model = _model()
     gen = make_generate(model)
