@@ -13,6 +13,7 @@ from .validation import (
 )
 from .regularizer import L1L2Regularizer, L1Regularizer, L2Regularizer, Regularizer
 from .metrics import Metrics
+from .optax_bridge import OptaxMethod
 from .optimizer import LocalOptimizer, Optimizer
 from .distri_optimizer import DistriOptimizer
 from .evaluator import DistriValidator, Evaluator, LocalValidator

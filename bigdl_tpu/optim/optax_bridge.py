@@ -1,0 +1,82 @@
+"""Optax bridge — any ``optax.GradientTransformation`` as an
+``OptimMethod``.
+
+The rebuild ships the reference's own optimizer set (SGD+schedules,
+Adam, ... optim/optim_method.py, reference optim/SGD.scala etc.); this
+adapter opens the door to the wider JAX ecosystem: pass an optax
+transformation (or better, its FACTORY) and it drives every training
+path — LocalOptimizer, the data-parallel DistriOptimizer, and the
+multi-axis/pipeline SPMD steps, whose ``slot_specs`` shard optax's
+NamedTuple states (Adam moments etc.) alongside their parameters.
+
+Checkpointability: optax transformations close over Python functions
+and do not pickle.  Construct the method from a FACTORY —
+``OptaxMethod(optax.adam, 1e-3)`` — and only the importable factory +
+arguments are serialized (the transformation rebuilds on load).  A
+prebuilt transformation (``OptaxMethod(tx=...)``) works for training
+but refuses ``save`` loudly.
+
+Learning-rate semantics: optax factories bake their own schedule into
+the transformation, so the driver-side ``learning_rate`` here is a
+plain multiplier with default 1.0 (updates apply as optax produced
+them).  Use it with the Trigger-driven schedule hooks only if you know
+the transformation expects external scaling.
+"""
+from __future__ import annotations
+
+import jax
+
+from .optim_method import OptimMethod
+
+tmap = jax.tree_util.tree_map
+
+
+class OptaxMethod(OptimMethod):
+    """``OptaxMethod(optax.adam, 1e-3, b1=0.9)`` or
+    ``OptaxMethod(tx=my_transformation)`` (not checkpointable)."""
+
+    def __init__(self, factory=None, *args, tx=None,
+                 learning_rate: float = 1.0, **kwargs):
+        super().__init__()
+        if (factory is None) == (tx is None):
+            raise ValueError(
+                "pass exactly one of a factory (e.g. optax.adam) or a "
+                "prebuilt tx")
+        self.learning_rate = learning_rate
+        self._factory = factory
+        self._factory_args = args
+        self._factory_kwargs = kwargs
+        self._tx = tx if tx is not None else factory(*args, **kwargs)
+
+    # -- functional core -------------------------------------------------
+    def init_state(self, params):
+        return self._tx.init(params)
+
+    def step(self, grads, params, state, lr):
+        updates, new_state = self._tx.update(grads, state, params)
+        new_params = tmap(lambda p, u: p + lr * u, params, updates)
+        return new_params, new_state
+
+    # -- checkpointing ---------------------------------------------------
+    def __getstate__(self):
+        if self._factory is None:
+            raise TypeError(
+                "this OptaxMethod wraps a prebuilt transformation, "
+                "which cannot be pickled — construct it from a factory "
+                "(OptaxMethod(optax.adam, 1e-3)) for checkpoint support")
+        # base hook converts _slots' device arrays (possibly
+        # mesh-sharded) to portable numpy; only the transformation
+        # itself is dropped and rebuilt on load
+        d = super().__getstate__()
+        d["_tx"] = None
+        return d
+
+    def __setstate__(self, d):
+        super().__setstate__(d)
+        self._tx = self._factory(*self._factory_args,
+                                 **self._factory_kwargs)
+
+    def __repr__(self):
+        name = getattr(self._factory, "__name__", type(self._tx).__name__)
+        return (f"OptaxMethod({name}"
+                f"{', ' + repr(self._factory_args) if self._factory_args else ''})")

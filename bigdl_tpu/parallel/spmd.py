@@ -155,7 +155,8 @@ def _cast_fwd(model, compute_dtype, upcast_out=True):
 def slot_specs(slots, pspecs):
     """Optimizer-state specs: subtrees shaped like the param tree inherit
     the param specs (momentum/Adam moments shard with their params);
-    scalar leaves (step counters) replicate."""
+    scalar leaves (step counters) replicate.  Recurses through dicts AND
+    NamedTuples (optax states like ScaleByAdamState)."""
     ptreedef = jax.tree_util.tree_structure(pspecs)
 
     def rec(s):
@@ -163,6 +164,10 @@ def slot_specs(slots, pspecs):
             return pspecs
         if isinstance(s, dict):
             return {k: rec(v) for k, v in s.items()}
+        if isinstance(s, tuple) and hasattr(s, "_fields"):
+            return type(s)(*(rec(v) for v in s))
+        if isinstance(s, (tuple, list)):
+            return type(s)(rec(v) for v in s)
         return P()
 
     return rec(slots)
