@@ -212,6 +212,14 @@ class DistriOptimizer(Optimizer):
 
     # ------------------------------------------------------------------
     def optimize(self) -> AbstractModule:
+        try:
+            return self._optimize_routed()
+        finally:
+            # an in-flight async orbax save must commit even when the
+            # loop exits abnormally (Ctrl-C, exhausted retries)
+            self._orbax_close()
+
+    def _optimize_routed(self) -> AbstractModule:
         mesh = self.mesh
         if mesh is None:
             mesh = Engine.create_mesh()
@@ -253,18 +261,7 @@ class DistriOptimizer(Optimizer):
         return self._with_retry(lambda: self._optimize_once(mesh, n_dev))
 
     def _restore_latest(self):
-        from ..utils.file_io import load
-
-        latest = _latest_file(self.checkpoint_path, "model")
-        if latest is not None:
-            restored = load(latest)
-            self.model.set_param_tree(restored.param_tree())
-            self.model.set_buffer_tree(restored.buffer_tree())
-        latest_om = _latest_file(self.checkpoint_path, "optimMethod")
-        if latest_om is not None:
-            from .optim_method import OptimMethod
-
-            self.optim_method = OptimMethod.load(latest_om)
+        self.resume_from_checkpoint()
 
     # ------------------------------------------------------------------
     # multi-axis (data x seq x model) SPMD path
@@ -434,17 +431,23 @@ class DistriOptimizer(Optimizer):
                 self._validate_multi_axis(state, eval_fwd, params, buffers,
                                           n_data, n_seq)
             if do_checkpoint:
-                # host-gather the sharded params for the checkpoint
-                # (model-sharded leaves reassemble on fetch)
-                model.set_param_tree(jax.device_get(params))
-                model.set_buffer_tree(jax.device_get(buffers))
-                optim._slots = jax.device_get(slots)
-                self._checkpoint(state)
+                if self.checkpoint_format == "orbax":
+                    # sharded async save straight from the device trees
+                    self._orbax_save(state, self._orbax_tree(
+                        params, slots, buffers), kind="model")
+                else:
+                    # host-gather the sharded params for the checkpoint
+                    # (model-sharded leaves reassemble on fetch)
+                    model.set_param_tree(jax.device_get(params))
+                    model.set_buffer_tree(jax.device_get(buffers))
+                    optim._slots = jax.device_get(slots)
+                    self._checkpoint(state)
 
         model.set_param_tree(jax.device_get(params))
         model.set_buffer_tree(jax.device_get(buffers))
         optim._slots = jax.device_get(slots)
         model.evaluate()
+        self._orbax_close()
         return model
 
     # ------------------------------------------------------------------
@@ -595,11 +598,18 @@ class DistriOptimizer(Optimizer):
                 model.training()
                 self._report_validation(state, results)
             if do_checkpoint:
-                _sync_to_model()
-                self._checkpoint(state)
+                if self.checkpoint_format == "orbax":
+                    # sharded async save straight from the device trees
+                    # — no host gather, no unpack
+                    self._orbax_save(state, self._orbax_tree(
+                        packed, slots), kind="packed")
+                else:
+                    _sync_to_model()
+                    self._checkpoint(state)
 
         _sync_to_model()
         model.evaluate()
+        self._orbax_close()
         return model
 
     def _validate_multi_axis(self, state, eval_fwd, params, buffers,
@@ -857,15 +867,20 @@ class DistriOptimizer(Optimizer):
                 self._validate_on_mesh(state, mesh, params, buffers)
             if self.checkpoint_trigger is not None and \
                     self.checkpoint_trigger(state):
-                model.set_param_tree(params)
-                model.set_buffer_tree(buffers)
-                optim._slots = slots
-                self._checkpoint(state)
+                if self.checkpoint_format == "orbax":
+                    self._orbax_save(state, self._orbax_tree(
+                        params, slots, buffers), kind="model")
+                else:
+                    model.set_param_tree(params)
+                    model.set_buffer_tree(buffers)
+                    optim._slots = slots
+                    self._checkpoint(state)
 
         model.set_param_tree(params)
         model.set_buffer_tree(buffers)
         optim._slots = slots
         model.evaluate()
+        self._orbax_close()
         return model
 
     def _validate_on_mesh(self, state, mesh, params, buffers):

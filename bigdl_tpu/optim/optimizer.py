@@ -56,6 +56,8 @@ class Optimizer:
         self.end_when: Trigger = end_trigger or max_epoch(1)
         self.checkpoint_path: Optional[str] = None
         self.checkpoint_trigger: Optional[Trigger] = None
+        self.checkpoint_format = "pickle"
+        self._orbax = None
         self.is_overwrite = False
         self.validation_trigger: Optional[Trigger] = None
         self.validation_dataset = None
@@ -103,9 +105,23 @@ class Optimizer:
         self.validation_output_seq_dim = output_seq_dim
         return self
 
-    def set_checkpoint(self, path: str, trigger: Trigger):
+    def set_checkpoint(self, path: str, trigger: Trigger,
+                       format: str = "pickle"):
+        """``format="pickle"`` (default) writes whole-module files
+        (reference DistriOptimizer.scala:394-416 semantics);
+        ``"orbax"`` writes sharded, ASYNC array checkpoints
+        (utils/orbax_io.py) — on the sharded mesh paths the device-
+        resident trees save without a host gather."""
+        if format not in ("pickle", "orbax"):
+            raise ValueError(f"checkpoint format {format!r} not in "
+                             "('pickle', 'orbax')")
+        # re-pointing at a new directory must not keep writing into the
+        # old checkpointer's path
+        self._orbax_close()
+        self._orbax = None
         self.checkpoint_path = path
         self.checkpoint_trigger = trigger
+        self.checkpoint_format = format
         return self
 
     def overwrite_checkpoint(self):
@@ -147,6 +163,135 @@ class Optimizer:
         self.drop_percentage = drop_percentage
         self.max_drop_percentage = max_drop_percentage
         return self
+
+    # -- orbax sharded checkpoints (utils/orbax_io.py) -------------------
+    @staticmethod
+    def _orbax_tree(params, slots, buffers=None):
+        """Checkpoint tree with empty subtrees dropped (orbax rejects
+        leafless nodes)."""
+        tree = {"params": params}
+        if slots is not None and jax.tree_util.tree_leaves(slots):
+            tree["slots"] = slots
+        if buffers is not None and jax.tree_util.tree_leaves(buffers):
+            tree["buffers"] = buffers
+        return tree
+
+    def _orbax_save(self, state, tree, kind: str):
+        """Async-save ``tree`` as it is sharded (device arrays write
+        their own shards; no host gather) plus a small pickle sidecar
+        carrying the optimizer state table, the tree's abstract shapes
+        (the restore skeleton) and ``kind`` ("model": params are the
+        module tree; "packed": the pipeline's packed layout)."""
+        import pickle
+
+        from ..utils.orbax_io import ShardedCheckpointer
+
+        if self._orbax is None:
+            self._orbax = ShardedCheckpointer(self.checkpoint_path)
+        n = state["neval"] - 1
+        self._orbax.save(n, tree)
+        meta = {"kind": kind, "state": dict(state),
+                "abstract": jax.tree_util.tree_map(
+                    lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype),
+                    tree)}
+        with open(os.path.join(self._orbax.directory,
+                               f"meta-{n}.pkl"), "wb") as f:
+            pickle.dump(meta, f)
+        if self.is_overwrite:
+            # bounded retention (the pickle path's overwrite analogue):
+            # keep the in-flight step n AND the newest already-committed
+            # step (crash safety while n's async save is still writing);
+            # everything older deletes
+            import shutil
+
+            from ..utils.orbax_io import ShardedCheckpointer as SC
+            from ..utils.orbax_io import latest_step as _ls
+
+            committed = _ls(self._orbax.directory)
+            keep = {n, committed if committed is not None else n}
+            for name in os.listdir(self._orbax.directory):
+                for prefix, is_dir in ((SC.PREFIX, True), ("meta-", False)):
+                    if name.startswith(prefix):
+                        tail = name[len(prefix):].split(".")[0]
+                        if tail.isdigit() and int(tail) not in keep:
+                            p = os.path.join(self._orbax.directory, name)
+                            (shutil.rmtree if is_dir
+                             else os.remove)(p)
+
+    def _orbax_restore_into_model(self) -> bool:
+        """Restore the newest orbax step host-side into the live
+        model/optimizer (the resume path).  Returns False when no
+        committed step exists."""
+        import pickle
+
+        from ..utils.orbax_io import ShardedCheckpointer, latest_step
+
+        if self.checkpoint_path is None:
+            return False
+        directory = os.path.abspath(self.checkpoint_path)
+        n = latest_step(directory)
+        meta = None
+        while n is not None:
+            # a crash between the async step commit and the sidecar
+            # write can leave a committed step without meta — fall back
+            # to the newest step that has one
+            try:
+                with open(os.path.join(directory, f"meta-{n}.pkl"),
+                          "rb") as f:
+                    meta = pickle.load(f)
+                break
+            except FileNotFoundError:
+                log.warning("orbax step %d has no meta sidecar "
+                            "(interrupted save?) — falling back", n)
+                older = [s for s in range(n) if os.path.isdir(
+                    os.path.join(directory,
+                                 f"{ShardedCheckpointer.PREFIX}{s}"))]
+                n = max(older) if older else None
+        if meta is None:
+            return False
+        if self._orbax is None:
+            self._orbax = ShardedCheckpointer(directory)
+        tree = self._orbax.restore(n, meta["abstract"], host=True)
+        if meta["kind"] == "packed":
+            from ..parallel.pipeline import unpack_params
+
+            unpack_params(tree["params"], self.model)
+        else:
+            self.model.set_param_tree(tree["params"])
+            if tree.get("buffers"):
+                self.model.set_buffer_tree(tree["buffers"])
+        self.optim_method._slots = tree.get("slots") or None
+        self.optim_method.state.update(meta["state"])
+        return True
+
+    def _orbax_close(self):
+        if self._orbax is not None:
+            self._orbax.close()
+
+    def resume_from_checkpoint(self) -> bool:
+        """Restore the newest checkpoint at ``checkpoint_path`` into the
+        live model/optimizer — the manual-resume entry point (reference
+        'manual via Module.load + OptimMethod.load'); the Distri retry
+        loop calls it automatically on failure.  Returns False when
+        there is nothing to restore."""
+        if self.checkpoint_format == "orbax":
+            return self._orbax_restore_into_model()
+        from ..utils.file_io import load
+        from .distri_optimizer import _latest_file
+        from .optim_method import OptimMethod
+
+        restored_any = False
+        latest = _latest_file(self.checkpoint_path, "model")
+        if latest is not None:
+            restored = load(latest)
+            self.model.set_param_tree(restored.param_tree())
+            self.model.set_buffer_tree(restored.buffer_tree())
+            restored_any = True
+        latest_om = _latest_file(self.checkpoint_path, "optimMethod")
+        if latest_om is not None:
+            self.optim_method = OptimMethod.load(latest_om)
+            restored_any = True
+        return restored_any
 
     def optimize(self) -> AbstractModule:
         raise NotImplementedError
@@ -222,6 +367,13 @@ class LocalOptimizer(Optimizer):
     into the batch dimension, SURVEY §2.2 P2)."""
 
     def optimize(self) -> AbstractModule:
+        try:
+            return self._optimize_loop()
+        finally:
+            # commit any in-flight async orbax save on abnormal exits
+            self._orbax_close()
+
+    def _optimize_loop(self) -> AbstractModule:
         model, criterion, optim = self.model, self.criterion, self.optim_method
         model.training()
         from ..parallel.moe import aux_loss_term, collect_aux_paths
@@ -355,6 +507,7 @@ class LocalOptimizer(Optimizer):
         model.set_buffer_tree(buffers)
         optim._slots = slots
         model.evaluate()
+        self._orbax_close()
         return model
 
     @staticmethod
@@ -385,6 +538,11 @@ class LocalOptimizer(Optimizer):
             return
         if self.checkpoint_path is None:
             return
+        if self.checkpoint_format == "orbax":
+            self._orbax_save(state, self._orbax_tree(
+                self.model.param_tree(), self.optim_method._slots,
+                self.model.buffer_tree()), kind="model")
+            return
         from ..utils import file_io
 
         n = state["neval"] - 1
@@ -394,3 +552,4 @@ class LocalOptimizer(Optimizer):
         self.optim_method.save(
             file_io.join(self.checkpoint_path, f"optimMethod{suffix}"),
             overwrite=True)
+
