@@ -1,0 +1,21 @@
+"""The modern-LM stack example (examples/modern_lm_stack.py) runs its
+three modes end-to-end: GPT-2 load+export, Switch-MoE, GPipe pipeline
+— each fine-tunes, resumes from an orbax checkpoint, and generates."""
+import pytest
+
+pytest.importorskip("torch")
+pytest.importorskip("transformers")
+pytest.importorskip("optax")
+pytest.importorskip("orbax.checkpoint")
+
+from bigdl_tpu.examples.modern_lm_stack import main  # noqa: E402
+
+
+@pytest.mark.parametrize("argv", [[], ["--moe", "8"], ["--pipeline", "2"]])
+def test_modern_lm_stack_modes(argv, capsys):
+    main(argv + ["--iterations", "30"])
+    out = capsys.readouterr().out
+    assert "resumed from orbax step" in out
+    assert "greedy :" in out
+    if not argv:
+        assert "export verified" in out
