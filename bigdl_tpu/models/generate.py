@@ -14,9 +14,10 @@ parallel/pipeline.py pattern): LN/MLP sublayers run through their
 module ``apply_fn``; attention re-derives the q/k/v/o projections from
 the MultiHeadAttention parameter names (wq/wk/wv/wo + biases) because
 cached decode attention is a different computation from the module's
-full-sequence forward.  Greedy decode is pinned against the full dense
-forward by a teacher-forcing oracle in tests/test_generate.py, which
-keeps the two implementations from drifting.
+full-sequence forward.  ONE machinery (``_decode_machinery``) backs
+both the sampling decoder and beam search, and greedy decode is pinned
+against the full dense forward by a teacher-forcing oracle in
+tests/test_generate.py, which keeps the implementations from drifting.
 
 MoE models decode through a capacity-FREE gather dispatch (each token
 simply uses its argmax expert): at inference nothing should be
@@ -27,8 +28,10 @@ training forward's capacity does not bind.
 
 Sampling: ``temperature=0`` → greedy argmax; ``temperature>0`` →
 categorical over ``logits/temperature`` (optionally within ``top_k``
-and/or the ``top_p`` nucleus) and REQUIRES an explicit ``rng`` key — a silent fixed-seed default would
-return the identical "sample" every call.
+and/or the ``top_p`` nucleus) and REQUIRES an explicit ``rng`` key — a
+silent fixed-seed default would return the identical "sample" every
+call.  Beam decode: :func:`make_beam_search` (fixed-length, the LM has
+no EOS convention).
 """
 from __future__ import annotations
 
@@ -86,26 +89,15 @@ def _moe_ffn_nodrop(moe, params, x):
     return (gate[:, None] * y).reshape(B, Tq, D)
 
 
-def make_generate(model, max_len: Optional[int] = None,
-                  compute_dtype=None):
-    """Build ``generate(params, prompt_ids, max_new, rng=None,
-    temperature=0.0, top_k=0) -> [B, prompt+max_new] ids``.
-
-    ``params`` is ``model.param_tree()`` (1-based token ids, like the
-    training path).  ``max_len`` bounds prompt+generated (default: the
-    model's positional table length).  One compiled program per
-    (prompt_shape, max_new, top_k); the decode loop itself is a scan —
-    no per-token dispatch.
-    """
-    from ..optim.optimizer import _cast_floats
-    from ..parallel.moe import MoEFFN
-
-    first, count = _check_model(model)
+def _decode_machinery(model, first, count, T_max):
+    """The cached-attention forward shared by the sampling decoder and
+    beam search — built once per generator from the model structure.
+    Every function takes the (already cast) param tree ``pc``
+    explicitly."""
     blocks = model.modules[first:first + count]
     ln_f = model.modules[first + count]
     head = model.modules[first + count + 1]
     embed = model.modules[0]
-    T_max = int(max_len or model.max_len)
     mha0 = blocks[0].modules[1]
     H, Dh = mha0.num_heads, mha0.head_dim
 
@@ -152,13 +144,63 @@ def make_generate(model, max_len: Optional[int] = None,
             ffn = out
         return h + ffn, k_cache, v_cache
 
-    def _logits_last(p, h):
-        """Head on the LAST position of h only."""
+    def _embed_at(pc, tok, pos, Tq):
+        h, _ = embed.apply_fn(pc["0"], {}, tok, False, None)
+        return h + lax.dynamic_slice_in_dim(pc["pos"], pos, Tq)
+
+    def prefill(pc, prompt, dt):
+        """The whole prompt in one causal pass; returns (h [B,T0,D],
+        caches) with positions [0, T0) filled."""
+        B, T0 = prompt.shape
+        h = _embed_at(pc, prompt, 0, T0)
+        caches = []
+        for bi, block in enumerate(blocks):
+            kc = jnp.zeros((B, H, T_max, Dh), dt)
+            vc = jnp.zeros((B, H, T_max, Dh), dt)
+            h, kc, vc = _block_step(block, pc[str(first + bi)], h, kc,
+                                    vc, 0)
+            caches.append((kc, vc))
+        return h, caches
+
+    def decode_token(pc, tok, caches, pos):
+        """One token [B, 1] at absolute position ``pos``; returns
+        (h [B,1,D], new_caches)."""
+        h = _embed_at(pc, tok, pos, 1)
+        new_caches = []
+        for bi, block in enumerate(blocks):
+            h, kc, vc = _block_step(block, pc[str(first + bi)], h,
+                                    caches[bi][0], caches[bi][1], pos)
+            new_caches.append((kc, vc))
+        return h, new_caches
+
+    def logits_last(pc, h):
+        """Head on the LAST position of h only -> [B, V] f32."""
         h = h[:, -1:, :]
-        h, _ = ln_f.apply_fn(p[str(first + count)], {}, h, False, None)
-        h, _ = head.apply_fn(p[str(first + count + 1)], {}, h, False,
+        h, _ = ln_f.apply_fn(pc[str(first + count)], {}, h, False, None)
+        h, _ = head.apply_fn(pc[str(first + count + 1)], {}, h, False,
                              None)
-        return h[:, 0, :].astype(jnp.float32)  # [B, V]
+        return h[:, 0, :].astype(jnp.float32)
+
+    return prefill, decode_token, logits_last
+
+
+def make_generate(model, max_len: Optional[int] = None,
+                  compute_dtype=None):
+    """Build ``generate(params, prompt_ids, max_new, rng=None,
+    temperature=0.0, top_k=0, top_p=1.0) -> [B, prompt+max_new] ids``.
+
+    ``params`` is ``model.param_tree()`` (1-based token ids, like the
+    training path).  ``max_len`` bounds prompt+generated (default: the
+    model's positional table length).  One compiled program per
+    (prompt_shape, max_new, top_k); the decode loop itself is a scan —
+    no per-token dispatch.
+    """
+    from ..optim.optimizer import _cast_floats
+
+    first, count = _check_model(model)
+    T_max = int(max_len or model.max_len)
+    prefill, decode_token, logits_last = _decode_machinery(
+        model, first, count, T_max)
 
     def _sample(logits, temperature, top_k, top_p, key):
         greedy = jnp.argmax(logits, axis=-1)
@@ -191,40 +233,22 @@ def make_generate(model, max_len: Optional[int] = None,
                 f"prompt {T0} + max_new {max_new} exceeds max_len {T_max}")
         dt = (compute_dtype
               or jax.tree_util.tree_leaves(pc)[0].dtype)
-        pos_table = pc["pos"]
 
-        # ---- batched prefill: the whole prompt in one causal pass ----
-        h, _ = embed.apply_fn(pc["0"], {}, prompt, False, None)
-        h = h + lax.dynamic_slice_in_dim(pos_table, 0, T0)
-        caches = []
-        for bi, block in enumerate(blocks):
-            kc = jnp.zeros((B, H, T_max, Dh), dt)
-            vc = jnp.zeros((B, H, T_max, Dh), dt)
-            h, kc, vc = _block_step(block, pc[str(first + bi)], h, kc,
-                                    vc, 0)
-            caches.append((kc, vc))
+        h, caches = prefill(pc, prompt, dt)
         key, sub = jax.random.split(key)
-        nxt = (_sample(_logits_last(pc, h), temperature, top_k, top_p,
+        nxt = (_sample(logits_last(pc, h), temperature, top_k, top_p,
                        sub) + 1)  # 1-based ids
         ids = jnp.zeros((B, T0 + max_new), prompt.dtype)
         ids = lax.dynamic_update_slice(ids, prompt, (0, 0))
         ids = lax.dynamic_update_slice(ids, nxt[:, None].astype(
             ids.dtype), (0, T0))
 
-        # ---- decode loop: one token per scan step ----
         def one_token(carry, _):
             caches, ids, pos, key = carry
             tok = lax.dynamic_slice(ids, (0, pos), (B, 1))
-            h, _ = embed.apply_fn(pc["0"], {}, tok, False, None)
-            h = h + lax.dynamic_slice_in_dim(pos_table, pos, 1)
-            new_caches = []
-            for bi, block in enumerate(blocks):
-                h, kc, vc = _block_step(block, pc[str(first + bi)], h,
-                                        caches[bi][0], caches[bi][1],
-                                        pos)
-                new_caches.append((kc, vc))
+            h, new_caches = decode_token(pc, tok, caches, pos)
             key, sub = jax.random.split(key)
-            nxt = (_sample(_logits_last(pc, h), temperature, top_k,
+            nxt = (_sample(logits_last(pc, h), temperature, top_k,
                            top_p, sub) + 1)
             ids = lax.dynamic_update_slice(
                 ids, nxt[:, None].astype(ids.dtype), (0, pos + 1))
@@ -250,6 +274,103 @@ def make_generate(model, max_len: Optional[int] = None,
                     int(top_k), jnp.float32(top_p))
 
     return generate
+
+
+def make_beam_search(model, max_len: Optional[int] = None,
+                     compute_dtype=None):
+    """Build ``beam_search(params, prompt_ids, max_new, num_beams=4)
+    -> (ids [B, prompt+max_new], scores [B])``.
+
+    Fixed-length beam decode (the LM has no EOS convention, so every
+    beam has the same length and a GNMT length penalty would be
+    argmax-invariant — none is offered): each step expands every beam
+    over the vocabulary and keeps the top ``num_beams`` by cumulative
+    log-probability, gathering the KV caches along the beam dim to
+    follow their parents.  ``scores`` are total log-probs.  When
+    ``num_beams`` exceeds the vocabulary, the surplus first-step beams
+    start dead (-inf) and are claimed by real expansions at later
+    depths, so ``num_beams=1`` reduces to greedy and with enough beams
+    to hold every prefix it IS exhaustive search (the oracle test pins
+    that).  Shares :func:`_decode_machinery` with the sampling
+    decoder."""
+    from ..optim.optimizer import _cast_floats
+
+    first, count = _check_model(model)
+    T_max = int(max_len or model.max_len)
+    prefill, decode_token, logits_last = _decode_machinery(
+        model, first, count, T_max)
+
+    @partial(jax.jit, static_argnums=(2, 3))
+    def _run(p, prompt, max_new, kk):
+        pc = _cast_floats(p, compute_dtype) if compute_dtype else p
+        B, T0 = prompt.shape
+        if T0 + max_new > T_max:
+            raise ValueError(
+                f"prompt {T0} + max_new {max_new} exceeds max_len {T_max}")
+        dt = (compute_dtype
+              or jax.tree_util.tree_leaves(pc)[0].dtype)
+
+        h, caches = prefill(pc, prompt, dt)
+        logp0 = jax.nn.log_softmax(logits_last(pc, h), axis=-1)  # [B, V]
+        V = logp0.shape[-1]
+        # the first expansion has only V candidates: surplus beams
+        # start dead (-inf) and get claimed at later depths, keeping
+        # the beam width (and every shape) at kk throughout
+        k0 = min(kk, V)
+        scores, first_tok = jax.lax.top_k(logp0, k0)      # [B, k0]
+        if k0 < kk:
+            scores = jnp.concatenate(
+                [scores, jnp.full((B, kk - k0), -jnp.inf,
+                                  scores.dtype)], axis=1)
+            first_tok = jnp.concatenate(
+                [first_tok, jnp.zeros((B, kk - k0), first_tok.dtype)],
+                axis=1)
+        ids = jnp.zeros((B, kk, T0 + max_new), prompt.dtype)
+        ids = ids.at[:, :, :T0].set(prompt[:, None, :])
+        ids = ids.at[:, :, T0].set((first_tok + 1).astype(ids.dtype))
+        # caches replicate per beam: [B, H, Tm, Dh] -> [B*kk, ...]
+        caches = [(jnp.repeat(kc, kk, axis=0), jnp.repeat(vc, kk, axis=0))
+                  for kc, vc in caches]
+
+        def step(carry, off):
+            caches, ids, scores = carry
+            pos = T0 + off
+            tok = jax.vmap(
+                lambda row: lax.dynamic_slice(row, (pos,), (1,)))(
+                    ids.reshape(B * kk, -1))
+            h, new_caches = decode_token(pc, tok, caches, pos)
+            logp = jax.nn.log_softmax(logits_last(pc, h), axis=-1)
+            cand = scores[:, :, None] + logp.reshape(B, kk, V)
+            scores, idx = jax.lax.top_k(cand.reshape(B, kk * V), kk)
+            parent = idx // V                             # [B, kk]
+            tok_next = (idx % V) + 1
+            # beams follow their parents: reorder ids and caches
+            ids = jnp.take_along_axis(ids, parent[:, :, None], axis=1)
+            ids = jax.vmap(
+                lambda row, t: lax.dynamic_update_slice(row, t, (pos + 1,))
+            )(ids.reshape(B * kk, -1),
+              tok_next.astype(ids.dtype).reshape(B * kk, 1)).reshape(
+                  B, kk, -1)
+            gather = (parent + jnp.arange(B)[:, None] * kk).reshape(-1)
+            new_caches = [(kc[gather], vc[gather])
+                          for kc, vc in new_caches]
+            return (new_caches, ids, scores), None
+
+        if max_new > 1:
+            (caches, ids, scores), _ = lax.scan(
+                step, (caches, ids, scores), jnp.arange(max_new - 1))
+        best = jnp.argmax(scores, axis=-1)                # [B]
+        out = jnp.take_along_axis(ids, best[:, None, None], axis=1)[:, 0]
+        return out, jnp.take_along_axis(scores, best[:, None],
+                                        axis=1)[:, 0]
+
+    def beam_search(params, prompt_ids, max_new: int, num_beams: int = 4):
+        if num_beams < 1:
+            raise ValueError(f"num_beams must be >= 1, got {num_beams}")
+        return _run(params, jnp.asarray(prompt_ids, jnp.int32),
+                    int(max_new), int(num_beams))
+
+    return beam_search
 
 
 def cached_generate(model, compute_dtype=None):

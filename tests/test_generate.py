@@ -122,6 +122,51 @@ def test_top_p_nucleus_restricts_support():
     assert open_p.min() >= 1 and open_p.max() <= VOCAB
 
 
+def test_beam_search_exhaustive_oracle():
+    """With enough beams to hold every prefix, beam search must find
+    the globally best sequence — pinned against brute force over all
+    V^n continuations scored by the full dense forward."""
+    import itertools
+
+    from bigdl_tpu.models.generate import make_beam_search
+
+    V_small, n = 7, 3
+    RNG().set_seed(9)
+    model = TransformerLM(V_small, embed_dim=12, num_heads=2, mlp_dim=24,
+                          num_layers=2, max_len=8)
+    params = model.param_tree()
+    prompt = np.array([[2, 5]], np.int32)
+
+    # brute force: total log-prob of every continuation
+    best_score, best_seq = -np.inf, None
+    for cont in itertools.product(range(1, V_small + 1), repeat=n):
+        ids = np.concatenate([prompt[0], np.array(cont)])[None, :]
+        out, _ = model.apply_fn(params, model.buffer_tree(),
+                                jnp.asarray(ids), False, None)
+        lp = np.asarray(out)[0]  # log-probs [T, V]
+        score = sum(lp[prompt.shape[1] - 1 + t, cont[t] - 1]
+                    for t in range(n))
+        if score > best_score:
+            best_score, best_seq = score, cont
+
+    beam = make_beam_search(model)
+    ids, scores = beam(params, prompt, max_new=n, num_beams=V_small ** 2)
+    assert tuple(np.asarray(ids)[0, 2:].tolist()) == best_seq
+    np.testing.assert_allclose(float(scores[0]), best_score, atol=1e-4)
+
+
+def test_beam_one_equals_greedy():
+    from bigdl_tpu.models.generate import make_beam_search
+
+    model = _model()
+    rng = np.random.RandomState(6)
+    prompt = rng.randint(1, VOCAB + 1, (2, 4)).astype(np.int32)
+    greedy = np.asarray(model.generate(prompt, max_new=6))
+    beam_ids, _ = make_beam_search(model)(model.param_tree(), prompt,
+                                          max_new=6, num_beams=1)
+    np.testing.assert_array_equal(np.asarray(beam_ids), greedy)
+
+
 def test_generate_rejects_overflow_and_ring():
     model = _model()
     gen = make_generate(model)
