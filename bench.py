@@ -456,7 +456,11 @@ def run_worker(backend: str) -> None:
     out["resnet50_headline_conv_impl"] = "xla"
     if on_tpu and bf16_ips and not over_budget(0.6):
         import jax.numpy as _jnp
-        for impl in ("gemm", "pallas"):
+        # xla_nhwc first on purpose: the layout experiment is the most
+        # likely winner (the NHWC twin measured ~14% over the NCHW
+        # framework), and gemm/pallas already carry window-1 numbers
+        # that the stale-merge preserves if the budget cuts them off
+        for impl in ("xla_nhwc", "gemm", "pallas"):
             try:
                 alt_ips, alt_flops = _bench_resnet(
                     bf16_batch, 12, 3, _jnp.bfloat16, rng, spd=4,
@@ -469,7 +473,7 @@ def run_worker(backend: str) -> None:
             except Exception as e:
                 out[f"resnet50_{impl}_error"] = \
                     f"{type(e).__name__}: {e}"[:200]
-            if over_budget(0.7):
+            if over_budget(0.75):
                 break
         flush("resnet50_conv_impls")
     # (bf16/f32 throughput keys were assigned right after each bench ran,

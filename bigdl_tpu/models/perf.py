@@ -133,7 +133,7 @@ def main(argv=None):
     parser.add_argument("--dtype", default="float32",
                         choices=("float32", "bfloat16"))
     parser.add_argument("--conv-impl", default=None,
-                        choices=("xla", "gemm", "pallas"),
+                        choices=("xla", "xla_nhwc", "gemm", "pallas"),
                         help="conv lowering (bigdl.conv.impl property)")
     args = parser.parse_args(argv)
     if args.conv_impl:

@@ -235,9 +235,12 @@ def main(argv=None):
                              "in the backward pass (jax.checkpoint): HBM "
                              "for FLOPs on long contexts; transformer only")
     parser.add_argument("--conv-impl", default=None,
-                        choices=("xla", "gemm", "pallas"),
+                        choices=("xla", "xla_nhwc", "gemm", "pallas"),
                         help="conv lowering for spatial models: XLA's "
-                             "native conv, the k²-matmul decomposition "
+                             "native conv (NCHW), the same conv with "
+                             "activations flowing NHWC between boundary "
+                             "transposes (xla_nhwc — the layout "
+                             "experiment), the k²-matmul decomposition "
                              "(ops/conv_gemm — MXU-shaped matmuls, no "
                              "im2col materialization), or the Pallas "
                              "slab kernel for 3×3/s1 shapes")

@@ -94,6 +94,22 @@ class SpatialConvolution(TensorModule):
                 x, w, stride=(self.stride_h, self.stride_w),
                 padding=padding if padding == "SAME"
                 else (self.pad_h, self.pad_w))
+        if impl == "xla_nhwc" and self.n_group == 1:
+            # the layout experiment: same XLA conv, activations flowing
+            # NHWC between boundary transposes.  The independent twin
+            # (NHWC end-to-end) measured ~14% faster than the NCHW
+            # framework on-chip — if XLA cancels the adjacent transpose
+            # pairs between layers, this knob recovers the layout share
+            # of that gap without changing the module API.
+            xs = jnp.transpose(x, (0, 2, 3, 1))
+            ws = jnp.transpose(w, (2, 3, 1, 0))  # OIHW -> HWIO
+            y = lax.conv_general_dilated(
+                xs, ws,
+                window_strides=(self.stride_h, self.stride_w),
+                padding=padding,
+                dimension_numbers=("NHWC", "HWIO", "NHWC"),
+                preferred_element_type=_acc_dtype(x))
+            return jnp.transpose(y, (0, 3, 1, 2))
         if (impl == "pallas" and self.n_group == 1
                 and (self.kernel_w, self.kernel_h) == (3, 3)
                 and (self.stride_w, self.stride_h) == (1, 1)

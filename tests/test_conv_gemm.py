@@ -77,6 +77,41 @@ def test_framework_conv_impl_gemm_matches_xla():
     np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
 
 
+def test_framework_conv_impl_xla_nhwc_matches_xla():
+    """The NHWC boundary-transpose lowering is the same function
+    (forward AND gradients), incl. SAME padding and strides."""
+    from bigdl_tpu import nn
+
+    for args in ((3, 8, 3, 3, 2, 2, 1, 1), (3, 8, 7, 7, 2, 2, -1, -1),
+                 (4, 4, 1, 1, 1, 1, 0, 0)):
+        def run(impl):
+            m = nn.SpatialConvolution(*args)  # noqa: B023
+            if impl:
+                m.set_conv_impl(impl)
+            x = jnp.asarray(R2.randn(2, args[0], 16, 16),  # noqa: B023
+                            jnp.float32)
+            out = np.asarray(m.forward(x))
+            gi = np.asarray(m.backward(x, jnp.ones_like(
+                jnp.asarray(out))))
+            return out, gi, jax.device_get(m.grad_tree())
+
+        R2 = np.random.RandomState(3)
+        from bigdl_tpu.utils.rng import RNG
+
+        RNG().set_seed(11)
+        want, gi_want, gw_want = run(None)
+        R2 = np.random.RandomState(3)
+        RNG().set_seed(11)
+        got, gi_got, gw_got = run("xla_nhwc")
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+        np.testing.assert_allclose(gi_got, gi_want, rtol=1e-5, atol=1e-5)
+        for a, b in zip(jax.tree_util.tree_leaves(gw_got),
+                        jax.tree_util.tree_leaves(gw_want)):
+            # weight AND bias grads: the layout-sensitive vjp direction
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-5, atol=1e-5)
+
+
 def test_framework_resnet_gemm_impl_matches_xla():
     """Whole framework ResNet (CIFAR variant: fast on CPU) under the
     gemm lowering must match the native lowering numerically."""
