@@ -149,7 +149,10 @@ def save_gpt2(lm):
     cfg = GPT2Config(
         vocab_size=lm.vocab_size, n_positions=lm.max_len, n_embd=E,
         n_layer=L, n_head=blocks[0].modules[1].num_heads, n_inner=Hm,
-        attn_pdrop=0.0, embd_pdrop=0.0, resid_pdrop=0.0,
+        attn_pdrop=0.0, embd_pdrop=0.0,
+        # preserve the residual-dropout setting so HF-side fine-tuning
+        # of the export keeps regularizing (eval parity is unaffected)
+        resid_pdrop=getattr(blocks[0], "dropout", 0.0),
         tie_word_embeddings=False)
     hf = GPT2LMHeadModel(cfg).eval()
     sd = {}

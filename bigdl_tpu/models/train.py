@@ -142,7 +142,8 @@ def build(model_name: str, args):
             # keeps the dense dispatch (same function, one shard)
             moe_axis="data" if (moe and getattr(args, "distributed",
                                                 False)) else None,
-            moe_aux_coef=getattr(args, "moe_aux_coef", 0.0))
+            moe_aux_coef=getattr(args, "moe_aux_coef", 0.0),
+            dropout=getattr(args, "dropout", 0.0))
         crit = nn.TimeDistributedCriterion(nn.CrossEntropyCriterion(), True)
         # synthetic char-LM with learnable structure: next token is a
         # fixed permutation of the current one, plus noise tokens
@@ -230,6 +231,10 @@ def main(argv=None):
                         help="Switch load-balance auxiliary loss "
                              "coefficient (0 disables; 0.01 is the "
                              "Switch Transformer default)")
+    parser.add_argument("--dropout", type=float, default=0.0,
+                        help="residual dropout in the transformer blocks "
+                             "(train-time only; per-shard decorrelated "
+                             "keys on distributed meshes)")
     parser.add_argument("--remat", action="store_true",
                         help="rematerialize transformer-block activations "
                              "in the backward pass (jax.checkpoint): HBM "

@@ -18,6 +18,7 @@ from __future__ import annotations
 from typing import Optional
 
 import jax
+import jax.numpy as jnp
 from jax import lax
 
 from .. import nn
@@ -39,7 +40,7 @@ class TransformerBlock(Container):
                  seq_axis: str = "seq", model_axis: Optional[str] = None,
                  moe_experts: int = 0, moe_axis: Optional[str] = None,
                  moe_capacity_factor: float = 1.25,
-                 moe_aux_coef: float = 0.0):
+                 moe_aux_coef: float = 0.0, dropout: float = 0.0):
         mods = [
             nn.LayerNorm(embed_dim),
             nn.MultiHeadAttention(embed_dim, num_heads, causal=causal,
@@ -72,6 +73,18 @@ class TransformerBlock(Container):
                                        axis_name=model_axis)]
         super().__init__(*mods)
         self.is_moe = bool(moe_experts)
+        # residual dropout applied FUNCTIONALLY (no extra modules, so
+        # the block structure the pipeline/generation builders rely on
+        # is unchanged); train-time only, keyed off the step rng the
+        # drivers already decorrelate per batch shard
+        self.dropout = float(dropout)
+
+    def _drop(self, v, key, training):
+        if self.dropout <= 0.0 or not training or key is None:
+            return v
+        keep = 1.0 - self.dropout
+        mask = jax.random.bernoulli(key, keep, v.shape)
+        return jnp.where(mask, v / keep, 0).astype(v.dtype)
 
     def apply_fn(self, params, buffers, x, training, rng):
         def sub(i):
@@ -82,7 +95,7 @@ class TransformerBlock(Container):
             params["0"], buffers["0"], x, training, sub(0))
         h, nb["1"] = self.modules[1].apply_fn(
             params["1"], buffers["1"], h, training, sub(1))
-        x = x + h
+        x = x + self._drop(h, sub(10), training)
         h, nb["2"] = self.modules[2].apply_fn(
             params["2"], buffers["2"], x, training, sub(2))
         h, nb["3"] = self.modules[3].apply_fn(
@@ -93,7 +106,7 @@ class TransformerBlock(Container):
             h = jax.nn.gelu(h)
             h, nb["4"] = self.modules[4].apply_fn(
                 params["4"], buffers["4"], h, training, sub(4))
-        return x + h, nb
+        return x + self._drop(h, sub(11), training), nb
 
 
 class TransformerLM(Container):
@@ -113,7 +126,7 @@ class TransformerLM(Container):
                  remat: bool = False, output: str = "log_probs",
                  moe_experts: int = 0, moe_axis: Optional[str] = None,
                  moe_capacity_factor: float = 1.25,
-                 moe_aux_coef: float = 0.0):
+                 moe_aux_coef: float = 0.0, dropout: float = 0.0):
         if output not in ("log_probs", "logits"):
             raise ValueError(f"output {output!r} not in (log_probs, logits)")
         mlp_dim = mlp_dim or 4 * embed_dim
@@ -134,7 +147,8 @@ class TransformerLM(Container):
                                    moe_experts=moe_experts,
                                    moe_axis=moe_axis,
                                    moe_capacity_factor=moe_capacity_factor,
-                                   moe_aux_coef=moe_aux_coef)
+                                   moe_aux_coef=moe_aux_coef,
+                                   dropout=dropout)
                   for _ in range(num_layers)]
         super().__init__(
             nn.LookupTable(vocab_size, embed_dim),

@@ -158,3 +158,41 @@ def test_synthetic_treebank_trees_well_formed():
         # leaf markers map nodes L..2L-1 to tokens 1..L
         assert [int(tree[L - 1 + i, 2]) for i in range(L)] == \
             list(range(1, L + 1))
+
+
+def test_block_dropout_trains_stochastic_evals_deterministic():
+    """Functional residual dropout: train-mode outputs vary with the
+    key and differ from the no-dropout path; eval mode is EXACTLY the
+    dropout=0 function (no module-count change — the pipeline and
+    generation builders see the same block structure)."""
+    import jax
+    import numpy as np
+
+    from bigdl_tpu.models.transformer import TransformerLM
+    from bigdl_tpu.utils.rng import RNG
+
+    RNG().set_seed(3)
+    plain = TransformerLM(19, embed_dim=8, num_heads=2, mlp_dim=16,
+                          num_layers=2, max_len=6)
+    RNG().set_seed(3)
+    dropped = TransformerLM(19, embed_dim=8, num_heads=2, mlp_dim=16,
+                            num_layers=2, max_len=6, dropout=0.5)
+    p = dropped.param_tree()
+    x = np.random.RandomState(0).randint(1, 20, (2, 6)).astype(np.int32)
+
+    eval_a, _ = plain.apply_fn(plain.param_tree(), plain.buffer_tree(),
+                               x, False, None)
+    eval_b, _ = dropped.apply_fn(p, dropped.buffer_tree(), x, False,
+                                 jax.random.PRNGKey(0))
+    np.testing.assert_allclose(np.asarray(eval_a), np.asarray(eval_b),
+                               atol=1e-6)
+
+    t1, _ = dropped.apply_fn(p, dropped.buffer_tree(), x, True,
+                             jax.random.PRNGKey(1))
+    t2, _ = dropped.apply_fn(p, dropped.buffer_tree(), x, True,
+                             jax.random.PRNGKey(2))
+    t1r, _ = dropped.apply_fn(p, dropped.buffer_tree(), x, True,
+                              jax.random.PRNGKey(1))
+    assert not np.allclose(np.asarray(t1), np.asarray(t2))
+    np.testing.assert_array_equal(np.asarray(t1), np.asarray(t1r))
+    assert not np.allclose(np.asarray(t1), np.asarray(eval_a))
