@@ -72,6 +72,13 @@ def main():
     default_to_cpu()
     p = argparse.ArgumentParser()
     p.add_argument("--minutes", type=float, default=120.0)
+    p.add_argument("--mode", default="multi_axis",
+                   choices=("multi_axis", "pipeline"),
+                   help="multi_axis: the r4 dp x sp x tp ring-attention "
+                        "soak; pipeline: the session-3 combined soak — "
+                        "3-D dp x pipe x model GPipe driver with "
+                        "residual dropout, optax AdamW and ASYNC orbax "
+                        "sharded checkpoints")
     p.add_argument("--batch", type=int, default=16)
     p.add_argument("--seq-len", type=int, default=64)
     p.add_argument("--out", default=None)
@@ -96,12 +103,18 @@ def main():
 
     V, T = 257, a.seq_len
     devs = jax.devices()
-    mesh = Mesh(np.array(devs[:8]).reshape(2, 2, 2),
-                ("data", "seq", "model"))
     RNG().set_seed(42)
-    lm = TransformerLM(V, embed_dim=32, num_heads=4, num_layers=2,
-                       max_len=T, seq_strategy="ring", seq_axis="seq",
-                       model_axis="model")
+    if a.mode == "pipeline":
+        mesh = Mesh(np.array(devs[:8]).reshape(2, 2, 2),
+                    ("data", "pipe", "model"))
+        lm = TransformerLM(V, embed_dim=32, num_heads=4, num_layers=2,
+                           max_len=T, model_axis="model", dropout=0.1)
+    else:
+        mesh = Mesh(np.array(devs[:8]).reshape(2, 2, 2),
+                    ("data", "seq", "model"))
+        lm = TransformerLM(V, embed_dim=32, num_heads=4, num_layers=2,
+                           max_len=T, seq_strategy="ring", seq_axis="seq",
+                           model_axis="model")
 
     # learnable synthetic corpus: markov-ish byte stream (loss must
     # DESCEND over hours, so the data needs learnable structure)
@@ -125,13 +138,26 @@ def main():
     crit = nn.TimeDistributedCriterion(nn.ClassNLLCriterion(), True)
 
     opt = DistriOptimizer(lm, train, crit, batch_size=a.batch, mesh=mesh)
-    opt.set_optim_method(SGD(learning_rate=0.3, momentum=0.9))
+    if a.mode == "pipeline":
+        import optax
+
+        from ..optim import OptaxMethod
+
+        opt.set_optim_method(OptaxMethod(optax.adamw, 3e-3,
+                                         weight_decay=1e-5))
+        opt.set_pipeline_microbatch(2)
+    else:
+        opt.set_optim_method(SGD(learning_rate=0.3, momentum=0.9))
     telemetry = _Telemetry(a.minutes, out_path)
     opt.set_end_when(telemetry)
     opt.set_validation(every_epoch(), val, [Loss(crit)],
                        batch_size=a.batch)
     os.makedirs(a.checkpoint_dir, exist_ok=True)
-    opt.set_checkpoint(a.checkpoint_dir, several_iteration(500))
+    opt.set_checkpoint(a.checkpoint_dir, several_iteration(500),
+                       format="orbax" if a.mode == "pipeline"
+                       else "pickle")
+    if a.mode == "pipeline":
+        opt.overwrite_checkpoint()  # bounded orbax retention over hours
 
     t0 = time.time()
     opt.optimize()
@@ -158,7 +184,10 @@ def main():
         "telemetry": os.path.basename(out_path),
     }
     print(json.dumps(summary), flush=True)
-    with open(os.path.join(root, "LONGRUN_SUMMARY.json"), "w") as f:
+    summary["mode"] = a.mode
+    name = ("LONGRUN_SUMMARY.json" if a.mode == "multi_axis"
+            else "LONGRUN_PIPELINE_SUMMARY.json")
+    with open(os.path.join(root, name), "w") as f:
         json.dump(summary, f, indent=1)
 
 
