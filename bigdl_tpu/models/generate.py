@@ -35,6 +35,7 @@ no EOS convention).
 """
 from __future__ import annotations
 
+import threading
 import weakref
 from functools import partial
 from typing import Optional
@@ -56,16 +57,42 @@ def _check_model(model):
         raise TypeError(
             f"generation supports TransformerLM (got "
             f"{type(model).__name__})")
-    if model.seq_strategy in ("ring", "ulysses"):
-        raise ValueError(
-            "generation runs single-shard attention; build the model "
-            "with a dense/flash seq_strategy for decode")
+    # seq_strategy (dense/flash/ring/ulysses) changes only HOW training
+    # attention is computed — the parameter tree is strategy-independent,
+    # so a ring/Ulysses-trained model decodes through the same cached
+    # single-shard attention as a dense one (pinned against a dense twin
+    # built from the same params in tests/test_generate.py)
     return 1, len(model.modules) - 3
+
+
+def _check_len(model, max_len):
+    """Validate the decode window against the positional table: the
+    cached path embeds positions by ``lax.dynamic_slice_in_dim`` on
+    ``pc['pos']``, whose clamped start would silently REUSE the last
+    positions past ``model.max_len`` — wrong embeddings, so refuse
+    loudly instead."""
+    T_max = int(max_len or model.max_len)
+    if T_max > model.max_len:
+        raise ValueError(
+            f"max_len {T_max} exceeds the model's positional table "
+            f"({model.max_len}); the decode window cannot outgrow "
+            f"the positions the model was built with")
+    return T_max
 
 
 def _proj(x, params, w, b, with_bias):
     y = jnp.dot(x, params[w].T)
     return y + params[b] if with_bias else y
+
+
+# capacity-bind capture: while a list is installed on this thread,
+# every _moe_ffn_nodrop call appends the fraction of its tokens that
+# the TRAINING dispatch's static capacity would have dropped (trace-
+# time side channel for capacity_bind_report; absent during normal
+# decode).  Thread-LOCAL so a concurrent trace of another model's
+# generator cannot interleave its fractions into this report.
+
+_BIND_TLS = threading.local()
 
 
 def _moe_ffn_nodrop(moe, params, x):
@@ -80,6 +107,14 @@ def _moe_ffn_nodrop(moe, params, x):
     probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
     idx = jnp.argmax(probs, axis=-1)
     gate = jnp.max(probs, axis=-1).astype(x.dtype)
+    if getattr(_BIND_TLS, "capture", None) is not None:
+        # the training dispatch's keep rule, via the module's own
+        # shared helper so the two can never drift (capacity from THIS
+        # batch's token count)
+        onehot = jax.nn.one_hot(idx, moe.n_experts, dtype=jnp.float32)
+        _, keep = moe.keep_mask(onehot)
+        _BIND_TLS.capture.append(
+            1.0 - jnp.sum(keep.astype(jnp.float32)) / (B * Tq))
     wi, bi = params["wi"][idx], params["bi"][idx]      # [N, D, H], [N, H]
     wo, bo = params["wo"][idx], params["bo"][idx]      # [N, H, D], [N, D]
     h = jax.nn.gelu(jnp.einsum("nd,ndh->nh", x2, wi.astype(x.dtype))
@@ -198,7 +233,7 @@ def make_generate(model, max_len: Optional[int] = None,
     from ..optim.optimizer import _cast_floats
 
     first, count = _check_model(model)
-    T_max = int(max_len or model.max_len)
+    T_max = _check_len(model, max_len)
     prefill, decode_token, logits_last = _decode_machinery(
         model, first, count, T_max)
 
@@ -296,7 +331,7 @@ def make_beam_search(model, max_len: Optional[int] = None,
     from ..optim.optimizer import _cast_floats
 
     first, count = _check_model(model)
-    T_max = int(max_len or model.max_len)
+    T_max = _check_len(model, max_len)
     prefill, decode_token, logits_last = _decode_machinery(
         model, first, count, T_max)
 
@@ -371,6 +406,68 @@ def make_beam_search(model, max_len: Optional[int] = None,
                     int(max_new), int(num_beams))
 
     return beam_search
+
+
+# compiled capacity replays per model instance (the _GEN_CACHE
+# pattern): the report is meant to run on EVERY batch a generator
+# produces, so the prefill replay must not recompile per call
+_BIND_CACHE = weakref.WeakKeyDictionary()
+
+
+def capacity_bind_report(model, params, ids):
+    """How far MoE decode diverges from the trained function: per MoE
+    block, the fraction of ``ids``'s tokens that the TRAINING dispatch's
+    static capacity (``parallel/moe.py`` ``_route``: ``C = ceil(f·N/E)``
+    at this batch's token count) would have DROPPED.  Decode itself
+    routes capacity-free — a trained model whose capacity binds decodes
+    through a different function than it was trained on, and this is the
+    measurement of how often (weak-#8 contract: run it on real routed
+    batches, e.g. the sequences a generator just produced).
+
+    Teacher-forcing replay through the decode machinery (capacity-free
+    MoE advance, so the hidden states are exactly the decode path's).
+    The capacity rule applied is the DENSE dispatch's global convention
+    (one cumsum over all ``B·T`` tokens, ``C = ceil(f·N/E)``).  A model
+    trained under expert parallelism budgeted per (shard, expert) pair
+    instead (``C_local = ceil(f·N_local/E)``, moe.py module docstring),
+    which can only drop MORE when a hot expert's load concentrates on
+    one shard — so for sharded-trained models this report is a lower
+    bound (and the training-time shard composition of a batch isn't
+    reconstructible at decode time anyway).
+
+    Returns ``{block_index: fraction}`` over the model's MoE blocks plus
+    ``"overall"`` (their mean); ``{}`` for a dense model."""
+    first, count = _check_model(model)
+    blocks = model.modules[first:first + count]
+    moe_idx = [first + bi for bi, b in enumerate(blocks) if b.is_moe]
+    if not moe_idx:
+        return {}
+    ids = jnp.asarray(ids, jnp.int32)
+    T = int(ids.shape[1])
+    if T > model.max_len:
+        raise ValueError(f"sequence length {T} exceeds max_len "
+                         f"{model.max_len}")
+
+    slot = _BIND_CACHE.setdefault(model, {})
+    if T not in slot:
+        prefill, _, _ = _decode_machinery(model, first, count, T)
+
+        @jax.jit
+        def _replay(p, toks):
+            _BIND_TLS.capture = []
+            try:
+                dt = jax.tree_util.tree_leaves(p)[0].dtype
+                prefill(p, toks, dt)
+                fracs = list(_BIND_TLS.capture)
+            finally:
+                _BIND_TLS.capture = None
+            return jnp.stack(fracs)
+
+        slot[T] = _replay
+    fracs = [float(f) for f in slot[T](params, ids)]
+    report = dict(zip(moe_idx, fracs))
+    report["overall"] = sum(fracs) / len(fracs)
+    return report
 
 
 def cached_generate(model, compute_dtype=None):

@@ -184,11 +184,23 @@ class Optimizer:
         module tree; "packed": the pipeline's packed layout)."""
         import pickle
 
-        from ..utils.orbax_io import ShardedCheckpointer
+        from ..utils.orbax_io import ShardedCheckpointer, latest_step
 
         if self._orbax is None:
             self._orbax = ShardedCheckpointer(self.checkpoint_path)
         n = state["neval"] - 1
+        # retention safety: snapshot the newest COMMITTED step before
+        # kicking off step n's async save — probing after the save
+        # starts could see n's not-yet-committed directory as "latest"
+        # and delete the actual last good checkpoint while n is still
+        # in flight.  Drain the PREVIOUS async save first: probing
+        # while it is still writing would miss it, and save(n)'s own
+        # internal wait would then commit it right before retention
+        # deletes it as not-in-keep.
+        committed_before = None
+        if self.is_overwrite:
+            self._orbax.wait()
+            committed_before = latest_step(self._orbax.directory)
         self._orbax.save(n, tree)
         meta = {"kind": kind, "state": dict(state),
                 "abstract": jax.tree_util.tree_map(
@@ -205,10 +217,9 @@ class Optimizer:
             import shutil
 
             from ..utils.orbax_io import ShardedCheckpointer as SC
-            from ..utils.orbax_io import latest_step as _ls
 
-            committed = _ls(self._orbax.directory)
-            keep = {n, committed if committed is not None else n}
+            keep = {n, committed_before
+                    if committed_before is not None else n}
             for name in os.listdir(self._orbax.directory):
                 for prefix, is_dir in ((SC.PREFIX, True), ("meta-", False)):
                     if name.startswith(prefix):

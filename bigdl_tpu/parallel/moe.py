@@ -135,9 +135,8 @@ class MoEFFN(TensorModule):
         gate = jnp.max(probs, axis=-1)                        # [N]
         onehot = jax.nn.one_hot(idx, self.n_experts,
                                 dtype=jnp.float32)            # [N, E]
-        pos = jnp.cumsum(onehot, axis=0) * onehot             # 1-based
+        pos, keep = self.keep_mask(onehot)
         C = self._capacity(x2d.shape[0])
-        keep = (pos <= C) & (onehot > 0)                      # [N, E]
         gate = gate * jnp.sum(keep, axis=-1)                  # 0 if dropped
         # [N, E, C]: token n occupies slot pos-1 of its expert
         disp = (jax.nn.one_hot((pos - 1).astype(jnp.int32), C,
@@ -165,6 +164,16 @@ class MoEFFN(TensorModule):
     def _capacity(self, n_tokens: int) -> int:
         return max(1, int(np.ceil(self.capacity_factor * n_tokens
                                   / self.n_experts)))
+
+    def keep_mask(self, onehot):
+        """The dispatch's keep rule, shared with diagnostics
+        (models/generate.py capacity_bind_report re-applies it at decode
+        time): first-come slot assignment via 1-based position-in-expert
+        cumsum over the flattened token order, capacity from the token
+        count.  ``onehot`` [N, E] → (pos [N, E] 1-based, keep [N, E])."""
+        pos = jnp.cumsum(onehot, axis=0) * onehot             # 1-based
+        C = self._capacity(onehot.shape[0])
+        return pos, (pos <= C) & (onehot > 0)                 # [N, E]
 
     def _expert_mlp(self, inp, params):
         """inp [e, c, D] through the (possibly expert-sharded) stacked

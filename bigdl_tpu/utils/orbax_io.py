@@ -48,6 +48,11 @@ class ShardedCheckpointer:
         self._ckpt.wait_until_finished()  # at most one save in flight
         self._ckpt.save(self._path(step), tree)
 
+    def wait(self) -> None:
+        """Drain the in-flight async save (after this, its step is
+        committed and visible to :func:`latest_step`)."""
+        self._ckpt.wait_until_finished()
+
     def latest_step(self) -> Optional[int]:
         return latest_step(self.directory)
 
@@ -71,15 +76,33 @@ class ShardedCheckpointer:
         self._ckpt.wait_until_finished()
 
 
+def _is_finalized(path: str) -> bool:
+    """True when orbax's commit protocol has finalized ``path`` — an
+    async save's directory can be VISIBLE before it is committed, and
+    treating it as the latest step would let retention delete the last
+    good checkpoint (or resume pick a torn one)."""
+    try:
+        import orbax.checkpoint as ocp
+
+        return bool(ocp.utils.is_checkpoint_finalized(path))
+    except Exception:
+        # orbax without the helper (or a probe error): presence is the
+        # best signal available
+        return True
+
+
 def latest_step(directory: str) -> Optional[int]:
     """Newest committed ``ckpt-N`` step in ``directory`` (numeric order,
-    not lexicographic — ckpt-32 > ckpt-8)."""
+    not lexicographic — ckpt-32 > ckpt-8).  Steps whose orbax commit
+    marker is absent (async save still in flight, or a crash mid-write)
+    are not counted."""
     pat = re.compile(rf"^{ShardedCheckpointer.PREFIX}(\d+)$")
     best = None
     try:
         for name in os.listdir(directory):
             m = pat.match(name)
-            if m and os.path.isdir(os.path.join(directory, name)):
+            p = os.path.join(directory, name)
+            if m and os.path.isdir(p) and _is_finalized(p):
                 n = int(m.group(1))
                 best = n if best is None or n > best else best
     except OSError:

@@ -167,15 +167,103 @@ def test_beam_one_equals_greedy():
     np.testing.assert_array_equal(np.asarray(beam_ids), greedy)
 
 
-def test_generate_rejects_overflow_and_ring():
+def test_generate_rejects_overflow():
     model = _model()
     gen = make_generate(model)
     prompt = np.ones((1, 20), np.int32)
     with pytest.raises(ValueError, match="max_len"):
         gen(model.param_tree(), prompt, max_new=10)
-    RNG().set_seed(4)
-    ring = TransformerLM(VOCAB, embed_dim=EMBED, num_heads=HEADS,
-                         mlp_dim=MLP, num_layers=2, max_len=TMAX,
-                         seq_strategy="ring")
-    with pytest.raises(ValueError, match="dense/flash"):
-        make_generate(ring)
+
+
+def test_generate_rejects_max_len_beyond_positional_table():
+    """A decode window longer than the positional table would silently
+    reuse the last positions (dynamic_slice clamping) — must refuse."""
+    from bigdl_tpu.models.generate import make_beam_search
+
+    model = _model()
+    with pytest.raises(ValueError, match="positional table"):
+        make_generate(model, max_len=TMAX + 1)
+    with pytest.raises(ValueError, match="positional table"):
+        make_beam_search(model, max_len=TMAX + 1)
+
+
+@pytest.mark.parametrize("strategy", ["ring", "ulysses"])
+def test_ring_trained_model_decodes_like_dense_twin(strategy):
+    """seq_strategy changes HOW training attention is computed, not the
+    parameters — a ring/Ulysses-built model must decode exactly like a
+    dense twin holding the same params (VERDICT r4 #4: no caller-side
+    twin rebuild, no refusal)."""
+    sharded = _model(seq_strategy=strategy)   # seeded: same init as
+    dense = _model()                          # the dense twin
+    chex = jax.tree_util.tree_all(jax.tree_util.tree_map(
+        lambda a, b: jnp.array_equal(a, b), sharded.param_tree(),
+        dense.param_tree()))
+    assert bool(chex), "seeded init must be strategy-independent"
+    rng = np.random.RandomState(11)
+    prompt = rng.randint(1, VOCAB + 1, (2, 5)).astype(np.int32)
+    got = np.asarray(make_generate(sharded)(
+        sharded.param_tree(), prompt, max_new=7))
+    want = np.asarray(make_generate(dense)(
+        dense.param_tree(), prompt, max_new=7))
+    np.testing.assert_array_equal(got, want)
+    _teacher_force_check(dense, got, prompt_len=5)
+
+
+def test_capacity_bind_report_dense_and_loose():
+    from bigdl_tpu.models.generate import capacity_bind_report
+
+    dense = _model()
+    assert capacity_bind_report(
+        dense, dense.param_tree(), np.ones((2, 6), np.int32)) == {}
+    loose = _model(moe_experts=2, moe_capacity_factor=8.0)
+    rng = np.random.RandomState(5)
+    ids = rng.randint(1, VOCAB + 1, (2, 8)).astype(np.int32)
+    rep = capacity_bind_report(loose, loose.param_tree(), ids)
+    assert rep["overall"] == 0.0
+    assert set(rep) == {1, 2, "overall"}  # blocks at module idx 1, 2
+
+
+def test_capacity_bind_report_matches_brute_force():
+    """When capacity binds, the reported fraction must equal an
+    independent replay: hidden states advanced block by block through
+    the module apply_fns (full-sequence causal attention, capacity-free
+    MoE — the decode path's semantics), with the training dispatch's
+    over-capacity count recomputed in numpy at every MoE router."""
+    from bigdl_tpu.models.generate import (_moe_ffn_nodrop,
+                                           capacity_bind_report)
+
+    model = _model(moe_experts=2, moe_capacity_factor=0.51)
+    params = model.param_tree()
+    rng = np.random.RandomState(7)
+    ids = rng.randint(1, VOCAB + 1, (2, 6)).astype(np.int32)
+    rep = capacity_bind_report(model, params, ids)
+
+    count = len(model.modules) - 3
+    blocks = model.modules[1:1 + count]
+    N = ids.size
+    h, _ = model.modules[0].apply_fn(params["0"], {},
+                                     jnp.asarray(ids), False, None)
+    h = h + params["pos"][:ids.shape[1]]
+    want = {}
+    for bi, b in enumerate(blocks):
+        bp = params[str(1 + bi)]
+        ln1, _ = b.modules[0].apply_fn(bp["0"], {}, h, False, None)
+        att, _ = b.modules[1].apply_fn(bp["1"], {}, ln1, False, None)
+        h = h + att
+        ln2, _ = b.modules[2].apply_fn(bp["2"], {}, h, False, None)
+        moe = b.modules[3]
+        # independent numpy routing: top-1 argmax, first-come slots
+        x2 = np.asarray(ln2, np.float32).reshape(N, -1)
+        logits = x2 @ np.asarray(bp["3"]["router_w"]).T \
+            + np.asarray(bp["3"]["router_b"])
+        idx = np.argmax(logits, axis=-1)  # softmax is rank-preserving
+        C = moe._capacity(N)
+        seen, dropped = {}, 0
+        for e in idx:
+            seen[int(e)] = seen.get(int(e), 0) + 1
+            dropped += seen[int(e)] > C
+        want[1 + bi] = dropped / N
+        h = h + _moe_ffn_nodrop(moe, bp["3"], ln2)
+    for k, v in want.items():
+        np.testing.assert_allclose(rep[k], v, atol=1e-6)
+    assert rep["overall"] > 0.0  # capacity 0.51 must bind somewhere

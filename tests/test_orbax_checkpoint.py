@@ -129,6 +129,41 @@ def test_orbax_overwrite_bounds_retention(tmp_path):
     assert len(steps) <= 2 and "ckpt-8" in steps
 
 
+def test_orbax_retention_race_keeps_last_committed(tmp_path, monkeypatch):
+    """Regression (ADVICE r4): an async save whose ckpt-N directory is
+    already VISIBLE (but not yet committed) when retention runs must
+    not be counted as the newest committed step — the old probe-after-
+    save code would compute keep={N} and delete the last good
+    checkpoint while N was still in flight."""
+    import os
+
+    import jax.numpy as jnp
+
+    from bigdl_tpu.utils import orbax_io
+
+    mesh = Mesh(np.array(jax.devices()[:8]).reshape(2, 4),
+                ("data", "model"))
+    model = _tp_model()
+    opt = DistriOptimizer(model, array(_samples()), nn.ClassNLLCriterion(),
+                          batch_size=16, mesh=mesh)
+    opt.set_checkpoint(str(tmp_path), several_iteration(2),
+                       format="orbax")
+    opt.overwrite_checkpoint()
+
+    (tmp_path / "ckpt-2").mkdir()  # the last committed step
+
+    # a save whose target directory appears immediately but never
+    # commits (the worst-case filesystem visibility the advice names)
+    def fake_save(self, step, tree):
+        os.makedirs(self._path(step), exist_ok=True)
+
+    monkeypatch.setattr(orbax_io.ShardedCheckpointer, "save", fake_save)
+    opt._orbax_save({"neval": 5}, {"w": jnp.zeros((2,))}, "model")
+    assert (tmp_path / "ckpt-2").exists(), \
+        "retention deleted the last committed step during the race"
+    assert (tmp_path / "ckpt-4").exists()
+
+
 def test_orbax_resume_falls_back_when_meta_missing(tmp_path):
     """A committed step without its sidecar (interrupted save) is
     skipped; the newest complete step restores."""
