@@ -254,9 +254,17 @@ class Optimizer:
             except FileNotFoundError:
                 log.warning("orbax step %d has no meta sidecar "
                             "(interrupted save?) — falling back", n)
-                older = [s for s in range(n) if os.path.isdir(
-                    os.path.join(directory,
-                                 f"{ShardedCheckpointer.PREFIX}{s}"))]
+                from ..utils.orbax_io import _is_finalized
+
+                # same commit-marker guard as latest_step: a torn step
+                # can have a meta sidecar (written synchronously before
+                # the async save finished) — never restore it
+                older = [
+                    s for s in range(n)
+                    if os.path.isdir(os.path.join(
+                        directory, f"{ShardedCheckpointer.PREFIX}{s}"))
+                    and _is_finalized(os.path.join(
+                        directory, f"{ShardedCheckpointer.PREFIX}{s}"))]
                 n = max(older) if older else None
         if meta is None:
             return False

@@ -4,8 +4,11 @@ The fourth parallel axis of the rebuild (alongside ``data``/``seq``/
 ``model`` in parallel/spmd.py).  The reference has no pipeline story —
 its only strategy is synchronous data parallelism (SURVEY §2.2) — so
 this is a forward-looking extension shaped by how the hardware wants
-it: the repeated transformer blocks of a :class:`~bigdl_tpu.models.
-transformer.TransformerLM` are stacked into one leading-``L`` pytree,
+it: a repeated-block region — the transformer blocks of a
+:class:`~bigdl_tpu.models.transformer.TransformerLM`, or the maximal
+identical-block run of ANY :class:`~bigdl_tpu.nn.Sequential` (wrap the
+repeated unit in its own ``Sequential``) — is stacked into one
+leading-``L`` pytree,
 sharded over the ``pipe`` axis (each stage owns ``L/S`` layers AND
 their optimizer state), and the microbatched GPipe schedule is a
 ``lax.scan`` over ``M + S - 1`` ticks whose inter-stage hop is a single
@@ -47,42 +50,71 @@ from jax.sharding import PartitionSpec as P
 
 
 def _block_run(model):
-    """Locate the maximal run of structurally identical transformer
+    """Locate the maximal run of structurally identical PARAMETERIZED
     blocks in ``model.modules`` (same param treedef + leaf shapes).
-    Returns (first_index, count)."""
-    sig = []
+    Parameterless runs (e.g. repeated activations) are never candidates
+    — there is nothing to shard over the pipe axis, and letting them
+    win would shadow an equally long parameterized run.  Returns
+    (first_index, count)."""
+    sig, has_params = [], []
     for m in model.modules:
         t = m.param_tree()
         leaves, treedef = jax.tree_util.tree_flatten(t)
         sig.append((treedef, tuple(getattr(a, "shape", ()) for a in leaves),
                     type(m).__name__))
+        has_params.append(bool(leaves))
     best = (0, 0)
     i = 0
     while i < len(sig):
         j = i + 1
         while j < len(sig) and sig[j] == sig[i]:
             j += 1
-        if j - i > best[1]:
+        if has_params[i] and j - i > best[1]:
             best = (i, j - i)
         i = j
     return best
 
 
-def _check_layout(model):
-    """Validate the [embed, blocks..., ln, head] layout; return
-    (first, count).  Shared by pack/unpack and the step builders."""
+def _is_lm(model):
     from ..models.transformer import TransformerLM
 
-    if not isinstance(model, TransformerLM):
+    return isinstance(model, TransformerLM)
+
+
+def _check_layout(model):
+    """Validate the pipelined layout; return (first, count) of the
+    pipelined block run.  Shared by pack/unpack and the step builders.
+
+    Two shapes are accepted: a :class:`TransformerLM` ([embed,
+    blocks..., ln, head] — the blocks ride the pipe, embed/ln/head
+    replicate), or ANY :class:`~bigdl_tpu.nn.Sequential` whose middle is
+    a maximal run of structurally identical parameterized blocks (same
+    treedef + leaf shapes + class) — head/tail modules around the run
+    replicate the same way.  Users pipeline a custom stack by wrapping
+    the repeated unit in its own ``Sequential`` so consecutive units
+    compare equal."""
+    from ..nn.containers import Sequential
+
+    if _is_lm(model):
+        first, count = _block_run(model)
+        if first != 1 or count != len(model.modules) - 3:
+            raise ValueError(
+                "TransformerLM layout changed: expected [embed, "
+                f"blocks..., ln, head], found block run at {first} "
+                f"len {count}")
+        return first, count
+    if not isinstance(model, Sequential):
         raise TypeError(
-            "pipeline parallelism currently supports TransformerLM "
-            f"(got {type(model).__name__}); the pipelined region must be "
-            "a run of structurally identical blocks")
+            "pipeline parallelism supports TransformerLM or a "
+            "Sequential whose middle is a run of structurally identical "
+            f"blocks (got {type(model).__name__})")
     first, count = _block_run(model)
-    if first != 1 or count != len(model.modules) - 3:
+    if count < 2:
         raise ValueError(
-            "TransformerLM layout changed: expected [embed, blocks..., "
-            f"ln, head], found block run at {first} len {count}")
+            "no pipelined region: the Sequential needs a run of >= 2 "
+            "structurally identical parameterized blocks (wrap the "
+            "repeated unit in its own Sequential so consecutive units "
+            "compare equal)")
     return first, count
 
 
@@ -90,7 +122,7 @@ def _check_model(model, n_pipe, model_axis=None):
     from .tensor_parallel import ColumnParallelLinear, RowParallelLinear
 
     first, count = _check_layout(model)
-    if model.seq_strategy in ("ring", "ulysses"):
+    if getattr(model, "seq_strategy", None) in ("ring", "ulysses"):
         raise ValueError(
             "pipeline parallelism composes with data/model axes only; "
             f"seq_strategy {model.seq_strategy!r} needs a bound seq axis "
@@ -137,13 +169,22 @@ def _check_model(model, n_pipe, model_axis=None):
 def pack_params(model, n_pipe: int, model_axis=None):
     """Model param tree → pipeline tree: the L block subtrees stacked
     into leading-``L`` leaves (sharded P('pipe') over stages), the rest
-    verbatim.  Inverse: :func:`unpack_params`."""
+    verbatim.  TransformerLM keeps its named layout (embed/pos/ln/head
+    — checkpoint compatibility); a generic Sequential packs the modules
+    around the run as ``pre``/``post`` keyed by absolute module index.
+    Inverse: :func:`unpack_params`."""
     first, count = _check_model(model, n_pipe, model_axis)
     t = model.param_tree()
     blocks = [t[str(i)] for i in range(first, first + count)]
     stacked = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *blocks)
-    return {"embed": t["0"], "pos": t["pos"], "blocks": stacked,
-            "ln": t[str(first + count)], "head": t[str(first + count + 1)]}
+    if _is_lm(model):
+        return {"embed": t["0"], "pos": t["pos"], "blocks": stacked,
+                "ln": t[str(first + count)],
+                "head": t[str(first + count + 1)]}
+    return {"pre": {str(i): t[str(i)] for i in range(first)},
+            "blocks": stacked,
+            "post": {str(i): t[str(i)]
+                     for i in range(first + count, len(model.modules))}}
 
 
 def unpack_params(packed, model):
@@ -157,9 +198,13 @@ def unpack_params(packed, model):
         raise ValueError(
             f"packed tree carries {stacked_l[0].shape[0]} block layers "
             f"but the model has {count}")
-    tree = {"0": packed["embed"], "pos": packed["pos"],
-            str(first + count): packed["ln"],
-            str(first + count + 1): packed["head"]}
+    if _is_lm(model):
+        tree = {"0": packed["embed"], "pos": packed["pos"],
+                str(first + count): packed["ln"],
+                str(first + count + 1): packed["head"]}
+    else:
+        tree = dict(packed["pre"])
+        tree.update(packed["post"])
     for i in range(count):
         tree[str(first + i)] = jax.tree_util.tree_map(
             lambda a, _i=i: a[_i], packed["blocks"])
@@ -185,13 +230,13 @@ def param_specs(packed, pipe_axis: str = "pipe", block=None,
     else:
         blocks = jax.tree_util.tree_map(lambda _: P(pipe_axis),
                                         packed["blocks"])
-    return {
-        "embed": jax.tree_util.tree_map(lambda _: P(), packed["embed"]),
-        "pos": P(),
-        "blocks": blocks,
-        "ln": jax.tree_util.tree_map(lambda _: P(), packed["ln"]),
-        "head": jax.tree_util.tree_map(lambda _: P(), packed["head"]),
-    }
+    repl = lambda sub: jax.tree_util.tree_map(lambda _: P(), sub)
+    if "embed" in packed:
+        return {"embed": repl(packed["embed"]), "pos": P(),
+                "blocks": blocks, "ln": repl(packed["ln"]),
+                "head": repl(packed["head"])}
+    return {"pre": repl(packed["pre"]), "blocks": blocks,
+            "post": repl(packed["post"])}
 
 
 def _make_local_forward(model, first, count, S, M, pipe_axis,
@@ -208,9 +253,6 @@ def _make_local_forward(model, first, count, S, M, pipe_axis,
     Lp = count // S
     block = model.modules[first]
     block_bufs = block.buffer_tree()
-    embed = model.modules[0]
-    ln = model.modules[first + count]
-    head = model.modules[first + count + 1]
     perm = [(i, i + 1) for i in range(S - 1)]
 
     def stage_fn(blocks_local, act, rng, training):
@@ -227,14 +269,11 @@ def _make_local_forward(model, first, count, S, M, pipe_axis,
     if remat:
         stage_fn = jax.checkpoint(stage_fn, static_argnums=(3,))
 
-    def local_fwd(packed, x, training, rng, upcast):
-        pc = (_cast_floats(packed, compute_dtype)
-              if compute_dtype is not None else packed)
-        xc = (_cast_floats(x, compute_dtype)
-              if compute_dtype is not None else x)
-        h, _ = embed.apply_fn(pc["embed"], embed.buffer_tree(), xc,
-                              training, None)
-        h = h + model._positions(pc["pos"], h.shape[1])
+    def run_pipe(blocks_p, h, training, rng):
+        """The GPipe schedule on pre-computed activations ``h`` [B,...]:
+        microbatch split, the (M+S-1)-tick scan with the ppermute ring,
+        and the last-stage bank broadcast — ONE implementation behind
+        both model layouts so the schedules can never diverge."""
         B = h.shape[0]
         if B % M:
             raise ValueError(
@@ -252,7 +291,7 @@ def _make_local_forward(model, first, count, S, M, pipe_axis,
             # layer index on top — no two (tick, layer) reuse a key
             key = (jax.random.fold_in(jax.random.fold_in(rng, t), stage)
                    if rng is not None else None)
-            act_out = stage_fn(pc["blocks"], act_in, key, training)
+            act_out = stage_fn(blocks_p, act_in, key, training)
             slot = t - (S - 1)
             upd = lax.dynamic_update_index_in_dim(
                 store, act_out, jnp.clip(slot, 0, M - 1), 0)
@@ -270,12 +309,72 @@ def _make_local_forward(model, first, count, S, M, pipe_axis,
         store = lax.psum(
             jnp.where(stage == S - 1, store, jnp.zeros_like(store)),
             pipe_axis)
-        h = store.reshape((B,) + store.shape[2:])
-        h, _ = ln.apply_fn(pc["ln"], ln.buffer_tree(), h, training, None)
-        h, _ = head.apply_fn(pc["head"], head.buffer_tree(), h, training,
-                             None)
-        if model._output_mode == "log_probs":
-            h = jax.nn.log_softmax(h, axis=-1)
+        return store.reshape((B,) + store.shape[2:])
+
+    if _is_lm(model):
+        embed = model.modules[0]
+        ln = model.modules[first + count]
+        head = model.modules[first + count + 1]
+
+        def local_fwd(packed, x, training, rng, upcast):
+            pc = (_cast_floats(packed, compute_dtype)
+                  if compute_dtype is not None else packed)
+            xc = (_cast_floats(x, compute_dtype)
+                  if compute_dtype is not None else x)
+            h, _ = embed.apply_fn(pc["embed"], embed.buffer_tree(), xc,
+                                  training, None)
+            h = h + model._positions(pc["pos"], h.shape[1])
+            h = run_pipe(pc["blocks"], h, training, rng)
+            h, _ = ln.apply_fn(pc["ln"], ln.buffer_tree(), h, training,
+                               None)
+            h, _ = head.apply_fn(pc["head"], head.buffer_tree(), h,
+                                 training, None)
+            if model._output_mode == "log_probs":
+                h = jax.nn.log_softmax(h, axis=-1)
+            if compute_dtype is not None and upcast:
+                h = _cast_floats(h, jnp.float32)
+            return h
+
+        return local_fwd
+
+    pre = list(enumerate(model.modules[:first]))
+    post = [(first + count + i, m)
+            for i, m in enumerate(model.modules[first + count:])]
+
+    def _edge(mods, pc_sub, h, training, rng):
+        for i, m in mods:
+            key = (jax.random.fold_in(rng, i)
+                   if rng is not None else None)
+            h, _ = m.apply_fn(pc_sub[str(i)], m.buffer_tree(), h,
+                              training, key)
+        return h
+
+    def local_fwd(packed, x, training, rng, upcast):
+        pc = (_cast_floats(packed, compute_dtype)
+              if compute_dtype is not None else packed)
+        xc = (_cast_floats(x, compute_dtype)
+              if compute_dtype is not None else x)
+        # edge-module keys fold the absolute module index; the pipe
+        # region's keys fold (tick, stage, layer) — disjoint by use
+        h = _edge(pre, pc["pre"], xc, training,
+                  jax.random.fold_in(rng, 2**31 - 1) if rng is not None
+                  else None)
+        # shape-preservation check at trace time: the ring's where/
+        # ppermute need block(out) shaped exactly like block(in), and
+        # the raw XLA mismatch error would not name the real cause
+        lp0 = jax.tree_util.tree_map(lambda a: a[0], pc["blocks"])
+        sd = jax.eval_shape(
+            lambda p, a: block.apply_fn(p, block_bufs, a, False,
+                                        None)[0], lp0, h)
+        if sd.shape != h.shape or sd.dtype != h.dtype:
+            raise ValueError(
+                f"pipelined blocks must be shape/dtype-preserving: "
+                f"block maps {h.shape}/{h.dtype} -> {sd.shape}/"
+                f"{sd.dtype}")
+        h = run_pipe(pc["blocks"], h, training, rng)
+        h = _edge(post, pc["post"], h, training,
+                  jax.random.fold_in(rng, 2**31 - 2) if rng is not None
+                  else None)
         if compute_dtype is not None and upcast:
             h = _cast_floats(h, jnp.float32)
         return h
