@@ -1,0 +1,232 @@
+"""Real-data convergence with crash-resume: fine-tune a torch-initialized
+mid-size GPT-2 on a REAL text corpus through the multi-axis driver.
+
+The reference documents its zoo's convergence on real datasets
+(models/resnet/README.md:30-68: ResNet-20/CIFAR-10 to accuracy over 156
+epochs); this offline image ships no CIFAR/PTB blobs, so the corpus is
+the real English text the image DOES carry: this repo's own markdown
+docs plus the markdown shipped inside site-packages (README/guides of
+the installed libraries) — ~100k words of genuine prose, word-level
+tokenized through the framework's own text pipeline
+(SentenceTokenizer → Dictionary, reference dataset/text/ parity).
+
+The model is a ~6M-parameter GPT-2 authored BY torch (transformers,
+seeded), imported via ``interop.load_gpt2``, and re-hosted into a
+ring-attention + Megatron-split TransformerLM (the param tree is
+config-independent) so training runs through the FULL dp×sp×tp
+multi-axis DistriOptimizer on a 2x2x2 mesh with async sharded Orbax
+checkpoints.  Perplexity on a held-out split is appended to a JSONL
+trajectory at every segment end; the outer harness
+(tools/convergence_run.sh) kill -9s the process mid-run and restarts
+it, and the resumed segment must continue from the last committed
+Orbax step (``resumed_from`` in the trajectory records it).
+
+While the TPU measurement battery holds a tunnel window open
+(/tmp/battery3/WINDOW_OPEN), the per-iteration end-trigger PAUSES
+training — the 1-core host cannot grind this loop and feed the chip at
+the same time without contaminating the judged numbers.
+"""
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import time
+
+import numpy as np
+
+WINDOW_FLAG = "/tmp/battery3/WINDOW_OPEN"
+T = 32            # training sequence length (positions table is 64)
+VOCAB = 8000      # GPT-2 vocab (OOV bucket = id 8000)
+BATCH = 8
+GPT2_KW = dict(vocab_size=VOCAB, n_positions=64, n_embd=256, n_layer=4,
+               n_head=8, attn_pdrop=0.0, embd_pdrop=0.0, resid_pdrop=0.0)
+
+
+def _corpus_texts():
+    """Real markdown prose available in-image: the repo's docs and the
+    installed packages' own markdown."""
+    repo = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    paths = sorted(glob.glob(os.path.join(repo, "*.md"))) + \
+        sorted(glob.glob(os.path.join(repo, "docs", "*.md")))
+    site = sorted(glob.glob(
+        "/opt/venv/lib/python3.12/site-packages/**/*.md", recursive=True))
+    for p in paths + site[:400]:
+        try:
+            with open(p, errors="ignore") as f:
+                yield f.read()
+        except OSError:
+            continue
+
+
+def build_corpus(cache="/tmp/convergence_corpus.npz"):
+    """Tokenize through the text pipeline; returns (train_ids, val_ids)
+    as flat 1-based int32 arrays (cached — the corpus is static)."""
+    if os.path.exists(cache):
+        z = np.load(cache)
+        return z["train"], z["val"]
+    from ..dataset.text import Dictionary, SentenceTokenizer
+
+    tok = SentenceTokenizer()
+    sentences = list(tok.apply(iter(_corpus_texts())))
+    d = Dictionary(sentences, vocab_size=VOCAB - 1)
+    flat = np.fromiter(
+        (d.get_index(w) + 1 for s in sentences for w in s), np.int32)
+    # deterministic 90/10 split at document granularity is overkill for
+    # a trajectory proof; contiguous split keeps val text truly unseen
+    n_val = len(flat) // 10
+    print(f"corpus: {len(flat)} tokens, {d.vocab_size()} vocab words, "
+          f"{n_val} held out")
+    np.savez(cache, train=flat[:-n_val], val=flat[-n_val:])
+    return flat[:-n_val], flat[-n_val:]
+
+
+def _windows(flat, seed=None):
+    """[N, T+1] next-token windows (x=w[:,:-1], y=w[:,1:])."""
+    n = (len(flat) - 1) // T
+    w = np.stack([flat[i * T:i * T + T + 1] for i in range(n)])
+    if seed is not None:
+        np.random.RandomState(seed).shuffle(w)
+    return w
+
+
+def _minibatches(windows):
+    from ..dataset.sample import MiniBatch
+
+    out = []
+    for i in range(0, len(windows) - BATCH + 1, BATCH):
+        w = windows[i:i + BATCH]
+        out.append(MiniBatch(w[:, :-1].astype(np.float32),
+                             w[:, 1:].astype(np.float32)))
+    return out
+
+
+def build_model():
+    """Torch-authored GPT-2 (deterministic, cached as a .pt checkpoint)
+    → load_gpt2 → re-hosted into the multi-axis TransformerLM."""
+    import torch
+    import transformers
+
+    from ..interop.huggingface import load_gpt2
+    from ..models.transformer import TransformerLM
+
+    ckpt = "/tmp/convergence_gpt2_init.pt"
+    cfg = transformers.GPT2Config(**GPT2_KW)
+    hf = transformers.GPT2LMHeadModel(cfg)
+    if os.path.exists(ckpt):
+        hf.load_state_dict(torch.load(ckpt, weights_only=True))
+    else:
+        torch.manual_seed(4242)
+        hf = transformers.GPT2LMHeadModel(cfg)
+        torch.save(hf.state_dict(), ckpt)
+    n_params = sum(p.numel() for n, p in hf.named_parameters()
+                   if n != "lm_head.weight")
+    lm0 = load_gpt2(hf.eval())
+    # same parameter tree, multi-axis training config (ring attention
+    # over 'seq', Megatron column/row MLP split over 'model')
+    lm = TransformerLM(VOCAB, embed_dim=GPT2_KW["n_embd"],
+                       num_heads=GPT2_KW["n_head"],
+                       mlp_dim=4 * GPT2_KW["n_embd"],
+                       num_layers=GPT2_KW["n_layer"],
+                       max_len=GPT2_KW["n_positions"],
+                       seq_strategy="ring", model_axis="model")
+    lm.set_param_tree(lm0.param_tree())
+    print(f"model: {n_params / 1e6:.2f}M params (torch-initialized)")
+    return lm
+
+
+def _pause_while_window_open():
+    waited = 0
+    while os.path.exists(WINDOW_FLAG):
+        if waited == 0:
+            print("TPU window open — pausing the convergence loop")
+        time.sleep(30)
+        waited += 30
+    if waited:
+        print(f"TPU window closed — resuming after {waited}s pause")
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--iters", type=int, default=40,
+                    help="iterations to add in this segment")
+    ap.add_argument("--ckpt-dir", default="/tmp/convergence_ckpt")
+    ap.add_argument("--log", default="LONGRUN_CONVERGENCE.jsonl")
+    args = ap.parse_args(argv)
+
+    import jax
+
+    if (getattr(jax.config, "jax_platforms", None) or "").split(",")[0] \
+            in ("axon", ""):
+        os.environ.setdefault(
+            "XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+        jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+    from jax.sharding import Mesh
+
+    from .. import nn
+    from ..dataset.dataset import array
+    from ..optim import Adam, Trigger, several_iteration
+    from ..optim.distri_optimizer import DistriOptimizer
+    from ..optim.evaluator import evaluate_dataset
+    from ..optim.validation import Loss
+    from ..parallel.spmd import make_eval_forward
+    from ..utils.engine import Engine
+
+    Engine.init()
+    train_flat, val_flat = build_corpus()
+    train_mb = _minibatches(_windows(train_flat, seed=11))
+    val_mb = _minibatches(_windows(val_flat))
+    mesh = Mesh(np.array(jax.devices()[:8]).reshape(2, 2, 2),
+                ("data", "seq", "model"))
+
+    model = build_model()
+    crit = nn.TimeDistributedCriterion(nn.ClassNLLCriterion(), True)
+    opt = DistriOptimizer(model, array(train_mb), crit,
+                          batch_size=BATCH, mesh=mesh)
+    opt.set_optim_method(Adam(learning_rate=3e-4))
+    opt.set_checkpoint(args.ckpt_dir, several_iteration(10),
+                       format="orbax")
+    opt.overwrite_checkpoint()
+
+    resumed_from = None
+    if os.path.isdir(args.ckpt_dir) and opt.resume_from_checkpoint():
+        resumed_from = opt.optim_method.state["neval"] - 1
+        print(f"resumed from orbax step {resumed_from}")
+
+    start_iter = opt.optim_method.state.get("neval", 1) - 1
+    until = start_iter + args.iters
+
+    def _end(state):
+        _pause_while_window_open()  # per-iteration pause hook
+        return state.get("neval", 1) - 1 >= until
+
+    opt.set_end_when(Trigger(_end, f"until{until}"))
+    t0 = time.time()
+    opt.optimize()
+    train_secs = time.time() - t0
+
+    # held-out perplexity through the on-mesh eval forward (ring
+    # attention cannot run eagerly)
+    fwd = make_eval_forward(model, mesh)
+    res = evaluate_dataset(model, array(val_mb), [Loss(crit)],
+                           batch_size=BATCH, fwd=fwd, n_shard=2)
+    val_loss = res[0].result()[0]
+    row = {
+        "iteration": opt.optim_method.state["neval"] - 1,
+        "train_loss": round(float(opt.optim_method.state["loss"]), 4),
+        "val_loss": round(float(val_loss), 4),
+        "val_ppl": round(float(np.exp(val_loss)), 2),
+        "segment_secs": round(train_secs, 1),
+        "resumed_from": resumed_from,
+        "time": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+    }
+    with open(args.log, "a") as f:
+        f.write(json.dumps(row) + "\n")
+    print("segment:", json.dumps(row))
+
+
+if __name__ == "__main__":
+    main()

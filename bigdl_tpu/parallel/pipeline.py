@@ -49,19 +49,49 @@ from jax import lax, shard_map
 from jax.sharding import PartitionSpec as P
 
 
+# per-iteration bookkeeping/timing/state attributes that legitimately
+# differ between otherwise identical modules (or flip when a module has
+# run eagerly) — never part of the identity
+_SIG_SKIP = frozenset(("name", "is_training", "forward_time",
+                       "backward_time", "output", "grad_input"))
+
+
+def _module_sig(m):
+    """Recursive identity of a module for run detection: class name,
+    every simple (int/float/bool/str/tuple) PUBLIC attribute, and the
+    children's signatures.  The param treedef + leaf shapes alone are
+    BLIND to non-parameter config — two Dropout(0.1)/Dropout(0.5)
+    blocks, or two convs whose stride differs but whose weight shapes
+    coincide, are structurally identical yet compute different
+    functions, and the stacked stage scan would silently apply the
+    first block's config to every layer."""
+    cfg = []
+    for k, v in sorted(vars(m).items()):
+        if k.startswith("_") or k in _SIG_SKIP:
+            continue
+        if isinstance(v, (int, float, bool, str, bytes, type(None))):
+            cfg.append((k, v))
+        elif (isinstance(v, (tuple, list)) and
+              all(isinstance(e, (int, float, bool, str, type(None)))
+                  for e in v)):
+            cfg.append((k, tuple(v)))
+    kids = tuple(_module_sig(c) for c in getattr(m, "modules", ()))
+    return (type(m).__name__, tuple(cfg), kids)
+
+
 def _block_run(model):
-    """Locate the maximal run of structurally identical PARAMETERIZED
-    blocks in ``model.modules`` (same param treedef + leaf shapes).
-    Parameterless runs (e.g. repeated activations) are never candidates
-    — there is nothing to shard over the pipe axis, and letting them
-    win would shadow an equally long parameterized run.  Returns
-    (first_index, count)."""
+    """Locate the maximal run of identical PARAMETERIZED blocks in
+    ``model.modules`` (same param treedef + leaf shapes + recursive
+    config signature).  Parameterless runs (e.g. repeated activations)
+    are never candidates — there is nothing to shard over the pipe
+    axis, and letting them win would shadow an equally long
+    parameterized run.  Returns (first_index, count)."""
     sig, has_params = [], []
     for m in model.modules:
         t = m.param_tree()
         leaves, treedef = jax.tree_util.tree_flatten(t)
         sig.append((treedef, tuple(getattr(a, "shape", ()) for a in leaves),
-                    type(m).__name__))
+                    _module_sig(m)))
         has_params.append(bool(leaves))
     best = (0, 0)
     i = 0
@@ -99,9 +129,14 @@ def _check_layout(model):
         first, count = _block_run(model)
         if first != 1 or count != len(model.modules) - 3:
             raise ValueError(
-                "TransformerLM layout changed: expected [embed, "
-                f"blocks..., ln, head], found block run at {first} "
-                f"len {count}")
+                "TransformerLM blocks do not form one identical run "
+                f"(found run at {first} len {count}, expected 1 len "
+                f"{len(model.modules) - 3}): either the [embed, "
+                "blocks..., ln, head] layout changed, or per-layer "
+                "CONFIG diverged (e.g. one block's dropout rate edited "
+                "post-construction) — pipelined blocks must be "
+                "config-identical because one stacked stage function "
+                "runs every layer")
         return first, count
     if not isinstance(model, Sequential):
         raise TypeError(

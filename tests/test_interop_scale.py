@@ -1,0 +1,121 @@
+"""Interop at checkpoint scale (VERDICT r4 #7 — the reference validates
+real pretrained artifacts, example/loadmodel/ModelValidator.scala:30-60;
+this is the offline-image analogue): a ~10M-parameter GPT-2 checkpoint
+authored BY torch round-trips load → save → load with logits pinned
+against torch's own forward on 100 prompts, and a mid-size (~8M param)
+CNN round-trips the Caffe persister/loader.
+
+The torch checkpoint is generated deterministically into
+``tests/fixtures/generated/`` on first run and reused after (a 40 MB
+binary blob has no business in git; the generator IS the fixture).
+"""
+import os
+
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+transformers = pytest.importorskip("transformers")
+
+from bigdl_tpu import nn  # noqa: E402
+from bigdl_tpu.interop import CaffeLoader, CaffePersister  # noqa: E402
+
+GEN_DIR = os.path.join(os.path.dirname(__file__), "fixtures", "generated")
+
+# ~10M params: 8000·320 wte (2.56M) + 6 layers × ~1.23M + head tied
+GPT2_CFG = dict(vocab_size=8000, n_positions=64, n_embd=320, n_layer=6,
+                n_head=8, attn_pdrop=0.0, embd_pdrop=0.0, resid_pdrop=0.0)
+
+
+def _gpt2_checkpoint_path():
+    os.makedirs(GEN_DIR, exist_ok=True)
+    path = os.path.join(GEN_DIR, "gpt2_10m.pt")
+    if not os.path.exists(path):
+        torch.manual_seed(1234)
+        hf = transformers.GPT2LMHeadModel(
+            transformers.GPT2Config(**GPT2_CFG))
+        torch.save(hf.state_dict(), path)
+    return path
+
+
+def test_gpt2_10m_checkpoint_roundtrip_100_prompts():
+    """load(ckpt) → save_gpt2 → load_gpt2 must reproduce torch's own
+    logits on 100 prompts at a ~10M-parameter scale."""
+    import jax.numpy as jnp
+
+    from bigdl_tpu.interop.huggingface import load_gpt2, save_gpt2
+
+    hf = transformers.GPT2LMHeadModel(transformers.GPT2Config(**GPT2_CFG))
+    state = torch.load(_gpt2_checkpoint_path(), weights_only=True)
+    hf.load_state_dict(state)
+    hf = hf.eval()
+    n_params = sum(p.numel() for n, p in hf.named_parameters()
+                   if n != "lm_head.weight")  # tied with wte
+    assert 9e6 < n_params < 12e6, f"scale contract broken: {n_params}"
+
+    lm = load_gpt2(hf)                      # checkpoint → framework
+    hf2 = save_gpt2(lm).eval()              # framework → torch
+    lm2 = load_gpt2(hf2)                    # and back again
+
+    rng = np.random.RandomState(0)
+    prompts = rng.randint(0, GPT2_CFG["vocab_size"], (100, 24))
+    with torch.no_grad():
+        want = hf(torch.tensor(prompts)).logits.numpy()
+        want2 = hf2(torch.tensor(prompts)).logits.numpy()
+    # torch-side: the exported model IS the original function
+    np.testing.assert_allclose(want2, want, atol=1e-4)
+    got, _ = lm2.apply_fn(lm2.param_tree(), lm2.buffer_tree(),
+                          jnp.asarray(prompts + 1), False, None)
+    got = np.asarray(got)
+    # float32 tolerances at 320-dim/6-layer depth: compare against the
+    # logit RANGE, not machine eps
+    scale = np.abs(want).max()
+    np.testing.assert_allclose(got, want, atol=5e-4 * max(scale, 1.0))
+
+
+def _midsize_cnn():
+    """~8.4M params, dominated by the two Linear layers — mid-size by
+    the reference zoo's standards (LeNet 0.4M, AlexNet-FC scale)."""
+    return nn.Sequential(
+        nn.SpatialConvolution(3, 32, 3, 3, 1, 1, 1, 1).set_name("c1"),
+        nn.ReLU().set_name("r1"),
+        nn.SpatialMaxPooling(2, 2, 2, 2).set_name("p1"),
+        nn.SpatialConvolution(32, 64, 3, 3, 1, 1, 1, 1).set_name("c2"),
+        nn.ReLU().set_name("r2"),
+        nn.SpatialMaxPooling(2, 2, 2, 2).set_name("p2"),
+        nn.Reshape([64 * 4 * 4]).set_name("flat"),
+        nn.Linear(64 * 4 * 4, 4096).set_name("fc1"),
+        nn.ReLU().set_name("r3"),
+        nn.Linear(4096, 1000).set_name("fc2"),
+        # caffe has no log-softmax layer type (LogSoftMax persists as
+        # Softmax and would reload lossily) — use the exact round-tripper
+        nn.SoftMax().set_name("prob"))
+
+
+def test_caffe_midsize_artifact_roundtrip(tmp_path):
+    """An ~8M-param CNN through the Caffe persister: the on-disk
+    prototxt+caffemodel pair reloads into an equivalent network."""
+    rng = np.random.RandomState(3)
+    model = _midsize_cnn().evaluate()
+    n_params = sum(int(np.prod(p.shape))
+                   for m in model.modules_iter()
+                   for p in m.params.values())
+    assert n_params > 8e6, f"scale contract broken: {n_params}"
+
+    proto = str(tmp_path / "mid.prototxt")
+    weights = str(tmp_path / "mid.caffemodel")
+    CaffePersister.persist(proto, weights, model)
+    assert os.path.getsize(weights) > 4 * 8e6  # f32 blobs really wrote
+
+    loaded = CaffeLoader(proto, weights).create_caffe_model().evaluate()
+    x = rng.rand(4, 3, 16, 16).astype(np.float32)
+    np.testing.assert_allclose(np.asarray(loaded.forward(x)),
+                               np.asarray(model.forward(x)),
+                               rtol=1e-5, atol=1e-5)
+
+    # weight-copy path (CaffeLoader.load) at the same scale
+    target = _midsize_cnn()
+    CaffeLoader.load(target, proto, weights, match_all=True)
+    np.testing.assert_allclose(
+        np.asarray(target.modules[7].params["weight"]),
+        np.asarray(model.modules[7].params["weight"]), rtol=1e-6)

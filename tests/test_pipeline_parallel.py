@@ -265,6 +265,44 @@ def test_block_run_skips_parameterless_runs():
     assert _block_run(model) == (2, 2)
 
 
+def test_block_run_distinguishes_config_not_just_shapes():
+    """Blocks whose param shapes coincide but whose CONFIG differs
+    (dropout rate; conv stride with a shape-coinciding kernel) compute
+    different functions — they must not be stacked into one run, or the
+    stage scan would silently apply the first block's config to every
+    layer."""
+    from bigdl_tpu.parallel.pipeline import _block_run
+
+    RNG().set_seed(29)
+    m = nn.Sequential(
+        nn.Sequential(nn.Linear(8, 8), nn.Dropout(0.1)),
+        nn.Sequential(nn.Linear(8, 8), nn.Dropout(0.5)))
+    assert _block_run(m)[1] < 2  # different dropout p: not a run
+
+    c = nn.Sequential(
+        nn.Sequential(nn.SpatialConvolution(4, 4, 3, 3, 1, 1, 1, 1)),
+        nn.Sequential(nn.SpatialConvolution(4, 4, 3, 3, 2, 2, 1, 1)))
+    assert _block_run(c)[1] < 2  # different stride: not a run
+
+    ok = nn.Sequential(
+        nn.Sequential(nn.Linear(8, 8), nn.Dropout(0.5)),
+        nn.Sequential(nn.Linear(8, 8), nn.Dropout(0.5)))
+    assert _block_run(ok) == (0, 2)  # identical config: a run
+
+
+def test_block_run_ignores_eager_forward_state():
+    """Running one block eagerly (debugging) fills its output/grad_input
+    bookkeeping — that transient state must not break run detection."""
+    from bigdl_tpu.parallel.pipeline import _block_run
+
+    RNG().set_seed(31)
+    blocks = [nn.Sequential(nn.Linear(4, 4), nn.Tanh())
+              for _ in range(3)]
+    m = nn.Sequential(nn.Linear(2, 4), *blocks, nn.Linear(4, 1))
+    blocks[0].forward(np.zeros((1, 4), np.float32))  # eager debug call
+    assert _block_run(m) == (1, 3)
+
+
 def _tp_model(model_axis):
     """TransformerLM whose block MLPs are Column/Row-bound (3-D runs)
     — same RNG consumption as _model(), so params match it exactly."""
