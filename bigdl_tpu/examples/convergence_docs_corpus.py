@@ -114,12 +114,11 @@ def build_model():
 
     ckpt = "/tmp/convergence_gpt2_init.pt"
     cfg = transformers.GPT2Config(**GPT2_KW)
+    torch.manual_seed(4242)
     hf = transformers.GPT2LMHeadModel(cfg)
     if os.path.exists(ckpt):
         hf.load_state_dict(torch.load(ckpt, weights_only=True))
     else:
-        torch.manual_seed(4242)
-        hf = transformers.GPT2LMHeadModel(cfg)
         torch.save(hf.state_dict(), ckpt)
     n_params = sum(p.numel() for n, p in hf.named_parameters()
                    if n != "lm_head.weight")

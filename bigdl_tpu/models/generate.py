@@ -164,7 +164,21 @@ def _decode_machinery(model, first, count, T_max):
         v = _split(_proj(ln1, ap, "wv", "bv", mha.with_bias), B)
         k_cache = lax.dynamic_update_slice(k_cache, k, (0, 0, pos, 0))
         v_cache = lax.dynamic_update_slice(v_cache, v, (0, 0, pos, 0))
-        o = _attend(q, k_cache, v_cache, pos)
+        if isinstance(pos, int) and pos == 0 and q.shape[2] > 1:
+            # prefill: causal attention over the PROMPT only — cache
+            # slots past the prompt are outside the causal horizon
+            # anyway, so scoring the whole [T_max] cache (the _attend
+            # path) wastes T_max/T0 of the work and materializes the
+            # full score tile.  The flash kernels make this
+            # O(T0·block) memory on TPU; off-TPU (and at non-blockable
+            # T0) flash_attention falls back to the same dense causal
+            # attention, so numerics stay pinned by the greedy
+            # teacher-forcing oracle either way.
+            from ..ops.flash_attention import flash_attention
+
+            o = flash_attention(q, k, v, causal=True)
+        else:
+            o = _attend(q, k_cache, v_cache, pos)
         o = o.transpose(0, 2, 1, 3).reshape(B, o.shape[2], H * Dh)
         h = h + _proj(o, ap, "wo", "bo", mha.with_bias)
         ln2, _ = block.modules[2].apply_fn(bp["2"], {}, h, False, None)

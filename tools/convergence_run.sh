@@ -41,7 +41,8 @@ while [ $(( $(date +%s) - start )) -lt $(( TARGET_MIN * 60 )) ]; do
         wait "$pid" 2>/dev/null
     else
         wait "$pid"
-        echo "$(date -u +%FT%TZ) segment $seg completed rc=$?" \
+        rc=$?  # capture BEFORE the $(date) substitution resets $?
+        echo "$(date -u +%FT%TZ) segment $seg completed rc=$rc" \
             >> /tmp/convergence_run.log
     fi
 done
