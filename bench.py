@@ -604,6 +604,39 @@ def run_worker(backend: str) -> None:
                 out["decode_config"] = f"B{B} prompt{T0} new{NEW} D{D} L{L}"
             except Exception as e:
                 out["decode_error"] = f"{type(e).__name__}: {e}"[:300]
+            # long-prompt serving: prefill-dominated — measures the
+            # flash prompt-only prefill (r5: the old path scored every
+            # query against the whole cache).  max_new=1 so the number
+            # is prompt-processing throughput.  Own try (a prefill OOM
+            # must not masquerade as a decode failure) and the decode
+            # model is dropped first (a second 130M-param model +
+            # 2048-slot caches would otherwise double peak HBM).
+            if not over_budget(0.97):
+                try:
+                    del glm, gen, gp, ids
+                    from bigdl_tpu.models.generate import make_generate
+                    from bigdl_tpu.models.transformer import TransformerLM
+
+                    T0L = 1920
+                    glm2 = TransformerLM(V, embed_dim=D, num_heads=8,
+                                         num_layers=L, max_len=2048,
+                                         output="logits")
+                    gen2 = make_generate(glm2,
+                                         compute_dtype=jnp.bfloat16)
+                    gp2 = glm2.param_tree()
+                    prompt2 = rng.randint(1, V, (B, T0L)).astype("int32")
+                    ids2 = gen2(gp2, prompt2, 1)
+                    _ = int(jax.device_get(ids2)[0, -1])
+                    t0 = time.time()
+                    for _ in range(reps):
+                        ids2 = gen2(gp2, prompt2, 1)
+                    _ = int(jax.device_get(ids2)[0, -1])
+                    dt = time.time() - t0
+                    out["prefill_tokens_per_sec"] = round(
+                        B * T0L * reps / dt, 1)
+                    out["prefill_config"] = f"B{B} prompt{T0L} D{D} L{L}"
+                except Exception as e:
+                    out["prefill_error"] = f"{type(e).__name__}: {e}"[:300]
         flush("decode")
 
     # --- SimpleRNN: the reference's published workload (batch 12) -------

@@ -274,7 +274,8 @@ def make_generate(model, max_len: Optional[int] = None,
         return jnp.where(temperature > 0, sampled, greedy)
 
     @partial(jax.jit, static_argnums=(2, 5))
-    def _run(p, prompt, max_new, key, temperature, top_k, top_p):
+    def _run(p, prompt, max_new, key, temperature, top_k, top_p,
+             eos, pad):
         pc = _cast_floats(p, compute_dtype) if compute_dtype else p
         B, T0 = prompt.shape
         if T0 + max_new > T_max:
@@ -287,31 +288,39 @@ def make_generate(model, max_len: Optional[int] = None,
         key, sub = jax.random.split(key)
         nxt = (_sample(logits_last(pc, h), temperature, top_k, top_p,
                        sub) + 1)  # 1-based ids
+        # eos==0 disables early stop (ids are 1-based, 0 never matches).
+        # Static shapes throughout: finished rows keep decoding but
+        # emit `pad` (the hf.generate convention) — the work is bounded
+        # by max_new either way.
+        done = (nxt == eos) & (eos > 0)
         ids = jnp.zeros((B, T0 + max_new), prompt.dtype)
         ids = lax.dynamic_update_slice(ids, prompt, (0, 0))
         ids = lax.dynamic_update_slice(ids, nxt[:, None].astype(
             ids.dtype), (0, T0))
 
         def one_token(carry, _):
-            caches, ids, pos, key = carry
+            caches, ids, pos, key, done = carry
             tok = lax.dynamic_slice(ids, (0, pos), (B, 1))
             h, new_caches = decode_token(pc, tok, caches, pos)
             key, sub = jax.random.split(key)
             nxt = (_sample(logits_last(pc, h), temperature, top_k,
                            top_p, sub) + 1)
+            nxt = jnp.where(done, pad, nxt)
+            done = done | ((nxt == eos) & (eos > 0))
             ids = lax.dynamic_update_slice(
                 ids, nxt[:, None].astype(ids.dtype), (0, pos + 1))
-            return (new_caches, ids, pos + 1, key), None
+            return (new_caches, ids, pos + 1, key, done), None
 
         if max_new > 1:
-            (caches, ids, _, _), _ = lax.scan(
-                one_token, (caches, ids, T0, key), None,
+            (caches, ids, _, _, _), _ = lax.scan(
+                one_token, (caches, ids, T0, key, done), None,
                 length=max_new - 1)
         return ids
 
     def generate(params, prompt_ids, max_new: int, rng=None,
                  temperature: float = 0.0, top_k: int = 0,
-                 top_p: float = 1.0):
+                 top_p: float = 1.0, eos_id: Optional[int] = None,
+                 pad_id: Optional[int] = None):
         if temperature > 0 and rng is None:
             raise ValueError(
                 "temperature > 0 requires an explicit rng key "
@@ -320,7 +329,10 @@ def make_generate(model, max_len: Optional[int] = None,
         key = rng if rng is not None else jax.random.PRNGKey(0)
         return _run(params, jnp.asarray(prompt_ids, jnp.int32),
                     int(max_new), key, jnp.float32(temperature),
-                    int(top_k), jnp.float32(top_p))
+                    int(top_k), jnp.float32(top_p),
+                    jnp.int32(eos_id or 0),
+                    jnp.int32(pad_id if pad_id is not None
+                              else (eos_id or 0)))
 
     return generate
 

@@ -196,18 +196,22 @@ class TransformerLM(Container):
 
     def generate(self, prompt_ids, max_new: int, rng=None,
                  temperature: float = 0.0, top_k: int = 0,
-                 top_p: float = 1.0, compute_dtype=None):
+                 top_p: float = 1.0, compute_dtype=None,
+                 eos_id=None, pad_id=None):
         """Autoregressive decode with a KV cache (models/generate.py):
         prefill + ``lax.scan`` decode at static shapes.  ``temperature=0``
         is greedy (pinned against the dense forward by teacher forcing);
         ``>0`` samples, optionally within ``top_k`` and/or the ``top_p``
-        nucleus.  The compiled generator is cached per
+        nucleus.  ``eos_id`` stops a row early (it keeps emitting
+        ``pad_id``, default the eos itself — hf.generate's convention,
+        at static shapes).  The compiled generator is cached per
         (max_len, compute_dtype)."""
         from .generate import cached_generate
 
         return cached_generate(self, compute_dtype)(
             self.param_tree(), prompt_ids, max_new, rng=rng,
-            temperature=temperature, top_k=top_k, top_p=top_p)
+            temperature=temperature, top_k=top_k, top_p=top_p,
+            eos_id=eos_id, pad_id=pad_id)
 
     def _positions(self, pos_table, T):
         if self.seq_strategy in ("ring", "ulysses"):

@@ -209,6 +209,30 @@ def test_ring_trained_model_decodes_like_dense_twin(strategy):
     _teacher_force_check(dense, got, prompt_len=5)
 
 
+def test_eos_stops_row_and_pads():
+    """After a row's first eos the decode keeps emitting pad_id (static
+    shapes — hf.generate's convention); rows that never hit eos are
+    bit-identical to the eos-free decode."""
+    model = _model()
+    prompt = np.random.RandomState(8).randint(
+        1, VOCAB + 1, (2, 4)).astype(np.int32)
+    free = np.asarray(model.generate(prompt, max_new=8))
+    # choose the token row 0 greedily emits mid-way as the eos
+    eos = int(free[0, 6])
+    got = np.asarray(model.generate(prompt, max_new=8, eos_id=eos,
+                                    pad_id=VOCAB))
+    for b in range(2):
+        hits = np.where(free[b, 4:] == eos)[0]
+        if len(hits) == 0:
+            np.testing.assert_array_equal(got[b], free[b])
+            continue
+        stop = 4 + hits[0]
+        np.testing.assert_array_equal(got[b, :stop + 1],
+                                      free[b, :stop + 1])
+        assert (got[b, stop + 1:] == VOCAB).all()
+    assert (got[0] != free[0]).any()  # the eos actually bound
+
+
 def test_capacity_bind_report_dense_and_loose():
     from bigdl_tpu.models.generate import capacity_bind_report
 

@@ -49,6 +49,31 @@ def test_gpt2_greedy_generation_matches_torch():
     np.testing.assert_array_equal(got, want)
 
 
+def test_gpt2_eos_early_stop_matches_torch():
+    """eos_id/pad_id semantics cross-checked against hf.generate: pick
+    the token torch greedily emits mid-decode as the eos — both sides
+    must stop that row there and pad with pad_token_id."""
+    hf = _hf(seed=3)
+    lm = load_gpt2(hf)
+    rng = np.random.RandomState(2)
+    prompt = rng.randint(0, 57, (2, 5))
+    with torch.no_grad():
+        free = hf.generate(torch.tensor(prompt), max_new_tokens=6,
+                           do_sample=False, pad_token_id=0).numpy()
+    eos0 = int(free[0, 7])  # a token row 0 actually emits
+    with torch.no_grad():
+        want = hf.generate(torch.tensor(prompt), max_new_tokens=6,
+                           do_sample=False, eos_token_id=eos0,
+                           pad_token_id=3).numpy()
+    got = np.asarray(lm.generate((prompt + 1).astype(np.int32),
+                                 max_new=6, eos_id=eos0 + 1,
+                                 pad_id=3 + 1)) - 1
+    # hf truncates when every row finishes early; compare the columns
+    # it kept
+    L = want.shape[1]
+    np.testing.assert_array_equal(got[:, :L], want)
+
+
 def test_save_gpt2_torch_forward_matches_and_roundtrips():
     """Export: a framework TransformerLM becomes a torch GPT-2 whose
     forward matches ours; loading it back reproduces the param tree."""
