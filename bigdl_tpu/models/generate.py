@@ -96,32 +96,43 @@ _BIND_TLS = threading.local()
 
 
 def _moe_ffn_nodrop(moe, params, x):
-    """Capacity-free top-1 dispatch for decode: gather each token's
-    argmax expert weights and apply its MLP.  [B, Tq, D] -> [B, Tq, D].
-    (Prefill materializes [N, D, H] gathered weights — fine for decode
-    windows; very long prompts on tiny-HBM chips may prefer the
-    training dispatch.)"""
+    """Capacity-free top-k dispatch for decode: gather each token's
+    chosen experts' weights and apply their MLPs, mixed by the (top-1
+    raw / top-k renormalized) gates.  [B, Tq, D] -> [B, Tq, D].
+    (Prefill materializes [N, D, H] gathered weights per choice — fine
+    for decode windows; very long prompts on tiny-HBM chips may prefer
+    the training dispatch.)"""
     B, Tq, D = x.shape
+    K = getattr(moe, "top_k", 1)
     x2 = x.reshape(B * Tq, D)
     logits = jnp.dot(x2, params["router_w"].T) + params["router_b"]
     probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
-    idx = jnp.argmax(probs, axis=-1)
-    gate = jnp.max(probs, axis=-1).astype(x.dtype)
+    gk, idxk = jax.lax.top_k(probs, K)                  # [N, K]
+    if K > 1:
+        gk = gk / jnp.sum(gk, axis=-1, keepdims=True)
     if getattr(_BIND_TLS, "capture", None) is not None:
         # the training dispatch's keep rule, via the module's own
         # shared helper so the two can never drift (capacity from THIS
-        # batch's token count)
-        onehot = jax.nn.one_hot(idx, moe.n_experts, dtype=jnp.float32)
-        _, keep = moe.keep_mask(onehot)
-        _BIND_TLS.capture.append(
-            1.0 - jnp.sum(keep.astype(jnp.float32)) / (B * Tq))
-    wi, bi = params["wi"][idx], params["bi"][idx]      # [N, D, H], [N, H]
-    wo, bo = params["wo"][idx], params["bo"][idx]      # [N, H, D], [N, D]
-    h = jax.nn.gelu(jnp.einsum("nd,ndh->nh", x2, wi.astype(x.dtype))
-                    + bi.astype(x.dtype))
-    y = jnp.einsum("nh,nhd->nd", h, wo.astype(x.dtype)) + bo.astype(
-        x.dtype)
-    return (gate[:, None] * y).reshape(B, Tq, D)
+        # batch's token count; choice-ordered stream like _route) —
+        # the fraction is over all N·K routing assignments
+        kept, counts = 0.0, None
+        for c in range(K):
+            oh = jax.nn.one_hot(idxk[:, c], moe.n_experts,
+                                dtype=jnp.float32)
+            _, keep, counts = moe.keep_mask(oh, counts)
+            kept = kept + jnp.sum(keep.astype(jnp.float32))
+        _BIND_TLS.capture.append(1.0 - kept / (B * Tq * K))
+    y = 0.0
+    for c in range(K):
+        idx = idxk[:, c]
+        wi, bi = params["wi"][idx], params["bi"][idx]  # [N, D, H], [N, H]
+        wo, bo = params["wo"][idx], params["bo"][idx]  # [N, H, D], [N, D]
+        h = jax.nn.gelu(jnp.einsum("nd,ndh->nh", x2, wi.astype(x.dtype))
+                        + bi.astype(x.dtype))
+        yc = jnp.einsum("nh,nhd->nd", h, wo.astype(x.dtype)) + bo.astype(
+            x.dtype)
+        y = y + gk[:, c, None].astype(x.dtype) * yc
+    return y.reshape(B, Tq, D)
 
 
 def _decode_machinery(model, first, count, T_max):
@@ -442,9 +453,11 @@ _BIND_CACHE = weakref.WeakKeyDictionary()
 
 def capacity_bind_report(model, params, ids):
     """How far MoE decode diverges from the trained function: per MoE
-    block, the fraction of ``ids``'s tokens that the TRAINING dispatch's
-    static capacity (``parallel/moe.py`` ``_route``: ``C = ceil(f·N/E)``
-    at this batch's token count) would have DROPPED.  Decode itself
+    block, the fraction of ``ids``'s ROUTING ASSIGNMENTS (``N·top_k``
+    of them — for top-1 that is simply the tokens) that the TRAINING
+    dispatch's static capacity (``parallel/moe.py`` ``_route``:
+    ``C = ceil(f·N/E)`` at this batch's token count, choice-ordered
+    stream) would have DROPPED.  Decode itself
     routes capacity-free — a trained model whose capacity binds decodes
     through a different function than it was trained on, and this is the
     measurement of how often (weak-#8 contract: run it on real routed

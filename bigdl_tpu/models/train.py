@@ -143,6 +143,7 @@ def build(model_name: str, args):
             moe_axis="data" if (moe and getattr(args, "distributed",
                                                 False)) else None,
             moe_aux_coef=getattr(args, "moe_aux_coef", 0.0),
+            moe_top_k=getattr(args, "moe_top_k", 1),
             dropout=getattr(args, "dropout", 0.0))
         crit = nn.TimeDistributedCriterion(nn.CrossEntropyCriterion(), True)
         # synthetic char-LM with learnable structure: next token is a
@@ -226,6 +227,10 @@ def main(argv=None):
                              "the data axis (expert parallelism, "
                              "all_to_all dispatch) and E must be "
                              "divisible by the data-shard count")
+    parser.add_argument("--moe-top-k", type=int, default=1, metavar="K",
+                        help="experts per token: 1 = Switch (raw gate), "
+                             "2 = GShard-style (renormalized gates, "
+                             "first choices claim capacity first)")
     parser.add_argument("--moe-aux-coef", type=float, default=0.0,
                         metavar="C",
                         help="Switch load-balance auxiliary loss "

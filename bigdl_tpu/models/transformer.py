@@ -40,7 +40,8 @@ class TransformerBlock(Container):
                  seq_axis: str = "seq", model_axis: Optional[str] = None,
                  moe_experts: int = 0, moe_axis: Optional[str] = None,
                  moe_capacity_factor: float = 1.25,
-                 moe_aux_coef: float = 0.0, dropout: float = 0.0):
+                 moe_aux_coef: float = 0.0, moe_top_k: int = 1,
+                 dropout: float = 0.0):
         mods = [
             nn.LayerNorm(embed_dim),
             nn.MultiHeadAttention(embed_dim, num_heads, causal=causal,
@@ -60,6 +61,7 @@ class TransformerBlock(Container):
                                capacity_factor=moe_capacity_factor,
                                axis_name=moe_axis,
                                aux_loss_coef=moe_aux_coef,
+                               top_k=moe_top_k,
                                # under sequence parallelism the tokens
                                # are seq-sharded too: aux routing stats
                                # must pmean over that axis as well
@@ -126,7 +128,8 @@ class TransformerLM(Container):
                  remat: bool = False, output: str = "log_probs",
                  moe_experts: int = 0, moe_axis: Optional[str] = None,
                  moe_capacity_factor: float = 1.25,
-                 moe_aux_coef: float = 0.0, dropout: float = 0.0):
+                 moe_aux_coef: float = 0.0, moe_top_k: int = 1,
+                 dropout: float = 0.0):
         if output not in ("log_probs", "logits"):
             raise ValueError(f"output {output!r} not in (log_probs, logits)")
         mlp_dim = mlp_dim or 4 * embed_dim
@@ -148,6 +151,7 @@ class TransformerLM(Container):
                                    moe_axis=moe_axis,
                                    moe_capacity_factor=moe_capacity_factor,
                                    moe_aux_coef=moe_aux_coef,
+                                   moe_top_k=moe_top_k,
                                    dropout=dropout)
                   for _ in range(num_layers)]
         super().__init__(

@@ -25,9 +25,16 @@ dense path would have kept.  With capacity loose enough that nothing
 drops, the two paths compute exactly the same function (pinned in
 tests/test_moe.py).
 
+Routing: ``top_k=1`` (default) is Switch — one expert per token, raw
+softmax gate.  ``top_k=2`` is GShard-style — the two gates renormalize
+to sum 1 and capacity is granted in choice order (all first choices
+claim slots before any second choice), so when capacity binds the
+less-confident assignments drop first.  Both ride the same [E, C]
+dispatch/combine einsums and the same all_to_all wire.
+
 Load balancing: ``aux_loss_coef > 0`` enables the Switch auxiliary
-loss ``E · Σ_e f_e · P_e`` (f_e = fraction of tokens routed to expert
-e pre-capacity, P_e = mean router probability).  The activation-
+loss ``E · Σ_e f_e · P_e`` (f_e = fraction of tokens FIRST-choice
+routed to expert e pre-capacity, P_e = mean router probability).  The activation-
 dependent term travels on the framework's buffer thread — the layer
 writes it to an ``aux_loss`` buffer, which the train-step builders
 read back INSIDE the differentiated loss function and add to the
@@ -52,8 +59,10 @@ class MoEFFN(TensorModule):
     """Switch-style top-1 MoE feed-forward over [batch, seq, embed].
 
     ``n_experts`` expert MLPs (``embed -> hidden -> embed``, gelu); a
-    linear router picks one expert per token, scaled by its softmax
-    gate.  ``capacity_factor`` sizes the static per-expert buffer:
+    linear router picks each token's expert, scaled by its softmax
+    gate — or, with ``top_k=2``, the token's two best experts mixed by
+    renormalized gates (GShard-style; capacity granted in choice
+    order).  ``capacity_factor`` sizes the static per-expert buffer:
     ``C = ceil(capacity_factor * n_tokens / n_experts)`` — tokens over
     capacity are dropped (contribute zero; the transformer block's
     residual carries them through).  ``jitter`` multiplies router
@@ -71,13 +80,17 @@ class MoEFFN(TensorModule):
                  capacity_factor: float = 1.25, jitter: float = 0.0,
                  axis_name: Optional[str] = None,
                  aux_loss_coef: float = 0.0,
-                 stat_axes: tuple = ()):
+                 stat_axes: tuple = (), top_k: int = 1):
         super().__init__()
         if n_experts < 1:
             raise ValueError(f"n_experts must be >= 1, got {n_experts}")
+        if not 1 <= top_k <= n_experts:
+            raise ValueError(
+                f"top_k must be in [1, n_experts={n_experts}], got {top_k}")
         self.embed_dim = embed_dim
         self.hidden_dim = hidden_dim
         self.n_experts = n_experts
+        self.top_k = int(top_k)
         self.capacity_factor = float(capacity_factor)
         self.jitter = float(jitter)
         self.axis_name = axis_name
@@ -122,8 +135,14 @@ class MoEFFN(TensorModule):
             return 1
 
     def _route(self, x2d, params, training, rng):
-        """Top-1 routing: gates [N], expert one-hot [N, E], position-in-
-        expert one-hot [N, E, C] (capacity-masked)."""
+        """Top-k routing: (dispatch [N, E, C] binary, combine [N, E, C]
+        gate-weighted, aux) — capacity-masked slot assignment.
+
+        ``top_k == 1`` is Switch (raw softmax gate); ``top_k > 1`` is
+        GShard-style: the k gates renormalize to sum 1, and capacity is
+        granted in choice order — ALL first choices claim slots before
+        any second choice, so when capacity binds the less-confident
+        assignments drop first."""
         logits = jnp.dot(x2d, params["router_w"].T) + params["router_b"]
         if training and self.jitter > 0.0 and rng is not None:
             noise = jax.random.uniform(
@@ -131,24 +150,31 @@ class MoEFFN(TensorModule):
                 1.0 - self.jitter, 1.0 + self.jitter)
             logits = logits * noise
         probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
-        idx = jnp.argmax(probs, axis=-1)                      # [N]
-        gate = jnp.max(probs, axis=-1)                        # [N]
-        onehot = jax.nn.one_hot(idx, self.n_experts,
-                                dtype=jnp.float32)            # [N, E]
-        pos, keep = self.keep_mask(onehot)
+        gk, idxk = lax.top_k(probs, self.top_k)               # [N, K]
+        if self.top_k > 1:
+            gk = gk / jnp.sum(gk, axis=-1, keepdims=True)
         C = self._capacity(x2d.shape[0])
-        gate = gate * jnp.sum(keep, axis=-1)                  # 0 if dropped
-        # [N, E, C]: token n occupies slot pos-1 of its expert
-        disp = (jax.nn.one_hot((pos - 1).astype(jnp.int32), C,
-                               dtype=jnp.float32)
-                * keep[..., None])
+        disp = jnp.zeros((x2d.shape[0], self.n_experts, C), jnp.float32)
+        comb = jnp.zeros_like(disp)
+        counts = None
+        for c in range(self.top_k):                          # K static
+            oh = jax.nn.one_hot(idxk[:, c], self.n_experts,
+                                dtype=jnp.float32)            # [N, E]
+            pos, keep, counts = self.keep_mask(oh, counts)
+            slot = (jax.nn.one_hot((pos - 1).astype(jnp.int32), C,
+                                   dtype=jnp.float32)
+                    * keep[..., None])                        # [N, E, C]
+            disp = disp + slot
+            comb = comb + gk[:, c, None, None] * slot
         # Switch aux loss (pre-capacity): E * sum_e f_e * P_e, where
-        # f_e = fraction of tokens argmax-routed to e, P_e = mean prob.
-        # Under expert parallelism the statistics are pmean'd over the
-        # axis FIRST so the term is the documented GLOBAL formula —
-        # mean-of-products of shard-local stats would silently differ
-        # from the dense twin (product of global means).
-        f_vec = jnp.mean(onehot, axis=0)
+        # f_e = fraction of tokens FIRST-choice-routed to e, P_e = mean
+        # prob (the standard formula for top-k too).  Under expert
+        # parallelism the statistics are pmean'd over the axis FIRST so
+        # the term is the documented GLOBAL formula — mean-of-products
+        # of shard-local stats would silently differ from the dense
+        # twin (product of global means).
+        f_vec = jnp.mean(jax.nn.one_hot(idxk[:, 0], self.n_experts,
+                                        dtype=jnp.float32), axis=0)
         p_vec = jnp.mean(probs, axis=0)
         for ax in (self.axis_name,) + self.stat_axes:
             if ax is None:
@@ -159,21 +185,28 @@ class MoEFFN(TensorModule):
             except NameError:  # axis not bound: eager/unsharded call
                 pass
         aux = self.n_experts * jnp.sum(f_vec * p_vec)
-        return gate.astype(x2d.dtype), disp.astype(x2d.dtype), aux
+        return disp.astype(x2d.dtype), comb.astype(x2d.dtype), aux
 
     def _capacity(self, n_tokens: int) -> int:
         return max(1, int(np.ceil(self.capacity_factor * n_tokens
                                   / self.n_experts)))
 
-    def keep_mask(self, onehot):
+    def keep_mask(self, onehot, counts=None):
         """The dispatch's keep rule, shared with diagnostics
         (models/generate.py capacity_bind_report re-applies it at decode
         time): first-come slot assignment via 1-based position-in-expert
         cumsum over the flattened token order, capacity from the token
-        count.  ``onehot`` [N, E] → (pos [N, E] 1-based, keep [N, E])."""
+        count.  ``counts`` [E] offsets the stream for later routing
+        choices (top-k: every choice-c assignment queues behind all
+        choice-(c-1) ones).  ``onehot`` [N, E] → (pos [N, E] 1-based,
+        keep [N, E], new_counts [E])."""
         pos = jnp.cumsum(onehot, axis=0) * onehot             # 1-based
+        if counts is not None:
+            pos = (pos + counts[None, :]) * onehot
         C = self._capacity(onehot.shape[0])
-        return pos, (pos <= C) & (onehot > 0)                 # [N, E]
+        new_counts = jnp.sum(onehot, axis=0) + (
+            counts if counts is not None else 0.0)
+        return pos, (pos <= C) & (onehot > 0), new_counts     # [N, E]
 
     def _expert_mlp(self, inp, params):
         """inp [e, c, D] through the (possibly expert-sharded) stacked
@@ -190,7 +223,7 @@ class MoEFFN(TensorModule):
     def _apply(self, params, buffers, x, training, rng):
         B, T, D = x.shape
         x2d = x.reshape(B * T, D)
-        gate, disp, aux = self._route(x2d, params, training, rng)
+        disp, comb, aux = self._route(x2d, params, training, rng)
         if self.aux_loss_coef > 0.0:
             buffers = dict(buffers)
             buffers["aux_loss"] = aux.astype(jnp.float32)
@@ -209,7 +242,10 @@ class MoEFFN(TensorModule):
             # and back: split capacity, concat experts -> [E, C, D]
             out_e = lax.all_to_all(out, self.axis_name,
                                    split_axis=1, concat_axis=0, tiled=True)
-        y = jnp.einsum("nec,ecd->nd", disp, out_e) * gate[:, None]
+        # the combine tensor carries the gates (top-1: the raw Switch
+        # gate; top-k: the renormalized per-choice gates), so the
+        # weighted mixture falls out of one einsum
+        y = jnp.einsum("nec,ecd->nd", comb, out_e)
         return y.reshape(B, T, D), buffers
 
 
