@@ -30,8 +30,9 @@ Sampling: ``temperature=0`` → greedy argmax; ``temperature>0`` →
 categorical over ``logits/temperature`` (optionally within ``top_k``
 and/or the ``top_p`` nucleus) and REQUIRES an explicit ``rng`` key — a
 silent fixed-seed default would return the identical "sample" every
-call.  Beam decode: :func:`make_beam_search` (fixed-length, the LM has
-no EOS convention).
+call.  ``eos_id`` stops a row (sampling) or finishes a beam (beam
+search) early at static shapes, emitting ``pad_id`` from then on —
+hf.generate's convention.  Beam decode: :func:`make_beam_search`.
 """
 from __future__ import annotations
 
@@ -78,6 +79,23 @@ def _check_len(model, max_len):
             f"({model.max_len}); the decode window cannot outgrow "
             f"the positions the model was built with")
     return T_max
+
+
+def _eos_pad(model, eos_id, pad_id):
+    """Normalize the shared eos/pad convention for BOTH decoders:
+    ``eos_id=None`` disables early stop (sentinel 0 — ids are 1-based);
+    ``pad_id`` defaults to the eos itself.  Out-of-vocabulary ids are
+    rejected loudly — the beam decoder builds a one-hot pad row over
+    [1, V], where a bad pad would silently annihilate finished beams
+    instead of freezing them."""
+    for name, v in (("eos_id", eos_id), ("pad_id", pad_id)):
+        if v is not None and not 1 <= int(v) <= model.vocab_size:
+            raise ValueError(
+                f"{name}={v} outside the 1-based vocabulary "
+                f"[1, {model.vocab_size}]")
+    eos = int(eos_id or 0)
+    pad = int(pad_id) if pad_id is not None else eos
+    return jnp.int32(eos), jnp.int32(pad)
 
 
 def _proj(x, params, w, b, with_bias):
@@ -338,33 +356,36 @@ def make_generate(model, max_len: Optional[int] = None,
                 "(jax.random.PRNGKey) — a fixed default would return "
                 "the identical sample every call")
         key = rng if rng is not None else jax.random.PRNGKey(0)
+        eos, pad = _eos_pad(model, eos_id, pad_id)
         return _run(params, jnp.asarray(prompt_ids, jnp.int32),
                     int(max_new), key, jnp.float32(temperature),
-                    int(top_k), jnp.float32(top_p),
-                    jnp.int32(eos_id or 0),
-                    jnp.int32(pad_id if pad_id is not None
-                              else (eos_id or 0)))
+                    int(top_k), jnp.float32(top_p), eos, pad)
 
     return generate
 
 
 def make_beam_search(model, max_len: Optional[int] = None,
                      compute_dtype=None):
-    """Build ``beam_search(params, prompt_ids, max_new, num_beams=4)
-    -> (ids [B, prompt+max_new], scores [B])``.
+    """Build ``beam_search(params, prompt_ids, max_new, num_beams=4,
+    eos_id=None, pad_id=None) -> (ids [B, prompt+max_new], scores [B])``.
 
-    Fixed-length beam decode (the LM has no EOS convention, so every
-    beam has the same length and a GNMT length penalty would be
-    argmax-invariant — none is offered): each step expands every beam
-    over the vocabulary and keeps the top ``num_beams`` by cumulative
+    Beam decode at static shapes: each step expands every beam over the
+    vocabulary and keeps the top ``num_beams`` by cumulative
     log-probability, gathering the KV caches along the beam dim to
-    follow their parents.  ``scores`` are total log-probs.  When
-    ``num_beams`` exceeds the vocabulary, the surplus first-step beams
-    start dead (-inf) and are claimed by real expansions at later
+    follow their parents.  ``scores`` are total log-probs.  With
+    ``eos_id``, a beam that emits eos FINISHES: its score freezes and
+    its only continuation is ``pad_id`` (default the eos) at zero cost,
+    so finished beams compete with live ones at full width — the
+    returned best may be a finished beam.  No length penalty is applied
+    (scores are raw sums; with eos enabled, shorter finished beams
+    naturally carry fewer negative terms — the standard caveat).
+
+    When ``num_beams`` exceeds the vocabulary, the surplus first-step
+    beams start dead (-inf) and are claimed by real expansions at later
     depths, so ``num_beams=1`` reduces to greedy and with enough beams
     to hold every prefix it IS exhaustive search (the oracle test pins
-    that).  Shares :func:`_decode_machinery` with the sampling
-    decoder."""
+    that, with and without eos).  Shares :func:`_decode_machinery` with
+    the sampling decoder."""
     from ..optim.optimizer import _cast_floats
 
     first, count = _check_model(model)
@@ -373,7 +394,7 @@ def make_beam_search(model, max_len: Optional[int] = None,
         model, first, count, T_max)
 
     @partial(jax.jit, static_argnums=(2, 3))
-    def _run(p, prompt, max_new, kk):
+    def _run(p, prompt, max_new, kk, eos, pad):
         pc = _cast_floats(p, compute_dtype) if compute_dtype else p
         B, T0 = prompt.shape
         if T0 + max_new > T_max:
@@ -397,25 +418,32 @@ def make_beam_search(model, max_len: Optional[int] = None,
             first_tok = jnp.concatenate(
                 [first_tok, jnp.zeros((B, kk - k0), first_tok.dtype)],
                 axis=1)
+        done = ((first_tok + 1) == eos) & (eos > 0)       # [B, kk]
         ids = jnp.zeros((B, kk, T0 + max_new), prompt.dtype)
         ids = ids.at[:, :, :T0].set(prompt[:, None, :])
         ids = ids.at[:, :, T0].set((first_tok + 1).astype(ids.dtype))
         # caches replicate per beam: [B, H, Tm, Dh] -> [B*kk, ...]
         caches = [(jnp.repeat(kc, kk, axis=0), jnp.repeat(vc, kk, axis=0))
                   for kc, vc in caches]
+        # a finished beam's one legal continuation: pad at zero cost
+        pad_row = jnp.where(jnp.arange(V) == pad - 1, 0.0, -jnp.inf)
 
         def step(carry, off):
-            caches, ids, scores = carry
+            caches, ids, scores, done = carry
             pos = T0 + off
             tok = jax.vmap(
                 lambda row: lax.dynamic_slice(row, (pos,), (1,)))(
                     ids.reshape(B * kk, -1))
             h, new_caches = decode_token(pc, tok, caches, pos)
             logp = jax.nn.log_softmax(logits_last(pc, h), axis=-1)
-            cand = scores[:, :, None] + logp.reshape(B, kk, V)
+            logp = jnp.where(done[:, :, None], pad_row[None, None],
+                             logp.reshape(B, kk, V))
+            cand = scores[:, :, None] + logp
             scores, idx = jax.lax.top_k(cand.reshape(B, kk * V), kk)
             parent = idx // V                             # [B, kk]
             tok_next = (idx % V) + 1
+            done = (jnp.take_along_axis(done, parent, axis=1)
+                    | ((tok_next == eos) & (eos > 0)))
             # beams follow their parents: reorder ids and caches
             ids = jnp.take_along_axis(ids, parent[:, :, None], axis=1)
             ids = jax.vmap(
@@ -426,21 +454,24 @@ def make_beam_search(model, max_len: Optional[int] = None,
             gather = (parent + jnp.arange(B)[:, None] * kk).reshape(-1)
             new_caches = [(kc[gather], vc[gather])
                           for kc, vc in new_caches]
-            return (new_caches, ids, scores), None
+            return (new_caches, ids, scores, done), None
 
         if max_new > 1:
-            (caches, ids, scores), _ = lax.scan(
-                step, (caches, ids, scores), jnp.arange(max_new - 1))
+            (caches, ids, scores, done), _ = lax.scan(
+                step, (caches, ids, scores, done), jnp.arange(max_new - 1))
         best = jnp.argmax(scores, axis=-1)                # [B]
         out = jnp.take_along_axis(ids, best[:, None, None], axis=1)[:, 0]
         return out, jnp.take_along_axis(scores, best[:, None],
                                         axis=1)[:, 0]
 
-    def beam_search(params, prompt_ids, max_new: int, num_beams: int = 4):
+    def beam_search(params, prompt_ids, max_new: int, num_beams: int = 4,
+                    eos_id: Optional[int] = None,
+                    pad_id: Optional[int] = None):
         if num_beams < 1:
             raise ValueError(f"num_beams must be >= 1, got {num_beams}")
+        eos, pad = _eos_pad(model, eos_id, pad_id)
         return _run(params, jnp.asarray(prompt_ids, jnp.int32),
-                    int(max_new), int(num_beams))
+                    int(max_new), int(num_beams), eos, pad)
 
     return beam_search
 

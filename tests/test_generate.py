@@ -155,6 +155,70 @@ def test_beam_search_exhaustive_oracle():
     np.testing.assert_allclose(float(scores[0]), best_score, atol=1e-4)
 
 
+def test_beam_search_eos_exhaustive_oracle():
+    """With eos enabled and enough beams, beam search must find the
+    best sequence under finished-beam semantics: a sequence's score
+    stops accumulating at its first eos — pinned against brute force
+    over all continuations with early-stop scoring."""
+    import itertools
+
+    from bigdl_tpu.models.generate import make_beam_search
+
+    V_small, n = 7, 3
+    RNG().set_seed(9)
+    model = TransformerLM(V_small, embed_dim=12, num_heads=2, mlp_dim=24,
+                          num_layers=2, max_len=8)
+    params = model.param_tree()
+    prompt = np.array([[2, 5]], np.int32)
+    # pick an eos that competes: the 2nd-best first token of the free
+    # search (so finishing immediately is a real candidate)
+    out, _ = model.apply_fn(params, model.buffer_tree(),
+                            jnp.asarray(prompt), False, None)
+    eos = int(np.argsort(np.asarray(out)[0, -1])[-2]) + 1
+    pad = 1
+
+    best_score, best_seq = -np.inf, None
+    for cont in itertools.product(range(1, V_small + 1), repeat=n):
+        # early-stop scoring: tokens after the first eos must be pad
+        # (zero cost); other post-eos continuations are the same
+        # sequence, skip duplicates by requiring canonical pad fill
+        if eos in cont:
+            j = cont.index(eos)
+            if any(c != pad for c in cont[j + 1:]):
+                continue
+        ids = np.concatenate([prompt[0], np.array(cont)])[None, :]
+        out, _ = model.apply_fn(params, model.buffer_tree(),
+                                jnp.asarray(ids), False, None)
+        lp = np.asarray(out)[0]
+        stop = cont.index(eos) if eos in cont else n - 1
+        score = sum(lp[prompt.shape[1] - 1 + t, cont[t] - 1]
+                    for t in range(stop + 1))
+        if score > best_score:
+            best_score, best_seq = score, cont
+
+    beam = make_beam_search(model)
+    ids, scores = beam(params, prompt, max_new=n,
+                       num_beams=V_small ** 2, eos_id=eos, pad_id=pad)
+    assert tuple(np.asarray(ids)[0, 2:].tolist()) == best_seq
+    np.testing.assert_allclose(float(scores[0]), best_score, atol=1e-4)
+
+
+def test_beam_one_eos_equals_greedy_eos():
+    from bigdl_tpu.models.generate import make_beam_search
+
+    model = _model()
+    prompt = np.random.RandomState(14).randint(
+        1, VOCAB + 1, (2, 4)).astype(np.int32)
+    free = np.asarray(model.generate(prompt, max_new=6))
+    eos = int(free[0, 6])
+    greedy = np.asarray(model.generate(prompt, max_new=6, eos_id=eos,
+                                       pad_id=2))
+    beam_ids, _ = make_beam_search(model)(
+        model.param_tree(), prompt, max_new=6, num_beams=1,
+        eos_id=eos, pad_id=2)
+    np.testing.assert_array_equal(np.asarray(beam_ids), greedy)
+
+
 def test_beam_one_equals_greedy():
     from bigdl_tpu.models.generate import make_beam_search
 
