@@ -191,3 +191,86 @@ def save_gpt2(lm):
             f"GPT-2 export mismatch: missing={real_missing} "
             f"unexpected={unexpected}")
     return hf
+
+
+def load_llama(hf_model):
+    """Build a :class:`TransformerLM` carrying the weights of a
+    ``transformers`` Llama-family model (``LlamaForCausalLM`` /
+    ``LlamaModel``): RMSNorm + RoPE + grouped-query attention + SwiGLU,
+    all bias-free.  Returns the model in eval mode with
+    ``output="logits"`` — its forward matches
+    ``hf_model(input_ids).logits`` on ``input_ids + 1`` (1-based ids).
+
+    HF's ``nn.Linear`` stores ``[out, in]`` weights, exactly the
+    framework's ``x @ W.T`` convention, so projections copy without
+    transposition (unlike GPT-2's Conv1D)."""
+    import jax.numpy as jnp
+
+    from ..models.transformer import TransformerLM
+
+    cfg = hf_model.config
+    if getattr(cfg, "model_type", "") != "llama":
+        raise ValueError(f"expected a llama config, got "
+                         f"{getattr(cfg, 'model_type', None)!r}")
+    if cfg.hidden_act not in ("silu", "swish"):
+        raise ValueError(f"activation {cfg.hidden_act!r} is not the "
+                         "silu the SwiGLU block computes")
+    scaling = getattr(cfg, "rope_scaling", None)
+    if scaling and scaling.get("rope_type", scaling.get("type")) not in (
+            None, "default"):
+        # llama3/linear/dynamic scaling changes the rotation itself —
+        # loading would silently break the 'logits match' contract
+        raise ValueError(
+            f"rope_scaling {scaling!r} is not supported; only the "
+            "plain theta rotation is implemented")
+    base = getattr(hf_model, "model", hf_model)
+    # .float(): published llama checkpoints are bf16, which numpy
+    # cannot represent directly
+    sd = {k: v.detach().cpu().float().numpy()
+          for k, v in base.state_dict().items()}
+    E, L = cfg.hidden_size, cfg.num_hidden_layers
+    lm = TransformerLM(
+        cfg.vocab_size, embed_dim=E, num_heads=cfg.num_attention_heads,
+        mlp_dim=cfg.intermediate_size, num_layers=L,
+        max_len=cfg.max_position_embeddings, output="logits",
+        norm="rms", mlp="swiglu",
+        num_kv_heads=cfg.num_key_value_heads, rope=True,
+        rope_theta=float(getattr(cfg, "rope_theta", 10000.0)),
+        attn_bias=bool(getattr(cfg, "attention_bias", False)),
+        mlp_bias=bool(getattr(cfg, "mlp_bias", False)),
+        head_bias=False, norm_eps=float(cfg.rms_norm_eps))
+    tree = lm.param_tree()
+    tree["0"] = {"weight": jnp.asarray(sd["embed_tokens.weight"])}
+    for i in range(L):
+        p = f"layers.{i}."
+        blk = {
+            "0": {"weight": jnp.asarray(sd[p + "input_layernorm.weight"])},
+            "1": {"wq": jnp.asarray(sd[p + "self_attn.q_proj.weight"]),
+                  "wk": jnp.asarray(sd[p + "self_attn.k_proj.weight"]),
+                  "wv": jnp.asarray(sd[p + "self_attn.v_proj.weight"]),
+                  "wo": jnp.asarray(sd[p + "self_attn.o_proj.weight"]),
+                  **({"bq": jnp.asarray(sd[p + "self_attn.q_proj.bias"]),
+                      "bk": jnp.asarray(sd[p + "self_attn.k_proj.bias"]),
+                      "bv": jnp.asarray(sd[p + "self_attn.v_proj.bias"]),
+                      "bo": jnp.asarray(sd[p + "self_attn.o_proj.bias"])}
+                     if getattr(cfg, "attention_bias", False) else {})},
+            "2": {"weight": jnp.asarray(
+                sd[p + "post_attention_layernorm.weight"])},
+            "3": {"weight": jnp.asarray(sd[p + "mlp.gate_proj.weight"]),
+                  **({"bias": jnp.asarray(sd[p + "mlp.gate_proj.bias"])}
+                     if getattr(cfg, "mlp_bias", False) else {})},
+            "4": {"weight": jnp.asarray(sd[p + "mlp.up_proj.weight"]),
+                  **({"bias": jnp.asarray(sd[p + "mlp.up_proj.bias"])}
+                     if getattr(cfg, "mlp_bias", False) else {})},
+            "5": {"weight": jnp.asarray(sd[p + "mlp.down_proj.weight"]),
+                  **({"bias": jnp.asarray(sd[p + "mlp.down_proj.bias"])}
+                     if getattr(cfg, "mlp_bias", False) else {})},
+        }
+        tree[str(1 + i)] = blk
+    tree[str(1 + L)] = {"weight": jnp.asarray(sd["norm.weight"])}
+    head_w = (hf_model.lm_head.weight.detach().cpu().float().numpy()
+              if hasattr(hf_model, "lm_head") else sd["embed_tokens.weight"])
+    tree[str(2 + L)] = {"weight": jnp.asarray(head_w)}
+    lm.set_param_tree(tree)
+    lm.evaluate()
+    return lm

@@ -164,22 +164,45 @@ def _decode_machinery(model, first, count, T_max):
     embed = model.modules[0]
     mha0 = blocks[0].modules[1]
     H, Dh = mha0.num_heads, mha0.head_dim
+    Hkv = getattr(mha0, "num_kv_heads", H)   # GQA: smaller KV caches
+    use_rope = getattr(model, "use_rope", False)
+    rope_theta = getattr(mha0, "rope_theta", 10000.0)
 
-    def _split(x, B):
-        return x.reshape(B, -1, H, Dh).transpose(0, 2, 1, 3)
+    def _split(x, B, h=H):
+        return x.reshape(B, -1, h, Dh).transpose(0, 2, 1, 3)
+
+    def _rep(kv):
+        """Broadcast the Hkv kv heads to the H query heads (GQA) — only
+        used on the prompt-length prefill tensors; the decode hot loop
+        keeps the cache un-repeated via the grouped einsum below."""
+        if Hkv == H:
+            return kv
+        return jnp.repeat(kv, H // Hkv, axis=1)
 
     def _attend(q, k_cache, v_cache, pos):
         """Causal attention of Tq queries (absolute positions
-        pos..pos+Tq-1) against the cache."""
+        pos..pos+Tq-1) against the cache.  GQA contracts the query
+        groups against the UN-repeated [B, Hkv, T_max, Dh] cache — a
+        repeat here would materialize H/Hkv copies of the whole cache
+        every decode step, exactly the bandwidth GQA exists to save."""
         Tq, Tm = q.shape[2], k_cache.shape[2]
         scale = 1.0 / jnp.sqrt(jnp.float32(Dh)).astype(q.dtype)
-        scores = jnp.einsum("bhqd,bhkd->bhqk", q, k_cache) * scale
         qpos = pos + jnp.arange(Tq)
         mask = jnp.arange(Tm)[None, :] <= qpos[:, None]   # [Tq, Tm]
-        scores = jnp.where(mask[None, None], scores, -jnp.inf)
+        if Hkv == H:
+            scores = jnp.einsum("bhqd,bhkd->bhqk", q, k_cache) * scale
+            scores = jnp.where(mask[None, None], scores, -jnp.inf)
+            probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1)
+            return jnp.einsum("bhqk,bhkd->bhqd", probs.astype(q.dtype),
+                              v_cache)
+        B = q.shape[0]
+        qg = q.reshape(B, Hkv, H // Hkv, Tq, Dh)
+        scores = jnp.einsum("bhgqd,bhkd->bhgqk", qg, k_cache) * scale
+        scores = jnp.where(mask[None, None, None], scores, -jnp.inf)
         probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1)
-        return jnp.einsum("bhqk,bhkd->bhqd", probs.astype(q.dtype),
-                          v_cache)
+        o = jnp.einsum("bhgqk,bhkd->bhgqd", probs.astype(q.dtype),
+                       v_cache)
+        return o.reshape(B, H, Tq, Dh)
 
     def _block_step(block, bp, h, k_cache, v_cache, pos):
         """One block on Tq tokens (prefill: Tq=T0 at pos 0; decode:
@@ -189,8 +212,16 @@ def _decode_machinery(model, first, count, T_max):
         ln1, _ = block.modules[0].apply_fn(bp["0"], {}, h, False, None)
         ap = bp["1"]
         q = _split(_proj(ln1, ap, "wq", "bq", mha.with_bias), B)
-        k = _split(_proj(ln1, ap, "wk", "bk", mha.with_bias), B)
-        v = _split(_proj(ln1, ap, "wv", "bv", mha.with_bias), B)
+        k = _split(_proj(ln1, ap, "wk", "bk", mha.with_bias), B, Hkv)
+        v = _split(_proj(ln1, ap, "wv", "bv", mha.with_bias), B, Hkv)
+        if use_rope:
+            # rotate at ABSOLUTE positions; the cache stores rotated
+            # keys (the standard KV-cache convention for RoPE)
+            from ..nn.attention import rope_rotate
+
+            qpos = pos + jnp.arange(q.shape[2])
+            q = rope_rotate(q, qpos, rope_theta)
+            k = rope_rotate(k, qpos, rope_theta)
         k_cache = lax.dynamic_update_slice(k_cache, k, (0, 0, pos, 0))
         v_cache = lax.dynamic_update_slice(v_cache, v, (0, 0, pos, 0))
         if isinstance(pos, int) and pos == 0 and q.shape[2] > 1:
@@ -205,14 +236,23 @@ def _decode_machinery(model, first, count, T_max):
             # teacher-forcing oracle either way.
             from ..ops.flash_attention import flash_attention
 
-            o = flash_attention(q, k, v, causal=True)
+            o = flash_attention(q, _rep(k), _rep(v), causal=True)
         else:
             o = _attend(q, k_cache, v_cache, pos)
         o = o.transpose(0, 2, 1, 3).reshape(B, o.shape[2], H * Dh)
         h = h + _proj(o, ap, "wo", "bo", mha.with_bias)
         ln2, _ = block.modules[2].apply_fn(bp["2"], {}, h, False, None)
-        if block.is_moe:
+        kind = getattr(block, "mlp_kind",
+                       "moe" if block.is_moe else "gelu")
+        if kind == "moe":
             ffn = _moe_ffn_nodrop(block.modules[3], bp["3"], ln2)
+        elif kind == "swiglu":
+            g, _ = block.modules[3].apply_fn(bp["3"], {}, ln2, False,
+                                             None)
+            u, _ = block.modules[4].apply_fn(bp["4"], {}, ln2, False,
+                                             None)
+            ffn, _ = block.modules[5].apply_fn(
+                bp["5"], {}, jax.nn.silu(g) * u, False, None)
         else:
             mid, _ = block.modules[3].apply_fn(bp["3"], {}, ln2, False,
                                                None)
@@ -224,6 +264,8 @@ def _decode_machinery(model, first, count, T_max):
 
     def _embed_at(pc, tok, pos, Tq):
         h, _ = embed.apply_fn(pc["0"], {}, tok, False, None)
+        if use_rope:  # positions live in the per-layer q/k rotation
+            return h
         return h + lax.dynamic_slice_in_dim(pc["pos"], pos, Tq)
 
     def prefill(pc, prompt, dt):
@@ -233,8 +275,8 @@ def _decode_machinery(model, first, count, T_max):
         h = _embed_at(pc, prompt, 0, T0)
         caches = []
         for bi, block in enumerate(blocks):
-            kc = jnp.zeros((B, H, T_max, Dh), dt)
-            vc = jnp.zeros((B, H, T_max, Dh), dt)
+            kc = jnp.zeros((B, Hkv, T_max, Dh), dt)
+            vc = jnp.zeros((B, Hkv, T_max, Dh), dt)
             h, kc, vc = _block_step(block, pc[str(first + bi)], h, kc,
                                     vc, 0)
             caches.append((kc, vc))

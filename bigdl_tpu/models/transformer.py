@@ -27,6 +27,16 @@ from ..parallel.tensor_parallel import ColumnParallelLinear, RowParallelLinear
 from ..utils.rng import next_jax_key
 
 
+def _norm_factory(norm: str, norm_eps):
+    """One policy for both the blocks and the final norm: the norm
+    class and its eps default (rms 1e-6 / ln 1e-5, HF's conventions)."""
+    eps = norm_eps if norm_eps is not None else (
+        1e-6 if norm == "rms" else 1e-5)
+    if norm == "rms":
+        return lambda d: nn.RMSNorm(d, eps=eps)
+    return lambda d: nn.LayerNorm(d, eps=eps)
+
+
 class TransformerBlock(Container):
     """Pre-norm residual block: x + MHA(LN(x)); x + MLP(LN(x)).
 
@@ -41,13 +51,32 @@ class TransformerBlock(Container):
                  moe_experts: int = 0, moe_axis: Optional[str] = None,
                  moe_capacity_factor: float = 1.25,
                  moe_aux_coef: float = 0.0, moe_top_k: int = 1,
-                 dropout: float = 0.0):
+                 dropout: float = 0.0, norm: str = "ln",
+                 mlp: str = "gelu", num_kv_heads: Optional[int] = None,
+                 rope: bool = False, rope_theta: float = 10000.0,
+                 attn_bias: Optional[bool] = None,
+                 mlp_bias: Optional[bool] = None,
+                 norm_eps: Optional[float] = None):
+        if norm not in ("ln", "rms"):
+            raise ValueError(f"norm {norm!r} not in ('ln', 'rms')")
+        if mlp not in ("gelu", "swiglu"):
+            raise ValueError(f"mlp {mlp!r} not in ('gelu', 'swiglu')")
+        if mlp == "swiglu" and moe_experts:
+            raise ValueError("moe_experts uses gelu expert MLPs; "
+                             "mlp='swiglu' does not compose with MoE")
+        Norm = _norm_factory(norm, norm_eps)
+        # llama convention: bias-free attention (and swiglu) projections
+        with_bias = (attn_bias if attn_bias is not None
+                     else not (rope or norm == "rms"))
         mods = [
-            nn.LayerNorm(embed_dim),
+            Norm(embed_dim),
             nn.MultiHeadAttention(embed_dim, num_heads, causal=causal,
                                   seq_strategy=seq_strategy,
-                                  seq_axis=seq_axis),
-            nn.LayerNorm(embed_dim),
+                                  seq_axis=seq_axis,
+                                  num_kv_heads=num_kv_heads,
+                                  rope=rope, rope_theta=rope_theta,
+                                  with_bias=with_bias),
+            Norm(embed_dim),
         ]
         if moe_experts:
             if model_axis is not None:
@@ -68,6 +97,20 @@ class TransformerBlock(Container):
                                stat_axes=((seq_axis,) if seq_strategy
                                           in ("ring", "ulysses")
                                           and seq_axis else ())))
+        elif mlp == "swiglu":
+            # Megatron mapping: gate/up are column-split, down row-split
+            # (bias independent of the attention's: HF llama separates
+            # attention_bias from mlp_bias)
+            mb = mlp_bias if mlp_bias is not None else with_bias
+            mods += [ColumnParallelLinear(embed_dim, mlp_dim,
+                                          with_bias=mb,
+                                          axis_name=model_axis),
+                     ColumnParallelLinear(embed_dim, mlp_dim,
+                                          with_bias=mb,
+                                          axis_name=model_axis),
+                     RowParallelLinear(mlp_dim, embed_dim,
+                                       with_bias=mb,
+                                       axis_name=model_axis)]
         else:
             mods += [ColumnParallelLinear(embed_dim, mlp_dim,
                                           axis_name=model_axis),
@@ -75,6 +118,7 @@ class TransformerBlock(Container):
                                        axis_name=model_axis)]
         super().__init__(*mods)
         self.is_moe = bool(moe_experts)
+        self.mlp_kind = "moe" if moe_experts else mlp
         # residual dropout applied FUNCTIONALLY (no extra modules, so
         # the block structure the pipeline/generation builders rely on
         # is unchanged); train-time only, keyed off the step rng the
@@ -100,14 +144,24 @@ class TransformerBlock(Container):
         x = x + self._drop(h, sub(10), training)
         h, nb["2"] = self.modules[2].apply_fn(
             params["2"], buffers["2"], x, training, sub(2))
-        h, nb["3"] = self.modules[3].apply_fn(
-            params["3"], buffers["3"], h, training, sub(3))
-        if not self.is_moe:
-            # dense MLP: gelu between the column/row pair; the MoE FFN
-            # applies its own gelu between the expert matmuls
-            h = jax.nn.gelu(h)
-            h, nb["4"] = self.modules[4].apply_fn(
+        if getattr(self, "mlp_kind", None) == "swiglu":
+            # llama MLP: down(silu(gate(x)) * up(x))
+            g, nb["3"] = self.modules[3].apply_fn(
+                params["3"], buffers["3"], h, training, sub(3))
+            u, nb["4"] = self.modules[4].apply_fn(
                 params["4"], buffers["4"], h, training, sub(4))
+            h, nb["5"] = self.modules[5].apply_fn(
+                params["5"], buffers["5"], jax.nn.silu(g) * u,
+                training, sub(5))
+        else:
+            h, nb["3"] = self.modules[3].apply_fn(
+                params["3"], buffers["3"], h, training, sub(3))
+            if not self.is_moe:
+                # dense MLP: gelu between the column/row pair; the MoE
+                # FFN applies its own gelu between the expert matmuls
+                h = jax.nn.gelu(h)
+                h, nb["4"] = self.modules[4].apply_fn(
+                    params["4"], buffers["4"], h, training, sub(4))
         return x + self._drop(h, sub(11), training), nb
 
 
@@ -129,7 +183,13 @@ class TransformerLM(Container):
                  moe_experts: int = 0, moe_axis: Optional[str] = None,
                  moe_capacity_factor: float = 1.25,
                  moe_aux_coef: float = 0.0, moe_top_k: int = 1,
-                 dropout: float = 0.0):
+                 dropout: float = 0.0, norm: str = "ln",
+                 mlp: str = "gelu", num_kv_heads: Optional[int] = None,
+                 rope: bool = False, rope_theta: float = 10000.0,
+                 attn_bias: Optional[bool] = None,
+                 mlp_bias: Optional[bool] = None,
+                 head_bias: bool = True,
+                 norm_eps: Optional[float] = None):
         if output not in ("log_probs", "logits"):
             raise ValueError(f"output {output!r} not in (log_probs, logits)")
         mlp_dim = mlp_dim or 4 * embed_dim
@@ -145,6 +205,9 @@ class TransformerLM(Container):
         self.seq_axis = seq_axis
         self.seq_strategy = seq_strategy
         self.remat = remat
+        # rope models carry no learned positional table — positions
+        # live in the per-layer q/k rotation
+        self.use_rope = bool(rope)
         blocks = [TransformerBlock(embed_dim, num_heads, mlp_dim, causal,
                                    seq_strategy, seq_axis, model_axis,
                                    moe_experts=moe_experts,
@@ -152,17 +215,25 @@ class TransformerLM(Container):
                                    moe_capacity_factor=moe_capacity_factor,
                                    moe_aux_coef=moe_aux_coef,
                                    moe_top_k=moe_top_k,
-                                   dropout=dropout)
+                                   dropout=dropout, norm=norm, mlp=mlp,
+                                   num_kv_heads=num_kv_heads, rope=rope,
+                                   rope_theta=rope_theta,
+                                   attn_bias=attn_bias,
+                                   mlp_bias=mlp_bias,
+                                   norm_eps=norm_eps)
                   for _ in range(num_layers)]
+        Norm = _norm_factory(norm, norm_eps)
         super().__init__(
             nn.LookupTable(vocab_size, embed_dim),
             *blocks,
-            nn.LayerNorm(embed_dim),
-            nn.Linear(embed_dim, vocab_size),
+            Norm(embed_dim),
+            nn.Linear(embed_dim, vocab_size, with_bias=head_bias),
         )
         self._reset_pos()
 
     def _reset_pos(self):
+        if getattr(self, 'use_rope', False):
+            return
         self._register_param(
             "pos", 0.02 * jax.random.normal(
                 next_jax_key(), (self.max_len, self.embed_dim)))
@@ -173,29 +244,35 @@ class TransformerLM(Container):
         return self
 
     # own params ("pos") + children keyed by index, like Container
+    # (rope models carry no positional table at all)
     def param_tree(self):
         tree = super().param_tree()
-        tree["pos"] = self.params["pos"]
+        if not getattr(self, 'use_rope', False):
+            tree["pos"] = self.params["pos"]
         return tree
 
     def set_param_tree(self, tree):
         tree = dict(tree)
-        self.params["pos"] = tree.pop("pos")
+        if not getattr(self, 'use_rope', False):
+            self.params["pos"] = tree.pop("pos")
         super().set_param_tree(tree)
 
     def grad_tree(self):
         tree = super().grad_tree()
-        tree["pos"] = self.grads["pos"]
+        if not getattr(self, 'use_rope', False):
+            tree["pos"] = self.grads["pos"]
         return tree
 
     def set_grad_tree(self, tree):
         tree = dict(tree)
-        self.grads["pos"] = tree.pop("pos")
+        if not getattr(self, 'use_rope', False):
+            self.grads["pos"] = tree.pop("pos")
         super().set_grad_tree(tree)
 
     def gradient_scale_tree(self):
         tree = super().gradient_scale_tree()
-        tree["pos"] = self.scale_w
+        if not getattr(self, 'use_rope', False):
+            tree["pos"] = self.scale_w
         return tree
 
     def generate(self, prompt_ids, max_new: int, rng=None,
@@ -235,7 +312,8 @@ class TransformerLM(Container):
         h, eb = embed.apply_fn(params["0"], buffers["0"], x, training,
                                jax.random.fold_in(rng, 0)
                                if rng is not None else None)
-        h = h + self._positions(params["pos"], h.shape[1])
+        if not getattr(self, 'use_rope', False):  # rope positions live in the q/k rotation
+            h = h + self._positions(params["pos"], h.shape[1])
         new_buffers = dict(buffers)
         for i, m in enumerate(self.modules[1:], start=1):
             sub = jax.random.fold_in(rng, i) if rng is not None else None

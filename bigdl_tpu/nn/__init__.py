@@ -33,7 +33,7 @@ from .pooling import (
     RoiPooling, SpatialAveragePooling, SpatialMaxPooling, VolumetricMaxPooling,
 )
 from .normalization import (
-    LayerNorm,
+    LayerNorm, RMSNorm,
     BatchNormalization, ImageNormalize, L1Penalty, Normalize,
     SpatialBatchNormalization,
     SpatialContrastiveNormalization, SpatialCrossMapLRN,

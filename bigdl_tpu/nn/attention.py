@@ -18,6 +18,20 @@ from .module import TensorModule
 SEQ_STRATEGIES = ("dense", "flash", "block", "ring", "ulysses")
 
 
+def rope_rotate(x, pos, theta: float = 10000.0):
+    """Rotary position embedding (HF Llama's rotate-half convention)
+    over ``x`` [B, H, T, D] at absolute positions ``pos`` [T]."""
+    D = x.shape[-1]
+    inv = 1.0 / (theta ** (jnp.arange(0, D, 2, dtype=jnp.float32) / D))
+    ang = pos.astype(jnp.float32)[:, None] * inv[None, :]   # [T, D/2]
+    cos = jnp.concatenate([jnp.cos(ang), jnp.cos(ang)], -1)  # [T, D]
+    sin = jnp.concatenate([jnp.sin(ang), jnp.sin(ang)], -1)
+    x1, x2 = x[..., :D // 2], x[..., D // 2:]
+    rot = jnp.concatenate([-x2, x1], -1)
+    return (x * cos[None, None].astype(x.dtype)
+            + rot * sin[None, None].astype(x.dtype))
+
+
 class MultiHeadAttention(TensorModule):
     """Multi-head self-attention over [batch, seq, embed].
 
@@ -34,7 +48,9 @@ class MultiHeadAttention(TensorModule):
     def __init__(self, embed_dim: int, num_heads: int,
                  causal: bool = False, with_bias: bool = True,
                  seq_strategy: str = "dense", seq_axis: str = "seq",
-                 block_size: int = 512):
+                 block_size: int = 512,
+                 num_kv_heads: "int | None" = None,
+                 rope: bool = False, rope_theta: float = 10000.0):
         super().__init__()
         assert embed_dim % num_heads == 0, "embed_dim % num_heads != 0"
         if seq_strategy not in SEQ_STRATEGIES:
@@ -48,23 +64,40 @@ class MultiHeadAttention(TensorModule):
         self.seq_strategy = seq_strategy
         self.seq_axis = seq_axis
         self.block_size = block_size
+        # grouped-query attention: kv projections carry num_kv_heads
+        # heads (each shared by num_heads/num_kv_heads query groups)
+        self.num_kv_heads = int(num_kv_heads or num_heads)
+        if self.num_heads % self.num_kv_heads:
+            raise ValueError(
+                f"num_heads {num_heads} not divisible by num_kv_heads "
+                f"{self.num_kv_heads}")
+        self.rope = bool(rope)
+        self.rope_theta = float(rope_theta)
+        if self.rope and seq_strategy in ("ring", "ulysses"):
+            # the rotation needs GLOBAL positions, which the module
+            # cannot know inside a seq-sharded shard_map region
+            raise ValueError(
+                "rope composes with dense/flash/block attention; "
+                "ring/ulysses sequence parallelism would rotate at "
+                "shard-local positions")
         self.reset()
 
     def reset(self):
         w_init = self._init_methods.get("weight", (Xavier(), None))[0]
         b_init = self._init_methods.get("bias", (Zeros(), None))[0]
         E = self.embed_dim
-        for name in ("wq", "wk", "wv", "wo"):
-            self._register_param(name, w_init.init((E, E), IN_OUT))
+        kv = self.num_kv_heads * self.head_dim
+        for name, rows in (("wq", E), ("wk", kv), ("wv", kv), ("wo", E)):
+            self._register_param(name, w_init.init((rows, E), IN_OUT))
         if self.with_bias:
-            for name in ("bq", "bk", "bv", "bo"):
-                self._register_param(name, b_init.init((E,), ONE_D))
+            for name, n in (("bq", E), ("bk", kv), ("bv", kv), ("bo", E)):
+                self._register_param(name, b_init.init((n,), ONE_D))
         return self
 
-    def _split(self, x):
+    def _split(self, x, heads=None):
         B, T, _ = x.shape
-        return x.reshape(B, T, self.num_heads, self.head_dim).transpose(
-            0, 2, 1, 3)
+        h = heads or self.num_heads
+        return x.reshape(B, T, h, self.head_dim).transpose(0, 2, 1, 3)
 
     def _attend(self, q, k, v):
         if self.seq_strategy == "ring":
@@ -89,8 +122,16 @@ class MultiHeadAttention(TensorModule):
             return y + params[b] if self.with_bias else y
 
         q = self._split(proj(x, params["wq"], "bq"))
-        k = self._split(proj(x, params["wk"], "bk"))
-        v = self._split(proj(x, params["wv"], "bv"))
+        k = self._split(proj(x, params["wk"], "bk"), self.num_kv_heads)
+        v = self._split(proj(x, params["wv"], "bv"), self.num_kv_heads)
+        if self.rope:
+            pos = jnp.arange(q.shape[2])
+            q = rope_rotate(q, pos, self.rope_theta)
+            k = rope_rotate(k, pos, self.rope_theta)
+        if self.num_kv_heads != self.num_heads:
+            group = self.num_heads // self.num_kv_heads
+            k = jnp.repeat(k, group, axis=1)
+            v = jnp.repeat(v, group, axis=1)
         o = self._attend(q, k, v)
         B, H, T, D = o.shape
         o = o.transpose(0, 2, 1, 3).reshape(B, T, H * D)

@@ -270,6 +270,35 @@ class LayerNorm(TensorModule):
         return (x - mean) * lax.rsqrt(var + self.eps), buffers
 
 
+class RMSNorm(TensorModule):
+    """Root-mean-square normalization over the last dimension (the
+    Llama-family norm): ``x * rsqrt(mean(x²) + eps) * weight`` — no
+    mean subtraction, no bias.
+
+    No reference counterpart (the reference predates transformers).
+    Matches the HF Llama convention: the variance is computed in
+    float32, the normalized activations cast back to the input dtype
+    BEFORE the weight multiply."""
+
+    def __init__(self, n_output: int, eps: float = 1e-6):
+        super().__init__()
+        self.n_output = n_output
+        self.eps = eps
+        self.reset()
+
+    def reset(self):
+        w_init = self._init_methods.get("weight", (Ones(), None))[0]
+        self._register_param("weight", w_init.init((self.n_output,),
+                                                   ONE_D))
+        return self
+
+    def _apply(self, params, buffers, x, training, rng):
+        xf = x.astype(jnp.float32)
+        var = jnp.mean(xf * xf, axis=-1, keepdims=True)
+        normed = (xf * lax.rsqrt(var + self.eps)).astype(x.dtype)
+        return normed * params["weight"].astype(x.dtype), buffers
+
+
 class ImageNormalize(TensorModule):
     """Device-side image normalization + layout move.
 

@@ -213,9 +213,12 @@ def pack_params(model, n_pipe: int, model_axis=None):
     blocks = [t[str(i)] for i in range(first, first + count)]
     stacked = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *blocks)
     if _is_lm(model):
-        return {"embed": t["0"], "pos": t["pos"], "blocks": stacked,
-                "ln": t[str(first + count)],
-                "head": t[str(first + count + 1)]}
+        packed = {"embed": t["0"], "blocks": stacked,
+                  "ln": t[str(first + count)],
+                  "head": t[str(first + count + 1)]}
+        if "pos" in t:  # rope models carry no positional table
+            packed["pos"] = t["pos"]
+        return packed
     return {"pre": {str(i): t[str(i)] for i in range(first)},
             "blocks": stacked,
             "post": {str(i): t[str(i)]
@@ -234,9 +237,11 @@ def unpack_params(packed, model):
             f"packed tree carries {stacked_l[0].shape[0]} block layers "
             f"but the model has {count}")
     if _is_lm(model):
-        tree = {"0": packed["embed"], "pos": packed["pos"],
+        tree = {"0": packed["embed"],
                 str(first + count): packed["ln"],
                 str(first + count + 1): packed["head"]}
+        if "pos" in packed:
+            tree["pos"] = packed["pos"]
     else:
         tree = dict(packed["pre"])
         tree.update(packed["post"])
@@ -267,9 +272,11 @@ def param_specs(packed, pipe_axis: str = "pipe", block=None,
                                         packed["blocks"])
     repl = lambda sub: jax.tree_util.tree_map(lambda _: P(), sub)
     if "embed" in packed:
-        return {"embed": repl(packed["embed"]), "pos": P(),
-                "blocks": blocks, "ln": repl(packed["ln"]),
-                "head": repl(packed["head"])}
+        specs = {"embed": repl(packed["embed"]), "blocks": blocks,
+                 "ln": repl(packed["ln"]), "head": repl(packed["head"])}
+        if "pos" in packed:
+            specs["pos"] = P()
+        return specs
     return {"pre": repl(packed["pre"]), "blocks": blocks,
             "post": repl(packed["post"])}
 
@@ -358,7 +365,8 @@ def _make_local_forward(model, first, count, S, M, pipe_axis,
                   if compute_dtype is not None else x)
             h, _ = embed.apply_fn(pc["embed"], embed.buffer_tree(), xc,
                                   training, None)
-            h = h + model._positions(pc["pos"], h.shape[1])
+            if not getattr(model, "use_rope", False):
+                h = h + model._positions(pc["pos"], h.shape[1])
             h = run_pipe(pc["blocks"], h, training, rng)
             h, _ = ln.apply_fn(pc["ln"], ln.buffer_tree(), h, training,
                                None)

@@ -1,0 +1,213 @@
+"""Llama-family model support: RMSNorm + RoPE + grouped-query attention
++ SwiGLU, loaded from torch ``transformers`` weights and pinned against
+torch's own forward/generate (the GPT-2 interop contract, extended to
+the architecture family that dominates modern LMs).  Beyond reference
+parity (the reference predates transformers, SURVEY §5.7)."""
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+transformers = pytest.importorskip("transformers")
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+from bigdl_tpu import nn  # noqa: E402
+from bigdl_tpu.interop import load_llama  # noqa: E402
+from bigdl_tpu.models.transformer import TransformerLM  # noqa: E402
+from bigdl_tpu.utils.rng import RNG  # noqa: E402
+
+V = 61
+
+
+def _hf(seed=0, **kw):
+    torch.manual_seed(seed)
+    cfg = transformers.LlamaConfig(
+        vocab_size=V, hidden_size=32, intermediate_size=48,
+        num_hidden_layers=2, num_attention_heads=4,
+        num_key_value_heads=kw.pop("num_key_value_heads", 2),
+        max_position_embeddings=24, rms_norm_eps=1e-5,
+        rope_theta=10000.0, attention_bias=False,
+        tie_word_embeddings=False, **kw)
+    return transformers.LlamaForCausalLM(cfg).eval()
+
+
+def test_rmsnorm_matches_torch():
+    t = torch.manual_seed(1)
+    x = torch.randn(3, 5, 16)
+    ref = transformers.models.llama.modeling_llama.LlamaRMSNorm(
+        16, eps=1e-6)
+    with torch.no_grad():
+        ref.weight.copy_(torch.randn(16))
+        want = ref(x).numpy()
+    m = nn.RMSNorm(16, eps=1e-6)
+    m.params["weight"] = jnp.asarray(ref.weight.detach().numpy())
+    got, _ = m.apply_fn(m.param_tree(), {}, jnp.asarray(x.numpy()),
+                        False, None)
+    np.testing.assert_allclose(np.asarray(got), want, atol=1e-6)
+
+
+def test_llama_logits_match_torch_forward():
+    hf = _hf()
+    lm = load_llama(hf)
+    ids = np.random.RandomState(0).randint(0, V, (2, 10))
+    with torch.no_grad():
+        want = hf(torch.tensor(ids)).logits.numpy()
+    got, _ = lm.apply_fn(lm.param_tree(), lm.buffer_tree(),
+                         jnp.asarray(ids + 1), False, None)
+    np.testing.assert_allclose(np.asarray(got), want, atol=2e-5)
+
+
+def test_llama_mha_gqa_no_kv_sharing_escape():
+    """num_key_value_heads == num_heads (MHA) must also load + match —
+    the GQA path's repeat must be a no-op, not a different function."""
+    hf = _hf(seed=2, num_key_value_heads=4)
+    lm = load_llama(hf)
+    ids = np.random.RandomState(3).randint(0, V, (2, 7))
+    with torch.no_grad():
+        want = hf(torch.tensor(ids)).logits.numpy()
+    got, _ = lm.apply_fn(lm.param_tree(), lm.buffer_tree(),
+                         jnp.asarray(ids + 1), False, None)
+    np.testing.assert_allclose(np.asarray(got), want, atol=2e-5)
+
+
+def test_llama_greedy_decode_matches_torch_generate():
+    """The whole serving pipeline: load → RoPE/GQA KV-cache decode ==
+    torch greedy (explicit all-ones attention_mask: random prompts can
+    contain the literal pad token and HF would otherwise mask them)."""
+    hf = _hf()
+    lm = load_llama(hf)
+    ids = np.random.RandomState(0).randint(0, V, (2, 5))
+    with torch.no_grad():
+        want = hf.generate(
+            torch.tensor(ids), max_new_tokens=5, do_sample=False,
+            pad_token_id=0,
+            attention_mask=torch.ones_like(torch.tensor(ids))).numpy()
+    got = np.asarray(lm.generate((ids + 1).astype(np.int32),
+                                 max_new=5)) - 1
+    np.testing.assert_array_equal(got, want)
+
+
+def test_llama_style_decode_teacher_forcing():
+    """A framework-built llama-config model (no torch involved): greedy
+    decode must match its own full forward."""
+    RNG().set_seed(7)
+    lm = TransformerLM(31, embed_dim=32, num_heads=4, mlp_dim=48,
+                       num_layers=2, max_len=16, norm="rms",
+                       mlp="swiglu", num_kv_heads=2, rope=True)
+    assert "pos" not in lm.param_tree()  # rope: no positional table
+    prompt = np.random.RandomState(1).randint(1, 32, (2, 4)).astype(
+        np.int32)
+    ids = np.asarray(lm.generate(prompt, max_new=6))
+    out, _ = lm.apply_fn(lm.param_tree(), lm.buffer_tree(),
+                         jnp.asarray(ids), False, None)
+    pred = 1 + np.argmax(np.asarray(out), axis=-1)
+    np.testing.assert_array_equal(ids[:, 4:], pred[:, 3:-1])
+
+
+def test_llama_style_pipeline_matches_dense_twin():
+    """The llama config (no positional table) through the GPipe pipe
+    axis: pack/specs/forward must handle the missing 'pos' leaf and the
+    loss must match the dense twin."""
+    from jax.sharding import Mesh
+
+    from bigdl_tpu.optim import SGD
+    from bigdl_tpu.parallel.pipeline import (make_pipeline_train_step,
+                                             unpack_params)
+
+    def build():
+        RNG().set_seed(23)
+        return TransformerLM(31, embed_dim=32, num_heads=4, mlp_dim=48,
+                             num_layers=4, max_len=8, norm="rms",
+                             mlp="swiglu", num_kv_heads=2, rope=True)
+
+    dense, piped = build(), build()
+    crit = nn.TimeDistributedCriterion(nn.ClassNLLCriterion(), True)
+    rng = np.random.RandomState(6)
+    x = rng.randint(1, 32, (8, 8)).astype(np.float32)
+    y = rng.randint(1, 32, (8, 8)).astype(np.float32)
+
+    sgd = SGD(learning_rate=0.2)
+    params = dense.param_tree()
+    slots = sgd.init_state(params)
+
+    def loss_fn(p):
+        out, _ = dense.apply_fn(p, dense.buffer_tree(), jnp.asarray(x),
+                                True, None)
+        return crit._loss(out, jnp.asarray(y))
+
+    want_loss, grads = jax.value_and_grad(loss_fn)(params)
+    want_params, _ = sgd.step(grads, params, slots, 0.2)
+
+    mesh = Mesh(np.array(jax.devices()[:4]).reshape(2, 2),
+                ("data", "pipe"))
+    sgd2 = SGD(learning_rate=0.2)
+    step = make_pipeline_train_step(piped, crit, sgd2, mesh,
+                                    n_microbatch=2)
+    packed = step.pack()
+    assert "pos" not in packed
+    pslots = sgd2.init_state(packed)
+    loss, packed, pslots = step(packed, pslots, 0.2, x, y)
+    assert abs(float(loss) - float(want_loss)) < 2e-5
+    unpack_params(packed, piped)
+    for path, leaf in jax.tree_util.tree_leaves_with_path(
+            piped.param_tree()):
+        want = dict(jax.tree_util.tree_leaves_with_path(
+            want_params))[path]
+        np.testing.assert_allclose(np.asarray(leaf), np.asarray(want),
+                                   atol=3e-5,
+                                   err_msg=jax.tree_util.keystr(path))
+
+
+def test_llama_style_trains_on_mesh_matches_dense_twin():
+    """The llama config through the multi-axis train step (dp x tp,
+    SwiGLU column/column/row split): loss and updated params must match
+    the single-device dense twin."""
+    from jax.sharding import Mesh
+
+    from bigdl_tpu.optim import SGD
+    from bigdl_tpu.parallel.spmd import make_train_step
+
+    def build(model_axis):
+        RNG().set_seed(21)
+        return TransformerLM(31, embed_dim=32, num_heads=4, mlp_dim=48,
+                             num_layers=2, max_len=8, norm="rms",
+                             mlp="swiglu", num_kv_heads=2, rope=True,
+                             model_axis=model_axis)
+
+    dense, tp = build(None), build("model")
+    crit = nn.TimeDistributedCriterion(nn.ClassNLLCriterion(), True)
+    rng = np.random.RandomState(5)
+    x = rng.randint(1, 32, (8, 8)).astype(np.float32)
+    y = rng.randint(1, 32, (8, 8)).astype(np.float32)
+
+    def dense_step(model):
+        sgd = SGD(learning_rate=0.2)
+        params = model.param_tree()
+        slots = sgd.init_state(params)
+
+        def loss_fn(p):
+            out, _ = model.apply_fn(p, model.buffer_tree(),
+                                    jnp.asarray(x), True, None)
+            return crit._loss(out, jnp.asarray(y))
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        params, _ = sgd.step(grads, params, slots, 0.2)
+        return float(loss), params
+
+    want_loss, want_params = dense_step(dense)
+
+    mesh = Mesh(np.array(jax.devices()[:8]).reshape(4, 2),
+                ("data", "model"))
+    sgd = SGD(learning_rate=0.2)
+    params = tp.param_tree()
+    slots = sgd.init_state(params)
+    step = make_train_step(tp, crit, sgd, mesh)
+    loss, params, _, _ = step(params, slots, tp.buffer_tree(), 0.2, x, y)
+    assert abs(float(loss) - want_loss) < 2e-5
+    for path, leaf in jax.tree_util.tree_leaves_with_path(params):
+        want = dict(jax.tree_util.tree_leaves_with_path(
+            want_params))[path]
+        np.testing.assert_allclose(np.asarray(leaf), np.asarray(want),
+                                   atol=3e-5,
+                                   err_msg=jax.tree_util.keystr(path))
