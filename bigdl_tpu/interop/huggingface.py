@@ -215,6 +215,13 @@ def load_llama(hf_model):
     if cfg.hidden_act not in ("silu", "swish"):
         raise ValueError(f"activation {cfg.hidden_act!r} is not the "
                          "silu the SwiGLU block computes")
+    explicit_hd = getattr(cfg, "head_dim", None)
+    if explicit_hd and explicit_hd != cfg.hidden_size // cfg.num_attention_heads:
+        raise ValueError(
+            f"head_dim={explicit_hd} != hidden_size//num_attention_heads "
+            f"({cfg.hidden_size // cfg.num_attention_heads}); the "
+            "framework attention derives head_dim from the quotient — "
+            "decoupled-head-dim checkpoints cannot be represented")
     scaling = getattr(cfg, "rope_scaling", None)
     if scaling and scaling.get("rope_type", scaling.get("type")) not in (
             None, "default"):

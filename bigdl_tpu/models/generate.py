@@ -5,7 +5,8 @@ is Recurrent/TimeDistributed, SURVEY §5.7), so this is a TPU-native
 extension: one jitted program containing a **batched prefill** (the
 whole prompt in one causal pass that fills the per-layer KV caches —
 MXU-sized matmuls, not a token loop) followed by a ``lax.scan`` over
-decode steps at static shapes, with the caches (``[B, H, T_max, Dh]``)
+decode steps at static shapes, with the caches (``[B, Hkv, T_max,
+Dh]`` — the KV head count, smaller than the query's under GQA)
 updated in place via ``lax.dynamic_update_slice``.  No Python-level
 loop over tokens, no recompilation per length.
 

@@ -293,7 +293,9 @@ class RMSNorm(TensorModule):
         return self
 
     def _apply(self, params, buffers, x, training, rng):
-        xf = x.astype(jnp.float32)
+        # at-LEAST float32 statistics (bf16 upcasts, f64 oracles keep
+        # their precision) — the HF convention for low-precision inputs
+        xf = x.astype(jnp.promote_types(x.dtype, jnp.float32))
         var = jnp.mean(xf * xf, axis=-1, keepdims=True)
         normed = (xf * lax.rsqrt(var + self.eps)).astype(x.dtype)
         return normed * params["weight"].astype(x.dtype), buffers

@@ -252,6 +252,7 @@ MODULE_CASES = {
     "LSTMPeephole": (lambda: nn.Recurrent().add(nn.LSTMPeephole(6, 4)),
                      lambda: X3, {}),
     "LayerNorm": (lambda: nn.LayerNorm(6), lambda: X, {}),
+    "RMSNorm": (lambda: nn.RMSNorm(6), lambda: X, {}),
     "LeakyReLU": (lambda: nn.LeakyReLU(0.1), lambda: X, {}),
     "Linear": (lambda: nn.Linear(6, 4), lambda: X, {}),
     "Log": (lambda: nn.Log(), lambda: XP, {}),
