@@ -604,6 +604,38 @@ def run_worker(backend: str) -> None:
                 out["decode_config"] = f"B{B} prompt{T0} new{NEW} D{D} L{L}"
             except Exception as e:
                 out["decode_error"] = f"{type(e).__name__}: {e}"[:300]
+            # GQA serving: same shape, llama-style blocks with a
+            # 4x-smaller KV cache (2 of 8 heads) — decode is cache-
+            # bandwidth-bound, so this row measures what grouped-query
+            # attention buys on THIS chip.  Own try/except (a GQA
+            # failure must not masquerade as a dense-decode one).
+            if over_budget(0.93):
+                out["decode_gqa_skipped"] = "worker time budget"
+            else:
+                try:
+                    glm = gen = gp = ids = None  # drop the dense model
+                    glm = TransformerLM(V, embed_dim=D, num_heads=8,
+                                        num_layers=L, max_len=T0 + NEW,
+                                        output="logits", norm="rms",
+                                        mlp="swiglu", num_kv_heads=2,
+                                        rope=True)
+                    gen = make_generate(glm, compute_dtype=jnp.bfloat16)
+                    gp = glm.param_tree()
+                    ids = gen(gp, prompt, NEW)
+                    _ = int(jax.device_get(ids)[0, -1])
+                    t0 = time.time()
+                    for _ in range(reps):
+                        ids = gen(gp, prompt, NEW)
+                    _ = int(jax.device_get(ids)[0, -1])
+                    dt = time.time() - t0
+                    out["decode_gqa_tokens_per_sec"] = round(
+                        B * NEW * reps / dt, 1)
+                    out["decode_gqa_config"] = (
+                        f"B{B} prompt{T0} new{NEW} D{D} L{L} kv2/8 "
+                        "llama-style")
+                except Exception as e:
+                    out["decode_gqa_error"] = \
+                        f"{type(e).__name__}: {e}"[:300]
             # long-prompt serving: prefill-dominated — measures the
             # flash prompt-only prefill (r5: the old path scored every
             # query against the whole cache).  max_new=1 so the number
@@ -613,7 +645,7 @@ def run_worker(backend: str) -> None:
             # 2048-slot caches would otherwise double peak HBM).
             if not over_budget(0.97):
                 try:
-                    del glm, gen, gp, ids
+                    glm = gen = gp = ids = None  # free before rebuild
                     from bigdl_tpu.models.generate import make_generate
                     from bigdl_tpu.models.transformer import TransformerLM
 

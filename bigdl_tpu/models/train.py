@@ -144,7 +144,12 @@ def build(model_name: str, args):
                                                 False)) else None,
             moe_aux_coef=getattr(args, "moe_aux_coef", 0.0),
             moe_top_k=getattr(args, "moe_top_k", 1),
-            dropout=getattr(args, "dropout", 0.0))
+            dropout=getattr(args, "dropout", 0.0),
+            # --llama: the modern decoder dialect (RMSNorm + RoPE +
+            # GQA halved KV heads + SwiGLU, bias-free)
+            **({"norm": "rms", "mlp": "swiglu", "rope": True,
+                "num_kv_heads": 2}
+               if getattr(args, "llama", False) else {}))
         crit = nn.TimeDistributedCriterion(nn.CrossEntropyCriterion(), True)
         # synthetic char-LM with learnable structure: next token is a
         # fixed permutation of the current one, plus noise tokens
@@ -227,6 +232,12 @@ def main(argv=None):
                              "the data axis (expert parallelism, "
                              "all_to_all dispatch) and E must be "
                              "divisible by the data-shard count")
+    parser.add_argument("--llama", action="store_true",
+                        help="llama-style transformer blocks: RMSNorm + "
+                             "rotary positions + grouped-query attention "
+                             "(2 KV heads) + SwiGLU, bias-free "
+                             "(transformer only; not with --seq-parallel "
+                             "— rope needs global positions)")
     parser.add_argument("--moe-top-k", type=int, default=1, metavar="K",
                         help="experts per token: 1 = Switch (raw gate), "
                              "2 = GShard-style (renormalized gates, "
@@ -271,6 +282,15 @@ def main(argv=None):
     if args.pipeline_microbatch and args.pipeline_parallel < 2:
         parser.error("--pipeline-microbatch needs --pipeline-parallel >= 2 "
                      "(it configures the GPipe schedule)")
+    if getattr(args, "llama", False):
+        if args.model != "transformer":
+            parser.error("--llama supports --model transformer")
+        if args.seq_parallel > 1:
+            parser.error("--llama (rope) needs global positions; it "
+                         "does not compose with --seq-parallel")
+        if args.moe_experts:
+            parser.error("--llama (swiglu) does not compose with "
+                         "--moe-experts (gelu expert MLPs)")
     if args.moe_experts and args.model != "transformer":
         parser.error("--moe-experts supports --model transformer")
     if args.moe_experts and (args.tensor_parallel > 1

@@ -276,9 +276,11 @@ class RMSNorm(TensorModule):
     mean subtraction, no bias.
 
     No reference counterpart (the reference predates transformers).
-    Matches the HF Llama convention: the variance is computed in
-    float32, the normalized activations cast back to the input dtype
-    BEFORE the weight multiply."""
+    Matches the HF Llama convention for low-precision inputs: the
+    variance is computed in at-LEAST float32 (bf16/f16 upcast; float64
+    keeps float64 — the gradient-sweep oracles need the precision),
+    and the normalized activations cast back to the input dtype BEFORE
+    the weight multiply."""
 
     def __init__(self, n_output: int, eps: float = 1e-6):
         super().__init__()
