@@ -574,98 +574,74 @@ def run_worker(backend: str) -> None:
         flush("moe_transformerlm")
 
         # KV-cache decode throughput (round-4 generation path): batched
-        # prefill + scan decode, the standard serving metric
+        # prefill + scan decode, the standard serving metric.  One
+        # timing protocol (compile+barrier, reps, value-fetch barrier)
+        # behind three rows: dense decode, GQA decode (llama-style,
+        # 4x-smaller KV cache — decode is cache-bandwidth-bound, so
+        # this row measures what grouped-query attention buys on THIS
+        # chip), and prefill-only long-prompt throughput (the flash
+        # prompt-only prefill; max_new=1).  Each row has its own
+        # try/except + skip marker so one failure neither masquerades
+        # as another nor silently vanishes, and each model drops
+        # before the next builds (two 130M-param models + caches would
+        # double peak HBM).
         if over_budget(0.95):
             out["decode_skipped"] = "worker time budget"
         else:
-            try:
-                from bigdl_tpu.models.generate import make_generate
-                from bigdl_tpu.models.transformer import TransformerLM
-                from bigdl_tpu.utils.rng import set_global_seed
+            from bigdl_tpu.models.generate import make_generate
+            from bigdl_tpu.models.transformer import TransformerLM
+            from bigdl_tpu.utils.rng import set_global_seed
 
-                set_global_seed(42)
-                V, D, L, B, T0, NEW = 32000, 1024, 8, 8, 128, 128
+            set_global_seed(42)
+            V, D, L, B, T0, NEW = 32000, 1024, 8, 8, 128, 128
+            DEC_REPS = 3
+
+            def timed_decode(prompt_len, max_new, **lm_kw):
+                """tokens/sec of (prefill + decode) at the shared
+                timing protocol; tokens = generated for decode rows,
+                prompt for the prefill row (max_new=1)."""
                 glm = TransformerLM(V, embed_dim=D, num_heads=8,
-                                    num_layers=L, max_len=T0 + NEW,
-                                    output="logits")
+                                    num_layers=L,
+                                    max_len=prompt_len + max_new,
+                                    output="logits", **lm_kw)
                 gen = make_generate(glm, compute_dtype=jnp.bfloat16)
                 gp = glm.param_tree()
-                prompt = rng.randint(1, V, (B, T0)).astype("int32")
-                ids = gen(gp, prompt, NEW)
+                prompt = rng.randint(1, V, (B, prompt_len)).astype(
+                    "int32")
+                ids = gen(gp, prompt, max_new)
                 _ = int(jax.device_get(ids)[0, -1])  # compile+barrier
                 t0 = time.time()
-                reps = 3
-                for _ in range(reps):
-                    ids = gen(gp, prompt, NEW)
+                for _ in range(DEC_REPS):
+                    ids = gen(gp, prompt, max_new)
                 _ = int(jax.device_get(ids)[0, -1])
                 dt = time.time() - t0
-                out["decode_tokens_per_sec"] = round(
-                    B * NEW * reps / dt, 1)
+                n_tok = max_new if max_new > 1 else prompt_len
+                return round(B * n_tok * DEC_REPS / dt, 1)
+
+            try:
+                out["decode_tokens_per_sec"] = timed_decode(T0, NEW)
                 out["decode_config"] = f"B{B} prompt{T0} new{NEW} D{D} L{L}"
             except Exception as e:
                 out["decode_error"] = f"{type(e).__name__}: {e}"[:300]
-            # GQA serving: same shape, llama-style blocks with a
-            # 4x-smaller KV cache (2 of 8 heads) — decode is cache-
-            # bandwidth-bound, so this row measures what grouped-query
-            # attention buys on THIS chip.  Own try/except (a GQA
-            # failure must not masquerade as a dense-decode one).
             if over_budget(0.93):
                 out["decode_gqa_skipped"] = "worker time budget"
             else:
                 try:
-                    glm = gen = gp = ids = None  # drop the dense model
-                    glm = TransformerLM(V, embed_dim=D, num_heads=8,
-                                        num_layers=L, max_len=T0 + NEW,
-                                        output="logits", norm="rms",
-                                        mlp="swiglu", num_kv_heads=2,
-                                        rope=True)
-                    gen = make_generate(glm, compute_dtype=jnp.bfloat16)
-                    gp = glm.param_tree()
-                    ids = gen(gp, prompt, NEW)
-                    _ = int(jax.device_get(ids)[0, -1])
-                    t0 = time.time()
-                    for _ in range(reps):
-                        ids = gen(gp, prompt, NEW)
-                    _ = int(jax.device_get(ids)[0, -1])
-                    dt = time.time() - t0
-                    out["decode_gqa_tokens_per_sec"] = round(
-                        B * NEW * reps / dt, 1)
+                    out["decode_gqa_tokens_per_sec"] = timed_decode(
+                        T0, NEW, norm="rms", mlp="swiglu",
+                        num_kv_heads=2, rope=True)
                     out["decode_gqa_config"] = (
                         f"B{B} prompt{T0} new{NEW} D{D} L{L} kv2/8 "
                         "llama-style")
                 except Exception as e:
                     out["decode_gqa_error"] = \
                         f"{type(e).__name__}: {e}"[:300]
-            # long-prompt serving: prefill-dominated — measures the
-            # flash prompt-only prefill (r5: the old path scored every
-            # query against the whole cache).  max_new=1 so the number
-            # is prompt-processing throughput.  Own try (a prefill OOM
-            # must not masquerade as a decode failure) and the decode
-            # model is dropped first (a second 130M-param model +
-            # 2048-slot caches would otherwise double peak HBM).
-            if not over_budget(0.97):
+            if over_budget(0.97):
+                out["prefill_skipped"] = "worker time budget"
+            else:
                 try:
-                    glm = gen = gp = ids = None  # free before rebuild
-                    from bigdl_tpu.models.generate import make_generate
-                    from bigdl_tpu.models.transformer import TransformerLM
-
                     T0L = 1920
-                    glm2 = TransformerLM(V, embed_dim=D, num_heads=8,
-                                         num_layers=L, max_len=2048,
-                                         output="logits")
-                    gen2 = make_generate(glm2,
-                                         compute_dtype=jnp.bfloat16)
-                    gp2 = glm2.param_tree()
-                    prompt2 = rng.randint(1, V, (B, T0L)).astype("int32")
-                    ids2 = gen2(gp2, prompt2, 1)
-                    _ = int(jax.device_get(ids2)[0, -1])
-                    t0 = time.time()
-                    for _ in range(reps):
-                        ids2 = gen2(gp2, prompt2, 1)
-                    _ = int(jax.device_get(ids2)[0, -1])
-                    dt = time.time() - t0
-                    out["prefill_tokens_per_sec"] = round(
-                        B * T0L * reps / dt, 1)
+                    out["prefill_tokens_per_sec"] = timed_decode(T0L, 1)
                     out["prefill_config"] = f"B{B} prompt{T0L} D{D} L{L}"
                 except Exception as e:
                     out["prefill_error"] = f"{type(e).__name__}: {e}"[:300]

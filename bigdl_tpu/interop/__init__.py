@@ -2,5 +2,6 @@
 Face GPT-2 loaders and savers (reference utils/caffe/*, utils/tf/*,
 utils/TorchFile.scala; HF is the modern-family extension)."""
 from .caffe import CaffeLoader, CaffePersister
-from .huggingface import load_gpt2, load_llama, save_gpt2
+from .huggingface import (load_gpt2, load_llama, save_gpt2,
+                          save_llama)
 from .tensorflow import TensorflowLoader, TensorflowSaver

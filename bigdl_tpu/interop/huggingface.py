@@ -281,3 +281,73 @@ def load_llama(hf_model):
     lm.set_param_tree(tree)
     lm.evaluate()
     return lm
+
+
+def save_llama(lm):
+    """Inverse of :func:`load_llama`: build a ``transformers``
+    ``LlamaForCausalLM`` carrying this llama-shaped
+    :class:`TransformerLM`'s weights (untied head,
+    ``tie_word_embeddings=False``).  The model must have been built
+    with the llama dialect (``norm="rms", mlp="swiglu", rope=True``);
+    GPT-shaped models export via :func:`save_gpt2`.  Round-trip and
+    torch-forward equivalence are pinned in tests/test_llama.py."""
+    import torch
+    from transformers import LlamaConfig, LlamaForCausalLM
+
+    from .. import nn
+    from ..models.transformer import TransformerBlock, TransformerLM
+
+    if not isinstance(lm, TransformerLM):
+        raise TypeError(f"expected TransformerLM, got {type(lm).__name__}")
+    blocks = [m for m in lm.modules if isinstance(m, TransformerBlock)]
+    if (not getattr(lm, "use_rope", False)
+            or getattr(blocks[0], "mlp_kind", None) != "swiglu"
+            or not isinstance(blocks[0].modules[0], nn.RMSNorm)):
+        raise ValueError(
+            "save_llama exports llama-dialect models (norm='rms', "
+            "mlp='swiglu', rope=True); GPT-shaped models export via "
+            "save_gpt2")
+    mha = blocks[0].modules[1]
+    if mha.with_bias:
+        raise ValueError("llama checkpoints are attention-bias-free; "
+                         "this model was built with attn_bias=True")
+    if blocks[0].modules[3].with_bias:
+        raise ValueError(
+            "save_llama exports the bias-free SwiGLU config; this "
+            "model was built with mlp_bias=True and its gate/up/down "
+            "biases cannot be represented")
+    tree = lm.param_tree()
+    L = len(blocks)
+    head = tree[str(1 + L + 1)]
+    if "bias" in head and float(
+            np.abs(np.asarray(head["bias"])).max()) > 0:
+        raise ValueError("llama's lm_head is bias-free; zero the head "
+                         "bias before export")
+    cfg = LlamaConfig(
+        vocab_size=lm.vocab_size, hidden_size=lm.embed_dim,
+        intermediate_size=blocks[0].modules[3].params["weight"].shape[0],
+        num_hidden_layers=L, num_attention_heads=mha.num_heads,
+        num_key_value_heads=mha.num_kv_heads,
+        max_position_embeddings=lm.max_len,
+        rms_norm_eps=blocks[0].modules[0].eps,
+        rope_theta=mha.rope_theta, attention_bias=False,
+        tie_word_embeddings=False)
+    hf = LlamaForCausalLM(cfg).eval()
+    t = lambda a: torch.tensor(np.ascontiguousarray(np.asarray(a)))
+    sd = {"model.embed_tokens.weight": t(tree["0"]["weight"])}
+    for i in range(L):
+        blk = tree[str(1 + i)]
+        p = f"model.layers.{i}."
+        sd[p + "input_layernorm.weight"] = t(blk["0"]["weight"])
+        sd[p + "self_attn.q_proj.weight"] = t(blk["1"]["wq"])
+        sd[p + "self_attn.k_proj.weight"] = t(blk["1"]["wk"])
+        sd[p + "self_attn.v_proj.weight"] = t(blk["1"]["wv"])
+        sd[p + "self_attn.o_proj.weight"] = t(blk["1"]["wo"])
+        sd[p + "post_attention_layernorm.weight"] = t(blk["2"]["weight"])
+        sd[p + "mlp.gate_proj.weight"] = t(blk["3"]["weight"])
+        sd[p + "mlp.up_proj.weight"] = t(blk["4"]["weight"])
+        sd[p + "mlp.down_proj.weight"] = t(blk["5"]["weight"])
+    sd["model.norm.weight"] = t(tree[str(1 + L)]["weight"])
+    sd["lm_head.weight"] = t(tree[str(2 + L)]["weight"])
+    hf.load_state_dict(sd)
+    return hf

@@ -148,7 +148,7 @@ def build(model_name: str, args):
             # --llama: the modern decoder dialect (RMSNorm + RoPE +
             # GQA halved KV heads + SwiGLU, bias-free)
             **({"norm": "rms", "mlp": "swiglu", "rope": True,
-                "num_kv_heads": 2}
+                "num_kv_heads": 2, "head_bias": False}
                if getattr(args, "llama", False) else {}))
         crit = nn.TimeDistributedCriterion(nn.CrossEntropyCriterion(), True)
         # synthetic char-LM with learnable structure: next token is a

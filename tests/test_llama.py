@@ -105,6 +105,36 @@ def test_llama_style_decode_teacher_forcing():
     np.testing.assert_array_equal(ids[:, 4:], pred[:, 3:-1])
 
 
+def test_save_llama_roundtrip_and_torch_forward():
+    """Export: a llama-dialect TransformerLM becomes a torch
+    LlamaForCausalLM whose forward matches ours; loading it back
+    reproduces the param tree exactly."""
+    from bigdl_tpu.interop import save_llama
+
+    hf0 = _hf(seed=5)
+    lm = load_llama(hf0)
+    hf2 = save_llama(lm).eval()
+    ids = np.random.RandomState(4).randint(0, V, (2, 9))
+    with torch.no_grad():
+        want = hf0(torch.tensor(ids)).logits.numpy()
+        got = hf2(torch.tensor(ids)).logits.numpy()
+    np.testing.assert_allclose(got, want, atol=1e-5)
+    lm2 = load_llama(hf2)
+    a = jax.tree_util.tree_leaves_with_path(lm.param_tree())
+    b = jax.tree_util.tree_leaves_with_path(lm2.param_tree())
+    assert len(a) == len(b)
+    for (pa, la), (pb, lb) in zip(a, b):
+        assert pa == pb
+        np.testing.assert_array_equal(np.asarray(la), np.asarray(lb))
+
+    # GPT-shaped models are refused with a pointer to save_gpt2
+    RNG().set_seed(3)
+    gpt_shaped = TransformerLM(V, embed_dim=16, num_heads=2,
+                               num_layers=1, max_len=8)
+    with pytest.raises(ValueError, match="save_gpt2"):
+        save_llama(gpt_shaped)
+
+
 def test_llama_style_pipeline_matches_dense_twin():
     """The llama config (no positional table) through the GPipe pipe
     axis: pack/specs/forward must handle the missing 'pos' leaf and the
