@@ -103,36 +103,60 @@ def _minibatches(windows):
     return out
 
 
-def build_model():
-    """Torch-authored GPT-2 (deterministic, cached as a .pt checkpoint)
-    → load_gpt2 → re-hosted into the multi-axis TransformerLM."""
+def build_model(llama: bool = False):
+    """Torch-authored init checkpoint (deterministic, cached) →
+    interop loader → re-hosted into the multi-axis TransformerLM.
+
+    Default: GPT-2 dialect, trained dp×sp×tp (ring attention over
+    'seq' + Megatron split over 'model').  ``llama=True``: the Llama
+    dialect (RMSNorm + RoPE + GQA + SwiGLU) — rope needs global
+    positions, so it trains dp×tp (no seq axis)."""
     import torch
     import transformers
 
-    from ..interop.huggingface import load_gpt2
+    from ..interop.huggingface import load_gpt2, load_llama
     from ..models.transformer import TransformerLM
 
-    ckpt = "/tmp/convergence_gpt2_init.pt"
-    cfg = transformers.GPT2Config(**GPT2_KW)
-    torch.manual_seed(4242)
-    hf = transformers.GPT2LMHeadModel(cfg)
+    if llama:
+        ckpt = "/tmp/convergence_llama_init.pt"
+        cfg = transformers.LlamaConfig(
+            vocab_size=VOCAB, hidden_size=256, intermediate_size=688,
+            num_hidden_layers=4, num_attention_heads=8,
+            num_key_value_heads=2, max_position_embeddings=64,
+            attention_bias=False, tie_word_embeddings=False)
+        torch.manual_seed(4242)
+        hf = transformers.LlamaForCausalLM(cfg)
+    else:
+        ckpt = "/tmp/convergence_gpt2_init.pt"
+        torch.manual_seed(4242)
+        hf = transformers.GPT2LMHeadModel(
+            transformers.GPT2Config(**GPT2_KW))
     if os.path.exists(ckpt):
         hf.load_state_dict(torch.load(ckpt, weights_only=True))
     else:
         torch.save(hf.state_dict(), ckpt)
+    # GPT-2 ties lm_head to the embedding (don't double-count); the
+    # llama config is untied, so its head is a real trained matrix
     n_params = sum(p.numel() for n, p in hf.named_parameters()
-                   if n != "lm_head.weight")
-    lm0 = load_gpt2(hf.eval())
-    # same parameter tree, multi-axis training config (ring attention
-    # over 'seq', Megatron column/row MLP split over 'model')
-    lm = TransformerLM(VOCAB, embed_dim=GPT2_KW["n_embd"],
-                       num_heads=GPT2_KW["n_head"],
-                       mlp_dim=4 * GPT2_KW["n_embd"],
-                       num_layers=GPT2_KW["n_layer"],
-                       max_len=GPT2_KW["n_positions"],
-                       seq_strategy="ring", model_axis="model")
+                   if llama or n != "lm_head.weight")
+    if llama:
+        lm0 = load_llama(hf.eval())
+        lm = TransformerLM(VOCAB, embed_dim=256, num_heads=8,
+                           mlp_dim=688, num_layers=4, max_len=64,
+                           norm="rms", mlp="swiglu", num_kv_heads=2,
+                           rope=True, attn_bias=False, head_bias=False,
+                           model_axis="model")
+    else:
+        lm0 = load_gpt2(hf.eval())
+        lm = TransformerLM(VOCAB, embed_dim=GPT2_KW["n_embd"],
+                           num_heads=GPT2_KW["n_head"],
+                           mlp_dim=4 * GPT2_KW["n_embd"],
+                           num_layers=GPT2_KW["n_layer"],
+                           max_len=GPT2_KW["n_positions"],
+                           seq_strategy="ring", model_axis="model")
     lm.set_param_tree(lm0.param_tree())
-    print(f"model: {n_params / 1e6:.2f}M params (torch-initialized)")
+    print(f"model: {n_params / 1e6:.2f}M params (torch-initialized"
+          f"{', llama dialect' if llama else ''})")
     return lm
 
 
@@ -151,9 +175,39 @@ def main(argv=None):
     ap = argparse.ArgumentParser()
     ap.add_argument("--iters", type=int, default=40,
                     help="iterations to add in this segment")
-    ap.add_argument("--ckpt-dir", default="/tmp/convergence_ckpt")
-    ap.add_argument("--log", default="LONGRUN_CONVERGENCE.jsonl")
+    ap.add_argument("--ckpt-dir", default=None,
+                    help="default: /tmp/convergence_ckpt "
+                         "(or _llama_ckpt with --llama)")
+    ap.add_argument("--log", default=None,
+                    help="default: LONGRUN_CONVERGENCE.jsonl "
+                         "(or _LLAMA with --llama)")
+    ap.add_argument("--llama", action="store_true",
+                    help="llama dialect (RMSNorm+RoPE+GQA+SwiGLU), "
+                         "trained dp x tp instead of dp x sp x tp")
     args = ap.parse_args(argv)
+    # dialect-specific defaults: resuming a GPT-2 orbax tree into a
+    # llama model (different param structure) must be impossible by
+    # default, and the two trajectories must not interleave in one file
+    if args.ckpt_dir is None:
+        args.ckpt_dir = ("/tmp/convergence_llama_ckpt" if args.llama
+                         else "/tmp/convergence_ckpt")
+    if args.log is None:
+        args.log = ("LONGRUN_CONVERGENCE_LLAMA.jsonl" if args.llama
+                    else "LONGRUN_CONVERGENCE.jsonl")
+    # explicit dirs still refuse a dialect mismatch
+    marker = os.path.join(args.ckpt_dir, "dialect.txt")
+    dialect = "llama" if args.llama else "gpt2"
+    if os.path.exists(marker):
+        prev = open(marker).read().strip()
+        if prev != dialect:
+            raise SystemExit(
+                f"checkpoint dir {args.ckpt_dir} holds a {prev!r} "
+                f"run; refusing to resume it as {dialect!r} — the "
+                "param trees are structurally different")
+    else:
+        os.makedirs(args.ckpt_dir, exist_ok=True)
+        with open(marker, "w") as f:
+            f.write(dialect)
 
     import jax
 
@@ -178,10 +232,14 @@ def main(argv=None):
     train_flat, val_flat = build_corpus()
     train_mb = _minibatches(_windows(train_flat, seed=11))
     val_mb = _minibatches(_windows(val_flat))
-    mesh = Mesh(np.array(jax.devices()[:8]).reshape(2, 2, 2),
-                ("data", "seq", "model"))
+    if args.llama:  # rope needs global positions: no seq axis
+        mesh = Mesh(np.array(jax.devices()[:8]).reshape(4, 2),
+                    ("data", "model"))
+    else:
+        mesh = Mesh(np.array(jax.devices()[:8]).reshape(2, 2, 2),
+                    ("data", "seq", "model"))
 
-    model = build_model()
+    model = build_model(llama=args.llama)
     crit = nn.TimeDistributedCriterion(nn.ClassNLLCriterion(), True)
     opt = DistriOptimizer(model, array(train_mb), crit,
                           batch_size=BATCH, mesh=mesh)
@@ -211,7 +269,8 @@ def main(argv=None):
     # attention cannot run eagerly)
     fwd = make_eval_forward(model, mesh)
     res = evaluate_dataset(model, array(val_mb), [Loss(crit)],
-                           batch_size=BATCH, fwd=fwd, n_shard=2)
+                           batch_size=BATCH, fwd=fwd,
+                           n_shard=4 if args.llama else 2)
     val_loss = res[0].result()[0]
     row = {
         "iteration": opt.optim_method.state["neval"] - 1,

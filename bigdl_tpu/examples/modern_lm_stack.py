@@ -33,10 +33,16 @@ def main(argv=None):
                              "E experts instead of the GPT-2 load")
     parser.add_argument("--pipeline", type=int, default=0,
                         help="GPipe stages (mesh data x pipe)")
+    parser.add_argument("--llama", action="store_true",
+                        help="start from a torch Llama checkpoint "
+                             "(RMSNorm+RoPE+GQA+SwiGLU) instead of "
+                             "GPT-2; exports back via save_llama")
     parser.add_argument("--iterations", type=int, default=60)
     args = parser.parse_args(argv)
     if args.moe and args.pipeline:
         parser.error("--moe and --pipeline are separate demos")
+    if args.llama and (args.moe or args.pipeline):
+        parser.error("--llama is the interop demo; run it alone")
     if args.iterations < 20:
         parser.error("--iterations must be >= 20 (the first fit must "
                      "reach the iteration-10 checkpoint the resume step "
@@ -72,6 +78,21 @@ def main(argv=None):
         lm = build_scratch()
         print(f"built TransformerLM from scratch "
               f"({'MoE E=' + str(args.moe) if args.moe else 'dense'})")
+    elif args.llama:
+        import torch
+        from transformers import LlamaConfig, LlamaForCausalLM
+
+        from ..interop import load_llama  # reused by the resume step
+
+        torch.manual_seed(0)
+        hf = LlamaForCausalLM(LlamaConfig(
+            vocab_size=V, hidden_size=32, intermediate_size=48,
+            num_hidden_layers=2, num_attention_heads=4,
+            num_key_value_heads=2, max_position_embeddings=2 * T,
+            attention_bias=False, tie_word_embeddings=False)).eval()
+        lm = load_llama(hf)
+        print("loaded torch Llama weights into TransformerLM "
+              "(RMSNorm+RoPE+GQA+SwiGLU)")
     else:
         import torch
         from transformers import GPT2Config, GPT2LMHeadModel
@@ -137,7 +158,12 @@ def main(argv=None):
     half_loss = opt.optim_method.state["loss"]
 
     # -- 3. "crash" and resume from the async sharded checkpoint -------
-    lm = build_scratch() if (args.moe or args.pipeline) else load_gpt2(hf)
+    if args.moe or args.pipeline:
+        lm = build_scratch()
+    elif args.llama:
+        lm = load_llama(hf)
+    else:
+        lm = load_gpt2(hf)
     opt2 = DistriOptimizer(lm, array(mk(256)), crit, batch_size=32,
                            mesh=mesh)
     opt2.set_optim_method(OptaxMethod(optax.adamw, 1e-2,
@@ -152,10 +178,10 @@ def main(argv=None):
     print(f"final loss {opt2.optim_method.state['loss']:.3f}")
 
     # -- 4. generate, then export back to torch ------------------------
-    if not (args.moe or args.pipeline):
+    if not (args.moe or args.pipeline or args.llama):
         # GPT-2 heads are bias-free: zero ours BEFORE generating so the
         # framework decode and the torch decode of the export run the
-        # SAME parameters
+        # SAME parameters (the llama head is born bias-free)
         tree = lm.param_tree()
         head = tree[str(len(lm.modules) - 1)]
         head["bias"] = head["bias"] * 0
@@ -175,17 +201,19 @@ def main(argv=None):
     if not (args.moe or args.pipeline):
         import torch
 
-        from ..interop import save_gpt2
+        from ..interop import save_gpt2, save_llama
 
-        hf_out = save_gpt2(lm)
-        back = hf_out.generate(torch.tensor(prompt.astype(np.int64) - 1),
-                               max_new_tokens=8, do_sample=False,
-                               pad_token_id=0).numpy() + 1
+        hf_out = (save_llama(lm) if args.llama else save_gpt2(lm))
+        tp = torch.tensor(prompt.astype(np.int64) - 1)
+        back = hf_out.generate(
+            tp, max_new_tokens=8, do_sample=False, pad_token_id=0,
+            attention_mask=torch.ones_like(tp)).numpy() + 1
         print("torch decode of the export:", back[0].tolist())
         assert back[0, 3:].tolist() == greedy[0, 3:].tolist(), \
             "export diverged from the framework decode"
-        print("export verified: torch GPT-2 reproduces the framework "
-              "decode")
+        print(f"export verified: torch "
+              f"{'Llama' if args.llama else 'GPT-2'} reproduces the "
+              "framework decode")
     ckdir_holder.cleanup()  # drop the demo's checkpoint tree
 
 

@@ -16,6 +16,7 @@ TARGET_MIN=${TARGET_MIN:-75}
 SEG_ITERS=${SEG_ITERS:-150}
 CKPT=${CKPT:-/tmp/convergence_ckpt}
 LOG=${LOG:-LONGRUN_CONVERGENCE.jsonl}
+EXTRA_FLAGS=${EXTRA_FLAGS:-}   # e.g. --llama
 FLAG=/tmp/battery3/WINDOW_OPEN
 export JAX_PLATFORMS=cpu
 export XLA_FLAGS=--xla_force_host_platform_device_count=8
@@ -28,7 +29,7 @@ while [ $(( $(date +%s) - start )) -lt $(( TARGET_MIN * 60 )) ]; do
     seg=$((seg + 1))
     python -m bigdl_tpu.examples.convergence_docs_corpus \
         --iters "$SEG_ITERS" --ckpt-dir "$CKPT" --log "$LOG" \
-        > "/tmp/convergence_seg${seg}.log" 2>&1 &
+        $EXTRA_FLAGS > "/tmp/convergence_seg${seg}.log" 2>&1 &
     pid=$!
     if [ $((seg % 2)) -eq 0 ]; then
         # hard-kill mid-training: past compile (~60s), before the end
