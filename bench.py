@@ -596,7 +596,8 @@ def run_worker(backend: str) -> None:
             V, D, L, B, T0, NEW = 32000, 1024, 8, 8, 128, 128
             DEC_REPS = 3
 
-            def timed_decode(prompt_len, max_new, **lm_kw):
+            def timed_decode(prompt_len, max_new, kv_dtype=None,
+                             **lm_kw):
                 """tokens/sec of (prefill + decode) at the shared
                 timing protocol; tokens = generated for decode rows,
                 prompt for the prefill row (max_new=1)."""
@@ -604,7 +605,8 @@ def run_worker(backend: str) -> None:
                                     num_layers=L,
                                     max_len=prompt_len + max_new,
                                     output="logits", **lm_kw)
-                gen = make_generate(glm, compute_dtype=jnp.bfloat16)
+                gen = make_generate(glm, compute_dtype=jnp.bfloat16,
+                                    kv_dtype=kv_dtype)
                 gp = glm.param_tree()
                 prompt = rng.randint(1, V, (B, prompt_len)).astype(
                     "int32")
@@ -635,6 +637,20 @@ def run_worker(backend: str) -> None:
                         "llama-style")
                 except Exception as e:
                     out["decode_gqa_error"] = \
+                        f"{type(e).__name__}: {e}"[:300]
+            if over_budget(0.94):
+                out["decode_int8kv_skipped"] = "worker time budget"
+            else:
+                try:
+                    # decode is cache-bandwidth-bound: the int8 cache
+                    # halves the bytes per step vs the bf16 cache (an
+                    # approximation knob, off by default)
+                    out["decode_int8kv_tokens_per_sec"] = timed_decode(
+                        T0, NEW, kv_dtype="int8")
+                    out["decode_int8kv_config"] = (
+                        f"B{B} prompt{T0} new{NEW} D{D} L{L} int8 cache")
+                except Exception as e:
+                    out["decode_int8kv_error"] = \
                         f"{type(e).__name__}: {e}"[:300]
             if over_budget(0.97):
                 out["prefill_skipped"] = "worker time budget"

@@ -204,6 +204,11 @@ def main(argv=None):
                 f"checkpoint dir {args.ckpt_dir} holds a {prev!r} "
                 f"run; refusing to resume it as {dialect!r} — the "
                 "param trees are structurally different")
+    elif os.path.isdir(args.ckpt_dir) and os.listdir(args.ckpt_dir):
+        raise SystemExit(
+            f"checkpoint dir {args.ckpt_dir} is non-empty but carries "
+            "no dialect marker (pre-marker run?) — refusing to guess; "
+            "point --ckpt-dir elsewhere or remove the old tree")
     else:
         os.makedirs(args.ckpt_dir, exist_ok=True)
         with open(marker, "w") as f:

@@ -154,11 +154,19 @@ def _moe_ffn_nodrop(moe, params, x):
     return y.reshape(B, Tq, D)
 
 
-def _decode_machinery(model, first, count, T_max):
+def _decode_machinery(model, first, count, T_max, kv_int8=False):
     """The cached-attention forward shared by the sampling decoder and
     beam search — built once per generator from the model structure.
     Every function takes the (already cast) param tree ``pc``
-    explicitly."""
+    explicitly.
+
+    ``kv_int8`` stores the caches as int8 with a float32 scale per
+    (batch, head, position) — absmax rounding over the head dim.
+    Decode is cache-bandwidth-bound, so halving (vs bf16) the bytes
+    read per step buys throughput; the prompt's own prefill attention
+    stays full-precision (only post-prefill decode steps read the
+    quantized cache).  Lossy by construction — an approximation knob,
+    off by default."""
     blocks = model.modules[first:first + count]
     ln_f = model.modules[first + count]
     head = model.modules[first + count + 1]
@@ -205,9 +213,49 @@ def _decode_machinery(model, first, count, T_max):
                        v_cache)
         return o.reshape(B, H, Tq, Dh)
 
-    def _block_step(block, bp, h, k_cache, v_cache, pos):
+    def _quant(x):
+        """absmax int8 over the head dim: x ≈ q * s, q int8,
+        s [β..., 1] float32."""
+        s_ = jnp.max(jnp.abs(x.astype(jnp.float32)), axis=-1,
+                     keepdims=True) / 127.0 + 1e-12
+        q_ = jnp.round(x.astype(jnp.float32) / s_).astype(jnp.int8)
+        return q_, s_
+
+    def _cache_init(B, dt):
+        if kv_int8:
+            return (jnp.zeros((B, Hkv, T_max, Dh), jnp.int8),
+                    jnp.zeros((B, Hkv, T_max, 1), jnp.float32),
+                    jnp.zeros((B, Hkv, T_max, Dh), jnp.int8),
+                    jnp.zeros((B, Hkv, T_max, 1), jnp.float32))
+        return (jnp.zeros((B, Hkv, T_max, Dh), dt),
+                jnp.zeros((B, Hkv, T_max, Dh), dt))
+
+    def _cache_write(cache, k, v, pos):
+        if kv_int8:
+            kq, ks, vq, vs = cache
+            qk, sk = _quant(k)
+            qv, sv = _quant(v)
+            return (lax.dynamic_update_slice(kq, qk, (0, 0, pos, 0)),
+                    lax.dynamic_update_slice(ks, sk, (0, 0, pos, 0)),
+                    lax.dynamic_update_slice(vq, qv, (0, 0, pos, 0)),
+                    lax.dynamic_update_slice(vs, sv, (0, 0, pos, 0)))
+        kc, vc = cache
+        return (lax.dynamic_update_slice(kc, k, (0, 0, pos, 0)),
+                lax.dynamic_update_slice(vc, v, (0, 0, pos, 0)))
+
+    def _cache_kv(cache, dt):
+        """(k, v) dense views of the cache — for int8 the convert+
+        scale is elementwise and fuses into the attention dot's
+        operand read (the int8 bytes are what HBM streams)."""
+        if kv_int8:
+            kq, ks, vq, vs = cache
+            return kq.astype(dt) * ks.astype(dt), \
+                vq.astype(dt) * vs.astype(dt)
+        return cache
+
+    def _block_step(block, bp, h, cache, pos):
         """One block on Tq tokens (prefill: Tq=T0 at pos 0; decode:
-        Tq=1) against the caches; returns (h, k_cache, v_cache)."""
+        Tq=1) against the cache pytree; returns (h, cache)."""
         mha = block.modules[1]
         B = h.shape[0]
         ln1, _ = block.modules[0].apply_fn(bp["0"], {}, h, False, None)
@@ -223,9 +271,12 @@ def _decode_machinery(model, first, count, T_max):
             qpos = pos + jnp.arange(q.shape[2])
             q = rope_rotate(q, qpos, rope_theta)
             k = rope_rotate(k, qpos, rope_theta)
-        k_cache = lax.dynamic_update_slice(k_cache, k, (0, 0, pos, 0))
-        v_cache = lax.dynamic_update_slice(v_cache, v, (0, 0, pos, 0))
-        if isinstance(pos, int) and pos == 0 and q.shape[2] > 1:
+        cache = _cache_write(cache, k, v, pos)
+        if isinstance(pos, int) and pos == 0:
+            # the whole prefill (ANY prompt length — a 1-token prompt
+            # rides flash_attention's dense fallback) attends the
+            # full-precision k/v, so the first generated token is
+            # bit-exact even under kv_int8
             # prefill: causal attention over the PROMPT only — cache
             # slots past the prompt are outside the causal horizon
             # anyway, so scoring the whole [T_max] cache (the _attend
@@ -239,7 +290,7 @@ def _decode_machinery(model, first, count, T_max):
 
             o = flash_attention(q, _rep(k), _rep(v), causal=True)
         else:
-            o = _attend(q, k_cache, v_cache, pos)
+            o = _attend(q, *_cache_kv(cache, q.dtype), pos)
         o = o.transpose(0, 2, 1, 3).reshape(B, o.shape[2], H * Dh)
         h = h + _proj(o, ap, "wo", "bo", mha.with_bias)
         ln2, _ = block.modules[2].apply_fn(bp["2"], {}, h, False, None)
@@ -261,7 +312,7 @@ def _decode_machinery(model, first, count, T_max):
                                                jax.nn.gelu(mid), False,
                                                None)
             ffn = out
-        return h + ffn, k_cache, v_cache
+        return h + ffn, cache
 
     def _embed_at(pc, tok, pos, Tq):
         h, _ = embed.apply_fn(pc["0"], {}, tok, False, None)
@@ -276,11 +327,10 @@ def _decode_machinery(model, first, count, T_max):
         h = _embed_at(pc, prompt, 0, T0)
         caches = []
         for bi, block in enumerate(blocks):
-            kc = jnp.zeros((B, Hkv, T_max, Dh), dt)
-            vc = jnp.zeros((B, Hkv, T_max, Dh), dt)
-            h, kc, vc = _block_step(block, pc[str(first + bi)], h, kc,
-                                    vc, 0)
-            caches.append((kc, vc))
+            cache = _cache_init(B, dt)
+            h, cache = _block_step(block, pc[str(first + bi)], h,
+                                   cache, 0)
+            caches.append(cache)
         return h, caches
 
     def decode_token(pc, tok, caches, pos):
@@ -289,9 +339,9 @@ def _decode_machinery(model, first, count, T_max):
         h = _embed_at(pc, tok, pos, 1)
         new_caches = []
         for bi, block in enumerate(blocks):
-            h, kc, vc = _block_step(block, pc[str(first + bi)], h,
-                                    caches[bi][0], caches[bi][1], pos)
-            new_caches.append((kc, vc))
+            h, cache = _block_step(block, pc[str(first + bi)], h,
+                                   caches[bi], pos)
+            new_caches.append(cache)
         return h, new_caches
 
     def logits_last(pc, h):
@@ -305,8 +355,14 @@ def _decode_machinery(model, first, count, T_max):
     return prefill, decode_token, logits_last
 
 
+def _kv_int8(kv_dtype):
+    if kv_dtype in (None, "int8"):
+        return kv_dtype == "int8"
+    raise ValueError(f"kv_dtype {kv_dtype!r} not in (None, 'int8')")
+
+
 def make_generate(model, max_len: Optional[int] = None,
-                  compute_dtype=None):
+                  compute_dtype=None, kv_dtype: Optional[str] = None):
     """Build ``generate(params, prompt_ids, max_new, rng=None,
     temperature=0.0, top_k=0, top_p=1.0) -> [B, prompt+max_new] ids``.
 
@@ -321,7 +377,7 @@ def make_generate(model, max_len: Optional[int] = None,
     first, count = _check_model(model)
     T_max = _check_len(model, max_len)
     prefill, decode_token, logits_last = _decode_machinery(
-        model, first, count, T_max)
+        model, first, count, T_max, kv_int8=_kv_int8(kv_dtype))
 
     def _sample(logits, temperature, top_k, top_p, key):
         greedy = jnp.argmax(logits, axis=-1)
@@ -408,7 +464,7 @@ def make_generate(model, max_len: Optional[int] = None,
 
 
 def make_beam_search(model, max_len: Optional[int] = None,
-                     compute_dtype=None):
+                     compute_dtype=None, kv_dtype: Optional[str] = None):
     """Build ``beam_search(params, prompt_ids, max_new, num_beams=4,
     eos_id=None, pad_id=None) -> (ids [B, prompt+max_new], scores [B])``.
 
@@ -434,7 +490,7 @@ def make_beam_search(model, max_len: Optional[int] = None,
     first, count = _check_model(model)
     T_max = _check_len(model, max_len)
     prefill, decode_token, logits_last = _decode_machinery(
-        model, first, count, T_max)
+        model, first, count, T_max, kv_int8=_kv_int8(kv_dtype))
 
     @partial(jax.jit, static_argnums=(2, 3))
     def _run(p, prompt, max_new, kk, eos, pad):
@@ -465,9 +521,11 @@ def make_beam_search(model, max_len: Optional[int] = None,
         ids = jnp.zeros((B, kk, T0 + max_new), prompt.dtype)
         ids = ids.at[:, :, :T0].set(prompt[:, None, :])
         ids = ids.at[:, :, T0].set((first_tok + 1).astype(ids.dtype))
-        # caches replicate per beam: [B, H, Tm, Dh] -> [B*kk, ...]
-        caches = [(jnp.repeat(kc, kk, axis=0), jnp.repeat(vc, kk, axis=0))
-                  for kc, vc in caches]
+        # caches replicate per beam: [B, ...] -> [B*kk, ...]
+        # (tree_map: the per-layer cache is an arbitrary pytree — the
+        # int8 variant carries quantized values + scales)
+        caches = jax.tree_util.tree_map(
+            lambda a: jnp.repeat(a, kk, axis=0), caches)
         # a finished beam's one legal continuation: pad at zero cost
         pad_row = jnp.where(jnp.arange(V) == pad - 1, 0.0, -jnp.inf)
 
@@ -495,8 +553,8 @@ def make_beam_search(model, max_len: Optional[int] = None,
               tok_next.astype(ids.dtype).reshape(B * kk, 1)).reshape(
                   B, kk, -1)
             gather = (parent + jnp.arange(B)[:, None] * kk).reshape(-1)
-            new_caches = [(kc[gather], vc[gather])
-                          for kc, vc in new_caches]
+            new_caches = jax.tree_util.tree_map(
+                lambda a: a[gather], new_caches)
             return (new_caches, ids, scores, done), None
 
         if max_new > 1:
@@ -583,11 +641,12 @@ def capacity_bind_report(model, params, ids):
     return report
 
 
-def cached_generate(model, compute_dtype=None):
+def cached_generate(model, compute_dtype=None, kv_dtype=None):
     """The per-model compiled generator (built once per
-    (max_len, compute_dtype) config, weakly cached)."""
-    cfg = (model.max_len, compute_dtype)
+    (max_len, compute_dtype, kv_dtype) config, weakly cached)."""
+    cfg = (model.max_len, compute_dtype, kv_dtype)
     slot = _GEN_CACHE.setdefault(model, {})
     if cfg not in slot:
-        slot[cfg] = make_generate(model, compute_dtype=compute_dtype)
+        slot[cfg] = make_generate(model, compute_dtype=compute_dtype,
+                                  kv_dtype=kv_dtype)
     return slot[cfg]

@@ -273,6 +273,32 @@ def test_ring_trained_model_decodes_like_dense_twin(strategy):
     _teacher_force_check(dense, got, prompt_len=5)
 
 
+def test_int8_kv_cache_decode():
+    """kv_dtype='int8': the prompt's prefill attention is full-precision
+    so the FIRST generated token is bit-exact vs the dense cache; later
+    tokens attend the quantized cache (absmax int8 per head/position —
+    the per-element error is bounded by scale/2) and must stay valid
+    ids.  On this seeded tiny model the greedy paths agree exactly."""
+    model = _model()
+    p = model.param_tree()
+    prompt = np.random.RandomState(21).randint(
+        1, VOCAB + 1, (2, 5)).astype(np.int32)
+    full = np.asarray(make_generate(model)(p, prompt, 7))
+    q8 = np.asarray(make_generate(model, kv_dtype="int8")(p, prompt, 7))
+    np.testing.assert_array_equal(q8[:, :6], full[:, :6])  # exact
+    assert q8.min() >= 1 and q8.max() <= VOCAB
+    np.testing.assert_array_equal(q8, full)  # deterministic seed: equal
+
+    # quantization error bound: dequant(quant(x)) within scale/2
+    x = np.random.RandomState(1).randn(2, 2, 8, 16).astype(np.float32)
+    s = np.abs(x).max(-1, keepdims=True) / 127.0
+    q = np.round(x / (s + 1e-12)).astype(np.int8)
+    np.testing.assert_allclose(q * s, x, atol=(s / 2 + 1e-6).max())
+
+    with pytest.raises(ValueError, match="kv_dtype"):
+        make_generate(model, kv_dtype="int4")
+
+
 def test_eos_stops_row_and_pads():
     """After a row's first eos the decode keeps emitting pad_id (static
     shapes — hf.generate's convention); rows that never hit eos are

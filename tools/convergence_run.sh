@@ -14,8 +14,8 @@ set -u
 cd /root/repo
 TARGET_MIN=${TARGET_MIN:-75}
 SEG_ITERS=${SEG_ITERS:-150}
-CKPT=${CKPT:-/tmp/convergence_ckpt}
-LOG=${LOG:-LONGRUN_CONVERGENCE.jsonl}
+CKPT=${CKPT:-}   # empty: the example picks dialect-specific defaults
+LOG=${LOG:-}
 EXTRA_FLAGS=${EXTRA_FLAGS:-}   # e.g. --llama
 FLAG=/tmp/battery3/WINDOW_OPEN
 export JAX_PLATFORMS=cpu
@@ -28,7 +28,8 @@ while [ $(( $(date +%s) - start )) -lt $(( TARGET_MIN * 60 )) ]; do
     while [ -e "$FLAG" ]; do sleep 30; done   # yield to the TPU window
     seg=$((seg + 1))
     python -m bigdl_tpu.examples.convergence_docs_corpus \
-        --iters "$SEG_ITERS" --ckpt-dir "$CKPT" --log "$LOG" \
+        --iters "$SEG_ITERS" \
+        ${CKPT:+--ckpt-dir "$CKPT"} ${LOG:+--log "$LOG"} \
         $EXTRA_FLAGS > "/tmp/convergence_seg${seg}.log" 2>&1 &
     pid=$!
     if [ $((seg % 2)) -eq 0 ]; then
