@@ -1,15 +1,17 @@
-"""Modern-LM stack walkthrough: the round-4 extension features in one
+"""Modern-LM stack walkthrough: the modern-LM surface in one
 end-to-end journey.
 
-1. build a (tiny) GPT-2 in torch ``transformers`` and LOAD its weights
-   into :class:`TransformerLM` (interop/huggingface.py);
+1. build a (tiny) GPT-2 — or, with ``--llama``, a Llama
+   (RMSNorm+RoPE+GQA+SwiGLU) — in torch ``transformers`` and LOAD its
+   weights into :class:`TransformerLM` (interop/huggingface.py);
 2. fine-tune it with the full DistriOptimizer lifecycle on an 8-device
    mesh — optionally GPipe-pipelined (``--pipeline 2``) or Switch-MoE
    from scratch (``--moe 8``, divisible by the shard count) — with
    optax AdamW and ASYNC orbax sharded checkpoints;
 3. resume from the newest checkpoint like a crashed run would;
 4. GENERATE from the fine-tuned model (KV-cache decode, greedy and
-   nucleus sampling) and EXPORT the result back to a torch GPT-2.
+   nucleus sampling) and EXPORT the result back to torch
+   (``save_gpt2`` / ``save_llama``), verifying torch's decode matches.
 
 Everything runs hermetically on the 8-virtual-device CPU mesh
 (``XLA_FLAGS=--xla_force_host_platform_device_count=8``) or on real
