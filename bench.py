@@ -658,7 +658,10 @@ def run_worker(backend: str) -> None:
                 try:
                     T0L = 1920
                     out["prefill_tokens_per_sec"] = timed_decode(T0L, 1)
-                    out["prefill_config"] = f"B{B} prompt{T0L} D{D} L{L}"
+                    # max_new=1: the timed region is prefill PLUS one
+                    # decode step — noted so the row reads honestly
+                    out["prefill_config"] = (f"B{B} prompt{T0L} D{D} L{L} "
+                                             "(+1 decode step)")
                 except Exception as e:
                     out["prefill_error"] = f"{type(e).__name__}: {e}"[:300]
         flush("decode")

@@ -27,6 +27,28 @@ _DTYPES = {0: np.uint8, 1: np.float32, 2: np.float64, 3: np.int32,
 _DTYPE_CODES = {np.dtype(v): k for k, v in _DTYPES.items()}
 
 
+class CorruptRecordError(IOError):
+    """A record failed CRC verification or its framing is torn.  NOT
+    retryable: the bytes on disk are wrong and will stay wrong —
+    re-reading only burns the retry budget (transient I/O errors, by
+    contrast, surface as plain OSError and are retried)."""
+
+
+def _ingest_retry_policy():
+    """Transient-I/O retry for shard reads (the resilience subsystem's
+    RetryPolicy reused at the ingest layer): flaky NFS/FUSE/object-store
+    reads get ``bigdl.ingest.retryTimes`` backoff-spaced attempts;
+    corrupt records fail immediately."""
+    from ..resilience.retry import RetryPolicy
+    from ..utils.engine import get_property
+
+    return RetryPolicy(
+        max_retries=int(get_property("bigdl.ingest.retryTimes", 3)),
+        backoff_base=float(get_property("bigdl.ingest.backoffBase", 0.05)),
+        backoff_max=float(get_property("bigdl.ingest.backoffMax", 2.0)),
+        fatal_types=(CorruptRecordError,))
+
+
 # ----------------------------------------------------------------- records
 def _encode_sample(sample: Sample) -> bytes:
     """feature dtype|ndim|dims|raw + label dtype|ndim|dims|raw."""
@@ -97,7 +119,9 @@ def read_records(path: str, verify: bool = True,
     indefinitely must copy (the batcher's ``np.stack`` is the designed
     copy point)."""
     from .. import native
+    from ..resilience import faults as _faults
 
+    _faults.check_io_fault(path)  # deterministic test-injection hook
     if zero_copy and os.path.getsize(path) > 0:
         import mmap as _mmap
 
@@ -110,7 +134,7 @@ def read_records(path: str, verify: bool = True,
     try:
         spans = native.parse_records(buf, verify=verify)
     except IOError as e:
-        raise IOError(f"corrupt record in {path}: {e}")
+        raise CorruptRecordError(f"corrupt record in {path}: {e}")
     if spans is not None:
         for off, length in spans:
             yield buf[off:off + length]
@@ -126,12 +150,13 @@ def read_records(path: str, verify: bool = True,
         if pos + 16 + length > len(buf):
             # truncated/corrupt length field — same contract as the
             # native btpu_parse_records path
-            raise IOError(f"corrupt record in {path}: truncated at {pos}")
+            raise CorruptRecordError(
+                f"corrupt record in {path}: truncated at {pos}")
         data = buf[pos + 12:pos + 12 + length]
         (dcrc,) = struct.unpack_from("<I", buf, pos + 12 + length)
         if verify and (masked_crc32c(buf[pos:pos + 8]) != hcrc
                        or masked_crc32c(data) != dcrc):
-            raise IOError(f"corrupt record in {path}")
+            raise CorruptRecordError(f"corrupt record in {path}")
         yield data
         pos += 16 + length
 
@@ -180,13 +205,20 @@ class SeqFileFolder(AbstractDataSet):
         # re-hashing 100+ GB every epoch would starve the chip
         self._verified: set = set()
 
+    def _read_shard(self, path: str) -> list:
+        """One shard's records, with transient-I/O retry (exponential
+        backoff via resilience.retry); corrupt records raise through
+        immediately — re-reading bad bytes cannot help."""
+        recs = _ingest_retry_policy().run(lambda: list(read_records(
+            path, verify=path not in self._verified, zero_copy=True)))
+        self._verified.add(path)
+        return recs
+
     def size(self) -> int:
         if self._size is None:
             total = 0
             for p in self.paths:
-                total += sum(1 for _ in read_records(
-                    p, verify=p not in self._verified, zero_copy=True))
-                self._verified.add(p)  # counting verified it already
+                total += len(self._read_shard(p))
             self._size = total
         return self._size
 
@@ -225,11 +257,7 @@ class SeqFileFolder(AbstractDataSet):
                         self.shuffle()
                     order = list(self._order)  # snapshot per pass
                     for shard in order:
-                        path = self.paths[shard]
-                        recs = list(read_records(
-                            path, verify=path not in self._verified,
-                            zero_copy=True))
-                        self._verified.add(path)
+                        recs = self._read_shard(self.paths[shard])
                         if not put_or_stop(recs):
                             return
                     if not train:

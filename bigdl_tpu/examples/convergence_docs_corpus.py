@@ -51,8 +51,10 @@ def _corpus_texts():
         os.path.abspath(__file__))))
     paths = sorted(glob.glob(os.path.join(repo, "*.md"))) + \
         sorted(glob.glob(os.path.join(repo, "docs", "*.md")))
-    site = sorted(glob.glob(
-        "/opt/venv/lib/python3.12/site-packages/**/*.md", recursive=True))
+    import sysconfig
+
+    site = sorted(glob.glob(os.path.join(
+        sysconfig.get_paths()["purelib"], "**", "*.md"), recursive=True))
     for p in paths + site[:400]:
         try:
             with open(p, errors="ignore") as f:

@@ -275,8 +275,18 @@ def load_llama(hf_model):
         }
         tree[str(1 + i)] = blk
     tree[str(1 + L)] = {"weight": jnp.asarray(sd["norm.weight"])}
-    head_w = (hf_model.lm_head.weight.detach().cpu().float().numpy()
-              if hasattr(hf_model, "lm_head") else sd["embed_tokens.weight"])
+    if hasattr(hf_model, "lm_head"):
+        head_w = hf_model.lm_head.weight.detach().cpu().float().numpy()
+    elif getattr(cfg, "tie_word_embeddings", True):
+        head_w = sd["embed_tokens.weight"]
+    else:
+        # a bare LlamaModel carries no lm_head; with untied embeddings
+        # there is no correct head weight to synthesize
+        raise ValueError(
+            "this checkpoint sets tie_word_embeddings=False but the "
+            "model has no lm_head (bare LlamaModel); load the "
+            "LlamaForCausalLM wrapper so the untied head weights are "
+            "available")
     tree[str(2 + L)] = {"weight": jnp.asarray(head_w)}
     lm.set_param_tree(tree)
     lm.evaluate()

@@ -22,8 +22,11 @@ def rope_rotate(x, pos, theta: float = 10000.0):
     """Rotary position embedding (HF Llama's rotate-half convention)
     over ``x`` [B, H, T, D] at absolute positions ``pos`` [T]."""
     D = x.shape[-1]
-    inv = 1.0 / (theta ** (jnp.arange(0, D, 2, dtype=jnp.float32) / D))
-    ang = pos.astype(jnp.float32)[:, None] * inv[None, :]   # [T, D/2]
+    # like RMSNorm: float64 oracles keep their precision, low-precision
+    # inputs still get at least float32 tables
+    ct = jnp.promote_types(x.dtype, jnp.float32)
+    inv = 1.0 / (theta ** (jnp.arange(0, D, 2, dtype=ct) / D))
+    ang = pos.astype(ct)[:, None] * inv[None, :]            # [T, D/2]
     cos = jnp.concatenate([jnp.cos(ang), jnp.cos(ang)], -1)  # [T, D]
     sin = jnp.concatenate([jnp.sin(ang), jnp.sin(ang)], -1)
     x1, x2 = x[..., :D // 2], x[..., D // 2:]

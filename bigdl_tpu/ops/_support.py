@@ -7,6 +7,10 @@ try:
     from jax.experimental import pallas as pl  # noqa: F401
     from jax.experimental.pallas import tpu as pltpu  # noqa: F401
 
+    if not hasattr(pltpu, "CompilerParams"):
+        # jax 0.4.x names it TPUCompilerParams (same kwargs); alias the
+        # modern name the kernels use
+        pltpu.CompilerParams = pltpu.TPUCompilerParams
     HAS_PALLAS = True
 except Exception:  # pragma: no cover
     pl = None

@@ -34,6 +34,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from ..nn.module import AbstractModule
 from ..parallel.all_reduce import AllReduceParameter, shard_batch
+from ..resilience.guards import tree_finite, where_tree
 from ..utils.engine import Engine, get_property
 from ..utils.rng import next_jax_key
 from ..utils.table import T
@@ -44,10 +45,7 @@ from .regularizer import collect_regularizer_paths, regularizer_loss
 
 log = logging.getLogger("bigdl_tpu")
 
-try:  # jax>=0.8: public API
-    from jax import shard_map  # type: ignore
-except ImportError:  # pragma: no cover
-    from jax.experimental.shard_map import shard_map  # type: ignore
+from ..utils.jax_compat import shard_map
 
 
 class DistriOptimizer(Optimizer):
@@ -61,9 +59,12 @@ class DistriOptimizer(Optimizer):
         # how the last profiled iteration's phase split was measured:
         # "trace" (jax.profiler device events) or "probe" (fallback)
         self.phase_source = None
-        # retry policy (reference DistriOptimizer.scala:750-752)
-        self.max_retry = int(get_property("bigdl.failure.retryTimes", 5))
-        self.retry_window = float(get_property("bigdl.failure.retryTimeInterval", 120))
+        # retry policy compat aliases (reference
+        # DistriOptimizer.scala:750-752); the actual loop lives in
+        # resilience.retry.RetryPolicy (exponential backoff + jitter +
+        # fatal/retryable classification), built in Optimizer.__init__
+        self.max_retry = self.retry_policy.max_retries
+        self.retry_window = self.retry_policy.window
 
     # ------------------------------------------------------------------
     def _build_step(self, mesh, arp: AllReduceParameter, masked=False):
@@ -87,6 +88,7 @@ class DistriOptimizer(Optimizer):
         axis = "data"
         n_dev = arp.partition_num
         cdtype = self.compute_dtype
+        guard = self.gradient_guard
         # f32-accumulating criterions (fused xent) take bf16 output as-is
         upcast_out = not getattr(criterion, "accepts_low_precision", False)
 
@@ -144,6 +146,18 @@ class DistriOptimizer(Optimizer):
                 g_slice = g_slice / n_dev
             w_slice = arp.my_weight_slice(params)
             new_w_slice, new_slots = optim.step(g_slice, w_slice, slots, lr)
+            if guard:
+                # anomaly guard: a NaN/Inf reduced-gradient slice (or
+                # loss) on ANY shard skips the whole update — pmin makes
+                # every shard agree, so the selected slices stay
+                # consistent through the all-gather below
+                ok_local = jnp.logical_and(tree_finite(g_slice),
+                                           jnp.isfinite(loss))
+                ok = jax.lax.pmin(ok_local.astype(jnp.int32), axis) > 0
+                new_w_slice = where_tree(ok, new_w_slice, w_slice)
+                new_slots = where_tree(ok, new_slots, slots)
+            else:
+                ok = jnp.bool_(True)
             new_params = arp.all_gather_weights(new_w_slice)
             if masked:
                 # padded rows would pollute batch statistics (BatchNorm
@@ -154,14 +168,16 @@ class DistriOptimizer(Optimizer):
                 # BN running stats etc.: average across shards (sync-BN)
                 new_buffers = jax.tree_util.tree_map(
                     lambda b: jax.lax.pmean(b, axis), new_buffers)
+            if guard:
+                new_buffers = where_tree(ok, new_buffers, buffers)
             loss = (jax.lax.psum(loss, axis) if masked
                     else jax.lax.pmean(loss, axis))
-            return loss, new_params, new_buffers, new_slots
+            return loss, new_params, new_buffers, new_slots, ok
 
         in_specs = (P(), P(), P(axis), P(), P(), P(axis), P(axis))
         if masked:
             in_specs = in_specs + (P(axis), P())
-        out_specs = (P(), P(), P(), P(axis))
+        out_specs = (P(), P(), P(), P(axis), P())
         # check_vma=False: params come back through all_gather of an
         # axis_index-derived slice, which the static replication checker
         # can't prove replicated (it is — every shard gathers all slices).
@@ -213,7 +229,8 @@ class DistriOptimizer(Optimizer):
     # ------------------------------------------------------------------
     def optimize(self) -> AbstractModule:
         try:
-            return self._optimize_routed()
+            with self._preemption_scope():
+                return self._optimize_routed()
         finally:
             # an in-flight async orbax save must commit even when the
             # loop exits abnormally (Ctrl-C, exhausted retries)
@@ -260,9 +277,6 @@ class DistriOptimizer(Optimizer):
 
         return self._with_retry(lambda: self._optimize_once(mesh, n_dev))
 
-    def _restore_latest(self):
-        self.resume_from_checkpoint()
-
     # ------------------------------------------------------------------
     # multi-axis (data x seq x model) SPMD path
     # ------------------------------------------------------------------
@@ -282,25 +296,15 @@ class DistriOptimizer(Optimizer):
         return self._with_retry(lambda: self._optimize_multi_axis_once(mesh))
 
     def _with_retry(self, fn):
-        """Driver retry-from-checkpoint loop shared by both mesh paths
-        (reference DistriOptimizer.scala:750-816)."""
-        attempts = 0
-        window_start = time.time()
-        while True:
-            try:
-                return fn()
-            except KeyboardInterrupt:
-                raise
-            except Exception as e:
-                if time.time() - window_start > self.retry_window:
-                    attempts = 0
-                    window_start = time.time()
-                attempts += 1
-                if attempts > self.max_retry or self.checkpoint_path is None:
-                    raise
-                log.warning("Error during training: %s — retry %d/%d from "
-                            "latest checkpoint", e, attempts, self.max_retry)
-                self._restore_latest()
+        """Driver retry-from-checkpoint loop shared by every mesh path
+        (reference DistriOptimizer.scala:750-816), now routed through
+        resilience.retry.RetryPolicy: exponential backoff + jitter
+        between attempts, fatal errors never retried.  A caller-mutated
+        ``max_retry``/``retry_window`` (the compat aliases) wins over
+        the policy's property-derived values."""
+        self.retry_policy.max_retries = int(self.max_retry)
+        self.retry_policy.window = float(self.retry_window)
+        return super()._with_retry(fn)
 
     def _optimize_multi_axis_once(self, mesh) -> AbstractModule:
         from jax.sharding import NamedSharding
@@ -379,6 +383,7 @@ class DistriOptimizer(Optimizer):
                                                 **mask_kw)
             loss = float(loss)  # value fetch = execution barrier
             train_time = time.time() - t0
+            self._check_loss_anomaly(loss, skipped=False)
 
             records_this_epoch += n_records
             state["loss"] = loss
@@ -430,7 +435,7 @@ class DistriOptimizer(Optimizer):
                         output_seq_dim=self.validation_output_seq_dim)
                 self._validate_multi_axis(state, eval_fwd, params, buffers,
                                           n_data, n_seq)
-            if do_checkpoint:
+            if do_checkpoint or self._preempted():
                 if self.checkpoint_format == "orbax":
                     # sharded async save straight from the device trees
                     self._orbax_save(state, self._orbax_tree(
@@ -442,6 +447,11 @@ class DistriOptimizer(Optimizer):
                     model.set_buffer_tree(jax.device_get(buffers))
                     optim._slots = jax.device_get(slots)
                     self._checkpoint(state)
+            if self._preempted():
+                log.warning("preemption requested — checkpointed at "
+                            "iteration %d; exiting resumable",
+                            state["neval"] - 1)
+                break
 
         model.set_param_tree(jax.device_get(params))
         model.set_buffer_tree(jax.device_get(buffers))
@@ -545,6 +555,7 @@ class DistriOptimizer(Optimizer):
                                        rng=next_jax_key(), **mask_kw)
             loss = float(loss)  # value fetch = execution barrier
             train_time = time.time() - t0
+            self._check_loss_anomaly(loss, skipped=False)
 
             records_this_epoch += n_records
             state["loss"] = loss
@@ -597,7 +608,7 @@ class DistriOptimizer(Optimizer):
                     fwd=eval_fwd, n_shard=n_data * n_mb)
                 model.training()
                 self._report_validation(state, results)
-            if do_checkpoint:
+            if do_checkpoint or self._preempted():
                 if self.checkpoint_format == "orbax":
                     # sharded async save straight from the device trees
                     # — no host gather, no unpack
@@ -606,6 +617,11 @@ class DistriOptimizer(Optimizer):
                 else:
                     _sync_to_model()
                     self._checkpoint(state)
+            if self._preempted():
+                log.warning("preemption requested — checkpointed at "
+                            "iteration %d; exiting resumable",
+                            state["neval"] - 1)
+                break
 
         _sync_to_model()
         model.evaluate()
@@ -792,7 +808,9 @@ class DistriOptimizer(Optimizer):
                 prefetch()
                 loss = float(out[0])  # device sync after prefetch overlap
                 train_time = time.time() - t0
-            _, params, buffers, slots = out
+            _, params, buffers, slots, step_ok = out
+            skipped = not bool(step_ok)
+            self._check_loss_anomaly(loss, skipped)
 
             if profiled and trace_split is None:
                 # fallback: collective-free fwd+bwd probe pins the pure
@@ -848,6 +866,10 @@ class DistriOptimizer(Optimizer):
                     "Throughput",
                     n_records / max(train_time + infeed_time, 1e-9),
                     state["neval"])
+                if self.gradient_guard:
+                    self.train_summary.add_scalar(
+                        "SkippedSteps", float(self.skipped_steps),
+                        state["neval"])
 
             state["neval"] += 1
             optim.state = state
@@ -865,8 +887,9 @@ class DistriOptimizer(Optimizer):
             if self.validation_trigger is not None and \
                     self.validation_trigger(state):
                 self._validate_on_mesh(state, mesh, params, buffers)
-            if self.checkpoint_trigger is not None and \
-                    self.checkpoint_trigger(state):
+            do_checkpoint = (self.checkpoint_trigger is not None
+                             and self.checkpoint_trigger(state))
+            if do_checkpoint or self._preempted():
                 if self.checkpoint_format == "orbax":
                     self._orbax_save(state, self._orbax_tree(
                         params, slots, buffers), kind="model")
@@ -875,6 +898,11 @@ class DistriOptimizer(Optimizer):
                     model.set_buffer_tree(buffers)
                     optim._slots = slots
                     self._checkpoint(state)
+            if self._preempted():
+                log.warning("preemption requested — checkpointed at "
+                            "iteration %d; exiting resumable",
+                            state["neval"] - 1)
+                break
 
         model.set_param_tree(params)
         model.set_buffer_tree(buffers)
@@ -894,17 +922,8 @@ class DistriOptimizer(Optimizer):
             self.model.training()
 
     def _checkpoint(self, state):
-        from ..utils import file_io
-
-        if self.checkpoint_path is None:
-            return
-        n = state["neval"] - 1
-        suffix = "" if self.is_overwrite else f".{n}"
-        self.model.save(file_io.join(self.checkpoint_path, f"model{suffix}"),
-                        overwrite=True)
-        self.optim_method.save(
-            file_io.join(self.checkpoint_path, f"optimMethod{suffix}"),
-            overwrite=True)
+        # atomic + crc32c-checksummed (resilience.checkpoint contract)
+        self._write_pickle_checkpoint(state)
 
 
 def _maskable(y, n_records: int) -> bool:

@@ -22,10 +22,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 from ..dataset.sample import MiniBatch, SampleToMiniBatch
 from .validation import ValidationMethod, ValidationResult
 
-try:  # jax>=0.8: public API
-    from jax import shard_map  # type: ignore
-except ImportError:  # pragma: no cover
-    from jax.experimental.shard_map import shard_map  # type: ignore
+from ..utils.jax_compat import shard_map
 
 from ._sharding_utils import data_mesh as _data_mesh, pad_batch, round_up
 

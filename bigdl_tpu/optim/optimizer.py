@@ -22,6 +22,10 @@ import numpy as np
 from ..dataset.dataset import AbstractDataSet
 from ..dataset.sample import MiniBatch, SampleToMiniBatch
 from ..nn.module import AbstractModule, to_array
+from ..resilience.guards import LossSpikeDetector, tree_finite, where_tree
+from ..resilience.preemption import PreemptionHandler
+from ..resilience.retry import LossSpikeError, RetryPolicy
+from ..utils.engine import get_property
 from ..utils.rng import next_jax_key
 from ..utils.table import T, Table
 from .metrics import Metrics
@@ -78,6 +82,33 @@ class Optimizer:
         # GPipe microbatch count for meshes with a 'pipe' axis (None:
         # the driver defaults to the pipe-axis size)
         self.pipeline_microbatch = None
+        # --- resilience (bigdl_tpu/resilience/) -----------------------
+        # gradient anomaly guard: NaN/Inf steps are skipped in-program
+        # (params/slots/buffers ride through intact) and counted
+        self.gradient_guard = str(get_property(
+            "bigdl.guard.gradients", "true")).lower() in ("1", "true",
+                                                          "yes", "on")
+        # loss-spike rollback: off unless configured (it needs a
+        # checkpoint to roll back to)
+        self.spike_detector: Optional[LossSpikeDetector] = None
+        _spike_k = get_property("bigdl.guard.spikeK")
+        if _spike_k:
+            self.spike_detector = LossSpikeDetector(
+                k=int(_spike_k),
+                ratio=float(get_property("bigdl.guard.spikeRatio", 2.0)),
+                warmup=int(get_property("bigdl.guard.spikeWarmup", 10)))
+        # retry: exponential backoff + classification (compat aliases
+        # bigdl.failure.retryTimes / retryTimeInterval honored inside)
+        self.retry_policy = RetryPolicy.from_properties()
+        # SIGTERM/SIGINT → checkpoint at the next step boundary + clean
+        # resumable exit (off by default: installing signal handlers is
+        # an application decision)
+        self.handle_preemption = str(get_property(
+            "bigdl.preemption.handleSignals", "false")).lower() in (
+            "1", "true", "yes", "on")
+        self._preemption: Optional[PreemptionHandler] = None
+        self.skipped_steps = 0   # anomalous steps skipped by the guard
+        self.rollbacks = 0       # checkpoint restores done by retry
 
     # -- fluent config (Optimizer.scala:98-243) -------------------------
     def set_optim_method(self, method: OptimMethod):
@@ -164,6 +195,118 @@ class Optimizer:
         self.max_drop_percentage = max_drop_percentage
         return self
 
+    # -- resilience config (bigdl_tpu/resilience/) ----------------------
+    def set_gradient_guard(self, enabled: bool = True):
+        """Enable/disable the in-program NaN/Inf gradient guard (on by
+        default; ``bigdl.guard.gradients`` property sets the default).
+        A guarded anomalous step is skipped — parameters, optimizer
+        slots and buffers come out unchanged — and counted in
+        ``skipped_steps`` and the train summary."""
+        self.gradient_guard = bool(enabled)
+        return self
+
+    def set_loss_spike_guard(self, k: int = 3, ratio: float = 2.0,
+                             warmup: int = 10):
+        """Roll back to the last good checkpoint after ``k`` consecutive
+        iterations whose loss exceeds ``ratio``× its running average
+        (see resilience.guards.LossSpikeDetector).  Pass ``k=None`` to
+        disable.  Needs ``set_checkpoint`` — without one the trigger
+        only logs."""
+        self.spike_detector = (None if k is None else
+                               LossSpikeDetector(k=k, ratio=ratio,
+                                                 warmup=warmup))
+        return self
+
+    def set_retry_policy(self, policy: RetryPolicy):
+        """Replace the failure retry policy (default: built from the
+        ``bigdl.failure.*`` properties)."""
+        self.retry_policy = policy
+        return self
+
+    def set_preemption_handling(self, enabled: bool = True):
+        """Install SIGTERM/SIGINT handlers for the duration of
+        ``optimize()``: on signal, finish the in-flight step, write a
+        checkpoint (when a checkpoint path is configured) and return
+        cleanly — the next run resumes via ``resume_from_checkpoint``."""
+        self.handle_preemption = bool(enabled)
+        return self
+
+    # -- resilience plumbing shared by the drivers ----------------------
+    def _restore_latest(self):
+        self.resume_from_checkpoint()
+
+    def _with_retry(self, fn):
+        """Failure-retry loop shared by every driver (reference
+        DistriOptimizer.scala:750-816, upgraded: exponential backoff +
+        jitter between attempts, fatal errors never retried).  Without
+        a checkpoint there is nothing to restore — first error raises,
+        matching the reference loop."""
+        if self.checkpoint_path is None:
+            return fn()
+
+        def on_retry(exc, attempt):
+            self.rollbacks += 1
+            if self.spike_detector is not None:
+                self.spike_detector.reset()
+            self._restore_latest()
+
+        return self.retry_policy.run(fn, on_retry=on_retry)
+
+    def _preemption_scope(self):
+        """Context manager arming preemption handling for one run (a
+        no-op context when disabled)."""
+        import contextlib
+
+        if not self.handle_preemption:
+            self._preemption = None
+            return contextlib.nullcontext()
+        self._preemption = PreemptionHandler()
+        return self._preemption
+
+    def _preempted(self) -> bool:
+        return self._preemption is not None and self._preemption.should_stop
+
+    def _check_loss_anomaly(self, loss: float, skipped: bool):
+        """Host-side per-iteration anomaly accounting: count guard
+        skips, feed the spike detector, and raise the retryable
+        LossSpikeError when it trips (the retry loop answers with a
+        rollback to the last good checkpoint)."""
+        if skipped:
+            self.skipped_steps += 1
+            log.warning("gradient anomaly (NaN/Inf) — step skipped "
+                        "(%d total); params/slots unchanged",
+                        self.skipped_steps)
+            return
+        if self.spike_detector is not None and \
+                self.spike_detector.update(loss):
+            if self.checkpoint_path is None:
+                log.error("loss spike detected (loss %.6g) but no "
+                          "checkpoint is configured — cannot roll back; "
+                          "continuing", loss)
+                return
+            raise LossSpikeError(
+                f"training loss diverged (loss {loss:.6g} after "
+                f"{self.spike_detector.k} consecutive spikes) — rolling "
+                "back to the last good checkpoint")
+
+    def _write_pickle_checkpoint(self, state):
+        """Atomic, checksummed model/optimMethod pickle checkpoint
+        (tmp + fsync + rename, crc32c sidecars — the write side of the
+        verified-restore contract in resilience.checkpoint)."""
+        from ..utils import file_io
+
+        if self.checkpoint_path is None:
+            return
+        n = state["neval"] - 1
+        suffix = "" if self.is_overwrite else f".{n}"
+        file_io.save(self.model,
+                     file_io.join(self.checkpoint_path, f"model{suffix}"),
+                     overwrite=True, atomic=True, checksum=True)
+        file_io.save(self.optim_method,
+                     file_io.join(self.checkpoint_path,
+                                  f"optimMethod{suffix}"),
+                     overwrite=True, atomic=True, checksum=True)
+
     # -- orbax sharded checkpoints (utils/orbax_io.py) -------------------
     @staticmethod
     def _orbax_tree(params, slots, buffers=None):
@@ -221,7 +364,10 @@ class Optimizer:
             keep = {n, committed_before
                     if committed_before is not None else n}
             for name in os.listdir(self._orbax.directory):
-                for prefix, is_dir in ((SC.PREFIX, True), ("meta-", False)):
+                if ".corrupt" in name:
+                    continue  # quarantined evidence is never reclaimed
+                for prefix, is_dir in ((SC.PREFIX, True), ("meta-", False),
+                                       (SC.MANIFEST_PREFIX, False)):
                     if name.startswith(prefix):
                         tail = name[len(prefix):].split(".")[0]
                         if tail.isdigit() and int(tail) not in keep:
@@ -235,14 +381,38 @@ class Optimizer:
         committed step exists."""
         import pickle
 
-        from ..utils.orbax_io import ShardedCheckpointer, latest_step
+        from ..utils.orbax_io import (ShardedCheckpointer, _is_finalized,
+                                      latest_step, quarantine_step,
+                                      verify_step)
 
         if self.checkpoint_path is None:
             return False
         directory = os.path.abspath(self.checkpoint_path)
+
+        def _older_than(n):
+            # same commit-marker guard as latest_step: a torn step can
+            # have a meta sidecar (written synchronously before the
+            # async save finished) — never restore it
+            older = [
+                s for s in range(n)
+                if os.path.isdir(os.path.join(
+                    directory, f"{ShardedCheckpointer.PREFIX}{s}"))
+                and _is_finalized(os.path.join(
+                    directory, f"{ShardedCheckpointer.PREFIX}{s}"))]
+            return max(older) if older else None
+
         n = latest_step(directory)
         meta = None
         while n is not None:
+            # crc32c manifest check: a bit-flipped or truncated shard
+            # is quarantined and restore walks back to the previous
+            # good step (manifest-less legacy steps pass through)
+            if verify_step(directory, n) is False:
+                log.warning("orbax step %d failed crc32c verification — "
+                            "quarantining and falling back", n)
+                quarantine_step(directory, n)
+                n = latest_step(directory)
+                continue
             # a crash between the async step commit and the sidecar
             # write can leave a committed step without meta — fall back
             # to the newest step that has one
@@ -254,18 +424,13 @@ class Optimizer:
             except FileNotFoundError:
                 log.warning("orbax step %d has no meta sidecar "
                             "(interrupted save?) — falling back", n)
-                from ..utils.orbax_io import _is_finalized
-
-                # same commit-marker guard as latest_step: a torn step
-                # can have a meta sidecar (written synchronously before
-                # the async save finished) — never restore it
-                older = [
-                    s for s in range(n)
-                    if os.path.isdir(os.path.join(
-                        directory, f"{ShardedCheckpointer.PREFIX}{s}"))
-                    and _is_finalized(os.path.join(
-                        directory, f"{ShardedCheckpointer.PREFIX}{s}"))]
-                n = max(older) if older else None
+                n = _older_than(n)
+            except (pickle.UnpicklingError, EOFError, OSError) as e:
+                log.warning("orbax step %d has an unreadable meta "
+                            "sidecar (%s) — quarantining and falling "
+                            "back", n, e)
+                quarantine_step(directory, n)
+                n = latest_step(directory)
         if meta is None:
             return False
         if self._orbax is None:
@@ -295,20 +460,19 @@ class Optimizer:
         there is nothing to restore."""
         if self.checkpoint_format == "orbax":
             return self._orbax_restore_into_model()
-        from ..utils.file_io import load
-        from .distri_optimizer import _latest_file
-        from .optim_method import OptimMethod
+        from ..resilience.checkpoint import verify_and_load_latest
 
         restored_any = False
-        latest = _latest_file(self.checkpoint_path, "model")
-        if latest is not None:
-            restored = load(latest)
+        restored, _path = verify_and_load_latest(self.checkpoint_path,
+                                                 "model")
+        if restored is not None:
             self.model.set_param_tree(restored.param_tree())
             self.model.set_buffer_tree(restored.buffer_tree())
             restored_any = True
-        latest_om = _latest_file(self.checkpoint_path, "optimMethod")
-        if latest_om is not None:
-            self.optim_method = OptimMethod.load(latest_om)
+        om, _path = verify_and_load_latest(self.checkpoint_path,
+                                           "optimMethod")
+        if om is not None:
+            self.optim_method = om
             restored_any = True
         return restored_any
 
@@ -387,7 +551,8 @@ class LocalOptimizer(Optimizer):
 
     def optimize(self) -> AbstractModule:
         try:
-            return self._optimize_loop()
+            with self._preemption_scope():
+                return self._with_retry(self._optimize_loop)
         finally:
             # commit any in-flight async orbax save on abnormal exits
             self._orbax_close()
@@ -408,6 +573,7 @@ class LocalOptimizer(Optimizer):
         # output directly — upcasting [N, V] logits first would undo the
         # fused path's HBM saving
         upcast_out = not getattr(criterion, "accepts_low_precision", False)
+        guard = self.gradient_guard
 
         def train_step(params, buffers, slots, lr, rng, x, y):
             def loss_fn(p):
@@ -434,13 +600,28 @@ class LocalOptimizer(Optimizer):
                 grads = jax.tree_util.tree_map(lambda g, s: g * s,
                                                grads, scale_tree)
             new_params, new_slots = optim.step(grads, params, slots, lr)
-            return loss, new_params, new_buffers, new_slots
+            if guard:
+                # anomaly guard: a NaN/Inf gradient (or loss) skips the
+                # whole update — params/slots/buffers ride through
+                # bit-identical (select, not branch: jit-compatible)
+                ok = jnp.logical_and(tree_finite(grads),
+                                     jnp.isfinite(loss))
+                new_params = where_tree(ok, new_params, params)
+                new_slots = where_tree(ok, new_slots, slots)
+                new_buffers = where_tree(ok, new_buffers, buffers)
+            else:
+                ok = jnp.bool_(True)
+            return loss, new_params, new_buffers, new_slots, ok
 
         # donate params/buffers/slots: the update is in-place in HBM —
         # without this every step keeps old+new parameters live and pays
         # a copy (a direct MFU tax at ResNet scale)
         jitted = jax.jit(train_step, donate_argnums=(0, 1, 2))
 
+        # the step donates these (in-place HBM update); a retry restart
+        # re-enters here AFTER resume_from_checkpoint has repointed the
+        # model at freshly-loaded arrays, so the donated originals are
+        # never handed back in
         params = model.param_tree()
         buffers = model.buffer_tree()
         # resume optimizer slots (Adam moments etc.) from a loaded
@@ -474,14 +655,16 @@ class LocalOptimizer(Optimizer):
             t0 = time.time()
             lr = optim.get_current_lr()
             rng = next_jax_key()
-            loss, params, buffers, slots = jitted(
+            loss, params, buffers, slots, step_ok = jitted(
                 params, buffers, slots, jnp.float32(lr), rng, x, y)
             # prefetch the next batch while the device runs this step —
             # only within the epoch, so rollover/shuffle semantics hold
             if records_this_epoch + n_records < epoch_size:
                 pending = fetch()
             loss = float(loss)  # device sync
+            skipped = not bool(step_ok)
             train_time = time.time() - t0
+            self._check_loss_anomaly(loss, skipped)
 
             self.metrics.add("computing time average", train_time)
             self.metrics.add("data fetch time", data_time)
@@ -502,6 +685,10 @@ class LocalOptimizer(Optimizer):
                     state["neval"])
                 if "LearningRate" in getattr(self.train_summary, "triggers", {}):
                     self.train_summary.add_scalar("LearningRate", lr, state["neval"])
+                if self.gradient_guard:
+                    self.train_summary.add_scalar(
+                        "SkippedSteps", float(self.skipped_steps),
+                        state["neval"])
 
             state["neval"] += 1
             optim.state = state
@@ -521,6 +708,18 @@ class LocalOptimizer(Optimizer):
                 optim._slots = slots
             self._validate(state)
             self._checkpoint(state)
+
+            if self._preempted():
+                # graceful preemption: checkpoint the live state at this
+                # step boundary and return resumable
+                model.set_param_tree(params)
+                model.set_buffer_tree(buffers)
+                optim._slots = slots
+                self._checkpoint_now(state)
+                log.warning("preemption requested — checkpointed at "
+                            "iteration %d; exiting resumable",
+                            state["neval"] - 1)
+                break
 
         model.set_param_tree(params)
         model.set_buffer_tree(buffers)
@@ -555,6 +754,11 @@ class LocalOptimizer(Optimizer):
     def _checkpoint(self, state):
         if not self._should(self.checkpoint_trigger, state):
             return
+        self._checkpoint_now(state)
+
+    def _checkpoint_now(self, state):
+        """Write a checkpoint regardless of triggers (the preemption
+        path uses this directly at the final step boundary)."""
         if self.checkpoint_path is None:
             return
         if self.checkpoint_format == "orbax":
@@ -562,13 +766,5 @@ class LocalOptimizer(Optimizer):
                 self.model.param_tree(), self.optim_method._slots,
                 self.model.buffer_tree()), kind="model")
             return
-        from ..utils import file_io
-
-        n = state["neval"] - 1
-        suffix = "" if self.is_overwrite else f".{n}"
-        self.model.save(file_io.join(self.checkpoint_path, f"model{suffix}"),
-                        overwrite=True)
-        self.optim_method.save(
-            file_io.join(self.checkpoint_path, f"optimMethod{suffix}"),
-            overwrite=True)
+        self._write_pickle_checkpoint(state)
 

@@ -29,9 +29,12 @@ _COLLECTIVE_MARKS = (
     "send", "recv",
 )
 # Host-side bookkeeping events that are neither compute nor collective.
+# ThunkExecutor/ExecuteHelper span whole executables (counting them would
+# double-count every op inside); "wait for completion" is idle time.
 _SKIP_MARKS = (
     "threadpoollistener", "startregion", "stopregion", "parsearguments",
-    "collectgarbage", "end:",
+    "collectgarbage", "end:", "executehelper", "thunkexecutor",
+    "d2d dispatch", "wait for complet",
 )
 
 
@@ -58,15 +61,65 @@ def _device_lines(profile_data):
         for line in plane.lines:
             if dev_plane and "xla ops" in line.name.lower():
                 yield line
-            elif "XLAPjRtCpuClient" in line.name:
+            elif line.name.startswith("tf_XLA"):
+                # CPU PJRT executor threads: tf_XLAPjRtCpuClient/... on
+                # newer runtimes; tf_XLAEigen/... + tf_XLATfrtCpuClient/...
+                # on jax 0.4.x — same per-op event stream either way
                 yield line
+
+
+def _load_profile(path: str):
+    """Parse an xplane.pb into the (planes → lines → named events with
+    duration_ns) shape ``_device_lines`` walks.  jax>=0.5 ships
+    ``jax.profiler.ProfileData``; older runtimes fall back to the raw
+    XSpace proto (tensorflow's tsl copy), adapted to the same surface."""
+    try:
+        from jax.profiler import ProfileData
+
+        return ProfileData.from_file(path)
+    except ImportError:
+        pass
+    from tensorflow.tsl.profiler.protobuf import xplane_pb2
+
+    class _Ev:
+        __slots__ = ("name", "duration_ns")
+
+        def __init__(self, name, duration_ns):
+            self.name = name
+            self.duration_ns = duration_ns
+
+    class _Line:
+        __slots__ = ("name", "events")
+
+        def __init__(self, line, meta):
+            self.name = line.name
+            self.events = [
+                _Ev(meta[e.metadata_id].name, e.duration_ps / 1e3)
+                for e in line.events if e.metadata_id in meta]
+
+    class _Plane:
+        __slots__ = ("name", "lines")
+
+        def __init__(self, plane):
+            meta = dict(plane.event_metadata)
+            self.name = plane.name
+            self.lines = [_Line(l, meta) for l in plane.lines]
+
+    class _Space:
+        __slots__ = ("planes",)
+
+        def __init__(self, space):
+            self.planes = [_Plane(p) for p in space.planes]
+
+    space = xplane_pb2.XSpace()
+    with open(path, "rb") as f:
+        space.ParseFromString(f.read())
+    return _Space(space)
 
 
 def split_from_xplane(path: str) -> Tuple[float, float]:
     """Sum (compute_seconds, collective_seconds) over a trace file."""
-    from jax.profiler import ProfileData
-
-    pd = ProfileData.from_file(path)
+    pd = _load_profile(path)
     compute_ns = 0
     collective_ns = 0
     for line in _device_lines(pd):

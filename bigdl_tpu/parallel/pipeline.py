@@ -45,8 +45,10 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax import lax, shard_map
+from jax import lax
 from jax.sharding import PartitionSpec as P
+
+from ..utils.jax_compat import shard_map
 
 
 # per-iteration bookkeeping/timing/state attributes that legitimately
@@ -54,6 +56,28 @@ from jax.sharding import PartitionSpec as P
 # run eagerly) — never part of the identity
 _SIG_SKIP = frozenset(("name", "is_training", "forward_time",
                        "backward_time", "output", "grad_input"))
+# attributes whose content is captured elsewhere in the block signature:
+# children recurse via ``kids``; param/grad/buffer arrays are compared
+# by treedef + leaf shape in _block_run
+_SIG_STRUCTURAL = frozenset(("modules", "params", "grads", "buffers"))
+
+
+def _sig_marker(v):
+    """Conservative signature entry for a non-simple attribute value.
+
+    Named module-level callables (functions, classes, bound activations
+    like ``jnp.tanh``) compare by qualified name — two blocks built with
+    the same default share it.  Everything else (closures, partials,
+    arrays, dicts, arbitrary objects) compares by OBJECT IDENTITY:
+    separately-constructed values refuse to match, so config-divergent
+    blocks can never silently stack — the scan falls back to per-block
+    execution instead of applying the first block's config to all."""
+    if callable(v):
+        mod = getattr(v, "__module__", None)
+        qn = getattr(v, "__qualname__", None)
+        if mod is not None and qn is not None and "<locals>" not in qn:
+            return ("callable", mod, qn)
+    return (type(v).__name__, id(v))
 
 
 def _module_sig(m):
@@ -67,7 +91,7 @@ def _module_sig(m):
     first block's config to every layer."""
     cfg = []
     for k, v in sorted(vars(m).items()):
-        if k.startswith("_") or k in _SIG_SKIP:
+        if k.startswith("_") or k in _SIG_SKIP or k in _SIG_STRUCTURAL:
             continue
         if isinstance(v, (int, float, bool, str, bytes, type(None))):
             cfg.append((k, v))
@@ -75,6 +99,10 @@ def _module_sig(m):
               all(isinstance(e, (int, float, bool, str, type(None)))
                   for e in v)):
             cfg.append((k, tuple(v)))
+        else:
+            # non-simple config (callable, array, dict, object): a
+            # conservative marker so divergent blocks never stack
+            cfg.append((k, _sig_marker(v)))
     kids = tuple(_module_sig(c) for c in getattr(m, "modules", ()))
     return (type(m).__name__, tuple(cfg), kids)
 

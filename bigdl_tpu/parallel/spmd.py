@@ -26,8 +26,10 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax import lax, shard_map
+from jax import lax
 from jax.sharding import PartitionSpec as P
+
+from ..utils.jax_compat import shard_map
 
 
 def param_specs(module, model_axis: str = "model"):

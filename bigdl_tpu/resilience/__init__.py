@@ -1,0 +1,39 @@
+"""Training resilience subsystem.
+
+The reference framework's only fault story is a fixed-count driver
+retry from the latest checkpoint (DistriOptimizer.scala:750-752),
+inherited from Spark's coarse-grained task re-execution (BigDL,
+arXiv:1804.05839).  A TPU-native trainer has no substrate to inherit
+resilience from, so this package owns it end to end:
+
+* :mod:`.guards`      — jit-compatible NaN/Inf gradient guard (skip the
+  step, keep params/slots intact) + host-side loss-spike detector that
+  triggers rollback-to-last-good-checkpoint.
+* :mod:`.checkpoint`  — atomic checkpoint writes (tmp + fsync + rename)
+  with crc32c sidecar checksums, verified restore, and walk-back to the
+  newest checkpoint that passes verification (corrupt ones are
+  quarantined, never deleted).
+* :mod:`.retry`       — :class:`RetryPolicy`: exponential backoff with
+  jitter and retryable-vs-fatal error classification, replacing the
+  fixed ``bigdl.failure.retryTimes``/``retryTimeInterval`` window
+  (kept as compat aliases) and reused by the ingest layer for
+  transient I/O.
+* :mod:`.preemption`  — SIGTERM/SIGINT handler that requests a
+  checkpoint at the next step boundary and exits cleanly resumable.
+* :mod:`.faults`      — deterministic fault-injection API (fail-at-step
+  exceptions, NaN-gradient injection, checkpoint truncation/bit-flip,
+  ingest I/O errors) driving the end-to-end recovery tests.
+"""
+from .guards import LossSpikeDetector, tree_finite, where_tree
+from .retry import (FatalTrainingError, LossSpikeError, RetryPolicy,
+                    classify_error)
+from .preemption import PreemptionHandler, request_preemption
+from .checkpoint import (quarantine, verify_file, verify_and_load_latest,
+                         write_sidecar)
+
+__all__ = [
+    "LossSpikeDetector", "tree_finite", "where_tree",
+    "FatalTrainingError", "LossSpikeError", "RetryPolicy", "classify_error",
+    "PreemptionHandler", "request_preemption",
+    "quarantine", "verify_file", "verify_and_load_latest", "write_sidecar",
+]

@@ -1,0 +1,176 @@
+"""Verified checkpoints: crc32c sidecars, walk-back restore, quarantine.
+
+The pickle-format checkpoints (``model.N``/``optimMethod.N`` files,
+reference DistriOptimizer.scala:394-416) are written atomically by
+``utils.file_io.save(atomic=True, checksum=True)`` — pickle to a temp
+file in the target directory, fsync, rename — with a ``<file>.crc32c``
+sidecar carrying the payload's crc32c and size.  This module owns the
+read side: verify a file against its sidecar, quarantine corrupt files
+(rename to ``<file>.corrupt`` — never delete: the bytes are evidence),
+and walk back through a checkpoint directory to the newest file that
+both verifies and unpickles.
+
+Orbax-format steps get the same treatment via per-step file manifests
+in :mod:`bigdl_tpu.utils.orbax_io`.
+"""
+from __future__ import annotations
+
+import logging
+import os
+from typing import Any, List, Optional, Tuple
+
+from ..visualization.crc32c import crc32c
+
+log = logging.getLogger("bigdl_tpu")
+
+CRC_SUFFIX = ".crc32c"
+QUARANTINE_SUFFIX = ".corrupt"
+_CHUNK = 1 << 20
+
+
+def _native_crc():
+    from .. import native
+
+    return native.crc32c if native.available() else crc32c
+
+
+def stream_crc32c(path: str) -> Tuple[int, int]:
+    """(crc32c, size) of a file's bytes, streamed in 1 MiB chunks
+    through the native slicing-by-8 CRC when built."""
+    from ..utils import file_io
+
+    fn = _native_crc()
+    crc, size = 0, 0
+    with file_io.filesystem_for(path).open(path, "rb") as f:
+        while True:
+            chunk = f.read(_CHUNK)
+            if not chunk:
+                break
+            crc = fn(bytes(chunk), crc)
+            size += len(chunk)
+    return crc, size
+
+
+def sidecar_path(path: str) -> str:
+    """``<dir>/.<name>.crc32c`` — hidden, so checkpoint-directory scans
+    that glob by prefix (``model.*``) never pick a sidecar up as a
+    checkpoint candidate."""
+    if "://" in path or "/" in path:
+        sep = "/" if "://" in path else os.sep
+        d, _, base = path.rpartition(sep)
+        return f"{d}{sep}.{base}{CRC_SUFFIX}"
+    return f".{path}{CRC_SUFFIX}"
+
+
+def write_sidecar(path: str, crc: int, size: int):
+    """Write ``<path>``'s sidecar = "<crc hex> <size>"."""
+    from ..utils import file_io
+
+    with file_io.filesystem_for(path).open(sidecar_path(path), "wb") as f:
+        f.write(f"{crc:08x} {size}\n".encode())
+
+
+def read_sidecar(path: str) -> Optional[Tuple[int, int]]:
+    from ..utils import file_io
+
+    side = sidecar_path(path)
+    fs = file_io.filesystem_for(path)
+    if not fs.exists(side):
+        return None
+    try:
+        with fs.open(side, "rb") as f:
+            crc_hex, size = f.read().split()
+        return int(crc_hex, 16), int(size)
+    except (ValueError, OSError):
+        return None  # unreadable sidecar: treat the file as unverifiable
+
+
+def verify_file(path: str) -> Optional[bool]:
+    """True: sidecar present and crc+size match.  False: sidecar present
+    and MISMATCH (the file is corrupt).  None: no (readable) sidecar —
+    a legacy checkpoint; the caller decides (restore still attempts the
+    unpickle, which catches gross truncation)."""
+    expected = read_sidecar(path)
+    if expected is None:
+        return None
+    try:
+        actual = stream_crc32c(path)
+    except OSError:
+        return False
+    return actual == expected
+
+
+def quarantine(path: str) -> str:
+    """Move a corrupt checkpoint (and its sidecar) out of the restore
+    set: ``<path>`` → ``<path>.corrupt``.  Returns the new path."""
+    dst = path + QUARANTINE_SUFFIX
+    try:
+        os.replace(path, dst)
+    except OSError:
+        # non-local / already-moved: fall back to best-effort removal
+        # from the candidate namespace via the backend
+        log.warning("could not quarantine %s in place", path)
+        return path
+    side = sidecar_path(path)
+    if os.path.exists(side):
+        try:
+            os.replace(side, side + QUARANTINE_SUFFIX)
+        except OSError:
+            pass
+    log.warning("quarantined corrupt checkpoint %s -> %s", path, dst)
+    return dst
+
+
+# ---------------------------------------------------------------------------
+# walk-back restore
+# ---------------------------------------------------------------------------
+
+def candidate_files(directory: str, prefix: str) -> List[str]:
+    """All ``<prefix>``/``<prefix>.N`` files under ``directory``, newest
+    step first (a bare ``<prefix>`` — the overwrite layout — sorts
+    newest, matching the old ``_latest_file`` preference)."""
+    from ..utils import file_io
+
+    if directory is None or not file_io.isdir(directory):
+        return []
+    steps = []
+    for f in file_io.listdir(directory):
+        if f == prefix:
+            steps.append((float("inf"), f))
+        elif f.startswith(prefix + ".") and not f.endswith(
+                (CRC_SUFFIX, QUARANTINE_SUFFIX)):
+            try:
+                steps.append((int(f.rsplit(".", 1)[1]), f))
+            except ValueError:
+                continue
+    steps.sort(key=lambda t: t[0], reverse=True)
+    return [file_io.join(directory, f) for _, f in steps]
+
+
+def verify_and_load_latest(directory: str, prefix: str
+                           ) -> Tuple[Optional[Any], Optional[str]]:
+    """Walk the ``<prefix>.N`` files newest-first; return
+    ``(loaded_object, path)`` for the first one that passes crc32c
+    verification AND unpickles.  Corrupt candidates are quarantined and
+    the walk continues — a torn newest checkpoint falls back to the
+    previous good one instead of killing the resume.  ``(None, None)``
+    when nothing survives."""
+    from ..utils import file_io
+
+    for path in candidate_files(directory, prefix):
+        ok = verify_file(path)
+        if ok is False:
+            quarantine(path)
+            continue
+        try:
+            return file_io.load(path), path
+        except (KeyboardInterrupt, SystemExit):
+            raise
+        except Exception as e:
+            # sidecar absent (legacy) or matched-but-unloadable (e.g. a
+            # truncated legacy file): quarantine and keep walking
+            log.warning("checkpoint %s failed to load (%s: %s)",
+                        path, type(e).__name__, e)
+            quarantine(path)
+            continue
+    return None, None

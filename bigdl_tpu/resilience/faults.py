@@ -1,0 +1,186 @@
+"""Deterministic fault injection for recovery tests.
+
+Generalizes the old ``tests/_fault.py`` ExceptionTransformer (reference
+ExceptionTest module, SURVEY §4.5) into a first-class API: every
+injector fires at an explicit, deterministic point (record index, byte
+offset, open count) and records that it fired, so recovery tests can
+assert both that the fault happened AND that training rode through it.
+
+Under XLA a module can only throw at trace time, so the host-visible
+fault surface is the input pipeline — data-plane transformers inject
+driver exceptions (:class:`ExceptionTransformer`), NaN gradients
+(:class:`NaNInjector` — a NaN feature makes every downstream gradient
+NaN), and loss spikes (:class:`ScaleInjector`).  File-level helpers
+(:func:`bit_flip`, :func:`truncate`) corrupt checkpoints on disk, and
+the :func:`io_faults` context injects transient errors into the ingest
+layer's shard opens.
+"""
+from __future__ import annotations
+
+import contextlib
+import os
+import threading
+from typing import Callable, Optional
+
+import numpy as np
+
+from ..dataset.sample import Sample
+from ..dataset.transformer import Transformer
+
+
+# ---------------------------------------------------------------------------
+# data-plane injectors (Transformer stages)
+# ---------------------------------------------------------------------------
+
+class ExceptionTransformer(Transformer):
+    """Raises once when the ``fail_at``-th record passes through;
+    ``fired`` records that the fault actually triggered."""
+
+    def __init__(self, fail_at: int,
+                 exc: Callable[[], BaseException] = None):
+        self.fail_at = fail_at
+        self.count = 0
+        self.fired = False
+        self._exc = exc or (lambda: RuntimeError("injected failure"))
+
+    def apply(self, it):
+        for item in it:
+            self.count += 1
+            if self.count == self.fail_at and not self.fired:
+                self.fired = True
+                raise self._exc()
+            yield item
+
+
+class NaNInjector(Transformer):
+    """Replaces the features of records [``at``, ``at + n``) with NaN —
+    once per run — so the step's gradients (and loss) go NaN and the
+    gradient guard's skip path is exercised end to end."""
+
+    def __init__(self, at: int, n: int = 1):
+        self.at = at
+        self.n = n
+        self.count = 0
+        self.fired = 0
+
+    def apply(self, it):
+        for item in it:
+            self.count += 1
+            if (self.at <= self.count < self.at + self.n
+                    and self.fired < self.n):
+                self.fired += 1
+                f = np.full_like(np.asarray(item.feature, np.float32),
+                                 np.nan)
+                item = Sample(f, item.label)
+            yield item
+
+
+class ScaleInjector(Transformer):
+    """Scales the features of records [``at``, ``at + n``) by ``scale``
+    — once per run — driving the loss far above its running average to
+    exercise the loss-spike rollback path."""
+
+    def __init__(self, at: int, n: int, scale: float):
+        self.at = at
+        self.n = n
+        self.scale = float(scale)
+        self.count = 0
+        self.fired = 0
+
+    def apply(self, it):
+        for item in it:
+            self.count += 1
+            if (self.at <= self.count < self.at + self.n
+                    and self.fired < self.n):
+                self.fired += 1
+                f = np.asarray(item.feature, np.float32) * self.scale
+                item = Sample(f, item.label)
+            yield item
+
+
+class PreemptTransformer(Transformer):
+    """Requests a graceful preemption (the SIGTERM path, minus the
+    signal) when the ``at``-th record passes through."""
+
+    def __init__(self, at: int):
+        self.at = at
+        self.count = 0
+        self.fired = False
+
+    def apply(self, it):
+        from .preemption import request_preemption
+
+        for item in it:
+            self.count += 1
+            if self.count == self.at and not self.fired:
+                self.fired = True
+                request_preemption()
+            yield item
+
+
+# ---------------------------------------------------------------------------
+# checkpoint corruption (file-level)
+# ---------------------------------------------------------------------------
+
+def bit_flip(path: str, offset: Optional[int] = None, seed: int = 0):
+    """Flip one byte's bits at ``offset`` (deterministically mid-file by
+    default) — the classic silent-corruption case crc32c must catch."""
+    size = os.path.getsize(path)
+    if size == 0:
+        raise ValueError(f"{path} is empty — nothing to flip")
+    if offset is None:
+        offset = (size // 2 + seed) % size
+    with open(path, "r+b") as f:
+        f.seek(offset)
+        b = f.read(1)
+        f.seek(offset)
+        f.write(bytes([b[0] ^ 0xFF]))
+    return offset
+
+
+def truncate(path: str, keep_fraction: float = 0.5):
+    """Truncate a file to ``keep_fraction`` of its size — the torn-write
+    / out-of-disk case."""
+    size = os.path.getsize(path)
+    keep = int(size * keep_fraction)
+    with open(path, "r+b") as f:
+        f.truncate(keep)
+    return keep
+
+
+# ---------------------------------------------------------------------------
+# ingest I/O faults
+# ---------------------------------------------------------------------------
+
+_IO_LOCK = threading.Lock()
+_IO_FAULTS: list = []  # [dict(substr, remaining, exc_type)]
+
+
+def check_io_fault(path: str):
+    """Called by the ingest layer at each shard open; raises the
+    injected transient error while its budget lasts.  No-op (and free)
+    when nothing is registered."""
+    if not _IO_FAULTS:
+        return
+    with _IO_LOCK:
+        for f in _IO_FAULTS:
+            if f["substr"] in path and f["remaining"] > 0:
+                f["remaining"] -= 1
+                raise f["exc_type"](
+                    f"injected transient I/O error on {path} "
+                    f"({f['remaining']} left)")
+
+
+@contextlib.contextmanager
+def io_faults(substr: str, times: int = 1, exc_type=OSError):
+    """Inject ``times`` transient ``exc_type`` failures into ingest
+    opens of any shard path containing ``substr``."""
+    entry = {"substr": substr, "remaining": int(times),
+             "exc_type": exc_type}
+    with _IO_LOCK:
+        _IO_FAULTS.append(entry)
+    try:
+        yield entry
+    finally:
+        with _IO_LOCK:
+            _IO_FAULTS.remove(entry)

@@ -1,0 +1,114 @@
+"""Gradient anomaly guard + loss-spike detector.
+
+The gradient guard is a pair of jit-compatible helpers the compiled
+training steps build on: :func:`tree_finite` reduces a gradient pytree
+to one scalar "every leaf is finite" predicate, and :func:`where_tree`
+selects between the post-update and pre-update state trees under that
+predicate.  A NaN/Inf step is thereby SKIPPED — parameters, optimizer
+slots and buffers come out bit-identical to their pre-step values, the
+batch is dropped, and the host counts the skip in the train summary.
+This is the select-not-branch idiom: under jit both sides are computed
+and ``jnp.where`` picks, so the guard adds no host sync and composes
+with shard_map (callers psum/pmin the predicate across shards so every
+shard takes the same branch).
+
+The loss-spike detector is HOST-side: it watches the per-iteration loss
+scalar the driver already fetches, and after K consecutive spikes above
+a running-mean threshold signals rollback — the driver raises
+:class:`~bigdl_tpu.resilience.retry.LossSpikeError`, which the retry
+loop classifies as retryable and answers by restoring the last good
+checkpoint.
+"""
+from __future__ import annotations
+
+import logging
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+log = logging.getLogger("bigdl_tpu")
+
+
+def tree_finite(*trees):
+    """Scalar bool: every floating leaf of every given pytree is finite.
+
+    Integer leaves pass vacuously.  jit/shard_map compatible (pure jnp,
+    no host sync)."""
+    ok = jnp.bool_(True)
+    for tree in trees:
+        for leaf in jax.tree_util.tree_leaves(tree):
+            if jnp.issubdtype(jnp.result_type(leaf), jnp.floating):
+                ok = jnp.logical_and(ok, jnp.all(jnp.isfinite(leaf)))
+    return ok
+
+
+def where_tree(pred, new_tree, old_tree):
+    """Leaf-wise ``jnp.where(pred, new, old)`` over matching pytrees —
+    the skip-step select: with ``pred`` False the old state rides
+    through untouched (params/slots/buffers stay intact)."""
+    return jax.tree_util.tree_map(
+        lambda n, o: jnp.where(pred, n, o), new_tree, old_tree)
+
+
+class LossSpikeDetector:
+    """K-consecutive-spike trigger over the training loss stream.
+
+    A step is a *spike* when its loss exceeds ``ratio`` times the
+    exponential moving average of recent (non-spike) losses, or is
+    non-finite.  ``k`` consecutive spikes trip the detector: ``update``
+    returns True and the driver rolls back to the last good checkpoint.
+    Isolated spikes (a hard batch) decay back into the average; genuine
+    divergence — where every subsequent loss stays elevated — trips
+    within ``k`` steps instead of wasting the rest of the run.
+
+    Host-side and cheap: one float compare per iteration on the loss
+    the driver already fetched.
+    """
+
+    def __init__(self, k: int = 3, ratio: float = 2.0,
+                 warmup: int = 10, ema_decay: float = 0.9):
+        if k < 1:
+            raise ValueError(f"k must be >= 1, got {k}")
+        if ratio <= 1.0:
+            raise ValueError(f"ratio must be > 1, got {ratio}")
+        self.k = int(k)
+        self.ratio = float(ratio)
+        self.warmup = int(warmup)
+        self.ema_decay = float(ema_decay)
+        self.reset()
+
+    def reset(self):
+        """Forget history — call after a rollback so the restored run
+        re-warms on its own losses."""
+        self._ema: Optional[float] = None
+        self._steps = 0
+        self._consecutive = 0
+
+    @property
+    def consecutive_spikes(self) -> int:
+        return self._consecutive
+
+    def update(self, loss: float) -> bool:
+        """Feed one iteration's loss; True means roll back now."""
+        loss = float(loss)
+        self._steps += 1
+        finite = math.isfinite(loss)
+        in_warmup = self._ema is None or self._steps <= self.warmup
+        spike = not finite or (not in_warmup
+                               and loss > self.ratio * self._ema)
+        if spike:
+            self._consecutive += 1
+            log.warning("loss spike %d/%d: loss %.6g vs EMA %.6g",
+                        self._consecutive, self.k, loss,
+                        self._ema if self._ema is not None else float("nan"))
+        else:
+            self._consecutive = 0
+            self._ema = (loss if self._ema is None else
+                         self.ema_decay * self._ema
+                         + (1.0 - self.ema_decay) * loss)
+        if self._consecutive >= self.k:
+            self._consecutive = 0
+            return True
+        return False

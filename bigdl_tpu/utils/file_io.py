@@ -168,7 +168,18 @@ def _to_device(obj):
         lambda x: jnp.asarray(x) if isinstance(x, np.ndarray) else x, obj)
 
 
-def save(obj: Any, path: str, overwrite: bool = False):
+def save(obj: Any, path: str, overwrite: bool = False, *,
+         atomic: bool = False, checksum: bool = False):
+    """Pickle ``obj`` to ``path``.
+
+    ``atomic=True`` makes the write crash-safe on local filesystems:
+    pickle to a temp file in the target directory, fsync, rename — a
+    crash mid-write can never leave a torn file under the final name
+    (remote backends fall back to a plain write; their stores are
+    already put-atomic or out of rename's reach).  ``checksum=True``
+    writes a ``<path>.crc32c`` sidecar of the payload, which
+    ``resilience.checkpoint.verify_file`` checks on restore.
+    """
     fs = filesystem_for(path)
     if fs.exists(path) and not overwrite:
         raise FileExistsError(f"{path} exists and overwrite=False "
@@ -178,8 +189,46 @@ def save(obj: Any, path: str, overwrite: bool = False):
         fs.makedirs(d)
     # raw pytrees (save_weights, optimizer slots) go to portable numpy;
     # module/optim objects additionally convert via their __getstate__
-    with fs.open(path, "wb") as f:
-        pickle.dump(_to_host(obj), f)
+    if not (atomic or checksum):
+        with fs.open(path, "wb") as f:
+            pickle.dump(_to_host(obj), f)
+        return
+    data = pickle.dumps(_to_host(obj))
+    if atomic and isinstance(fs, _LocalBackend):
+        p = _strip_file_scheme(path)
+        tmp = f"{p}.tmp-{os.getpid()}"
+        try:
+            with open(tmp, "wb") as f:
+                f.write(data)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, p)
+            _fsync_dir(os.path.dirname(p) or ".")
+        finally:
+            if os.path.exists(tmp):
+                os.remove(tmp)
+    else:
+        with fs.open(path, "wb") as f:
+            f.write(data)
+    if checksum:
+        from ..resilience.checkpoint import _native_crc, write_sidecar
+
+        write_sidecar(path, _native_crc()(data), len(data))
+
+
+def _fsync_dir(path: str):
+    """fsync a directory so a just-renamed entry survives power loss;
+    best-effort (not all filesystems allow O_RDONLY dir fds)."""
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
 
 
 def load(path: str) -> Any:

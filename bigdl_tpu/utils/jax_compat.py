@@ -1,0 +1,32 @@
+"""Version-portable jax surface.
+
+The codebase targets the public ``jax.shard_map`` API (jax>=0.8,
+``check_vma=`` keyword); older runtimes (0.4.x) only ship
+``jax.experimental.shard_map.shard_map`` whose replication-check
+keyword is ``check_rep=``.  Import :func:`shard_map` from here and the
+right underlying implementation (and keyword spelling) is used.
+"""
+from __future__ import annotations
+
+import functools
+
+try:  # jax>=0.8: public API, check_vma keyword
+    from jax import shard_map as _shard_map  # type: ignore
+
+    _CHECK_KW = "check_vma"
+except ImportError:  # jax 0.4.x: experimental API, check_rep keyword
+    from jax.experimental.shard_map import shard_map as _shard_map  # type: ignore
+
+    _CHECK_KW = "check_rep"
+
+
+@functools.wraps(_shard_map)
+def shard_map(f, *, mesh, in_specs, out_specs, check_vma=None,
+              check_rep=None, **kwargs):
+    """``jax.shard_map`` with ``check_vma``/``check_rep`` accepted
+    interchangeably on every supported jax version."""
+    flag = check_vma if check_vma is not None else check_rep
+    if flag is not None:
+        kwargs[_CHECK_KW] = flag
+    return _shard_map(f, mesh=mesh, in_specs=in_specs,
+                      out_specs=out_specs, **kwargs)

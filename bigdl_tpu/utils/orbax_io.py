@@ -9,6 +9,13 @@ shards, no gather, asynchronously off the training loop) via Orbax's
 StandardCheckpointer, and restores either back onto the same mesh
 layout or host-side for the pickle-era resume paths.
 
+Each committed step additionally gets a ``manifest-N.json`` sidecar
+(written at the next drain point, once the async save has finalized)
+holding a crc32c per file in the step directory.  Restore verifies the
+manifest before touching a step; a bit-flipped or truncated shard is
+detected, the step is quarantined (renamed ``ckpt-N.corrupt``), and
+restore walks back to the newest step that passes.
+
 The pickle format stays the default (it round-trips whole module
 objects and needs no directory layout); ``format="orbax"`` on
 ``Optimizer.set_checkpoint`` switches the sharded training paths to
@@ -16,11 +23,15 @@ this writer.
 """
 from __future__ import annotations
 
+import json
+import logging
 import os
 import re
-from typing import Optional
+from typing import Dict, Optional
 
 import jax
+
+log = logging.getLogger("bigdl_tpu")
 
 
 class ShardedCheckpointer:
@@ -33,6 +44,7 @@ class ShardedCheckpointer:
     the drivers' ``model.N`` convention)."""
 
     PREFIX = "ckpt-"
+    MANIFEST_PREFIX = "manifest-"
 
     def __init__(self, directory: str):
         import orbax.checkpoint as ocp
@@ -40,18 +52,33 @@ class ShardedCheckpointer:
         self.directory = os.path.abspath(directory)
         os.makedirs(self.directory, exist_ok=True)
         self._ckpt = ocp.StandardCheckpointer()
+        self._pending_manifest: Optional[int] = None
 
     def _path(self, step: int) -> str:
         return os.path.join(self.directory, f"{self.PREFIX}{step}")
 
+    def _drain(self) -> None:
+        """Wait out the in-flight async save, then write its manifest —
+        the crc32c record a later restore verifies against."""
+        self._ckpt.wait_until_finished()
+        if self._pending_manifest is not None:
+            step, self._pending_manifest = self._pending_manifest, None
+            try:
+                write_manifest(self.directory, step)
+            except OSError as e:  # a failed save has no files to hash
+                log.warning("could not write manifest for step %d: %s",
+                            step, e)
+
     def save(self, step: int, tree) -> None:
-        self._ckpt.wait_until_finished()  # at most one save in flight
+        self._drain()  # at most one save in flight
         self._ckpt.save(self._path(step), tree)
+        self._pending_manifest = step
 
     def wait(self) -> None:
         """Drain the in-flight async save (after this, its step is
-        committed and visible to :func:`latest_step`)."""
-        self._ckpt.wait_until_finished()
+        committed, manifest included, and visible to
+        :func:`latest_step`)."""
+        self._drain()
 
     def latest_step(self) -> Optional[int]:
         return latest_step(self.directory)
@@ -61,7 +88,7 @@ class ShardedCheckpointer:
         arrays).  ``host=False`` keeps each leaf's sharding (the live
         mesh layout); ``host=True`` restores unsharded host arrays (the
         resume-into-model path)."""
-        self._ckpt.wait_until_finished()
+        self._drain()
 
         def abstract(a):
             kw = {}
@@ -73,7 +100,7 @@ class ShardedCheckpointer:
         return self._ckpt.restore(self._path(step), like_abs)
 
     def close(self):
-        self._ckpt.wait_until_finished()
+        self._drain()
 
 
 def _is_finalized(path: str) -> bool:
@@ -108,3 +135,97 @@ def latest_step(directory: str) -> Optional[int]:
     except OSError:
         return None
     return best
+
+
+# ---------------------------------------------------------------------------
+# per-step crc32c manifests (resilience: detect bit rot / torn shards)
+# ---------------------------------------------------------------------------
+
+def _step_files(step_dir: str) -> Dict[str, str]:
+    """relpath → absolute path for every regular file under a step."""
+    out = {}
+    for root, _dirs, files in os.walk(step_dir):
+        for f in files:
+            p = os.path.join(root, f)
+            out[os.path.relpath(p, step_dir)] = p
+    return out
+
+
+def _manifest_path(directory: str, step: int) -> str:
+    return os.path.join(
+        directory, f"{ShardedCheckpointer.MANIFEST_PREFIX}{step}.json")
+
+
+def write_manifest(directory: str, step: int) -> Optional[str]:
+    """Hash every file of committed step ``step`` into
+    ``manifest-<step>.json`` (written atomically).  Returns the manifest
+    path, or None when the step directory does not exist."""
+    from ..resilience.checkpoint import stream_crc32c
+
+    step_dir = os.path.join(directory,
+                            f"{ShardedCheckpointer.PREFIX}{step}")
+    if not os.path.isdir(step_dir):
+        return None
+    entries = {}
+    for rel, p in sorted(_step_files(step_dir).items()):
+        crc, size = stream_crc32c(p)
+        entries[rel] = [crc, size]
+    mp = _manifest_path(directory, step)
+    tmp = f"{mp}.tmp-{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump({"step": step, "files": entries}, f)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, mp)
+    return mp
+
+
+def verify_step(directory: str, step: int) -> Optional[bool]:
+    """Check step ``step``'s files against its manifest.  True: all
+    crcs+sizes match.  False: mismatch or missing file — the step is
+    corrupt.  None: no manifest (legacy step or crash before the drain
+    that writes it) — unverifiable; callers keep the old behavior."""
+    from ..resilience.checkpoint import stream_crc32c
+
+    mp = _manifest_path(directory, step)
+    if not os.path.exists(mp):
+        return None
+    try:
+        with open(mp) as f:
+            manifest = json.load(f)["files"]
+    except (OSError, ValueError, KeyError):
+        return None  # unreadable manifest: unverifiable, not corrupt
+    step_dir = os.path.join(directory,
+                            f"{ShardedCheckpointer.PREFIX}{step}")
+    for rel, (crc, size) in manifest.items():
+        p = os.path.join(step_dir, rel)
+        try:
+            if stream_crc32c(p) != (crc, size):
+                return False
+        except OSError:
+            return False  # file vanished or unreadable
+    return True
+
+
+def quarantine_step(directory: str, step: int) -> Optional[str]:
+    """Move a corrupt step out of the restore set:
+    ``ckpt-N`` → ``ckpt-N.corrupt`` (with its manifest and meta
+    sidecars).  The renamed directory no longer matches the step
+    pattern, so latest_step/restore never see it again."""
+    step_dir = os.path.join(directory,
+                            f"{ShardedCheckpointer.PREFIX}{step}")
+    dst = step_dir + ".corrupt"
+    try:
+        os.replace(step_dir, dst)
+    except OSError as e:
+        log.warning("could not quarantine %s: %s", step_dir, e)
+        return None
+    for sidecar in (_manifest_path(directory, step),
+                    os.path.join(directory, f"meta-{step}.pkl")):
+        if os.path.exists(sidecar):
+            try:
+                os.replace(sidecar, sidecar + ".corrupt")
+            except OSError:
+                pass
+    log.warning("quarantined corrupt checkpoint step %d -> %s", step, dst)
+    return dst

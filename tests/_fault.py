@@ -1,21 +1,3 @@
-"""Shared fault-injection transformer (reference ExceptionTest module,
-SURVEY §4.5) for the driver retry tests."""
-from bigdl_tpu.dataset.transformer import Transformer
-
-
-class ExceptionTransformer(Transformer):
-    """Raises once when the ``fail_at``-th record passes through;
-    ``fired`` records that the fault actually triggered."""
-
-    def __init__(self, fail_at: int):
-        self.fail_at = fail_at
-        self.count = 0
-        self.fired = False
-
-    def apply(self, it):
-        for item in it:
-            self.count += 1
-            if self.count == self.fail_at and not self.fired:
-                self.fired = True
-                raise RuntimeError("injected failure")
-            yield item
+"""Compat shim — the fault-injection API moved into the framework
+proper (bigdl_tpu/resilience/faults.py); import from there."""
+from bigdl_tpu.resilience.faults import ExceptionTransformer  # noqa: F401

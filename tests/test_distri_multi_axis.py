@@ -277,7 +277,7 @@ def test_multi_axis_retry_recovers_from_checkpoint(tmp_path):
     from bigdl_tpu.dataset import SampleToMiniBatch
     from bigdl_tpu.optim import several_iteration
 
-    from _fault import ExceptionTransformer
+    from bigdl_tpu.resilience.faults import ExceptionTransformer
 
     mesh = Mesh(np.array(jax.devices()[:8]).reshape(2, 4), ("data", "model"))
     # 8 iterations x batch 16 pull ~130+ records (with prefetch), so a

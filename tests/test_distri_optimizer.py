@@ -98,7 +98,7 @@ def test_allreduce_parameter_semantics():
     reduce-scatter of per-shard grads + all-gather reproduces psum."""
     from functools import partial
 
-    from jax import shard_map
+    from bigdl_tpu.utils.jax_compat import shard_map
     from jax.sharding import Mesh, PartitionSpec as P
 
     mesh = Mesh(np.array(jax.devices()), ("data",))
@@ -121,7 +121,7 @@ def test_allreduce_parameter_semantics():
 def test_bf16_compression_close():
     """bf16 wire format ≈ fp32 within bf16 tolerance (reference fp16
     codec round-trip spec)."""
-    from jax import shard_map
+    from bigdl_tpu.utils.jax_compat import shard_map
     from jax.sharding import Mesh, PartitionSpec as P
 
     mesh = Mesh(np.array(jax.devices()), ("data",))
@@ -146,7 +146,7 @@ def test_checkpoint_retry_recovers(tmp_path):
     so the host-visible fault surface is the input pipeline."""
     from bigdl_tpu.dataset import SampleToMiniBatch
 
-    from _fault import ExceptionTransformer
+    from bigdl_tpu.resilience.faults import ExceptionTransformer
 
     fault = ExceptionTransformer(fail_at=200)
     ds = array(xor_samples()) >> fault >> SampleToMiniBatch(64)
@@ -277,7 +277,9 @@ def test_trace_phase_split_classifies_collectives():
     """Unit: the xplane classifier separates psum/rendezvous events from
     compute on the 8-device CPU backend."""
     import jax.numpy as jnp
-    from jax import lax, shard_map
+    from jax import lax
+
+    from bigdl_tpu.utils.jax_compat import shard_map
     from jax.sharding import Mesh, PartitionSpec as P
 
     from bigdl_tpu.optim.profiling import trace_phase_split
