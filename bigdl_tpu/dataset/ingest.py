@@ -251,6 +251,11 @@ class SeqFileFolder(AbstractDataSet):
             return False
 
         def producer():
+            # EVERY exit path must leave the consumer unblockable:
+            # either the stop event is set (the consumer abandoned us —
+            # nothing to deliver) or a sentinel/exception goes into the
+            # queue.  A bare return without one would strand a consumer
+            # blocked in q.get() forever.
             try:
                 while not stop.is_set():
                     if train:
@@ -265,12 +270,32 @@ class SeqFileFolder(AbstractDataSet):
                         return
             except Exception as e:  # surface IO/corruption to the consumer
                 put_or_stop(e)
+            except BaseException as e:
+                # SystemExit & co. must not silently kill the thread
+                # (and must not be re-raised verbatim in the consumer,
+                # where SystemExit would take the whole process down)
+                put_or_stop(RuntimeError(
+                    f"ingest producer died: {type(e).__name__}: {e}"))
 
         thread = threading.Thread(target=producer, daemon=True)
         thread.start()
         try:
             while True:
-                recs = q.get()
+                # bounded get + liveness check: if the producer died
+                # without managing to deliver (it tries hard above),
+                # fail loudly instead of blocking forever — the
+                # abandonment-race guard on the consumer side
+                while True:
+                    if stop.is_set():
+                        return
+                    try:
+                        recs = q.get(timeout=0.5)
+                        break
+                    except queue.Empty:
+                        if not thread.is_alive() and q.empty():
+                            raise RuntimeError(
+                                "ingest producer thread died without "
+                                "delivering a result")
                 if recs is None:
                     return
                 if isinstance(recs, Exception):

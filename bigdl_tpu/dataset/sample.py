@@ -114,12 +114,12 @@ class SampleToMiniBatch(Transformer):
         for s in it:
             buf.append(s)
             if len(buf) == self.batch_size:
-                yield self._make(buf)
+                yield self.make(buf)
                 buf = []
         if buf and not self.drop_last:
-            yield self._make(buf)
+            yield self.make(buf)
 
-    def _make(self, buf: List[Sample]) -> MiniBatch:
+    def make(self, buf: List[Sample]) -> MiniBatch:
         multi_f = isinstance(buf[0].feature, (list, tuple))
         multi_l = isinstance(buf[0].label, (list, tuple))
         if multi_f:
@@ -135,6 +135,10 @@ class SampleToMiniBatch(Transformer):
         else:
             labels = _pad_stack([s.label for s in buf], self.label_padding_param)
         return MiniBatch(feats, labels)
+
+    #: compat alias — ``make`` is public API now (the eval/predict
+    #: drivers build tail batches directly); old callers keep working
+    _make = make
 
 
 SampleToBatch = SampleToMiniBatch  # reference Transformer.scala:136 alias

@@ -641,12 +641,17 @@ def capacity_bind_report(model, params, ids):
     return report
 
 
-def cached_generate(model, compute_dtype=None, kv_dtype=None):
+def cached_generate(model, compute_dtype=None, kv_dtype=None,
+                    max_len: Optional[int] = None):
     """The per-model compiled generator (built once per
-    (max_len, compute_dtype, kv_dtype) config, weakly cached)."""
-    cfg = (model.max_len, compute_dtype, kv_dtype)
+    (max_len, compute_dtype, kv_dtype) config, weakly cached).
+    ``max_len`` bounds the decode window below the model's positional
+    table (``_check_len`` validates it) — a serving config can cap
+    per-request work without rebuilding the model."""
+    cfg = (max_len or model.max_len, compute_dtype, kv_dtype)
     slot = _GEN_CACHE.setdefault(model, {})
     if cfg not in slot:
-        slot[cfg] = make_generate(model, compute_dtype=compute_dtype,
+        slot[cfg] = make_generate(model, max_len=max_len,
+                                  compute_dtype=compute_dtype,
                                   kv_dtype=kv_dtype)
     return slot[cfg]

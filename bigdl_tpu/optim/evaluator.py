@@ -117,10 +117,10 @@ def evaluate_dataset(model, dataset, v_methods: Sequence[ValidationMethod],
             else:
                 pending.append(item)
                 if len(pending) == batch_size:
-                    yield batcher._make(pending)
+                    yield batcher.make(pending)
                     pending = []
         if pending:
-            yield batcher._make(pending)
+            yield batcher.make(pending)
 
     for batch in batches():
         x = batch.get_input()

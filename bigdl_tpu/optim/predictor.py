@@ -35,10 +35,10 @@ class Predictor:
             else:
                 pending.append(item)
                 if len(pending) == batch_size:
-                    yield batcher._make(pending)
+                    yield batcher.make(pending)
                     pending = []
         if pending:
-            yield batcher._make(pending)
+            yield batcher.make(pending)
 
     def predict(self, dataset, batch_size: int = 32) -> List[np.ndarray]:
         """RDD[Activity] analogue: list of per-sample outputs."""
@@ -55,9 +55,15 @@ class Predictor:
         for batch in self._batches(dataset, batch_size):
             x = jax.tree_util.tree_map(jnp.asarray, batch.get_input())
             size = batch.size()
-            padded = size % n_dev != 0
-            if padded:  # static-shape contract over the mesh
-                x, _, _ = pad_batch(x, (), size, round_up(size, n_dev))
+            # static-shape contract: the tail pads up to the FULL
+            # batch_size bucket (not just the mesh multiple) — every
+            # distinct tail size would otherwise trace its own XLA
+            # executable, one compile per dataset-length remainder
+            target = round_up(batch_size if size < batch_size else size,
+                              n_dev)
+            padded = size != target
+            if padded:
+                x, _, _ = pad_batch(x, (), size, target)
             out = fwd(params, buffers, x)
             if padded:
                 out = jax.tree_util.tree_map(lambda a: a[:size], out)

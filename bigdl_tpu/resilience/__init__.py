@@ -28,12 +28,13 @@ from .guards import LossSpikeDetector, tree_finite, where_tree
 from .retry import (FatalTrainingError, LossSpikeError, RetryPolicy,
                     classify_error)
 from .preemption import PreemptionHandler, request_preemption
-from .checkpoint import (quarantine, verify_file, verify_and_load_latest,
-                         write_sidecar)
+from .checkpoint import (CorruptCheckpointError, quarantine, verified_load,
+                         verify_file, verify_and_load_latest, write_sidecar)
 
 __all__ = [
     "LossSpikeDetector", "tree_finite", "where_tree",
     "FatalTrainingError", "LossSpikeError", "RetryPolicy", "classify_error",
     "PreemptionHandler", "request_preemption",
-    "quarantine", "verify_file", "verify_and_load_latest", "write_sidecar",
+    "CorruptCheckpointError", "quarantine", "verified_load", "verify_file",
+    "verify_and_load_latest", "write_sidecar",
 ]

@@ -121,6 +121,35 @@ def quarantine(path: str) -> str:
     return dst
 
 
+class CorruptCheckpointError(IOError):
+    """A specifically-requested checkpoint failed crc32c verification
+    or would not unpickle.  Unlike the walk-back restore (which falls
+    back to an older file), a caller naming ONE file — e.g. the serving
+    hot-swap loading candidate params — has no older file to fall back
+    to, so the corruption surfaces as this typed error."""
+
+
+def verified_load(path: str) -> Any:
+    """Verify ``path`` against its sidecar and unpickle it — the
+    single-file counterpart of :func:`verify_and_load_latest`.  A crc
+    mismatch quarantines the file and raises
+    :class:`CorruptCheckpointError`; a missing sidecar (legacy file)
+    still attempts the unpickle, which catches gross truncation."""
+    from ..utils import file_io
+
+    if verify_file(path) is False:
+        quarantine(path)
+        raise CorruptCheckpointError(
+            f"{path} failed crc32c verification (quarantined)")
+    try:
+        return file_io.load(path)
+    except (KeyboardInterrupt, SystemExit):
+        raise
+    except Exception as e:
+        raise CorruptCheckpointError(
+            f"{path} failed to load ({type(e).__name__}: {e})")
+
+
 # ---------------------------------------------------------------------------
 # walk-back restore
 # ---------------------------------------------------------------------------

@@ -184,3 +184,93 @@ def io_faults(substr: str, times: int = 1, exc_type=OSError):
     finally:
         with _IO_LOCK:
             _IO_FAULTS.remove(entry)
+
+
+# ---------------------------------------------------------------------------
+# serving faults
+# ---------------------------------------------------------------------------
+# The serving worker calls check_serving_fault() immediately before each
+# compiled step, so every breaker / shedding / drain behavior is
+# deterministically testable on the CPU backend — the serving analogue
+# of the data-plane injectors above (under XLA the step itself can only
+# throw at trace time, so the injection point is the host-side dispatch).
+
+_SERVING_LOCK = threading.Lock()
+_SERVING_FAULTS: list = []  # [dict(kind, remaining, exc_type|seconds, fired)]
+
+
+def check_serving_fault():
+    """Called by the serving worker before each batch step: applies the
+    injected latency, then raises the injected failure while its budget
+    lasts.  No-op (and free) when nothing is registered."""
+    if not _SERVING_FAULTS:
+        return
+    delay = 0.0
+    boom = None
+    with _SERVING_LOCK:
+        for f in _SERVING_FAULTS:
+            if f["remaining"] <= 0:
+                continue
+            if f["kind"] == "latency":
+                f["remaining"] -= 1
+                f["fired"] += 1
+                delay += f["seconds"]
+            elif boom is None:
+                f["remaining"] -= 1
+                f["fired"] += 1
+                boom = f["exc_type"](
+                    f"injected serving step failure "
+                    f"({f['remaining']} left)")
+    if delay > 0:
+        import time
+
+        time.sleep(delay)
+    if boom is not None:
+        raise boom
+
+
+@contextlib.contextmanager
+def serving_step_failures(times: int = 1, exc_type=RuntimeError):
+    """Fail the next ``times`` serving batch steps with ``exc_type``
+    (classified by the server's RetryPolicy: a retryable type counts
+    toward the breaker threshold, a fatal one trips it immediately)."""
+    entry = {"kind": "fail", "remaining": int(times),
+             "exc_type": exc_type, "fired": 0}
+    with _SERVING_LOCK:
+        _SERVING_FAULTS.append(entry)
+    try:
+        yield entry
+    finally:
+        with _SERVING_LOCK:
+            _SERVING_FAULTS.remove(entry)
+
+
+@contextlib.contextmanager
+def serving_step_latency(seconds: float, times: int = 1 << 30):
+    """Add ``seconds`` of host-side latency to the next ``times``
+    serving batch steps — drives deadline-expiry and queue-depth
+    behaviors without a slow model."""
+    entry = {"kind": "latency", "remaining": int(times),
+             "seconds": float(seconds), "fired": 0}
+    with _SERVING_LOCK:
+        _SERVING_FAULTS.append(entry)
+    try:
+        yield entry
+    finally:
+        with _SERVING_LOCK:
+            _SERVING_FAULTS.remove(entry)
+
+
+def poison_params(tree):
+    """A NaN-poisoned copy of a param tree (every float leaf) — the
+    hot-swap canary must reject it and roll back."""
+    import jax
+    import jax.numpy as jnp
+
+    def _poison(leaf):
+        a = jnp.asarray(leaf)
+        if jnp.issubdtype(a.dtype, jnp.floating):
+            return jnp.full_like(a, jnp.nan)
+        return a
+
+    return jax.tree_util.tree_map(_poison, tree)

@@ -39,19 +39,36 @@ class PreemptionHandler:
     ``signal.signal`` is unavailable): the process-wide
     :func:`request_preemption` flag still works."""
 
-    def __init__(self, signals=(signal.SIGTERM, signal.SIGINT)):
+    def __init__(self, signals=(signal.SIGTERM, signal.SIGINT),
+                 on_request=None):
         self.signals = tuple(signals)
         self._old = {}
         self._requested = False
         self.installed = False
+        #: optional callback fired the instant a stop is requested
+        #: (from the signal handler or request()) — lets embedders
+        #: that poll between steps ALSO react immediately, e.g. the
+        #: serving readiness probe flipping unready on SIGTERM before
+        #: the worker reaches its next batch boundary.  Must be
+        #: async-signal-safe-ish: set a flag, don't do work.
+        self._on_request = on_request
 
     # ------------------------------------------------------------------
+    def _notify(self):
+        if self._on_request is None:
+            return
+        try:
+            self._on_request()
+        except Exception:  # a broken callback must not mask the signal
+            log.exception("preemption on_request callback failed")
+
     def _on_signal(self, signum, frame):
         if self._requested and signum == signal.SIGINT:
             raise KeyboardInterrupt
         self._requested = True
         log.warning("received signal %d — will checkpoint at the next "
                     "step boundary and exit resumable", signum)
+        self._notify()
 
     @property
     def should_stop(self) -> bool:
@@ -59,6 +76,7 @@ class PreemptionHandler:
 
     def request(self):
         self._requested = True
+        self._notify()
 
     # ------------------------------------------------------------------
     def __enter__(self):

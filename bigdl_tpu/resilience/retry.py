@@ -91,20 +91,24 @@ class RetryPolicy:
         self._rng = random.Random(seed)
 
     @classmethod
-    def from_properties(cls, **overrides) -> "RetryPolicy":
-        """Build from ``bigdl.failure.*`` properties (compat aliases
+    def from_properties(cls, prefix: str = "bigdl.failure",
+                        **overrides) -> "RetryPolicy":
+        """Build from ``<prefix>.*`` properties (compat aliases
         ``retryTimes``/``retryTimeInterval`` plus the new backoff
-        knobs); explicit ``overrides`` win."""
+        knobs); explicit ``overrides`` win.  The training loop reads
+        ``bigdl.failure.*``; the serving path passes
+        ``prefix="bigdl.serving"`` so its classification/backoff knobs
+        tune independently of the trainer's."""
         from ..utils.engine import get_property
 
         kw = dict(
-            max_retries=int(get_property("bigdl.failure.retryTimes", 5)),
-            window=float(get_property("bigdl.failure.retryTimeInterval",
+            max_retries=int(get_property(f"{prefix}.retryTimes", 5)),
+            window=float(get_property(f"{prefix}.retryTimeInterval",
                                       120)),
-            backoff_base=float(get_property("bigdl.failure.backoffBase",
+            backoff_base=float(get_property(f"{prefix}.backoffBase",
                                             0.1)),
-            backoff_max=float(get_property("bigdl.failure.backoffMax", 30)),
-            jitter=float(get_property("bigdl.failure.jitter", 0.1)),
+            backoff_max=float(get_property(f"{prefix}.backoffMax", 30)),
+            jitter=float(get_property(f"{prefix}.jitter", 0.1)),
         )
         kw.update(overrides)
         return cls(**kw)

@@ -1,0 +1,50 @@
+"""Hardened online serving subsystem.
+
+The offline surface (``optim.Predictor`` walking a dataset,
+``models.generate`` as a library call) serves nobody under live
+traffic: the first bad request, stuck device, or queue pile-up takes
+the whole process down.  This package is the serving-side counterpart
+of :mod:`bigdl_tpu.resilience` — the same discipline (typed failure
+classification, preemption hooks, deterministic fault injection,
+verified checkpoints) applied to an in-process request path:
+
+* :mod:`.server`  — :class:`InferenceServer`: bounded request queue +
+  a worker thread that coalesces requests into **static bucket
+  shapes** (continuous micro-batching through the same cached compiled
+  eval forward the Predictor uses, and the KV-cache decode generator
+  for token generation), so variable traffic never triggers a
+  recompile.  SIGTERM (via :mod:`bigdl_tpu.resilience.preemption`)
+  stops admission, finishes everything already admitted, and exits
+  cleanly.
+* :mod:`.status`  — the status taxonomy: every request resolves to a
+  :class:`ServeResult` (``OK`` / ``DEADLINE_EXCEEDED`` / ``OVERLOADED``
+  / ``UNAVAILABLE`` / ``INTERNAL_ERROR`` / ``CANCELLED``) — never a
+  silent drop, never an unbounded wait.
+* :mod:`.breaker` — :class:`CircuitBreaker` around the compiled step:
+  consecutive failures (classified retryable vs fatal by
+  :class:`bigdl_tpu.resilience.retry.RetryPolicy`) trip it open; while
+  open the server rejects fast instead of crashing; a half-open probe
+  admits one batch to test recovery.
+* :mod:`.batcher` — :class:`MicroBatcher`: bucket ladder + tail
+  padding (``optim._sharding_utils.pad_batch``) + compile accounting.
+* :mod:`.swap`    — hot model swap: new params load through the
+  crc32c-verified checkpoint path, pass a canary batch, and swap
+  atomically between batches — rolling back if the canary fails.
+* :mod:`.metrics` — per-request counters + latency quantiles
+  (p50/p99), exported through ``visualization.summary``.
+
+Deterministic serving fault injectors (fail-next-N steps, injected
+step latency, poisoned params) live with the training injectors in
+:mod:`bigdl_tpu.resilience.faults`.
+"""
+from .batcher import MicroBatcher
+from .breaker import CircuitBreaker
+from .metrics import ServingMetrics
+from .server import InferenceServer
+from .status import ServeFuture, ServeResult, Status
+from .swap import load_verified_params
+
+__all__ = [
+    "CircuitBreaker", "InferenceServer", "MicroBatcher", "ServeFuture",
+    "ServeResult", "ServingMetrics", "Status", "load_verified_params",
+]

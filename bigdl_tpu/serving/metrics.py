@@ -1,0 +1,98 @@
+"""Per-request serving metrics: counters + latency quantiles.
+
+Counts every terminal status (a shed request increments ``shed`` and
+nothing else — never a silent drop), tracks queue depth at admission,
+and keeps a bounded window of per-request latencies for p50/p99.
+``to_summary`` exports the snapshot through the tensorboard-compatible
+``visualization.summary`` writer so serving health lands next to the
+training curves.
+"""
+from __future__ import annotations
+
+import threading
+from collections import deque
+from typing import Dict, Optional
+
+import numpy as np
+
+from .status import Status
+
+#: latency window — big enough for stable p99, bounded so a long-lived
+#: server never grows without limit
+_WINDOW = 8192
+
+
+class ServingMetrics:
+    def __init__(self, window: int = _WINDOW):
+        self._lock = threading.Lock()
+        self._lat = deque(maxlen=window)       # OK latencies (seconds)
+        self._queued = deque(maxlen=window)    # OK queued portions
+        self._depth = deque(maxlen=window)     # queue depth at admission
+        self.counts: Dict[str, int] = {s.value: 0 for s in Status}
+        self.batches = 0
+        self.padded_rows = 0
+        self.swaps = 0
+        self.swap_rollbacks = 0
+
+    # ------------------------------------------------------------------
+    def record(self, status: Status, latency_s: float = 0.0,
+               queued_s: float = 0.0):
+        with self._lock:
+            self.counts[status.value] += 1
+            if status is Status.OK:
+                self._lat.append(latency_s)
+                self._queued.append(queued_s)
+
+    def record_depth(self, depth: int):
+        with self._lock:
+            self._depth.append(depth)
+
+    def record_batch(self, real: int, bucket: int):
+        with self._lock:
+            self.batches += 1
+            self.padded_rows += bucket - real
+
+    # ------------------------------------------------------------------
+    def _pct(self, q: float) -> Optional[float]:
+        return float(np.percentile(self._lat, q)) if self._lat else None
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            ok = self.counts[Status.OK.value]
+            total = sum(self.counts.values())
+            return {
+                "served_ok": ok,
+                "total": total,
+                "shed": self.counts[Status.OVERLOADED.value],
+                "deadline_exceeded":
+                    self.counts[Status.DEADLINE_EXCEEDED.value],
+                "unavailable": self.counts[Status.UNAVAILABLE.value],
+                "internal_error":
+                    self.counts[Status.INTERNAL_ERROR.value],
+                "cancelled": self.counts[Status.CANCELLED.value],
+                "shed_rate": (self.counts[Status.OVERLOADED.value]
+                              / total) if total else 0.0,
+                "latency_p50_s": self._pct(50),
+                "latency_p99_s": self._pct(99),
+                "queued_mean_s": (float(np.mean(self._queued))
+                                  if self._queued else None),
+                "queue_depth_mean": (float(np.mean(self._depth))
+                                     if self._depth else None),
+                "queue_depth_max": (int(max(self._depth))
+                                    if self._depth else 0),
+                "batches": self.batches,
+                "padded_rows": self.padded_rows,
+                "swaps": self.swaps,
+                "swap_rollbacks": self.swap_rollbacks,
+            }
+
+    def to_summary(self, summary, step: int):
+        """Write the snapshot's numeric fields as scalar events (tags
+        ``serving/<field>``) through a ``visualization.summary.Summary``
+        (e.g. :class:`~bigdl_tpu.visualization.summary.ServingSummary`).
+        """
+        for key, val in self.snapshot().items():
+            if val is None:
+                continue
+            summary.add_scalar(f"serving/{key}", float(val), step)
+        return summary

@@ -1,0 +1,528 @@
+"""In-process inference server — the hardened online request path.
+
+One worker thread owns the device: it pulls admitted requests off a
+bounded queue, coalesces them into static bucket shapes
+(:class:`.batcher.MicroBatcher`), and dispatches ONE compiled program
+per batch — classification through the same cached compiled eval
+forward the Predictor uses (``optim.evaluator._cached_eval_fwd``,
+shard_mapped when a mesh is given), token generation through the
+KV-cache decode generator (``models.generate.cached_generate``).
+Requests never touch the device individually and the device never
+sees a shape it hasn't seen before — variable traffic changes *which
+bucket* runs, not *what compiles*.
+
+Request lifecycle (every path ends in a typed
+:class:`~.status.ServeResult`; nothing hangs, nothing drops silently)::
+
+    submit ──► admission ──► queue ──► batch ──► compiled step ──► OK
+                  │            │         │            │
+                  │ full       │ expired │ breaker    │ step raised
+                  ▼            ▼         ▼ open       ▼
+              OVERLOADED   DEADLINE_  UNAVAILABLE  INTERNAL_ERROR
+              (shed)       EXCEEDED   (reject fast) (+ breaker count)
+
+Failures at the step are classified retryable-vs-fatal by the
+:class:`resilience.retry.RetryPolicy`; consecutive failures trip the
+:class:`.breaker.CircuitBreaker` open (fatal ones immediately), a
+half-open probe admits one request to test recovery, and while open
+the server degrades to fast UNAVAILABLE rejections instead of
+crashing.  SIGTERM (or ``resilience.preemption.request_preemption()``)
+stops admission, finishes everything already admitted, and exits the
+worker cleanly; a hard ``stop()`` resolves still-queued requests as
+CANCELLED.  New params install atomically between batches via
+:meth:`InferenceServer.swap_params` (crc32c-verified load + canary
+batch + rollback — see :mod:`.swap`).
+"""
+from __future__ import annotations
+
+import collections
+import logging
+import threading
+import time
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..resilience import faults as _faults
+from ..resilience.guards import tree_finite
+from ..resilience.preemption import PreemptionHandler
+from ..resilience.retry import RetryPolicy
+from .batcher import MicroBatcher
+from .breaker import OPEN, PROBE, REJECT, CircuitBreaker
+from .metrics import ServingMetrics
+from .status import Request, ServeFuture, ServeResult, Status
+from .swap import SwapRejected, load_verified_params
+
+log = logging.getLogger("bigdl_tpu")
+
+
+class _BoundedQueue:
+    """Deque + condition: reject-fast ``try_put``, front requeue for
+    the breaker's half-open probe leftovers, and atomic drain."""
+
+    def __init__(self, maxsize: int):
+        self.maxsize = int(maxsize)
+        self._d: collections.deque = collections.deque()
+        self._lock = threading.Lock()
+        self._not_empty = threading.Condition(self._lock)
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._d)
+
+    def try_put(self, item) -> bool:
+        with self._lock:
+            if len(self._d) >= self.maxsize:
+                return False
+            self._d.append(item)
+            self._not_empty.notify()
+            return True
+
+    def put_front(self, items) -> None:
+        """Requeue in original order ahead of newer arrivals (bound
+        intentionally not enforced — these were already admitted)."""
+        with self._lock:
+            for item in reversed(list(items)):
+                self._d.appendleft(item)
+            self._not_empty.notify()
+
+    def get(self, timeout: float):
+        with self._lock:
+            if not self._d:
+                self._not_empty.wait(timeout)
+            return self._d.popleft() if self._d else None
+
+    def get_nowait(self):
+        with self._lock:
+            return self._d.popleft() if self._d else None
+
+    def drain_all(self) -> list:
+        with self._lock:
+            items = list(self._d)
+            self._d.clear()
+            return items
+
+
+class InferenceServer:
+    """See the module docstring for the full request lifecycle.
+
+    Parameters
+    ----------
+    model : the module to serve.  Classification rides its cached
+        compiled eval forward; ``submit_generate`` additionally
+        requires a ``TransformerLM``.
+    mesh : optional Mesh — the forward shard_maps over its data axis
+        (bucket sizes are rounded to the axis size).
+    max_batch : largest micro-batch (top of the bucket ladder).
+    max_queue : admission bound; a full queue sheds with OVERLOADED.
+    batch_window_s : how long the worker waits to coalesce more
+        requests after the first one arrives.
+    default_deadline_s : per-request deadline when ``submit`` gives
+        none (``None`` = no deadline).
+    breaker / policy / metrics : injectable for tests; defaults are a
+        3-failure threshold breaker and ``RetryPolicy.from_properties``
+        classification.
+    generate_dtype : compute dtype for the generation path (e.g.
+        ``jnp.bfloat16``); ``None`` serves in the params' dtype.
+    """
+
+    def __init__(self, model, mesh=None, max_batch: int = 32,
+                 max_queue: int = 256, batch_window_s: float = 0.002,
+                 default_deadline_s: Optional[float] = None,
+                 breaker: Optional[CircuitBreaker] = None,
+                 policy: Optional[RetryPolicy] = None,
+                 metrics: Optional[ServingMetrics] = None,
+                 generate_dtype=None):
+        from ..optim._sharding_utils import data_mesh
+
+        self.model = model
+        self.mesh = data_mesh(mesh)
+        self._n_dev = self.mesh.shape["data"] if self.mesh is not None \
+            else 1
+        self.batcher = MicroBatcher(max_batch, multiple=self._n_dev)
+        self.metrics = metrics or ServingMetrics()
+        self.breaker = breaker or CircuitBreaker()
+        self.policy = policy or RetryPolicy.from_properties(
+            prefix="bigdl.serving")
+        self.generate_dtype = generate_dtype
+        self._queue = _BoundedQueue(max_queue)
+        self._batch_window_s = float(batch_window_s)
+        self._default_deadline_s = default_deadline_s
+        self._poll_s = 0.02
+
+        self._model_lock = threading.Lock()
+        self._params = model.param_tree()
+        self._buffers = model.buffer_tree()
+        self._canary_x = None  # last good classify batch (padded)
+
+        self._feature_shape = None  # pinned by the first classify submit
+        self._worker: Optional[threading.Thread] = None
+        self._started = False
+        self._draining = False
+        self._hard_stop = False
+        self._drained = threading.Event()
+        self._preemption: Optional[PreemptionHandler] = None
+        self._fwd = None
+
+    # ------------------------------------------------------------ lifecycle
+    def start(self, install_signal_handler: bool = False
+              ) -> "InferenceServer":
+        """Compile-cache the eval forward and start the worker.
+        ``install_signal_handler=True`` additionally routes SIGTERM/
+        SIGINT to a graceful drain (main thread only; off the main
+        thread the process-wide ``request_preemption()`` flag still
+        drains — PreemptionHandler's degrade contract)."""
+        if self._started:
+            raise RuntimeError("server already started")
+        from ..optim.evaluator import _cached_eval_fwd
+
+        self.model.evaluate()
+        self._fwd = _cached_eval_fwd(self.model, self.mesh)
+        # on_request flips readiness the instant the signal lands (the
+        # worker would only notice at its next batch boundary)
+        signals = None if install_signal_handler else ()
+        self._preemption = PreemptionHandler(
+            **({} if signals is None else {"signals": signals}),
+            on_request=self._note_drain)
+        self._preemption.__enter__()
+        self._started = True
+        self._draining = False
+        self._hard_stop = False
+        self._drained.clear()
+        self._worker = threading.Thread(target=self._run, daemon=True,
+                                        name="bigdl-serving-worker")
+        self._worker.start()
+        return self
+
+    def drain(self, timeout: Optional[float] = None) -> bool:
+        """Graceful shutdown: stop admission, finish everything already
+        admitted, then stop the worker.  Returns True when the worker
+        exited within ``timeout``."""
+        self._draining = True
+        done = self._drained.wait(timeout) if self._worker else True
+        if self._worker is not None:
+            self._worker.join(timeout)
+            done = done and not self._worker.is_alive()
+        if self._preemption is not None:
+            self._preemption.__exit__(None, None, None)
+            self._preemption = None
+        self._started = False
+        return done
+
+    def stop(self, timeout: Optional[float] = None) -> bool:
+        """Hard shutdown: still-queued requests resolve CANCELLED (the
+        in-flight batch, if any, completes first — the device step is
+        not interruptible)."""
+        self._hard_stop = True
+        return self.drain(timeout)
+
+    # ------------------------------------------------------------ health
+    def healthy(self) -> bool:
+        """Liveness: the worker thread is running."""
+        return bool(self._started and self._worker
+                    and self._worker.is_alive())
+
+    def ready(self) -> bool:
+        """Readiness: accepting requests with headroom — started, not
+        draining, breaker not open, queue below its bound."""
+        return (self.healthy() and not self._draining
+                and not self._should_drain()
+                and self.breaker.state != OPEN
+                and len(self._queue) < self._queue.maxsize)
+
+    def health(self) -> dict:
+        return {
+            "healthy": self.healthy(),
+            "ready": self.ready(),
+            "draining": bool(self._draining or self._should_drain()),
+            "queue_depth": len(self._queue),
+            "breaker": self.breaker.snapshot(),
+        }
+
+    def compile_stats(self) -> dict:
+        """Compile accounting for the static-shape contract: the jit
+        cache of the shared eval forward may hold at most one entry per
+        (bucket, feature-shape) ever dispatched."""
+        cache_size = None
+        if self._fwd is not None and hasattr(self._fwd, "_cache_size"):
+            cache_size = int(self._fwd._cache_size())
+        return {
+            "jit_cache_size": cache_size,
+            "buckets_dispatched":
+                sorted(self.batcher.buckets_dispatched),
+        }
+
+    # ------------------------------------------------------------ admission
+    def _admit(self, req: Request) -> ServeFuture:
+        now = time.monotonic()
+        if not self._started or self._draining or self._should_drain():
+            self._resolve(req, ServeResult(
+                Status.UNAVAILABLE,
+                error="server draining" if self._started
+                else "server not started"))
+            return req.future
+        if req.expired(now):
+            self._resolve(req, ServeResult(
+                Status.DEADLINE_EXCEEDED, error="expired on arrival"))
+            return req.future
+        self.metrics.record_depth(len(self._queue))
+        if not self._queue.try_put(req):
+            # load shedding: reject fast, count it, never queue forever
+            self._resolve(req, ServeResult(
+                Status.OVERLOADED,
+                error=f"queue full ({self._queue.maxsize})"))
+        return req.future
+
+    def _deadline(self, deadline_s: Optional[float],
+                  now: float) -> Optional[float]:
+        if deadline_s is None:
+            deadline_s = self._default_deadline_s
+        return None if deadline_s is None else now + float(deadline_s)
+
+    def submit(self, feature,
+               deadline_s: Optional[float] = None) -> ServeFuture:
+        """One classification/regression request: ``feature`` is a
+        single record (no batch dim); the result's ``output`` is the
+        model's output row for it."""
+        feature = np.asarray(feature)
+        # shape-check at admission: one malformed request must fail ITS
+        # caller synchronously, not poison whole batches (and trip the
+        # breaker) once coalesced
+        if self._feature_shape is None:
+            self._feature_shape = feature.shape
+        elif feature.shape != self._feature_shape:
+            raise ValueError(
+                f"feature shape {feature.shape} does not match this "
+                f"server's pinned shape {self._feature_shape}")
+        now = time.monotonic()
+        return self._admit(Request(
+            kind="classify", payload=feature,
+            future=ServeFuture(), submitted_at=now,
+            deadline=self._deadline(deadline_s, now)))
+
+    def submit_generate(self, prompt_ids, max_new: int,
+                        eos_id: Optional[int] = None,
+                        pad_id: Optional[int] = None,
+                        deadline_s: Optional[float] = None) -> ServeFuture:
+        """One greedy-decode generation request; the result's
+        ``output`` is the generated id row (``max_new`` tokens,
+        eos-then-pad per ``models.generate``).  Requests are micro-
+        batched with others sharing (prompt_len, max_new, eos, pad) —
+        the compiled decode program's static signature."""
+        prompt = np.asarray(prompt_ids, np.int32)
+        if prompt.ndim != 1:
+            raise ValueError(f"prompt_ids must be 1-D, got shape "
+                             f"{prompt.shape}")
+        if max_new < 1:
+            raise ValueError(f"max_new must be >= 1, got {max_new}")
+        now = time.monotonic()
+        return self._admit(Request(
+            kind="generate", payload=prompt, future=ServeFuture(),
+            submitted_at=now, deadline=self._deadline(deadline_s, now),
+            opts=(int(max_new), eos_id, pad_id)))
+
+    # ------------------------------------------------------------ hot swap
+    def swap_params(self, params: Any = None, path: Optional[str] = None,
+                    buffers: Any = None) -> bool:
+        """Install new params atomically between batches.
+
+        ``path`` loads through the crc32c-verified checkpoint path
+        (:func:`.swap.load_verified_params`); corrupt files quarantine
+        and the swap is refused.  Candidates then face a canary batch
+        on the live compiled forward (the last good batch's input; a
+        params-finiteness check before any traffic has flowed) — a
+        canary that raises or emits non-finite outputs raises
+        :class:`SwapRejected` and the server keeps serving the prior
+        params.  Returns True on install."""
+        if (params is None) == (path is None):
+            raise ValueError("pass exactly one of params/path")
+        try:
+            if path is not None:
+                params = load_verified_params(path)
+            with self._model_lock:
+                canary = self._canary_x
+                bufs = buffers if buffers is not None else self._buffers
+            if canary is not None and self._fwd is not None:
+                out = self._fwd(params, bufs, canary)
+                if not bool(tree_finite(out)):
+                    raise SwapRejected(
+                        "canary batch produced non-finite outputs")
+            elif not bool(tree_finite(params)):
+                raise SwapRejected("candidate params are non-finite")
+        except SwapRejected:
+            self.metrics.swap_rollbacks += 1
+            raise
+        except (KeyboardInterrupt, SystemExit):
+            raise
+        except Exception as e:
+            self.metrics.swap_rollbacks += 1
+            raise SwapRejected(f"canary batch failed "
+                               f"({type(e).__name__}: {e})")
+        with self._model_lock:
+            self._params = params
+            if buffers is not None:
+                self._buffers = buffers
+        self.metrics.swaps += 1
+        log.info("serving params hot-swapped%s",
+                 f" from {path}" if path else "")
+        return True
+
+    # ------------------------------------------------------------ worker
+    def _note_drain(self):
+        self._draining = True
+
+    def _should_drain(self) -> bool:
+        return self._preemption is not None \
+            and self._preemption.should_stop
+
+    def _resolve(self, req: Request, result: ServeResult):
+        now = time.monotonic()
+        result.latency_s = now - req.submitted_at
+        self.metrics.record(result.status, result.latency_s,
+                            result.queued_s)
+        req.future._resolve(result)
+
+    def _gather(self, limit: int) -> list:
+        """Block briefly for the first request, then coalesce whatever
+        arrives inside the batch window (continuous micro-batching:
+        the window bounds added latency, the ladder bounds compiles)."""
+        first = self._queue.get(timeout=self._poll_s)
+        if first is None:
+            return []
+        batch = [first]
+        window_end = time.monotonic() + self._batch_window_s
+        while len(batch) < limit:
+            remaining = window_end - time.monotonic()
+            nxt = self._queue.get_nowait() if remaining <= 0 else \
+                self._queue.get(timeout=remaining)
+            if nxt is None:
+                break
+            batch.append(nxt)
+        return batch
+
+    def _run(self):
+        try:
+            while True:
+                if self._hard_stop:
+                    break
+                if self._draining or self._should_drain():
+                    self._draining = True
+                    if len(self._queue) == 0:
+                        break
+                batch = self._gather(self.batcher.max_batch)
+                if not batch:
+                    continue
+                # expired-in-queue requests resolve typed, pre-device
+                now = time.monotonic()
+                live = []
+                for r in batch:
+                    if r.expired(now):
+                        self._resolve(r, ServeResult(
+                            Status.DEADLINE_EXCEEDED,
+                            error="deadline expired in queue",
+                            queued_s=now - r.submitted_at))
+                    else:
+                        live.append(r)
+                if not live:
+                    continue
+                verdict = self.breaker.acquire()
+                if verdict == REJECT:
+                    for r in live:
+                        self._resolve(r, ServeResult(
+                            Status.UNAVAILABLE,
+                            error="circuit breaker open"))
+                    continue
+                if verdict == PROBE and len(live) > 1:
+                    # half-open admits ONE request; the rest requeue
+                    # (ahead of newer arrivals) pending the verdict
+                    self._queue.put_front(live[1:])
+                    live = live[:1]
+                for kind, group in self._group(live):
+                    self._run_group(kind, group)
+        finally:
+            # hard stop (or a worker crash — nothing may hang): every
+            # queued request resolves
+            leftover = self._queue.drain_all()
+            for r in leftover:
+                self._resolve(r, ServeResult(
+                    Status.CANCELLED, error="server stopped"))
+            self._drained.set()
+
+    @staticmethod
+    def _group(reqs):
+        """Split a gathered batch into runnable groups: classify
+        requests coalesce together; generate requests group by their
+        compiled signature (prompt_len, opts)."""
+        groups: dict = {}
+        for r in reqs:
+            key = ("classify",) if r.kind == "classify" else \
+                ("generate", r.payload.shape[0], r.opts)
+            groups.setdefault(key, []).append(r)
+        for key, group in groups.items():
+            yield key[0], group
+
+    def _run_group(self, kind: str, reqs: list):
+        t_batch = time.monotonic()
+        queued = [t_batch - r.submitted_at for r in reqs]
+        with self._model_lock:
+            params, buffers = self._params, self._buffers
+        try:
+            _faults.check_serving_fault()
+            if kind == "classify":
+                x, bucket = self.batcher.coalesce(
+                    [r.payload for r in reqs])
+                xj = jnp.asarray(x)
+                out = self._fwd(params, buffers, xj)
+                # host transfer doubles as the execution barrier —
+                # device-side failures surface here, inside the try
+                out_np = jax.tree_util.tree_map(np.asarray, out)
+                with self._model_lock:
+                    self._canary_x = xj  # freshest known-good canary
+            else:
+                out_np, bucket = self._run_generate(params, reqs)
+        except (KeyboardInterrupt, SystemExit):
+            raise
+        except Exception as e:
+            fatal = self.policy.classify(e) == "fatal"
+            self.breaker.record_failure(fatal=fatal)
+            err = f"{type(e).__name__}: {e}"
+            log.warning("serving step failed (%s, %s): %s",
+                        "fatal" if fatal else "retryable",
+                        self.breaker.state, err)
+            for r, q in zip(reqs, queued):
+                self._resolve(r, ServeResult(
+                    Status.INTERNAL_ERROR, error=err, queued_s=q))
+            return
+        self.breaker.record_success()
+        self.metrics.record_batch(len(reqs), bucket)
+        for i, (r, q) in enumerate(zip(reqs, queued)):
+            self._resolve(r, ServeResult(
+                Status.OK, output=jax.tree_util.tree_map(
+                    lambda a: a[i], out_np),
+                queued_s=q, bucket=bucket))
+
+    def _run_generate(self, params, reqs):
+        """One compiled decode program per (bucket, prompt_len,
+        max_new): prompts stack along the batch dim and pad up to the
+        bucket by repeating the last row (same ladder as classify, so
+        generation traffic can't recompile per batch count either)."""
+        from ..models.generate import cached_generate
+
+        max_new, eos_id, pad_id = reqs[0].opts
+        prompts = np.stack([r.payload for r in reqs])
+        n = prompts.shape[0]
+        bucket = self.batcher.bucket_for(n)
+        if n < bucket:
+            prompts = np.concatenate(
+                [prompts, np.repeat(prompts[-1:], bucket - n, axis=0)],
+                axis=0)
+        self.batcher.buckets_dispatched.add(
+            ("gen", bucket, prompts.shape[1], max_new))
+        gen = cached_generate(self.model,
+                              compute_dtype=self.generate_dtype)
+        ids = gen(params, prompts, max_new, eos_id=eos_id,
+                  pad_id=pad_id)
+        out = np.asarray(ids)[:, prompts.shape[1]:]  # generated tail
+        return out, bucket

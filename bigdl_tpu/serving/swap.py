@@ -1,0 +1,44 @@
+"""Hot model swap: verified load, canary, atomic install, rollback.
+
+New parameters enter the serving path only through the crc32c-verified
+checkpoint machinery (:mod:`bigdl_tpu.resilience.checkpoint`) — a
+corrupt file quarantines and the swap is refused, exactly like
+training restore.  Loaded params then face a **canary batch** on the
+same compiled forward the live traffic uses; a canary that raises or
+emits non-finite outputs rolls the swap back, so poisoned params (the
+:func:`resilience.faults.poison_params` injector) can never reach a
+user request.  The install itself happens between batches under the
+server's model lock — in-flight batches finish on the old params,
+the next batch sees the new ones.
+"""
+from __future__ import annotations
+
+import logging
+from typing import Any
+
+from ..resilience.checkpoint import CorruptCheckpointError, verified_load
+
+log = logging.getLogger("bigdl_tpu")
+
+
+class SwapRejected(RuntimeError):
+    """The candidate params failed verification or the canary batch;
+    the server keeps serving the previous params."""
+
+
+def load_verified_params(path: str) -> Any:
+    """Load a checkpoint file for serving, refusing corrupt bytes.
+
+    The file must pass its crc32c sidecar check when one exists (a
+    mismatch quarantines it, like training restore — via
+    ``resilience.checkpoint.verified_load``); it must at least unpickle
+    either way.  Checkpoints written by the optimizer hold the whole
+    model object — those are unwrapped to their ``param_tree()``; a
+    pickled bare param tree passes through as-is."""
+    try:
+        obj = verified_load(path)
+    except CorruptCheckpointError as e:
+        raise SwapRejected(str(e))
+    if hasattr(obj, "param_tree"):
+        return obj.param_tree()
+    return obj

@@ -1,5 +1,5 @@
 from .crc32c import crc32c, masked_crc32c
 from .summary import (
-    Summary, TrainSummary, ValidationSummary, read_scalars,
+    ServingSummary, Summary, TrainSummary, ValidationSummary, read_scalars,
 )
 from .writer import EventWriter, FileWriter, RecordWriter

@@ -84,6 +84,17 @@ class ValidationSummary(Summary):
         super().__init__(log_dir, os.path.join(app_name, "validation"))
 
 
+class ServingSummary(Summary):
+    """Serving-path metrics stream (``<app>/serving``) — the export
+    target of ``serving.metrics.ServingMetrics.to_summary`` (per-
+    request p50/p99 latency, queue depth, shed/timeout/trip counts),
+    so serving health lands next to the train/validation curves in
+    the same tensorboard layout."""
+
+    def __init__(self, log_dir: str, app_name: str):
+        super().__init__(log_dir, os.path.join(app_name, "serving"))
+
+
 def read_scalars(log_dir: str, tag: str) -> List[Tuple[int, float]]:
     """Read scalar events back (reference tensorboard/FileReader —
     serves the python ``summary_read_scalar`` API)."""

@@ -63,7 +63,11 @@ def test_fallback_merges_persisted_tpu_numbers(tmp_path):
 
     env = dict(os.environ)
     env.update({"JAX_PLATFORMS": "cpu", "BENCH_PROBE_TIMEOUT": "30",
-                "BENCH_CPU_TIMEOUT": "3"})
+                "BENCH_CPU_TIMEOUT": "3",
+                # the serving leg is unit-tested in-process
+                # (test_serving_measurements_contract); skip its ~25s
+                # subprocess here
+                "BENCH_SERVING_TIMEOUT": "0"})
     out = subprocess.run(
         [sys.executable, "bench.py"], capture_output=True, text=True,
         timeout=300, cwd=".", env=env)
@@ -129,6 +133,29 @@ def test_salvage_partial_merges_with_provenance(monkeypatch, tmp_path):
     assert "transformerlm_mfu" in carried["keys"]
     assert carried["measured_at"] == "2026-07-30T06:09:44Z"
     assert "note" not in out and "stale" not in out    # bookkeeping dropped
+
+
+def test_serving_measurements_contract():
+    """The serving leg's measurement dict carries the judged fields
+    (p50/p99 + shed rates + typed totals) and drains clean — run tiny
+    in-process so tier-1 stays fast; the full leg is `--serving`."""
+    bench = _bench()
+    out = bench._serving_measurements(rate_rps=200.0, duration_s=0.5,
+                                      burst=48, max_batch=8,
+                                      max_queue=16)
+    assert out["steady"]["offered"] > 0
+    assert out["steady"]["ok"] > 0
+    assert out["steady"]["latency_p50_ms"] is not None
+    assert out["steady"]["latency_p99_ms"] >= out["steady"][
+        "latency_p50_ms"]
+    # the burst (3x the queue bound) must shed typed, not queue forever
+    assert out["burst"]["shed"] > 0
+    assert out["burst"]["ok"] + out["burst"]["shed"] == out["burst"][
+        "offered"]
+    assert out["drained_clean"] is True
+    t = out["totals"]
+    assert t["total"] == t["served_ok"] + t["shed"] \
+        + t["deadline_exceeded"] + t["internal_error"]
 
 
 def test_salvage_partial_requires_headline(monkeypatch, tmp_path):

@@ -39,6 +39,42 @@ class TestRecordShards:
         got = [next(it) for _ in range(8)]  # > one pass of 3
         assert len(got) == 8
 
+    def test_abandon_mid_shard_stops_producer(self, tmp_path):
+        """Shutdown-path regression: closing the generator mid-shard
+        (the consumer abandoning a prefetching pipeline) must stop the
+        producer thread promptly — no thread leak, no deadlock on the
+        maxsize-1 queue."""
+        import threading
+        import time
+
+        write_seq_files(self._samples(64), str(tmp_path), shard_size=4)
+        before = {t.ident for t in threading.enumerate()}
+        it = SeqFileFolder(str(tmp_path)).data(train=True)
+        for _ in range(2):   # mid-shard: 2 of 4 records consumed
+            next(it)
+        it.close()           # abandon; finally must set the stop event
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            leaked = [t for t in threading.enumerate()
+                      if t.ident not in before and t.is_alive()]
+            if not leaked:
+                break
+            time.sleep(0.05)
+        assert not leaked, f"producer thread leaked: {leaked}"
+
+    def test_producer_death_without_sentinel_raises(self, tmp_path,
+                                                    monkeypatch):
+        """If the producer dies via a non-Exception BaseException (so
+        the old `except Exception` delivery missed it), the consumer
+        must fail loudly instead of blocking forever on q.get()."""
+        write_seq_files(self._samples(8), str(tmp_path), shard_size=4)
+        ds = SeqFileFolder(str(tmp_path))
+        monkeypatch.setattr(
+            SeqFileFolder, "_read_shard",
+            lambda self, path: (_ for _ in ()).throw(SystemExit(3)))
+        with pytest.raises(RuntimeError, match="producer died"):
+            next(ds.data(train=False))
+
     def test_crc_detects_corruption(self, tmp_path):
         samples = self._samples(2)
         paths = write_seq_files(samples, str(tmp_path), shard_size=4)

@@ -59,3 +59,33 @@ def test_sharded_predict_uses_compiled_shard_map():
 
     cache = _EVAL_FWD_CACHE.get(model, {})
     assert data_mesh(mesh) in cache, "sharded forward was not compiled"
+
+
+def test_tail_batches_share_one_bucket_executable():
+    """Tail batches pad up to the FULL batch_size bucket, so datasets
+    of any length trace exactly ONE executable per batch_size — not
+    one per distinct tail remainder."""
+    from bigdl_tpu.optim.evaluator import _cached_eval_fwd
+
+    model, _ = _model_and_data()
+    fwd = _cached_eval_fwd(model, None)
+    for n in (37, 33, 42):  # tails 5, 1, 10
+        _, samples = _model_and_data(n=n)
+        outs = Predictor(model).predict(array(samples), batch_size=16)
+        assert len(outs) == n
+    assert fwd._cache_size() == 1, (
+        "tail batches retraced the eval forward")
+
+
+def test_sample_to_minibatch_make_is_public():
+    """SampleToMiniBatch.make is the public batch constructor (the
+    drivers use it directly); _make stays as a compat alias."""
+    from bigdl_tpu.dataset.sample import SampleToMiniBatch
+
+    rng = np.random.RandomState(0)
+    samples = [Sample(rng.rand(4).astype(np.float32), np.float32(1))
+               for _ in range(3)]
+    batcher = SampleToMiniBatch(4)
+    mb = batcher.make(samples)
+    assert mb.size() == 3
+    assert SampleToMiniBatch._make is SampleToMiniBatch.make
