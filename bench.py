@@ -838,6 +838,150 @@ def run_serving_bench() -> None:
 
 
 # --------------------------------------------------------------------------
+# Elastic leg: chaos run through the shrink-to-survivors coordinator
+# --------------------------------------------------------------------------
+
+ELASTIC_TIMEOUT = float(os.environ.get("BENCH_ELASTIC_TIMEOUT", "240"))
+ELASTIC_RESULT = "ELASTIC_r01.json"
+
+
+def _elastic_measurements(max_steps: int = 36, die_at: int = 10,
+                          rejoin_at: int = 24, n_hosts: int = 4,
+                          batch: int = 64, pace_s: float = 0.05):
+    """Simulated-cluster chaos leg: a 4-"host" gang (one coordinator per
+    fake host, resilience.elastic.SimulatedHost) trains a small
+    regression under DistriOptimizer with an injected host death at step
+    ``die_at`` and a rejoin at ``rejoin_at``.  Measures steady-state
+    steps/sec before the fault, the recovery wall-clock
+    (fault detection -> first post-restore step), and the post-shrink
+    throughput.  Control-plane numbers, meaningful on any backend."""
+    import tempfile
+
+    import numpy as np
+
+    from bigdl_tpu import nn
+    from bigdl_tpu.dataset import Sample, array
+    from bigdl_tpu.optim import SGD, max_iteration, several_iteration
+    from bigdl_tpu.optim.distri_optimizer import DistriOptimizer
+    from bigdl_tpu.resilience import (CollectiveWatchdog, ElasticContext,
+                                      ElasticCoordinator, InMemoryKV,
+                                      RetryPolicy, SimulatedHost,
+                                      StepTimeEstimator, faults)
+
+    rng = np.random.RandomState(0)
+    x = rng.rand(256, 4).astype(np.float32)
+    w = np.array([[1.5], [-2.0], [0.5], [3.0]], np.float32)
+    y = (x @ w + 0.7).astype(np.float32)
+    samples = [Sample(x[i], y[i]) for i in range(len(x))]
+
+    kv = InMemoryKV()
+    hosts = [f"host{i}" for i in range(n_hosts)]
+    coord = ElasticCoordinator("host0", kv, heartbeat_timeout=0.3)
+    coord.bootstrap(hosts)
+    sims = [SimulatedHost(h, kv, heartbeat_timeout=0.3,
+                          die_at_leader_step=(die_at if h == "host2"
+                                              else None),
+                          rejoin_at_leader_step=(rejoin_at
+                                                 if h == "host2" else None))
+            for h in hosts[1:]]
+    ctx = ElasticContext(
+        coord,
+        watchdog=CollectiveWatchdog(StepTimeEstimator(
+            floor=0.75, multiplier=4.0, min_samples=3)),
+        rendezvous_timeout=3.0, regrow_after_steps=4)
+
+    model = nn.Sequential(nn.Linear(4, 8), nn.Tanh(), nn.Linear(8, 1))
+    opt = DistriOptimizer(model, array(samples), nn.MSECriterion(),
+                          batch_size=batch)
+    opt.set_optim_method(SGD(learning_rate=0.3))
+    opt.set_end_when(max_iteration(max_steps))
+    ckpt = tempfile.mkdtemp(prefix="bench_elastic_")
+    opt.set_checkpoint(ckpt, several_iteration(1))
+    opt.set_retry_policy(RetryPolicy(max_retries=20, backoff_base=0.01,
+                                     backoff_max=0.05))
+    opt.set_elastic(ctx)
+
+    t0 = time.monotonic()
+    # pace the driver so heartbeat windows are meaningful on fast CPUs
+    with faults.delay_host("host0", pace_s, at_step=1):
+        for s in sims:
+            s.start()
+        try:
+            opt.optimize()
+        finally:
+            for s in sims:
+                s.stop()
+    wall = time.monotonic() - t0
+
+    def rate(entries):
+        # median step time, excluding each incarnation's first (compile)
+        # step; entries are (incarnation, step, t_end, dt)
+        dts = sorted(dt for _, _, _, dt in entries[1:])
+        if not dts:
+            return None
+        return round(1.0 / max(dts[len(dts) // 2], 1e-9), 2)
+
+    log = ctx.step_log
+    incs = [e[0] for e in log]
+    before = [e for e in log if e[0] == incs[0]]
+    shrunk = [e for e in log if e[0] != incs[0]]  # post-first-recovery
+    return {
+        "hosts": n_hosts,
+        "steps": int(opt.optim_method.state["neval"] - 1),
+        "wall_clock_s": round(wall, 2),
+        "shards_before": ctx.shard_history[0] if ctx.shard_history else None,
+        "shards_min": min(ctx.shard_history) if ctx.shard_history else None,
+        "shards_after": (ctx.shard_history[-1]
+                         if ctx.shard_history else None),
+        "steps_per_sec_before_fault": rate(before),
+        "steps_per_sec_after_shrink": rate(shrunk),
+        "recovery_wall_clock_s": (round(ctx.recoveries[0], 3)
+                                  if ctx.recoveries else None),
+        "incarnations": ctx.incarnation_changes,
+        "evictions": ctx.evictions,
+        "watchdog_trips": ctx.watchdog.trips,
+        "final_loss": round(float(opt.optim_method.state["loss"]), 5),
+    }
+
+
+def run_elastic_bench() -> None:
+    """--elastic mode: run the chaos leg on the virtual-CPU topology,
+    write ELASTIC_r01.json, print the one JSON line."""
+    # the multi-shard simulation needs >1 device; same fallback idiom as
+    # __graft_entry__.dryrun_multichip (set flags BEFORE backend init)
+    import re
+
+    flags = re.sub(r"--xla_force_host_platform_device_count=\d+", "",
+                   os.environ.get("XLA_FLAGS", "")).strip()
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    out = {"bench": "elastic", "backend": "cpu",
+           "measured_at": _utc_now()}
+    try:
+        out.update(_elastic_measurements())
+        rec = out.get("recovery_wall_clock_s")
+        out.update({
+            "metric": "elastic shrink-to-survivors recovery wall-clock",
+            "value": rec if rec is not None else 0.0,
+            "unit": "s",
+        })
+    except Exception as e:
+        out["error"] = f"{type(e).__name__}: {e}"[:500]
+        out.update({"metric": "elastic shrink-to-survivors recovery "
+                              "wall-clock",
+                    "value": 0.0, "unit": "s"})
+    try:
+        with open(os.path.join(_here(), ELASTIC_RESULT), "w") as f:
+            json.dump(out, f, indent=1)
+    except OSError:
+        pass
+    print(json.dumps(out), flush=True)
+
+
+# --------------------------------------------------------------------------
 # Probe: initialize the backend, print device info (runs in a subprocess)
 # --------------------------------------------------------------------------
 
@@ -1061,6 +1205,29 @@ def main() -> None:
                        or "serving leg returned nothing"}
     result["serving"] = serving
 
+    # elastic leg: chaos run through the shrink-to-survivors coordinator
+    # (recovery wall-clock + pre/post-fault throughput; backend-
+    # independent, lands in ELASTIC_r01.json) — best-effort like the
+    # serving leg; BENCH_ELASTIC_TIMEOUT=0 disables it.
+    if ELASTIC_TIMEOUT <= 0:
+        elastic = {"skipped": "BENCH_ELASTIC_TIMEOUT=0"}
+    else:
+        ok, eres, note = _run_sub(["--elastic"], ELASTIC_TIMEOUT)
+        if ok and eres and "error" not in eres:
+            elastic = {
+                "recovery_wall_clock_s": eres.get("recovery_wall_clock_s"),
+                "steps_per_sec_before_fault": eres.get(
+                    "steps_per_sec_before_fault"),
+                "steps_per_sec_after_shrink": eres.get(
+                    "steps_per_sec_after_shrink"),
+                "incarnations": eres.get("incarnations"),
+                "source": ELASTIC_RESULT,
+            }
+        else:
+            elastic = {"error": (eres or {}).get("error") or note
+                       or "elastic leg returned nothing"}
+    result["elastic"] = elastic
+
     if not from_tpu:
         # the tunnel dies for hours at a time: the judged artifact must
         # still CARRY the chip numbers, honestly stamped — merge the
@@ -1099,12 +1266,15 @@ if __name__ == "__main__":
     p = argparse.ArgumentParser()
     p.add_argument("--probe", action="store_true")
     p.add_argument("--serving", action="store_true")
+    p.add_argument("--elastic", action="store_true")
     p.add_argument("--worker", choices=["tpu", "cpu"])
     a = p.parse_args()
     if a.probe:
         run_probe()
     elif a.serving:
         run_serving_bench()
+    elif a.elastic:
+        run_elastic_bench()
     elif a.worker:
         run_worker(a.worker)
     else:

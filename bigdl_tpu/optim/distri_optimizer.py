@@ -228,6 +228,7 @@ class DistriOptimizer(Optimizer):
 
     # ------------------------------------------------------------------
     def optimize(self) -> AbstractModule:
+        self._warn_drop_knobs_if_inert()
         try:
             with self._preemption_scope():
                 return self._optimize_routed()
@@ -269,6 +270,20 @@ class DistriOptimizer(Optimizer):
         # collapse to a pure-data mesh if caller handed the 4-axis default
         mesh = data_mesh(mesh)
         n_dev = mesh.shape["data"]
+        if self.elastic is not None:
+            # elastic data path: the mesh is derived PER ATTEMPT from
+            # the live membership — on a shrink/regrow the retry loop
+            # restores the verified checkpoint and re-enters here with
+            # the survivors' mesh at the largest valid shard count
+            self.elastic.attach(n_devices=len(jax.devices()),
+                                batch_size=self.batch_size)
+
+            def attempt():
+                self._elastic_begin()
+                m = self.elastic.current_mesh()
+                return self._optimize_once(m, m.shape["data"])
+
+            return self._with_retry(attempt)
         if self.batch_size is not None and self.batch_size % n_dev != 0:
             raise ValueError(
                 f"batch size {self.batch_size} must be divisible by the "
@@ -293,7 +308,16 @@ class DistriOptimizer(Optimizer):
             raise ValueError(
                 f"batch size {self.batch_size} must be divisible by the "
                 f"mesh's data-axis size {n_data}")
-        return self._with_retry(lambda: self._optimize_multi_axis_once(mesh))
+
+        def attempt():
+            # elastic on a multi-axis mesh: heartbeats, watchdog and
+            # straggler tracking apply; a membership change restores the
+            # checkpoint and re-enters on the SAME mesh (multi-axis
+            # shard shrink is not derived — see docs/elastic.md)
+            self._elastic_begin()
+            return self._optimize_multi_axis_once(mesh)
+
+        return self._with_retry(attempt)
 
     def _with_retry(self, fn):
         """Driver retry-from-checkpoint loop shared by every mesh path
@@ -344,6 +368,7 @@ class DistriOptimizer(Optimizer):
 
         while not self.end_when(state):
             state["epoch_finished"] = False
+            self._elastic_step_start(state)
             t_data0 = time.time()
             batch = next(data_iter)
             x, y = _device_batch(batch)
@@ -377,10 +402,9 @@ class DistriOptimizer(Optimizer):
 
             t0 = time.time()
             lr = optim.get_current_lr()
-            loss, params, slots, buffers = step(params, slots, buffers,
-                                                lr, x, y,
-                                                rng=next_jax_key(),
-                                                **mask_kw)
+            loss, params, slots, buffers = self._elastic_dispatch(
+                lambda: step(params, slots, buffers, lr, x, y,
+                             rng=next_jax_key(), **mask_kw), state)
             loss = float(loss)  # value fetch = execution barrier
             train_time = time.time() - t0
             self._check_loss_anomaly(loss, skipped=False)
@@ -479,7 +503,14 @@ class DistriOptimizer(Optimizer):
                 f"batch size {self.batch_size} must be divisible by "
                 f"data-axis x pipeline microbatches = {n_data} x {n_mb} "
                 f"= {n_data * n_mb}")
-        return self._with_retry(lambda: self._optimize_pipeline_once(mesh))
+
+        def attempt():
+            # same elastic contract as the multi-axis path: watchdog +
+            # heartbeats + straggler tracking; mesh kept across attempts
+            self._elastic_begin()
+            return self._optimize_pipeline_once(mesh)
+
+        return self._with_retry(attempt)
 
     def _optimize_pipeline_once(self, mesh) -> AbstractModule:
         from jax.sharding import NamedSharding
@@ -528,6 +559,7 @@ class DistriOptimizer(Optimizer):
 
         while not self.end_when(state):
             state["epoch_finished"] = False
+            self._elastic_step_start(state)
             t_data0 = time.time()
             batch = next(data_iter)
             x, y = _device_batch(batch)
@@ -551,8 +583,9 @@ class DistriOptimizer(Optimizer):
 
             t0 = time.time()
             lr = optim.get_current_lr()
-            loss, packed, slots = step(packed, slots, lr, x, y,
-                                       rng=next_jax_key(), **mask_kw)
+            loss, packed, slots = self._elastic_dispatch(
+                lambda: step(packed, slots, lr, x, y,
+                             rng=next_jax_key(), **mask_kw), state)
             loss = float(loss)  # value fetch = execution barrier
             train_time = time.time() - t0
             self._check_loss_anomaly(loss, skipped=False)
@@ -729,6 +762,7 @@ class DistriOptimizer(Optimizer):
         pending = None
         while not self.end_when(state):
             state["epoch_finished"] = False
+            self._elastic_step_start(state)
             t_data0 = time.time()
             if pending is not None:
                 batch, x, y = pending
@@ -804,7 +838,10 @@ class DistriOptimizer(Optimizer):
                 out, loss, train_time = step_out[0]
                 prefetch()
             else:
-                out = dispatch()
+                # under elastic the dispatch runs inside the watchdog
+                # deadline (which blocks on the loss — hang coverage
+                # trades away the prefetch overlap for that iteration)
+                out = self._elastic_dispatch(dispatch, state)
                 prefetch()
                 loss = float(out[0])  # device sync after prefetch overlap
                 train_time = time.time() - t0

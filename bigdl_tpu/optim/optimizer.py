@@ -70,8 +70,13 @@ class Optimizer:
         self.train_summary = None
         self.validation_summary = None
         self.metrics = Metrics()
-        self.drop_percentage = 0.0  # reference straggler knob — no-op on TPU (SURVEY P6)
+        # reference straggler knobs (Optimizer.scala:229-243) — wired to
+        # the elastic straggler policy (resilience/elastic.py): they set
+        # the skew threshold / eviction budget once set_elastic attaches
+        # a coordinator; inert (with a warning) on single-host runs
+        self.drop_percentage = 0.0
         self.max_drop_percentage = 0.0
+        self._drop_warmup = 200
         self.compute_threshold_batchsize = 100
         # mixed precision: compute dtype for fwd/bwd; master weights,
         # gradients and the optimizer update stay float32 (the TPU-native
@@ -107,6 +112,11 @@ class Optimizer:
             "bigdl.preemption.handleSignals", "false")).lower() in (
             "1", "true", "yes", "on")
         self._preemption: Optional[PreemptionHandler] = None
+        # elastic multi-host coordination (resilience/elastic.py):
+        # heartbeats, hung-collective watchdog, straggler eviction,
+        # shrink-to-survivors recovery — off unless set_elastic attaches
+        # a context
+        self.elastic = None
         self.skipped_steps = 0   # anomalous steps skipped by the guard
         self.rollbacks = 0       # checkpoint restores done by retry
 
@@ -188,11 +198,23 @@ class Optimizer:
 
     def set_drop_module_property(self, drop_percentage, max_drop_percentage,
                                  batch_size=100, warmup_iteration=200):
-        """Straggler-drop knobs (reference Optimizer.scala:229-243).
-        Kept for parity; a synchronous TPU step has no stragglers to drop
-        (SURVEY §2.2 P6) so these are recorded but unused."""
-        self.drop_percentage = drop_percentage
-        self.max_drop_percentage = max_drop_percentage
+        """Straggler-drop knobs (reference Optimizer.scala:229-243) —
+        no longer a no-op: under ``set_elastic`` they configure the
+        straggler policy (``resilience.elastic.StragglerPolicy
+        .from_drop_knobs``): ``drop_percentage`` sets the step-time skew
+        threshold (``max(1.5, 1/drop_percentage)``× the cluster
+        median), ``max_drop_percentage`` caps the eviction budget as a
+        fraction of the gang, and ``warmup_iteration`` scales the
+        patience before a vote.  A single-host run has no straggler to
+        drop; ``optimize()`` warns instead of silently ignoring."""
+        self.drop_percentage = float(drop_percentage)
+        self.max_drop_percentage = float(max_drop_percentage)
+        self.compute_threshold_batchsize = batch_size
+        self._drop_warmup = int(warmup_iteration)
+        if self.elastic is not None and self.drop_percentage > 0:
+            self.elastic.configure_straggler_from_knobs(
+                self.drop_percentage, self.max_drop_percentage,
+                self._drop_warmup)
         return self
 
     # -- resilience config (bigdl_tpu/resilience/) ----------------------
@@ -231,7 +253,61 @@ class Optimizer:
         self.handle_preemption = bool(enabled)
         return self
 
+    def set_elastic(self, context):
+        """Attach an elastic-cluster context
+        (``resilience.elastic.ElasticContext``): the step loop then
+        heartbeats every iteration, runs the compiled step under the
+        hung-collective watchdog deadline, tracks per-host step-time
+        skew, and on a membership change (host death, straggler
+        eviction, rejoin) restores the last verified checkpoint and —
+        on the data-parallel mesh path — rebuilds the mesh at the
+        largest valid shard count for the survivors.  Pass ``None`` to
+        detach."""
+        self.elastic = context
+        if context is not None:
+            if self.batch_size is not None:
+                context.attach(batch_size=self.batch_size)
+            if self.drop_percentage > 0:
+                context.configure_straggler_from_knobs(
+                    self.drop_percentage, self.max_drop_percentage,
+                    self._drop_warmup)
+        return self
+
     # -- resilience plumbing shared by the drivers ----------------------
+    def _warn_drop_knobs_if_inert(self):
+        """Satellite of the straggler wiring: the reference knobs used
+        to no-op silently; now they either configure the elastic policy
+        or say loudly why they cannot."""
+        if self.drop_percentage and self.elastic is None:
+            log.warning(
+                "straggler-drop knobs set (drop_percentage=%.2f, "
+                "max_drop_percentage=%.2f) on a single-host run with no "
+                "elastic coordinator — there is no straggler to drop; "
+                "attach set_elastic(ElasticContext(...)) for multi-host "
+                "straggler eviction", self.drop_percentage,
+                self.max_drop_percentage)
+
+    def _elastic_begin(self):
+        """Start-of-attempt hook: adopt/rendezvous the current
+        incarnation and reset the watchdog estimator."""
+        if self.elastic is not None:
+            self.elastic.begin_attempt()
+
+    def _elastic_step_start(self, state):
+        """Per-iteration hook before the batch fetch: heartbeat +
+        membership/straggler/rejoin checks (may raise the retryable
+        MembershipChangedError)."""
+        if self.elastic is not None:
+            self.elastic.on_step_start(state["neval"])
+
+    def _elastic_dispatch(self, dispatch, state):
+        """Run one compiled-step dispatch, under the watchdog deadline
+        when elastic is attached (the watchdog blocks on the loss, so
+        prefetch overlap is traded for hang coverage)."""
+        if self.elastic is None:
+            return dispatch()
+        return self.elastic.run_step(dispatch, state["neval"])
+
     def _restore_latest(self):
         self.resume_from_checkpoint()
 
@@ -240,8 +316,11 @@ class Optimizer:
         DistriOptimizer.scala:750-816, upgraded: exponential backoff +
         jitter between attempts, fatal errors never retried).  Without
         a checkpoint there is nothing to restore — first error raises,
-        matching the reference loop."""
-        if self.checkpoint_path is None:
+        matching the reference loop — unless an elastic context is
+        attached: membership changes and watchdog trips must still
+        re-enter the attempt (with a fresh mesh) even when nothing is
+        checkpointed."""
+        if self.checkpoint_path is None and self.elastic is None:
             return fn()
 
         def on_retry(exc, attempt):
@@ -550,6 +629,7 @@ class LocalOptimizer(Optimizer):
     into the batch dimension, SURVEY §2.2 P2)."""
 
     def optimize(self) -> AbstractModule:
+        self._warn_drop_knobs_if_inert()
         try:
             with self._preemption_scope():
                 return self._with_retry(self._optimize_loop)
@@ -558,6 +638,7 @@ class LocalOptimizer(Optimizer):
             self._orbax_close()
 
     def _optimize_loop(self) -> AbstractModule:
+        self._elastic_begin()
         model, criterion, optim = self.model, self.criterion, self.optim_method
         model.training()
         from ..parallel.moe import aux_loss_term, collect_aux_paths
@@ -649,14 +730,16 @@ class LocalOptimizer(Optimizer):
         pending = None
         while not self.end_when(state):
             state["epoch_finished"] = False
+            self._elastic_step_start(state)
             n_records, x, y, data_time = pending or fetch()
             pending = None
 
             t0 = time.time()
             lr = optim.get_current_lr()
             rng = next_jax_key()
-            loss, params, buffers, slots, step_ok = jitted(
-                params, buffers, slots, jnp.float32(lr), rng, x, y)
+            loss, params, buffers, slots, step_ok = self._elastic_dispatch(
+                lambda: jitted(params, buffers, slots, jnp.float32(lr),
+                               rng, x, y), state)
             # prefetch the next batch while the device runs this step —
             # only within the epoch, so rollover/shuffle semantics hold
             if records_this_epoch + n_records < epoch_size:

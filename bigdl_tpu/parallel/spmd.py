@@ -70,6 +70,22 @@ def param_specs(module, model_axis: str = "model"):
     return jax.tree_util.tree_map(lambda _: P(), tree)
 
 
+def survivor_mesh(n_shards: int, devices=None):
+    """Data-only mesh over the first ``n_shards`` devices — the
+    shrink-to-survivors rebuild target (resilience/elastic.py).  On a
+    membership change the elastic layer picks the largest valid shard
+    count for the surviving gang and re-enters the data-parallel driver
+    with this mesh; the remaining devices idle until regrow."""
+    devs = list(devices if devices is not None else jax.devices())
+    n = int(n_shards)
+    if n < 1 or n > len(devs):
+        raise ValueError(
+            f"survivor mesh needs 1..{len(devs)} shards, got {n}")
+    from jax.sharding import Mesh
+
+    return Mesh(np.array(devs[:n]), ("data",))
+
+
 def _resolve_axes(mesh, data_axis, seq_axis, model_axis):
     """Keep only the axes the mesh actually has."""
     axes = set(mesh.axis_names)

@@ -22,7 +22,16 @@ resilience from, so this package owns it end to end:
   checkpoint at the next step boundary and exits cleanly resumable.
 * :mod:`.faults`      — deterministic fault-injection API (fail-at-step
   exceptions, NaN-gradient injection, checkpoint truncation/bit-flip,
-  ingest I/O errors) driving the end-to-end recovery tests.
+  ingest I/O errors, host kill/delay/hang) driving the end-to-end
+  recovery tests.
+* :mod:`.elastic`     — cluster-level coordination for multi-host runs:
+  heartbeat/membership with monotonically numbered incarnations over a
+  pluggable KV transport, straggler skew tracking + bounded eviction,
+  shrink-to-survivors recovery and regrow-on-rejoin.
+* :mod:`.watchdog`    — hung-collective watchdog: the compiled
+  distributed step runs under a deadline derived from a rolling
+  step-time estimate; expiry raises a retryable
+  :class:`HungCollectiveError` instead of blocking forever.
 """
 from .guards import LossSpikeDetector, tree_finite, where_tree
 from .retry import (FatalTrainingError, LossSpikeError, RetryPolicy,
@@ -30,6 +39,12 @@ from .retry import (FatalTrainingError, LossSpikeError, RetryPolicy,
 from .preemption import PreemptionHandler, request_preemption
 from .checkpoint import (CorruptCheckpointError, quarantine, verified_load,
                          verify_file, verify_and_load_latest, write_sidecar)
+from .watchdog import (CollectiveWatchdog, HungCollectiveError,
+                       StepTimeEstimator)
+from .elastic import (ElasticContext, ElasticCoordinator, FileKV,
+                      InMemoryKV, KVTransport, MembershipChangedError,
+                      SimulatedHost, StragglerPolicy, largest_valid_shards)
+from .faults import HostKilledError
 
 __all__ = [
     "LossSpikeDetector", "tree_finite", "where_tree",
@@ -37,4 +52,8 @@ __all__ = [
     "PreemptionHandler", "request_preemption",
     "CorruptCheckpointError", "quarantine", "verified_load", "verify_file",
     "verify_and_load_latest", "write_sidecar",
+    "CollectiveWatchdog", "HungCollectiveError", "StepTimeEstimator",
+    "ElasticContext", "ElasticCoordinator", "FileKV", "InMemoryKV",
+    "KVTransport", "MembershipChangedError", "SimulatedHost",
+    "StragglerPolicy", "largest_valid_shards", "HostKilledError",
 ]

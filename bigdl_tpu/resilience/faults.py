@@ -13,7 +13,10 @@ driver exceptions (:class:`ExceptionTransformer`), NaN gradients
 NaN), and loss spikes (:class:`ScaleInjector`).  File-level helpers
 (:func:`bit_flip`, :func:`truncate`) corrupt checkpoints on disk, and
 the :func:`io_faults` context injects transient errors into the ingest
-layer's shard opens.
+layer's shard opens.  Cluster-level chaos (:func:`kill_host`,
+:func:`delay_host`, :func:`hang_collective`) is keyed off the leader's
+published step so schedules stay deterministic against the training
+timeline — see the registry table in ``docs/resilience.md``.
 """
 from __future__ import annotations
 
@@ -26,6 +29,7 @@ import numpy as np
 
 from ..dataset.sample import Sample
 from ..dataset.transformer import Transformer
+from .retry import FatalTrainingError
 
 
 # ---------------------------------------------------------------------------
@@ -259,6 +263,113 @@ def serving_step_latency(seconds: float, times: int = 1 << 30):
     finally:
         with _SERVING_LOCK:
             _SERVING_FAULTS.remove(entry)
+
+
+# ---------------------------------------------------------------------------
+# elastic (multi-host) faults
+# ---------------------------------------------------------------------------
+# The elastic step runner (resilience.elastic.ElasticContext.run_step)
+# and every SimulatedHost call check_elastic_fault() once per step with
+# the host's name and the global (leader-published) step number, so
+# cluster chaos — a host dying, a host slowing down, a collective
+# hanging — is scheduled deterministically against the training
+# timeline, not wall clock.
+
+_ELASTIC_LOCK = threading.Lock()
+_ELASTIC_FAULTS: list = []  # [dict(kind, host, at_step, remaining, ...)]
+
+
+class HostKilledError(FatalTrainingError):
+    """Injected host death.  Fatal *for the killed host* — a dead host
+    does not retry; its survivors detect the missing heartbeat and
+    shrink without it."""
+
+
+def check_elastic_fault(host: str, step: int, cancel_event=None):
+    """Called once per step by each (real or simulated) cluster member.
+    Applies the first matching armed fault: ``kill`` raises
+    :class:`HostKilledError`, ``delay`` sleeps (making the host a
+    straggler), ``hang`` blocks for ``seconds`` — cooperatively: when
+    the watchdog trips it sets ``cancel_event`` and the hang re-raises
+    as ``HungCollectiveError`` inside the abandoned worker, so the
+    compiled step is never dispatched from an abandoned attempt.  No-op
+    (and free) when nothing is registered."""
+    if not _ELASTIC_FAULTS:
+        return
+    fault = None
+    with _ELASTIC_LOCK:
+        for f in _ELASTIC_FAULTS:
+            if (f["host"] == host and f["remaining"] > 0
+                    and step >= f["at_step"]):
+                f["remaining"] -= 1
+                f["fired"] += 1
+                fault = dict(f)
+                break
+    if fault is None:
+        return
+    if fault["kind"] == "kill":
+        raise HostKilledError(
+            f"injected kill of {host} at step {step}")
+    if fault["kind"] == "delay":
+        import time
+
+        time.sleep(fault["seconds"])
+        return
+    # hang: block like a dead collective would, but honor the
+    # watchdog's cancel so the abandoned worker exits promptly
+    from .watchdog import HungCollectiveError
+
+    if cancel_event is not None:
+        if cancel_event.wait(fault["seconds"]):
+            raise HungCollectiveError(
+                f"injected hang on {host} at step {step} canceled by "
+                "the watchdog")
+    else:
+        import time
+
+        time.sleep(fault["seconds"])
+
+
+@contextlib.contextmanager
+def _elastic_fault(entry):
+    with _ELASTIC_LOCK:
+        _ELASTIC_FAULTS.append(entry)
+    try:
+        yield entry
+    finally:
+        with _ELASTIC_LOCK:
+            _ELASTIC_FAULTS.remove(entry)
+
+
+def kill_host(host: str, at_step: int):
+    """Kill ``host`` when the global step reaches ``at_step``: its step
+    raises :class:`HostKilledError` and it stops heartbeating — the
+    survivors' death detection and shrink path is exercised end to
+    end."""
+    return _elastic_fault({"kind": "kill", "host": str(host),
+                           "at_step": int(at_step), "remaining": 1,
+                           "fired": 0})
+
+
+def delay_host(host: str, seconds: float, at_step: int = 0,
+               times: int = 1 << 30):
+    """Slow ``host`` by ``seconds`` per step from ``at_step`` for
+    ``times`` steps — its published step time inflates and the
+    straggler policy's warn/evict path is exercised."""
+    return _elastic_fault({"kind": "delay", "host": str(host),
+                           "at_step": int(at_step),
+                           "remaining": int(times), "fired": 0,
+                           "seconds": float(seconds)})
+
+
+def hang_collective(host: str, at_step: int, seconds: float = 60.0):
+    """Hang ``host``'s next step at ``at_step`` for up to ``seconds``
+    (or until the watchdog trips and cancels) — the
+    dead-peer-mid-collective case the watchdog deadline must convert
+    into a retryable error instead of an eternal block."""
+    return _elastic_fault({"kind": "hang", "host": str(host),
+                           "at_step": int(at_step), "remaining": 1,
+                           "fired": 0, "seconds": float(seconds)})
 
 
 def poison_params(tree):

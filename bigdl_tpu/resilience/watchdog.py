@@ -1,0 +1,152 @@
+"""Hung-collective watchdog: a deadline around the distributed step.
+
+A synchronous all-reduce over a gang of hosts has one failure mode the
+driver retry loop cannot see: a *hang*.  When a peer host dies between
+heartbeats (or its NIC degrades), the surviving hosts' collective never
+completes — no exception, no timeout, the dispatch thread blocks in the
+runtime forever and the reference's retry-from-checkpoint loop
+(DistriOptimizer.scala:750) never gets control back.
+
+The watchdog converts that eternal block into a *typed, retryable*
+error: the compiled step runs in a worker thread under a deadline
+derived from a rolling estimate of recent step times
+(:class:`StepTimeEstimator` — median-based, so a one-off compile does
+not inflate it), and expiry raises :class:`HungCollectiveError`, which
+the existing :mod:`.retry` taxonomy classifies as **retryable** (its
+``code`` is ``"UNAVAILABLE"``, mirroring the serving status taxonomy:
+degrade and recover, don't crash).  The elastic layer
+(:mod:`.elastic`) answers it by restoring the last verified checkpoint
+and re-rendezvousing the survivors.
+
+The abandoned worker thread cannot be killed — a genuinely hung
+collective only dies with the process — but the *cooperative* hang
+injector (``faults.hang_collective``) honors the cancel event the
+watchdog trips, so tests never leak a sleeping thread past the step
+that abandoned it, and never dispatch the step from an abandoned
+attempt.
+"""
+from __future__ import annotations
+
+import collections
+import statistics
+import threading
+import time
+from typing import Callable, Optional
+
+__all__ = ["CollectiveWatchdog", "HungCollectiveError", "StepTimeEstimator"]
+
+
+class HungCollectiveError(RuntimeError):
+    """A distributed step exceeded its watchdog deadline — a peer is
+    presumed dead or unreachable mid-collective.  Retryable by the
+    :mod:`.retry` taxonomy (the gang can shrink and resume); ``code``
+    follows the serving status vocabulary."""
+
+    code = "UNAVAILABLE"
+
+
+class StepTimeEstimator:
+    """Rolling step-time estimate → deadline.
+
+    The deadline is ``max(floor, multiplier * median(recent))`` over a
+    bounded window.  Median, not mean: the first step of every (re)build
+    is a compile measured in seconds, and an EMA polluted by it would
+    stretch the deadline by the multiplier — a real hang would then take
+    tens of seconds to classify.  ``min_samples`` withholds any deadline
+    until enough post-compile steps have landed, so a fresh incarnation
+    never trips on its own compilation.
+    """
+
+    def __init__(self, window: int = 16, multiplier: float = 8.0,
+                 floor: float = 0.5, min_samples: int = 3,
+                 cap: Optional[float] = None,
+                 warmup_deadline: Optional[float] = None):
+        if min_samples < 1:
+            raise ValueError("min_samples must be >= 1")
+        self.window = int(window)
+        self.multiplier = float(multiplier)
+        self.floor = float(floor)
+        self.min_samples = int(min_samples)
+        self.cap = cap
+        # optional generous bound for the warming steps themselves —
+        # without it a hang during an incarnation's very first (compile)
+        # steps has no deadline at all; set it well above the worst
+        # expected compile time
+        self.warmup_deadline = warmup_deadline
+        self._samples: "collections.deque" = collections.deque(
+            maxlen=self.window)
+
+    def observe(self, dt: float):
+        self._samples.append(float(dt))
+
+    def deadline(self) -> Optional[float]:
+        """Seconds the next step may take, or None while the estimate is
+        still warming up (callers run unbounded until then)."""
+        if len(self._samples) < self.min_samples:
+            return self.warmup_deadline
+        d = max(self.floor, self.multiplier
+                * statistics.median(self._samples))
+        return min(d, self.cap) if self.cap is not None else d
+
+    def reset(self):
+        """Forget the history — a new incarnation compiles a new program
+        with new timings."""
+        self._samples.clear()
+
+
+class CollectiveWatchdog:
+    """Runs a step function under the estimator's deadline.
+
+    ``run(fn)`` calls ``fn(cancel_event)`` in a worker thread; the
+    callable must block until the step's result is actually materialized
+    (the elastic layer blocks on the loss), so a hang anywhere between
+    dispatch and the value fetch is covered.  On expiry the cancel event
+    is set (cooperative injectors honor it), ``trips`` increments, and
+    :class:`HungCollectiveError` raises on the calling thread.
+    """
+
+    def __init__(self, estimator: Optional[StepTimeEstimator] = None,
+                 clock: Callable[[], float] = time.monotonic):
+        self.estimator = estimator or StepTimeEstimator()
+        self._clock = clock
+        self.trips = 0
+        self.last_deadline: Optional[float] = None
+
+    def run(self, fn: Callable, deadline: Optional[float] = None):
+        if deadline is None:
+            deadline = self.estimator.deadline()
+        self.last_deadline = deadline
+        t0 = self._clock()
+        if deadline is None:
+            # warming up: run inline (no deadline to enforce yet) but
+            # still feed the estimator
+            out = fn(None)
+            self.estimator.observe(self._clock() - t0)
+            return out
+
+        cancel = threading.Event()
+        done = threading.Event()
+        box: dict = {}
+
+        def worker():
+            try:
+                box["out"] = fn(cancel)
+            except BaseException as e:  # re-raised on the caller below
+                box["exc"] = e
+            finally:
+                done.set()
+
+        t = threading.Thread(target=worker, daemon=True,
+                             name="bigdl-collective-watchdog")
+        t.start()
+        if not done.wait(deadline):
+            cancel.set()
+            self.trips += 1
+            raise HungCollectiveError(
+                f"distributed step exceeded its {deadline:.2f}s watchdog "
+                "deadline — presuming a dead peer in the collective "
+                "(retryable: survivors shrink and resume)")
+        if "exc" in box:
+            raise box["exc"]
+        self.estimator.observe(self._clock() - t0)
+        return box["out"]

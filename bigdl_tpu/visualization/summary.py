@@ -95,6 +95,20 @@ class ServingSummary(Summary):
         super().__init__(log_dir, os.path.join(app_name, "serving"))
 
 
+class ElasticSummary(Summary):
+    """Elastic-training metrics stream (``<app>/elastic``) — the export
+    target of ``resilience.elastic.ElasticContext``: ``Incarnation``
+    (the current membership epoch), ``ClusterSize``, ``Evictions``
+    (straggler votes), ``WatchdogTrips`` (hung-collective deadline
+    expiries), ``StragglerSkew`` (per-warning step-time skew) and
+    ``RecoverySeconds`` (fault detection → first post-recovery step),
+    so cluster health lands next to the train/validation curves in the
+    same tensorboard layout."""
+
+    def __init__(self, log_dir: str, app_name: str):
+        super().__init__(log_dir, os.path.join(app_name, "elastic"))
+
+
 def read_scalars(log_dir: str, tag: str) -> List[Tuple[int, float]]:
     """Read scalar events back (reference tensorboard/FileReader —
     serves the python ``summary_read_scalar`` API)."""

@@ -56,9 +56,20 @@ class EventWriter:
         self._rw.close()
 
 
+#: queue sentinel: everything enqueued before it is on disk once the
+#: drain thread reaches it (FIFO), so close() never races a timeout
+#: against in-flight events
+_CLOSE = None
+
+
 class FileWriter:
     """Async queued writer (reference FileWriter.scala:30): producers
-    enqueue encoded events, a daemon thread drains to disk."""
+    enqueue encoded events, a daemon thread drains to disk.
+
+    ``close()`` drains deterministically: a sentinel is enqueued behind
+    every pending event and the drain thread exits when it reaches it —
+    a burst of events written immediately before ``close()`` is on disk
+    when ``close()`` returns, not dropped by a join timeout."""
 
     def __init__(self, log_dir: str, flush_secs: float = 2.0):
         self._writer = EventWriter(log_dir)
@@ -69,6 +80,8 @@ class FileWriter:
         self._thread.start()
 
     def add_event(self, event: bytes):
+        if self._closed:
+            raise ValueError("FileWriter is closed")
         self._q.put(event)
         return self
 
@@ -77,13 +90,17 @@ class FileWriter:
         while True:
             try:
                 ev = self._q.get(timeout=0.2)
-                try:
-                    self._writer.write_event(ev)
-                finally:
-                    self._q.task_done()
             except queue.Empty:
-                if self._closed and self._q.empty():
+                if time.time() - last_flush > self._flush_secs:
+                    self._writer.flush()
+                    last_flush = time.time()
+                continue
+            try:
+                if ev is _CLOSE:
                     return
+                self._writer.write_event(ev)
+            finally:
+                self._q.task_done()
             if time.time() - last_flush > self._flush_secs:
                 self._writer.flush()
                 last_flush = time.time()
@@ -95,6 +112,22 @@ class FileWriter:
         self._writer.flush()
 
     def close(self):
+        if self._closed:
+            return
         self._closed = True
-        self._thread.join(timeout=5)
+        self._q.put(_CLOSE)
+        self._thread.join(timeout=30)
+        # belt and braces: if the drain thread died (disk error) or the
+        # join timed out, write whatever is still queued on this thread
+        # rather than dropping it silently
+        while True:
+            try:
+                ev = self._q.get_nowait()
+            except queue.Empty:
+                break
+            try:
+                if ev is not _CLOSE:
+                    self._writer.write_event(ev)
+            finally:
+                self._q.task_done()
         self._writer.close()

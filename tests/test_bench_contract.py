@@ -64,10 +64,12 @@ def test_fallback_merges_persisted_tpu_numbers(tmp_path):
     env = dict(os.environ)
     env.update({"JAX_PLATFORMS": "cpu", "BENCH_PROBE_TIMEOUT": "30",
                 "BENCH_CPU_TIMEOUT": "3",
-                # the serving leg is unit-tested in-process
-                # (test_serving_measurements_contract); skip its ~25s
-                # subprocess here
-                "BENCH_SERVING_TIMEOUT": "0"})
+                # the serving and elastic legs are unit-tested
+                # in-process (test_serving_measurements_contract /
+                # test_elastic_measurements_contract); skip their slow
+                # subprocesses here
+                "BENCH_SERVING_TIMEOUT": "0",
+                "BENCH_ELASTIC_TIMEOUT": "0"})
     out = subprocess.run(
         [sys.executable, "bench.py"], capture_output=True, text=True,
         timeout=300, cwd=".", env=env)
@@ -156,6 +158,29 @@ def test_serving_measurements_contract():
     t = out["totals"]
     assert t["total"] == t["served_ok"] + t["shed"] \
         + t["deadline_exceeded"] + t["internal_error"]
+
+
+def test_elastic_measurements_contract():
+    """The elastic chaos leg's measurement dict carries the judged
+    fields (steps/sec before the fault, recovery wall-clock after the
+    injected host death, post-shrink throughput) — run small in-process
+    so tier-1 stays fast; the full leg is `--elastic` and its one JSON
+    line lands in ELASTIC_r01.json."""
+    bench = _bench()
+    out = bench._elastic_measurements(max_steps=20, die_at=6,
+                                      rejoin_at=14, pace_s=0.05)
+    assert out["hosts"] == 4
+    assert out["steps"] == 20                      # the run completes
+    assert out["steps_per_sec_before_fault"] > 0
+    assert out["steps_per_sec_after_shrink"] > 0
+    assert out["recovery_wall_clock_s"] > 0        # death -> resumed
+    assert out["recovery_wall_clock_s"] < 30       # ...bounded
+    assert out["incarnations"] >= 1
+    assert out["shards_min"] < out["shards_before"]  # it really shrank
+    # the regression target starts at ~8.0 loss; 20 steps with replayed
+    # recoveries land well below it (descent, not a tight absolute)
+    assert out["final_loss"] < 5.0
+    assert out["wall_clock_s"] < 120
 
 
 def test_salvage_partial_requires_headline(monkeypatch, tmp_path):

@@ -1,0 +1,163 @@
+"""Thread-safety specs for the shared resilience primitives.
+
+`RetryPolicy` and `CircuitBreaker` started life on the training driver
+thread; the serving worker (PR 2) and now the elastic layer's watchdog
+worker threads (PR 3) hammer them concurrently — state transitions must
+stay consistent and no failure count may be lost under contention.
+"""
+import threading
+
+import pytest
+
+from bigdl_tpu.resilience.retry import RetryPolicy
+from bigdl_tpu.serving.breaker import (ADMIT, CLOSED, HALF_OPEN, OPEN,
+                                       PROBE, REJECT, CircuitBreaker)
+
+N_THREADS = 16
+
+
+def _hammer(fn, n_threads=N_THREADS):
+    """Run ``fn(i)`` on n threads simultaneously (barrier-released so
+    the calls genuinely contend); re-raises the first worker error."""
+    barrier = threading.Barrier(n_threads)
+    errors = []
+
+    def work(i):
+        barrier.wait()
+        try:
+            fn(i)
+        except BaseException as e:  # pragma: no cover - failure path
+            errors.append(e)
+
+    threads = [threading.Thread(target=work, args=(i,))
+               for i in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60)
+        assert not t.is_alive(), "hammer thread wedged"
+    if errors:
+        raise errors[0]
+
+
+# ---------------------------------------------------------------------------
+# CircuitBreaker
+# ---------------------------------------------------------------------------
+
+def test_breaker_no_lost_failure_counts_under_contention():
+    """N threads x M failures with no interleaved success: every count
+    lands (the consecutive-failure counter only resets on success), and
+    the breaker ends open having tripped exactly once."""
+    br = CircuitBreaker(failure_threshold=5, reset_timeout=3600.0)
+    per_thread = 25
+    _hammer(lambda i: [br.record_failure() for _ in range(per_thread)])
+    snap = br.snapshot()
+    assert snap["consecutive_failures"] == N_THREADS * per_thread
+    assert snap["state"] == OPEN
+    assert snap["trips"] == 1  # open->open transitions never double-count
+
+
+def test_breaker_success_storm_closes_and_resets():
+    br = CircuitBreaker(failure_threshold=3, reset_timeout=3600.0)
+    for _ in range(3):
+        br.record_failure()
+    assert br.state == OPEN
+    _hammer(lambda i: [br.record_success() for _ in range(20)])
+    snap = br.snapshot()
+    assert snap["state"] == CLOSED
+    assert snap["consecutive_failures"] == 0
+
+
+def test_breaker_half_open_admits_exactly_one_probe():
+    t = [0.0]
+    br = CircuitBreaker(failure_threshold=1, reset_timeout=1.0,
+                        clock=lambda: t[0])
+    br.record_failure()
+    assert br.state == OPEN
+    t[0] = 2.0  # past the reset timeout: next acquire becomes the probe
+    verdicts = []
+    lock = threading.Lock()
+
+    def acquire(i):
+        v = br.acquire()
+        with lock:
+            verdicts.append(v)
+
+    _hammer(acquire)
+    assert verdicts.count(PROBE) == 1
+    assert verdicts.count(REJECT) == N_THREADS - 1
+    br.record_success()
+    assert br.state == CLOSED
+    assert br.snapshot()["recoveries"] == 1
+
+
+def test_breaker_mixed_storm_invariants():
+    """Random-ish interleavings: state stays in the valid set, trips
+    and recoveries only move forward, counter never goes negative."""
+    t = [0.0]
+    br = CircuitBreaker(failure_threshold=4, reset_timeout=0.001,
+                        clock=lambda: t[0])
+
+    def storm(i):
+        for k in range(50):
+            if (i + k) % 3 == 0:
+                br.record_success()
+            else:
+                br.record_failure()
+            br.acquire()
+            snap = br.snapshot()
+            assert snap["state"] in (CLOSED, OPEN, HALF_OPEN)
+            assert snap["consecutive_failures"] >= 0
+            assert snap["trips"] >= 0 and snap["recoveries"] >= 0
+
+    _hammer(storm)
+    assert br.acquire() in (ADMIT, PROBE, REJECT)
+
+
+# ---------------------------------------------------------------------------
+# RetryPolicy
+# ---------------------------------------------------------------------------
+
+def test_retry_policy_shared_across_threads():
+    """One policy instance, N threads each running their own flaky fn:
+    every thread converges, total backoff sleeps == total failures (no
+    lost or double-counted attempts), and the shared jitter stream
+    never corrupts a schedule (delays stay within jitter bounds)."""
+    sleeps = []
+    lock = threading.Lock()
+
+    def sleep(d):
+        with lock:
+            sleeps.append(d)
+
+    policy = RetryPolicy(max_retries=10, backoff_base=0.001,
+                         backoff_max=0.004, jitter=0.5, sleep=sleep)
+    fails_per_thread = 3
+    results = []
+
+    def run(i):
+        calls = {"n": 0}
+
+        def flaky():
+            calls["n"] += 1
+            if calls["n"] <= fails_per_thread:
+                raise OSError(f"transient {i}/{calls['n']}")
+            return i
+
+        results.append(policy.run(flaky))
+
+    _hammer(run)
+    assert sorted(results) == list(range(N_THREADS))
+    assert len(sleeps) == N_THREADS * fails_per_thread
+    # every delay drawn from the shared stream respects the bounds
+    assert all(0 <= d <= 0.004 * 1.5 for d in sleeps)
+
+
+def test_retry_policy_fatal_classification_is_thread_safe():
+    policy = RetryPolicy(max_retries=5, backoff_base=0.0)
+
+    def run(i):
+        with pytest.raises(MemoryError):
+            policy.run(lambda: (_ for _ in ()).throw(MemoryError()))
+
+    _hammer(run)

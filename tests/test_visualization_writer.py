@@ -1,0 +1,64 @@
+"""FileWriter drain semantics + ElasticSummary stream.
+
+Regression for the close() race: the async drain thread used to be
+joined with a 5s timeout and could silently drop queued events when the
+flush outlived it — close() now drains deterministically via a queue
+sentinel, so a burst of events written immediately before close() all
+reach disk.
+"""
+import os
+
+import pytest
+
+from bigdl_tpu.visualization import ElasticSummary, TrainSummary, read_scalars
+from bigdl_tpu.visualization.summary import scalar_event
+from bigdl_tpu.visualization.writer import FileWriter
+
+
+def test_burst_before_close_all_reaches_disk(tmp_path):
+    log_dir = str(tmp_path / "events")
+    w = FileWriter(log_dir)
+    n = 5000
+    for i in range(n):
+        w.add_event(scalar_event("Burst", float(i), i))
+    # no flush, no sleep: close() alone must drain the whole queue
+    w.close()
+    got = read_scalars(log_dir, "Burst")
+    assert len(got) == n
+    assert got[0] == (0, 0.0) and got[-1] == (n - 1, float(n - 1))
+
+
+def test_close_is_idempotent_and_rejects_late_events(tmp_path):
+    w = FileWriter(str(tmp_path / "events"))
+    w.add_event(scalar_event("X", 1.0, 1))
+    w.close()
+    w.close()  # second close is a no-op, not an error
+    with pytest.raises(ValueError):
+        w.add_event(scalar_event("X", 2.0, 2))
+    assert read_scalars(str(tmp_path / "events"), "X") == [(1, 1.0)]
+
+
+def test_flush_still_works_mid_stream(tmp_path):
+    w = FileWriter(str(tmp_path / "events"))
+    for i in range(100):
+        w.add_event(scalar_event("Y", float(i), i))
+    w.flush()
+    assert len(read_scalars(str(tmp_path / "events"), "Y")) == 100
+    w.add_event(scalar_event("Y", 100.0, 100))
+    w.close()
+    assert len(read_scalars(str(tmp_path / "events"), "Y")) == 101
+
+
+def test_elastic_summary_stream_layout(tmp_path):
+    s = ElasticSummary(str(tmp_path), "app")
+    t = TrainSummary(str(tmp_path), "app")
+    s.add_scalar("Incarnation", 1.0, 10)
+    s.add_scalar("WatchdogTrips", 1.0, 10)
+    t.add_scalar("Loss", 0.5, 10)
+    # elastic events land next to train/validation in the same layout
+    assert s.log_dir == os.path.join(str(tmp_path), "app", "elastic")
+    assert s.read_scalar("Incarnation") == [(10, 1.0)]
+    assert s.read_scalar("WatchdogTrips") == [(10, 1.0)]
+    assert t.read_scalar("Loss") == [(10, 0.5)]
+    s.close()
+    t.close()
