@@ -982,6 +982,187 @@ def run_elastic_bench() -> None:
 
 
 # --------------------------------------------------------------------------
+# Integrity leg: fingerprint/vote overhead + SDC detection latency
+# --------------------------------------------------------------------------
+
+INTEGRITY_TIMEOUT = float(os.environ.get("BENCH_INTEGRITY_TIMEOUT", "240"))
+INTEGRITY_RESULT = "INTEGRITY_r01.json"
+
+
+def _fingerprint_overhead(steps: int = 60, batch: int = 64,
+                          param_crc_every: int = 4):
+    """Wall-clock cost of the flight recorder at its default cadence:
+    the same LocalOptimizer run twice (fresh model each time, so both
+    passes pay one compile), bare vs. recording loss/grad-norm bits +
+    batch crc every step and a param-tree crc every
+    ``param_crc_every`` steps."""
+    import tempfile
+
+    import numpy as np
+
+    from bigdl_tpu import nn
+    from bigdl_tpu.dataset import Sample, array
+    from bigdl_tpu.optim import SGD, max_iteration
+    from bigdl_tpu.optim.optimizer import LocalOptimizer
+    from bigdl_tpu.resilience import FlightRecorder
+
+    rng = np.random.RandomState(0)
+    x = rng.rand(256, 16).astype(np.float32)
+    w = rng.rand(16, 1).astype(np.float32)
+    y = (x @ w + 0.3).astype(np.float32)
+    samples = [Sample(x[i], y[i]) for i in range(len(x))]
+
+    def run(recorder):
+        model = nn.Sequential(nn.Linear(16, 32), nn.Tanh(),
+                              nn.Linear(32, 1))
+        opt = LocalOptimizer(model, array(samples), nn.MSECriterion(),
+                             batch_size=batch)
+        opt.set_optim_method(SGD(learning_rate=0.1))
+        opt.set_end_when(max_iteration(steps))
+        if recorder is not None:
+            opt.set_flight_recorder(recorder)
+        t0 = time.monotonic()
+        opt.optimize()
+        return time.monotonic() - t0
+
+    bare = run(None)
+    jpath = os.path.join(tempfile.mkdtemp(prefix="bench_integrity_"),
+                         "journal.jsonl")
+    with FlightRecorder(jpath, param_crc_every=param_crc_every) as rec:
+        recorded = run(rec)
+    pct = 100.0 * (recorded - bare) / max(bare, 1e-9)
+    return {"fingerprint_steps": steps,
+            "fingerprint_param_crc_every": param_crc_every,
+            "bare_wall_s": round(bare, 3),
+            "recorded_wall_s": round(recorded, 3),
+            "fingerprint_overhead_pct": round(pct, 1)}
+
+
+def _integrity_measurements(max_steps: int = 30, corrupt_at: int = 9,
+                            cadence: int = 4, n_hosts: int = 4,
+                            batch: int = 64, pace_s: float = 0.05):
+    """SDC chaos leg: the elastic leg's 4-"host" simulated gang, but the
+    injected fault is `corrupt_gradient` on host2 — from step
+    ``corrupt_at`` its published integrity checksums are silently wrong.
+    The cross-host vote at ``cadence`` must flag it, evict it, and the
+    survivors keep training.  Measures the detection latency in steps
+    (vote cadence bounds it), the vote wall-clock overhead %, and the
+    flight-recorder overhead from :func:`_fingerprint_overhead`."""
+    import tempfile
+
+    import numpy as np
+
+    from bigdl_tpu import nn
+    from bigdl_tpu.dataset import Sample, array
+    from bigdl_tpu.optim import SGD, max_iteration, several_iteration
+    from bigdl_tpu.optim.distri_optimizer import DistriOptimizer
+    from bigdl_tpu.resilience import (CollectiveWatchdog, ElasticContext,
+                                      ElasticCoordinator, InMemoryKV,
+                                      RetryPolicy, SimulatedHost,
+                                      StepTimeEstimator, faults)
+
+    rng = np.random.RandomState(0)
+    x = rng.rand(256, 4).astype(np.float32)
+    w = np.array([[1.5], [-2.0], [0.5], [3.0]], np.float32)
+    y = (x @ w + 0.7).astype(np.float32)
+    samples = [Sample(x[i], y[i]) for i in range(len(x))]
+
+    kv = InMemoryKV()
+    hosts = [f"host{i}" for i in range(n_hosts)]
+    coord = ElasticCoordinator("host0", kv, heartbeat_timeout=0.3)
+    coord.bootstrap(hosts)
+    sims = [SimulatedHost(h, kv, heartbeat_timeout=0.3)
+            for h in hosts[1:]]
+    ctx = ElasticContext(
+        coord,
+        watchdog=CollectiveWatchdog(StepTimeEstimator(
+            floor=0.75, multiplier=4.0, min_samples=3)),
+        rendezvous_timeout=3.0, regrow_after_steps=1000,
+        integrity_cadence=cadence)
+
+    model = nn.Sequential(nn.Linear(4, 8), nn.Tanh(), nn.Linear(8, 1))
+    opt = DistriOptimizer(model, array(samples), nn.MSECriterion(),
+                          batch_size=batch)
+    opt.set_optim_method(SGD(learning_rate=0.3))
+    opt.set_end_when(max_iteration(max_steps))
+    ckpt = tempfile.mkdtemp(prefix="bench_integrity_")
+    opt.set_checkpoint(ckpt, several_iteration(1))
+    opt.set_retry_policy(RetryPolicy(max_retries=20, backoff_base=0.01,
+                                     backoff_max=0.05))
+    opt.set_elastic(ctx)
+
+    t0 = time.monotonic()
+    with faults.corrupt_gradient("host2", at_step=corrupt_at), \
+            faults.delay_host("host0", pace_s, at_step=1):
+        for s in sims:
+            s.start()
+        try:
+            opt.optimize()
+        finally:
+            for s in sims:
+                s.stop()
+    wall = time.monotonic() - t0
+
+    detected = (ctx.sdc_detected_steps[0]
+                if ctx.sdc_detected_steps else None)
+    vote_wall = sum(dt for _, dt in ctx.vote_log)
+    out = {
+        "hosts": n_hosts,
+        "steps": int(opt.optim_method.state["neval"] - 1),
+        "wall_clock_s": round(wall, 2),
+        "integrity_cadence": cadence,
+        "sdc_injected_at": corrupt_at,
+        "sdc_detected_at": detected,
+        "sdc_detection_latency_steps": (None if detected is None
+                                        else detected - corrupt_at),
+        "sdc_votes": ctx.sdc_votes,
+        "sdc_evictions": ctx.sdc_evictions,
+        "evicted_hosts": list(ctx.evicted_hosts),
+        "vote_overhead_pct": round(100.0 * vote_wall / max(wall, 1e-9),
+                                   1),
+        "final_loss": round(float(opt.optim_method.state["loss"]), 5),
+    }
+    out.update(_fingerprint_overhead())
+    return out
+
+
+def run_integrity_bench() -> None:
+    """--integrity mode: run the SDC chaos leg + fingerprint overhead
+    probe on the virtual-CPU topology, write INTEGRITY_r01.json, print
+    the one JSON line."""
+    import re
+
+    flags = re.sub(r"--xla_force_host_platform_device_count=\d+", "",
+                   os.environ.get("XLA_FLAGS", "")).strip()
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    out = {"bench": "integrity", "backend": "cpu",
+           "measured_at": _utc_now()}
+    try:
+        out.update(_integrity_measurements())
+        lat = out.get("sdc_detection_latency_steps")
+        out.update({
+            "metric": "SDC detection latency at default vote cadence",
+            "value": float(lat) if lat is not None else 0.0,
+            "unit": "steps",
+        })
+    except Exception as e:
+        out["error"] = f"{type(e).__name__}: {e}"[:500]
+        out.update({"metric": "SDC detection latency at default vote "
+                              "cadence",
+                    "value": 0.0, "unit": "steps"})
+    try:
+        with open(os.path.join(_here(), INTEGRITY_RESULT), "w") as f:
+            json.dump(out, f, indent=1)
+    except OSError:
+        pass
+    print(json.dumps(out), flush=True)
+
+
+# --------------------------------------------------------------------------
 # Probe: initialize the backend, print device info (runs in a subprocess)
 # --------------------------------------------------------------------------
 
@@ -1228,6 +1409,31 @@ def main() -> None:
                        or "elastic leg returned nothing"}
     result["elastic"] = elastic
 
+    # integrity leg: SDC chaos run through the cross-host vote plus the
+    # flight-recorder overhead probe (detection latency in steps at the
+    # default cadence, fingerprint/vote overhead %; backend-independent,
+    # lands in INTEGRITY_r01.json) — best-effort like the other legs;
+    # BENCH_INTEGRITY_TIMEOUT=0 disables it.
+    if INTEGRITY_TIMEOUT <= 0:
+        integrity = {"skipped": "BENCH_INTEGRITY_TIMEOUT=0"}
+    else:
+        ok, ires, note = _run_sub(["--integrity"], INTEGRITY_TIMEOUT)
+        if ok and ires and "error" not in ires:
+            integrity = {
+                "sdc_detection_latency_steps": ires.get(
+                    "sdc_detection_latency_steps"),
+                "integrity_cadence": ires.get("integrity_cadence"),
+                "fingerprint_overhead_pct": ires.get(
+                    "fingerprint_overhead_pct"),
+                "vote_overhead_pct": ires.get("vote_overhead_pct"),
+                "evicted_hosts": ires.get("evicted_hosts"),
+                "source": INTEGRITY_RESULT,
+            }
+        else:
+            integrity = {"error": (ires or {}).get("error") or note
+                         or "integrity leg returned nothing"}
+    result["integrity"] = integrity
+
     if not from_tpu:
         # the tunnel dies for hours at a time: the judged artifact must
         # still CARRY the chip numbers, honestly stamped — merge the
@@ -1267,6 +1473,7 @@ if __name__ == "__main__":
     p.add_argument("--probe", action="store_true")
     p.add_argument("--serving", action="store_true")
     p.add_argument("--elastic", action="store_true")
+    p.add_argument("--integrity", action="store_true")
     p.add_argument("--worker", choices=["tpu", "cpu"])
     a = p.parse_args()
     if a.probe:
@@ -1275,6 +1482,8 @@ if __name__ == "__main__":
         run_serving_bench()
     elif a.elastic:
         run_elastic_bench()
+    elif a.integrity:
+        run_integrity_bench()
     elif a.worker:
         run_worker(a.worker)
     else:

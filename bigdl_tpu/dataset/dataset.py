@@ -33,6 +33,17 @@ class AbstractDataSet:
     def transform(self, transformer: Transformer) -> "TransformedDataSet":
         return TransformedDataSet(self, transformer)
 
+    # -- checkpointable pipeline state (docs/determinism.md) -----------
+    # Datasets that own ordering/shuffling state override these so the
+    # optimizer can capture the input pipeline inside a checkpoint and
+    # resume on the exact next batch.  The base contract is "stateless":
+    # safe for purely functional sources.
+    def state_dict(self) -> dict:
+        return {}
+
+    def load_state_dict(self, state: dict):
+        return self
+
     # `ds -> transformer` spelled `ds >> transformer`
     def __rshift__(self, transformer: Transformer):
         return self.transform(transformer)
@@ -50,6 +61,18 @@ class LocalArrayDataSet(AbstractDataSet):
 
     def shuffle(self):
         RNG().shuffle(self._index)
+        return self
+
+    def state_dict(self) -> dict:
+        # the live index permutation IS the epoch's record order; the
+        # shuffler (the thread-local RNG()) is captured separately by
+        # the optimizer's train-state checkpoint
+        return {"index": np.array(self._index)}
+
+    def load_state_dict(self, state: dict):
+        idx = np.asarray(state.get("index", ()))
+        if idx.shape == self._index.shape:
+            self._index = idx.copy()
         return self
 
     def data(self, train: bool) -> Iterator:
@@ -74,6 +97,13 @@ class TransformedDataSet(AbstractDataSet):
 
     def shuffle(self):
         self.base.shuffle()
+        return self
+
+    def state_dict(self) -> dict:
+        return self.base.state_dict()
+
+    def load_state_dict(self, state: dict):
+        self.base.load_state_dict(state)
         return self
 
     def data(self, train: bool) -> Iterator:

@@ -15,6 +15,8 @@ from typing import Optional, Tuple
 
 import numpy as np
 
+from ..utils.rng import np_stream
+
 TRAIN_MEAN = 0.13066047740239506 * 255
 TRAIN_STD = 0.3081078 * 255
 TEST_MEAN = 0.13251460696903547 * 255
@@ -41,9 +43,9 @@ def _synthetic_images(n: int, shape, n_classes: int, seed: int,
     ``proto_seed`` fixes the class prototypes across train/test splits
     (only labels+noise vary with ``seed``) so a trained model generalizes.
     """
-    rng = np.random.RandomState(seed)
+    rng = np_stream(seed)
     labels = rng.randint(0, n_classes, n)
-    protos = np.random.RandomState(proto_seed).rand(n_classes, *shape) * 255
+    protos = np_stream(proto_seed).rand(n_classes, *shape) * 255
     imgs = protos[labels] + rng.randn(n, *shape) * 25
     return np.clip(imgs, 0, 255).astype(np.uint8), (labels + 1).astype(np.float32)
 
@@ -104,7 +106,7 @@ def load_news20(data_dir: Optional[str] = None, train: bool = True,
                                   np.float32(label)))
         if texts:
             return texts
-    rng = np.random.RandomState(10 if train else 11)
+    rng = np_stream(10 if train else 11)
     # 8 keywords per class + shared filler vocabulary
     filler = [f"word{i}" for i in range(100)]
     out = []
@@ -135,7 +137,7 @@ def load_movielens(data_dir: Optional[str] = None,
                         rows.append([int(parts[0]), int(parts[1]),
                                      int(float(parts[2]))])
             return np.asarray(rows, np.int64)
-    rng = np.random.RandomState(12)
+    rng = np_stream(12)
     n_users, n_items, rank = 100, 200, 4
     u = rng.randn(n_users, rank)
     v = rng.randn(n_items, rank)
@@ -169,5 +171,5 @@ def get_glove_w2v(data_dir: Optional[str] = None, dim: int = 50,
     w2v = {}
     for word in vocab or []:
         seed = zlib.crc32(word.encode("utf8")) % (2 ** 31)
-        w2v[word] = np.random.RandomState(seed).randn(dim).astype(np.float32)
+        w2v[word] = np_stream(seed).randn(dim).astype(np.float32)
     return w2v

@@ -85,13 +85,27 @@ def _decode_sample(data: bytes) -> Sample:
 
 
 class RecordFileWriter:
-    """TFRecord framing: len | crc(len) | data | crc(data) — one shard."""
+    """TFRecord framing: len | crc(len) | data | crc(data) — one shard.
+
+    Writes follow the checkpoint layer's file_io discipline: the bytes
+    go to a ``<path>.tmp.<pid>`` staging file and only a clean
+    :meth:`close` — flush, fsync, rename, directory fsync — publishes
+    ``<path>``.  A crash mid-write therefore leaves a staging file the
+    shard listing ignores (it does not end in ``.records``), never a
+    torn shard whose intact prefix would pass the CRC scan and silently
+    shrink the dataset."""
 
     def __init__(self, path: str):
-        self._f = open(path, "wb")
+        self.path = str(path)
+        self._tmp = f"{self.path}.tmp.{os.getpid()}"
+        self._f = open(self._tmp, "wb")
         self.count = 0
+        self.closed = False
 
     def write(self, data: bytes):
+        if self.closed:
+            raise ValueError(f"write to closed RecordFileWriter "
+                             f"({self.path})")
         header = struct.pack("<Q", len(data))
         self._f.write(header)
         self._f.write(struct.pack("<I", masked_crc32c(header)))
@@ -100,7 +114,28 @@ class RecordFileWriter:
         self.count += 1
 
     def close(self):
+        if self.closed:
+            return
+        self.closed = True
+        self._f.flush()
+        os.fsync(self._f.fileno())
         self._f.close()
+        os.replace(self._tmp, self.path)
+        from ..utils.file_io import _fsync_dir
+
+        _fsync_dir(os.path.dirname(self.path) or ".")
+
+    def abort(self):
+        """Drop the staging file without publishing (the crash-cleanup
+        path for callers that know the shard is incomplete)."""
+        if self.closed:
+            return
+        self.closed = True
+        self._f.close()
+        try:
+            os.remove(self._tmp)
+        except OSError:
+            pass
 
 
 def read_records(path: str, verify: bool = True,
@@ -192,12 +227,19 @@ class SeqFileFolder(AbstractDataSet):
     """
 
     def __init__(self, folder: str, shard_index: int = 0,
-                 shard_count: int = 1):
+                 shard_count: int = 1, seed: int = 1):
+        from ..utils.rng import RandomGenerator
+
         all_paths = sorted(
             os.path.join(folder, f) for f in os.listdir(folder)
             if f.endswith(".records"))
         self.paths = all_paths[shard_index::shard_count]
         self._order = list(range(len(self.paths)))
+        # per-dataset generator (NOT the thread-local global RNG()):
+        # shard-order shuffling draws from a stream this dataset owns,
+        # so its position can be captured/restored for bitwise resume
+        # and two datasets never race on one stream
+        self._rng = RandomGenerator(seed)
         self._size: Optional[int] = None
         # shards whose CRCs have already been verified this process:
         # later epochs skip the CRC pass (the frame walk alone detects
@@ -223,10 +265,25 @@ class SeqFileFolder(AbstractDataSet):
         return self._size
 
     def shuffle(self):
-        from ..utils.rng import RNG
-
-        perm = RNG().permutation(len(self._order))
+        perm = self._rng.permutation(len(self._order))
         self._order = [self._order[int(i)] for i in perm]
+
+    # -- checkpointable pipeline state (docs/determinism.md) -----------
+    def state_dict(self) -> dict:
+        """Shard order + the shuffle generator's exact stream position:
+        restoring this and re-creating ``data(train=True)`` reproduces
+        the record sequence bit-for-bit (iterators never mutate dataset
+        state — they shuffle a cloned generator — so a state captured
+        at any step boundary is exact, prefetch depth included)."""
+        return {"order": list(self._order),
+                "rng": self._rng.state_dict(),
+                "n_shards": len(self.paths)}
+
+    def load_state_dict(self, state: dict):
+        if state.get("n_shards") == len(self.paths) and "order" in state:
+            self._order = list(state["order"])
+            self._rng.load_state_dict(state["rng"])
+        return self
 
     def data(self, train: bool) -> Iterator[Sample]:
         # train iterators loop forever with a fresh shard-order shuffle
@@ -240,6 +297,12 @@ class SeqFileFolder(AbstractDataSet):
 
         stop = threading.Event()
         q: "queue.Queue" = queue.Queue(maxsize=1)
+        # train passes shuffle from a CLONE of the dataset generator:
+        # the stream is a pure function of the dataset state at iterator
+        # creation, and the prefetching producer can never race a
+        # concurrent shuffle()/state_dict() on the shared stream — the
+        # determinism contract resume depends on (docs/determinism.md)
+        rng = self._rng.clone() if train else None
 
         def put_or_stop(item) -> bool:
             while not stop.is_set():
@@ -259,8 +322,10 @@ class SeqFileFolder(AbstractDataSet):
             try:
                 while not stop.is_set():
                     if train:
-                        self.shuffle()
-                    order = list(self._order)  # snapshot per pass
+                        perm = rng.permutation(len(self._order))
+                        order = [self._order[int(i)] for i in perm]
+                    else:
+                        order = list(self._order)  # snapshot per pass
                     for shard in order:
                         recs = self._read_shard(self.paths[shard])
                         if not put_or_stop(recs):

@@ -144,6 +144,12 @@ class DistriOptimizer(Optimizer):
             g_slice = arp.reduce_scatter_gradients(grads)
             if not masked:
                 g_slice = g_slice / n_dev
+            # global gradient norm from the already-reduced slices (the
+            # flight recorder's fingerprint): psum of per-slice sum-sq
+            # is exactly ||global grad||^2, one scalar collective
+            gnorm = jnp.sqrt(jax.lax.psum(
+                sum(jnp.vdot(g, g).astype(jnp.float32)
+                    for g in jax.tree_util.tree_leaves(g_slice)), axis))
             w_slice = arp.my_weight_slice(params)
             new_w_slice, new_slots = optim.step(g_slice, w_slice, slots, lr)
             if guard:
@@ -172,12 +178,12 @@ class DistriOptimizer(Optimizer):
                 new_buffers = where_tree(ok, new_buffers, buffers)
             loss = (jax.lax.psum(loss, axis) if masked
                     else jax.lax.pmean(loss, axis))
-            return loss, new_params, new_buffers, new_slots, ok
+            return loss, new_params, new_buffers, new_slots, ok, gnorm
 
         in_specs = (P(), P(), P(axis), P(), P(), P(axis), P(axis))
         if masked:
             in_specs = in_specs + (P(axis), P())
-        out_specs = (P(), P(), P(), P(axis), P())
+        out_specs = (P(), P(), P(), P(axis), P(), P())
         # check_vma=False: params come back through all_gather of an
         # axis_index-derived slice, which the static replication checker
         # can't prove replicated (it is — every shard gathers all slices).
@@ -361,9 +367,10 @@ class DistriOptimizer(Optimizer):
         state["epoch"] = state.get("epoch", 1)
         state["neval"] = state.get("neval", 1)
         state["epoch_finished"] = False
-        records_this_epoch = 0
         epoch_size = _epoch_records(self.dataset)
         data_iter = self.dataset.data(train=True)
+        records_this_epoch = self._consume_resume_cursor(data_iter,
+                                                         epoch_size)
         wall_start = time.time()
 
         while not self.end_when(state):
@@ -408,8 +415,14 @@ class DistriOptimizer(Optimizer):
             loss = float(loss)  # value fetch = execution barrier
             train_time = time.time() - t0
             self._check_loss_anomaly(loss, skipped=False)
+            params = self._maybe_corrupt_params(state, params)
+            # fused multi-axis step: grad norm is not a program output
+            self._record_fingerprint(state, loss, None, (x, y),
+                                     lambda: params)
+            self._integrity_step(state, lambda: params)
 
             records_this_epoch += n_records
+            state["records_this_epoch"] = records_this_epoch
             state["loss"] = loss
             # metric-name contract (reference DistriOptimizer.scala:146-151);
             # collectives are fused into the one program here, so the wall
@@ -438,6 +451,7 @@ class DistriOptimizer(Optimizer):
                 state["epoch"] += 1
                 state["epoch_finished"] = True
                 records_this_epoch = 0
+                state["records_this_epoch"] = 0
                 self.dataset.shuffle()
                 data_iter = self.dataset.data(train=True)
 
@@ -547,9 +561,10 @@ class DistriOptimizer(Optimizer):
         state["epoch"] = state.get("epoch", 1)
         state["neval"] = state.get("neval", 1)
         state["epoch_finished"] = False
-        records_this_epoch = 0
         epoch_size = _epoch_records(self.dataset)
         data_iter = self.dataset.data(train=True)
+        records_this_epoch = self._consume_resume_cursor(data_iter,
+                                                         epoch_size)
         wall_start = time.time()
         pad_multiple = n_data * n_mb
 
@@ -589,8 +604,14 @@ class DistriOptimizer(Optimizer):
             loss = float(loss)  # value fetch = execution barrier
             train_time = time.time() - t0
             self._check_loss_anomaly(loss, skipped=False)
+            packed = self._maybe_corrupt_params(state, packed)
+            # fused pipeline step: grad norm is not a program output
+            self._record_fingerprint(state, loss, None, (x, y),
+                                     lambda: packed)
+            self._integrity_step(state, lambda: packed)
 
             records_this_epoch += n_records
+            state["records_this_epoch"] = records_this_epoch
             state["loss"] = loss
             # metric-name contract (reference DistriOptimizer.scala:146-151)
             self.metrics.add("computing time average", train_time)
@@ -752,11 +773,13 @@ class DistriOptimizer(Optimizer):
         state["neval"] = state.get("neval", 1)
         state["epoch_finished"] = False
 
-        records_this_epoch = 0
         from .optimizer import _epoch_records
 
         epoch_size = _epoch_records(self.dataset)
         data_iter = self.dataset.data(train=True)
+        # total-state resume: continue mid-epoch on the exact next batch
+        records_this_epoch = self._consume_resume_cursor(data_iter,
+                                                         epoch_size)
         wall_start = time.time()
 
         pending = None
@@ -845,9 +868,13 @@ class DistriOptimizer(Optimizer):
                 prefetch()
                 loss = float(out[0])  # device sync after prefetch overlap
                 train_time = time.time() - t0
-            _, params, buffers, slots, step_ok = out
+            _, params, buffers, slots, step_ok, gnorm = out
             skipped = not bool(step_ok)
             self._check_loss_anomaly(loss, skipped)
+            params = self._maybe_corrupt_params(state, params)
+            self._record_fingerprint(state, loss, float(gnorm), (x, y),
+                                     lambda: params, skipped=skipped)
+            self._integrity_step(state, lambda: params)
 
             if profiled and trace_split is None:
                 # fallback: collective-free fwd+bwd probe pins the pure
@@ -864,6 +891,7 @@ class DistriOptimizer(Optimizer):
                 compute_time = time.time() - tp
 
             records_this_epoch += n_records
+            state["records_this_epoch"] = records_this_epoch
             state["loss"] = loss
             # metric-name contract (reference DistriOptimizer.scala:146-151)
             # with measured per-phase numbers: the profiled iterations pin
@@ -915,6 +943,7 @@ class DistriOptimizer(Optimizer):
                 state["epoch"] += 1
                 state["epoch_finished"] = True
                 records_this_epoch = 0
+                state["records_this_epoch"] = 0
                 self.dataset.shuffle()
                 data_iter = self.dataset.data(train=True)
 

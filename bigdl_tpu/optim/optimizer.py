@@ -117,6 +117,14 @@ class Optimizer:
         # shrink-to-survivors recovery — off unless set_elastic attaches
         # a context
         self.elastic = None
+        # step-fingerprint flight recorder (resilience/integrity.py):
+        # off unless set_flight_recorder attaches one
+        self.flight_recorder = None
+        self.integrity_summary = None
+        # input-pipeline resume cursor (records already trained in the
+        # interrupted epoch) — set by resume_from_checkpoint when the
+        # checkpoint carries train state, consumed once by the loop
+        self._resume_cursor: Optional[int] = None
         self.skipped_steps = 0   # anomalous steps skipped by the guard
         self.rollbacks = 0       # checkpoint restores done by retry
 
@@ -243,6 +251,14 @@ class Optimizer:
         """Replace the failure retry policy (default: built from the
         ``bigdl.failure.*`` properties)."""
         self.retry_policy = policy
+        # keep the reference compat aliases (DistriOptimizer.max_retry/
+        # retry_window) in sync: _with_retry lets a caller-mutated alias
+        # win, so a stale snapshot of the DEFAULT policy must not
+        # silently clobber an explicitly installed one
+        if hasattr(self, "max_retry"):
+            self.max_retry = policy.max_retries
+        if hasattr(self, "retry_window"):
+            self.retry_window = policy.window
         return self
 
     def set_preemption_handling(self, enabled: bool = True):
@@ -251,6 +267,29 @@ class Optimizer:
         checkpoint (when a checkpoint path is configured) and return
         cleanly — the next run resumes via ``resume_from_checkpoint``."""
         self.handle_preemption = bool(enabled)
+        return self
+
+    def set_flight_recorder(self, recorder):
+        """Attach a step-fingerprint flight recorder
+        (``resilience.integrity.FlightRecorder``): every iteration
+        appends the loss's exact bit pattern, the global gradient norm
+        and a crc32c of the batch bytes to its journal, plus a crc32c
+        of the parameter tree at the recorder's ``param_crc_every``
+        cadence (and whenever a checkpoint is written) — the evidence
+        ``resilience.replay`` diffs to localize the first divergent
+        step.  Pass ``None`` to detach."""
+        self.flight_recorder = recorder
+        return self
+
+    def set_integrity_summary(self, summary):
+        """Attach a ``visualization.IntegritySummary``: the flight
+        recorder's journal length streams as ``FingerprintSteps`` and
+        the elastic SDC-vote counters (``IntegrityVotes`` /
+        ``IntegrityDisagreements`` / ``IntegrityEvictions``) land in
+        the same ``<app>/integrity`` event stream."""
+        self.integrity_summary = summary
+        if self.elastic is not None:
+            self.elastic.integrity_summary = summary
         return self
 
     def set_elastic(self, context):
@@ -265,6 +304,8 @@ class Optimizer:
         detach."""
         self.elastic = context
         if context is not None:
+            if self.integrity_summary is not None:
+                context.integrity_summary = self.integrity_summary
             if self.batch_size is not None:
                 context.attach(batch_size=self.batch_size)
             if self.drop_percentage > 0:
@@ -310,6 +351,120 @@ class Optimizer:
 
     def _restore_latest(self):
         self.resume_from_checkpoint()
+
+    # -- determinism + integrity plumbing (docs/determinism.md) ---------
+    def _fault_host(self) -> str:
+        """The host name the SDC fault injectors key off: the elastic
+        identity on a cluster, ``"local"`` on a single-host run."""
+        return self.elastic.host if self.elastic is not None else "local"
+
+    def _maybe_corrupt_params(self, state, params):
+        """Apply an armed ``flip_param_bits`` fault to the live params
+        (the silent-data-corruption injection point: one mantissa bit,
+        everything stays finite).  No-op when nothing is armed."""
+        from ..resilience import faults
+
+        if faults.check_param_corruption(self._fault_host(),
+                                         state["neval"]):
+            log.warning("fault injection: flipping a parameter bit at "
+                        "iteration %d", state["neval"])
+            params = faults.flip_tree_bits(params)
+        return params
+
+    def _record_fingerprint(self, state, loss, grad_norm, batch,
+                            params_fn, skipped=False):
+        """One flight-recorder entry for this iteration (no-op without
+        a recorder): the step fingerprint, plus a parameter checksum
+        at the recorder's cadence."""
+        rec = self.flight_recorder
+        if rec is None:
+            return
+        from ..resilience.integrity import batch_fingerprint, checksum_tree
+
+        step = state["neval"]
+        rec.record_step(
+            step=step, epoch=state["epoch"], loss=loss,
+            grad_norm=grad_norm,
+            batch_id=batch_fingerprint(batch), skipped=skipped)
+        if rec.wants_param_crc(step):
+            rec.record_param(step, checksum_tree(params_fn()))
+        if self.integrity_summary is not None:
+            self.integrity_summary.add_scalar(
+                "FingerprintSteps", rec.steps_recorded, step)
+
+    def _record_checkpoint_param_crc(self, state, tree):
+        """Parameter checksum at checkpoint cadence — ties every
+        written checkpoint to a journal fingerprint, so replay can
+        verify a checkpoint's params against the run that wrote it.
+        ``tree`` may be a whole checkpoint tree (the orbax layouts:
+        params under ``"params"``, or the pipeline's packed tree) or
+        a bare param tree (the pickle path)."""
+        if self.flight_recorder is None:
+            return
+        from ..resilience.integrity import checksum_tree
+
+        if isinstance(tree, dict) and "params" in tree:
+            tree = tree["params"]
+        self.flight_recorder.record_param(state["neval"] - 1,
+                                          checksum_tree(tree))
+
+    def _integrity_step(self, state, params_fn):
+        """Cross-host SDC vote at the elastic context's cadence: this
+        host's parameter checksum against the gang's strict majority.
+        Raises through to the retry loop (eviction/restore) on a
+        flagged host; fatal IntegrityError without a quorum."""
+        el = self.elastic
+        if el is None or getattr(el, "integrity_cadence", 0) <= 0:
+            return
+        step = state["neval"]
+        if step % el.integrity_cadence != 0:
+            return
+        from ..resilience.integrity import checksum_tree
+
+        el.integrity_vote(step, checksum_tree(params_fn()))
+
+    def _train_state_dict(self, state) -> dict:
+        """The non-parameter half of total training state: the host RNG
+        stream (per-step jax keys, shuffles) and the input pipeline's
+        order/cursor — what turns "restore the params" into "resume on
+        the exact next batch"."""
+        from ..utils.rng import RNG
+
+        return {"version": 1,
+                "rng": RNG().state_dict(),
+                "dataset": self.dataset.state_dict(),
+                "records_this_epoch": int(
+                    state.get("records_this_epoch", 0))}
+
+    def _apply_train_state(self, ts: dict):
+        from ..utils.rng import RNG
+
+        if not isinstance(ts, dict) or "rng" not in ts:
+            return
+        RNG().load_state_dict(ts["rng"])
+        self.dataset.load_state_dict(ts.get("dataset") or {})
+        self._resume_cursor = int(ts.get("records_this_epoch", 0))
+
+    def _consume_resume_cursor(self, data_iter, epoch_size: int) -> int:
+        """Fast-forward a fresh epoch iterator past the records the
+        interrupted run already trained on (deterministic recomputation
+        of the input pipeline — the order is restored state, so the
+        skipped batches are bit-identical to the ones trained).
+        Returns the restored records-this-epoch count."""
+        cursor, self._resume_cursor = self._resume_cursor, None
+        if not cursor:
+            return 0
+        if cursor >= epoch_size:
+            log.warning("resume cursor %d >= epoch size %d — starting "
+                        "the epoch from its first record", cursor,
+                        epoch_size)
+            return 0
+        skipped = 0
+        while skipped < cursor:
+            skipped += next(data_iter).size()
+        log.info("resumed input pipeline at record %d/%d of the "
+                 "interrupted epoch", skipped, epoch_size)
+        return skipped
 
     def _with_retry(self, fn):
         """Failure-retry loop shared by every driver (reference
@@ -385,6 +540,14 @@ class Optimizer:
                      file_io.join(self.checkpoint_path,
                                   f"optimMethod{suffix}"),
                      overwrite=True, atomic=True, checksum=True)
+        # the third leg of total state: host RNG stream + input-pipeline
+        # order/cursor — what makes the resume land on the exact next
+        # batch instead of restarting the epoch (docs/determinism.md)
+        file_io.save(self._train_state_dict(state),
+                     file_io.join(self.checkpoint_path,
+                                  f"trainState{suffix}"),
+                     overwrite=True, atomic=True, checksum=True)
+        self._record_checkpoint_param_crc(state, self.model.param_tree())
 
     # -- orbax sharded checkpoints (utils/orbax_io.py) -------------------
     @staticmethod
@@ -425,12 +588,14 @@ class Optimizer:
             committed_before = latest_step(self._orbax.directory)
         self._orbax.save(n, tree)
         meta = {"kind": kind, "state": dict(state),
+                "train_state": self._train_state_dict(state),
                 "abstract": jax.tree_util.tree_map(
                     lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype),
                     tree)}
         with open(os.path.join(self._orbax.directory,
                                f"meta-{n}.pkl"), "wb") as f:
             pickle.dump(meta, f)
+        self._record_checkpoint_param_crc(state, tree)
         if self.is_overwrite:
             # bounded retention (the pickle path's overwrite analogue):
             # keep the in-flight step n AND the newest already-committed
@@ -525,34 +690,58 @@ class Optimizer:
                 self.model.set_buffer_tree(tree["buffers"])
         self.optim_method._slots = tree.get("slots") or None
         self.optim_method.state.update(meta["state"])
+        if meta.get("train_state"):
+            self._apply_train_state(meta["train_state"])
         return True
 
     def _orbax_close(self):
         if self._orbax is not None:
             self._orbax.close()
 
-    def resume_from_checkpoint(self) -> bool:
+    def resume_from_checkpoint(self, step: Optional[int] = None) -> bool:
         """Restore the newest checkpoint at ``checkpoint_path`` into the
         live model/optimizer — the manual-resume entry point (reference
         'manual via Module.load + OptimMethod.load'); the Distri retry
         loop calls it automatically on failure.  Returns False when
-        there is nothing to restore."""
+        there is nothing to restore.
+
+        The restore is *total* when the checkpoint carries a
+        ``trainState`` leg (written since the determinism work): the
+        host RNG stream and the input pipeline's order + record cursor
+        come back too, so the resumed run continues on the exact next
+        batch (docs/determinism.md).  ``step`` pins the restore to the
+        newest checkpoint at or below that step (the replay entry
+        point's knob); optimMethod/trainState are always pinned to the
+        step the model actually restored from, so the trio can never
+        mix steps on a partially corrupt directory."""
         if self.checkpoint_format == "orbax":
+            if step is not None:
+                log.warning("resume_from_checkpoint(step=%s) is pickle-"
+                            "format only; orbax restores the newest "
+                            "verified step", step)
             return self._orbax_restore_into_model()
         from ..resilience.checkpoint import verify_and_load_latest
 
         restored_any = False
-        restored, _path = verify_and_load_latest(self.checkpoint_path,
-                                                 "model")
+        restored, path = verify_and_load_latest(self.checkpoint_path,
+                                                "model", max_step=step)
+        pin = step
         if restored is not None:
             self.model.set_param_tree(restored.param_tree())
             self.model.set_buffer_tree(restored.buffer_tree())
             restored_any = True
+            tail = path.rsplit(".", 1)[-1] if path else ""
+            if tail.isdigit():
+                pin = int(tail)
         om, _path = verify_and_load_latest(self.checkpoint_path,
-                                           "optimMethod")
+                                           "optimMethod", max_step=pin)
         if om is not None:
             self.optim_method = om
             restored_any = True
+        ts, _path = verify_and_load_latest(self.checkpoint_path,
+                                           "trainState", max_step=pin)
+        if ts is not None:
+            self._apply_train_state(ts)
         return restored_any
 
     def optimize(self) -> AbstractModule:
@@ -680,6 +869,11 @@ class LocalOptimizer(Optimizer):
             if needs_scale:  # reference setScaleW/setScaleB semantics
                 grads = jax.tree_util.tree_map(lambda g, s: g * s,
                                                grads, scale_tree)
+            # global gradient norm: one reduction over grads already in
+            # registers — the flight recorder's per-step fingerprint
+            gnorm = jnp.sqrt(sum(
+                jnp.vdot(g, g).astype(jnp.float32)
+                for g in jax.tree_util.tree_leaves(grads)))
             new_params, new_slots = optim.step(grads, params, slots, lr)
             if guard:
                 # anomaly guard: a NaN/Inf gradient (or loss) skips the
@@ -692,7 +886,7 @@ class LocalOptimizer(Optimizer):
                 new_buffers = where_tree(ok, new_buffers, buffers)
             else:
                 ok = jnp.bool_(True)
-            return loss, new_params, new_buffers, new_slots, ok
+            return loss, new_params, new_buffers, new_slots, ok, gnorm
 
         # donate params/buffers/slots: the update is in-place in HBM —
         # without this every step keeps old+new parameters live and pays
@@ -716,9 +910,12 @@ class LocalOptimizer(Optimizer):
         state["neval"] = state.get("neval", 1)
         state["epoch_finished"] = False
 
-        records_this_epoch = 0
         epoch_size = _epoch_records(self.dataset)
         data_iter = self.dataset.data(train=True)
+        # a total-state resume continues mid-epoch on the exact next
+        # batch (the restored order makes the skipped prefix identical)
+        records_this_epoch = self._consume_resume_cursor(data_iter,
+                                                         epoch_size)
         wall_start = time.time()
 
         def fetch():
@@ -737,9 +934,10 @@ class LocalOptimizer(Optimizer):
             t0 = time.time()
             lr = optim.get_current_lr()
             rng = next_jax_key()
-            loss, params, buffers, slots, step_ok = self._elastic_dispatch(
-                lambda: jitted(params, buffers, slots, jnp.float32(lr),
-                               rng, x, y), state)
+            loss, params, buffers, slots, step_ok, gnorm = \
+                self._elastic_dispatch(
+                    lambda: jitted(params, buffers, slots,
+                                   jnp.float32(lr), rng, x, y), state)
             # prefetch the next batch while the device runs this step —
             # only within the epoch, so rollover/shuffle semantics hold
             if records_this_epoch + n_records < epoch_size:
@@ -748,10 +946,15 @@ class LocalOptimizer(Optimizer):
             skipped = not bool(step_ok)
             train_time = time.time() - t0
             self._check_loss_anomaly(loss, skipped)
+            params = self._maybe_corrupt_params(state, params)
+            self._record_fingerprint(state, loss, float(gnorm), (x, y),
+                                     lambda: params, skipped=skipped)
+            self._integrity_step(state, lambda: params)
 
             self.metrics.add("computing time average", train_time)
             self.metrics.add("data fetch time", data_time)
             records_this_epoch += n_records
+            state["records_this_epoch"] = records_this_epoch
             state["loss"] = loss
             log.info(
                 "[Epoch %d %d/%d][Iteration %d][Wall Clock %.3fs] "
@@ -780,6 +983,7 @@ class LocalOptimizer(Optimizer):
                 state["epoch"] += 1
                 state["epoch_finished"] = True
                 records_this_epoch = 0
+                state["records_this_epoch"] = 0
                 self.dataset.shuffle()
                 data_iter = self.dataset.data(train=True)
 

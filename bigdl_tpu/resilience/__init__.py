@@ -32,6 +32,15 @@ resilience from, so this package owns it end to end:
   distributed step runs under a deadline derived from a rolling
   step-time estimate; expiry raises a retryable
   :class:`HungCollectiveError` instead of blocking forever.
+* :mod:`.integrity`   — silent-data-corruption defense: the
+  :class:`FlightRecorder` step-fingerprint journal (loss/grad-norm bit
+  patterns, batch + param checksums) and the cross-host integrity vote
+  (majority checksum defines truth; a minority host is evicted, no
+  quorum is the fatal :class:`IntegrityError`).
+* :mod:`.replay`      — deterministic replay: re-execute from a
+  verified checkpoint and diff fingerprint journals to localize the
+  first divergent step (total train state — params, slots, RNG stream,
+  pipeline cursor — makes the re-execution bit-faithful).
 """
 from .guards import LossSpikeDetector, tree_finite, where_tree
 from .retry import (FatalTrainingError, LossSpikeError, RetryPolicy,
@@ -45,6 +54,10 @@ from .elastic import (ElasticContext, ElasticCoordinator, FileKV,
                       InMemoryKV, KVTransport, MembershipChangedError,
                       SimulatedHost, StragglerPolicy, largest_valid_shards)
 from .faults import HostKilledError
+from .integrity import (FlightRecorder, IntegrityError,
+                        SilentDataCorruptionError, checksum_tree,
+                        float_bits, majority_vote)
+from .replay import diff_journals, load_journal, replay
 
 __all__ = [
     "LossSpikeDetector", "tree_finite", "where_tree",
@@ -56,4 +69,7 @@ __all__ = [
     "ElasticContext", "ElasticCoordinator", "FileKV", "InMemoryKV",
     "KVTransport", "MembershipChangedError", "SimulatedHost",
     "StragglerPolicy", "largest_valid_shards", "HostKilledError",
+    "FlightRecorder", "IntegrityError", "SilentDataCorruptionError",
+    "checksum_tree", "float_bits", "majority_vote",
+    "diff_journals", "load_journal", "replay",
 ]

@@ -154,10 +154,15 @@ def verified_load(path: str) -> Any:
 # walk-back restore
 # ---------------------------------------------------------------------------
 
-def candidate_files(directory: str, prefix: str) -> List[str]:
+def candidate_files(directory: str, prefix: str,
+                    max_step: Optional[int] = None) -> List[str]:
     """All ``<prefix>``/``<prefix>.N`` files under ``directory``, newest
     step first (a bare ``<prefix>`` — the overwrite layout — sorts
-    newest, matching the old ``_latest_file`` preference)."""
+    newest, matching the old ``_latest_file`` preference).  With
+    ``max_step``, only steps ``<= max_step`` qualify — the replay
+    entry point pins its restore to checkpoint K this way, and the
+    resume path pins optimMethod/trainState to the step the model
+    actually restored from (a consistent trio, never a mix)."""
     from ..utils import file_io
 
     if directory is None or not file_io.isdir(directory):
@@ -172,11 +177,14 @@ def candidate_files(directory: str, prefix: str) -> List[str]:
                 steps.append((int(f.rsplit(".", 1)[1]), f))
             except ValueError:
                 continue
+    if max_step is not None:
+        steps = [t for t in steps if t[0] <= max_step]
     steps.sort(key=lambda t: t[0], reverse=True)
     return [file_io.join(directory, f) for _, f in steps]
 
 
-def verify_and_load_latest(directory: str, prefix: str
+def verify_and_load_latest(directory: str, prefix: str,
+                           max_step: Optional[int] = None
                            ) -> Tuple[Optional[Any], Optional[str]]:
     """Walk the ``<prefix>.N`` files newest-first; return
     ``(loaded_object, path)`` for the first one that passes crc32c
@@ -186,7 +194,7 @@ def verify_and_load_latest(directory: str, prefix: str
     when nothing survives."""
     from ..utils import file_io
 
-    for path in candidate_files(directory, prefix):
+    for path in candidate_files(directory, prefix, max_step=max_step):
         ok = verify_file(path)
         if ok is False:
             quarantine(path)

@@ -179,6 +179,7 @@ _HB = "hb/"
 _INC = "inc"
 _ACK = "ack/"
 _EVICTED = "evicted/"
+_SDC = "sdc/"
 
 
 class ElasticCoordinator:
@@ -465,6 +466,9 @@ class ElasticContext:
                  batch_size: Optional[int] = None,
                  rendezvous_timeout: float = 5.0,
                  regrow_after_steps: int = 3,
+                 integrity_cadence: int = 0,
+                 integrity_timeout: float = 2.0,
+                 integrity_summary=None,
                  sleep: Callable[[float], None] = time.sleep):
         self.coordinator = coordinator
         self.watchdog = watchdog or CollectiveWatchdog()
@@ -473,6 +477,14 @@ class ElasticContext:
         self.batch_size = batch_size
         self.rendezvous_timeout = float(rendezvous_timeout)
         self.regrow_after_steps = max(1, int(regrow_after_steps))
+        # cross-host SDC vote knobs (resilience/integrity.py): every
+        # ``integrity_cadence`` steps each member publishes a param
+        # checksum through the transport and the strict majority defines
+        # truth; 0 disables.  ``integrity_timeout`` bounds the wait for
+        # peers' checksums (a silent peer counts against quorum).
+        self.integrity_cadence = max(0, int(integrity_cadence))
+        self.integrity_timeout = float(integrity_timeout)
+        self.integrity_summary = integrity_summary
         self._sleep = sleep
         self._mesh_factory = mesh_factory
         self._n_devices: Optional[int] = None
@@ -492,6 +504,11 @@ class ElasticContext:
         self.recoveries: List[float] = []
         self.step_log: List[Tuple[int, int, float, float]] = []
         self.shard_history: List[int] = []
+        self.sdc_votes = 0
+        self.sdc_disagreements = 0
+        self.sdc_evictions = 0
+        self.sdc_detected_steps: List[int] = []
+        self.vote_log: List[Tuple[int, float]] = []  # (step, vote wall s)
 
     # -- configuration --------------------------------------------------
     @property
@@ -529,6 +546,10 @@ class ElasticContext:
             "watchdog_trips": self.watchdog.trips,
             "recoveries_s": list(self.recoveries),
             "shard_history": list(self.shard_history),
+            "sdc_votes": self.sdc_votes,
+            "sdc_disagreements": self.sdc_disagreements,
+            "sdc_evictions": self.sdc_evictions,
+            "sdc_detected_steps": list(self.sdc_detected_steps),
         }
 
     # -- mesh -----------------------------------------------------------
@@ -724,7 +745,91 @@ class ElasticContext:
             self._scalar("RecoverySeconds", rec)
         return out
 
+    # -- cross-host integrity votes (resilience/integrity.py) -----------
+    def integrity_vote(self, step: int, checksum: str):
+        """One SDC vote round: publish this host's param checksum under
+        ``sdc/<step>/<host>``, bounded-wait for the other members',
+        and let the strict majority define truth
+        (:func:`~bigdl_tpu.resilience.integrity.majority_vote`).
+
+        * a corrupt PEER → evicted + membership proposal without it →
+          retryable :class:`MembershipChangedError` (the survivors
+          restore the verified checkpoint and shrink — the same path
+          a dead host takes, because a silently-wrong host is worse
+          than a dead one);
+        * a corrupt SELF → retryable
+          :class:`~bigdl_tpu.resilience.integrity
+          .SilentDataCorruptionError` (restore replaces our bad state
+          with known-good bytes);
+        * no strict majority → fatal
+          :class:`~bigdl_tpu.resilience.integrity.IntegrityError`.
+        """
+        from .integrity import SilentDataCorruptionError, majority_vote
+
+        c = self.coordinator
+        # rounds are keyed by incarnation AND step: a post-restore replay
+        # of the same step is a FRESH round — peers' answers from before
+        # the membership change must never count against it (the restore
+        # legitimately changes the bits: fewer shards, different
+        # reduction order)
+        prefix = f"{_SDC}{self.incarnation}/{int(step)}/"
+        c.transport.put(prefix + c.host, str(checksum))
+        want = set(self.members) or {c.host}
+        t0 = time.monotonic()
+        deadline = t0 + self.integrity_timeout
+        while True:
+            votes = {}
+            for key in c.transport.keys(prefix):
+                host = key[len(prefix):]
+                if host in want:
+                    votes[host] = c.transport.get(key)
+            if want <= set(votes) or time.monotonic() >= deadline:
+                break
+            self._sleep(0.005)
+        self.sdc_votes += 1
+        self.vote_log.append((int(step), time.monotonic() - t0))
+        self._iscalar("IntegrityVotes", self.sdc_votes, step)
+        truth, corrupt = majority_vote(votes, sorted(want))
+        if not corrupt:
+            return
+        self.sdc_disagreements += 1
+        self.sdc_detected_steps.append(int(step))
+        self._iscalar("IntegrityDisagreements", self.sdc_disagreements,
+                      step)
+        log.warning("elastic: integrity vote at step %d flagged %s "
+                    "(majority checksum %s, votes %s)", step, corrupt,
+                    truth, votes)
+        if c.host in corrupt:
+            self._mark_fault()
+            raise SilentDataCorruptionError(
+                f"this host's parameter checksum {votes.get(c.host)} "
+                f"was flagged against the {truth} majority at step "
+                f"{step} — restoring the last verified checkpoint")
+        for h in corrupt:
+            c.evict(h, "silent data corruption")
+        self.sdc_evictions += len(corrupt)
+        self.evictions += len(corrupt)
+        self.evicted_hosts.extend(corrupt)
+        survivors = [m for m in self.members if m not in corrupt]
+        n2 = c.propose(survivors, f"sdc eviction: {corrupt}",
+                       expect=self.incarnation)
+        self._iscalar("IntegrityEvictions", self.sdc_evictions, step)
+        self._mark_fault()
+        raise MembershipChangedError(
+            f"host(s) {corrupt} failed the step-{step} integrity vote "
+            f"(checksum minority vs {truth}) — shrinking to {survivors}",
+            incarnation=n2, members=survivors)
+
     # -- internals -------------------------------------------------------
+    def _iscalar(self, tag: str, value, step: int):
+        summary = self.integrity_summary or self.summary
+        if summary is not None:
+            try:
+                summary.add_scalar(tag, float(value), int(step))
+            except Exception:
+                log.exception("elastic: integrity summary write failed "
+                              "for %s", tag)
+
     def _mark_fault(self):
         if self._fault_at is None:
             self._fault_at = time.monotonic()
@@ -844,4 +949,33 @@ class SimulatedHost:
             if member and n > self._acked:
                 c.ack(n)
                 self._acked = n
+            if member:
+                self._answer_integrity_votes(leader_step)
             self._stop.wait(self.interval)
+
+    def _answer_integrity_votes(self, leader_step: int):
+        """Echo the leader's published integrity checksum for any open
+        vote round this host has not answered — in real synchronous
+        SPMD every healthy host computes the bit-identical post-gather
+        parameters, so "agrees with the leader" is the faithful
+        simulation of a healthy host.  An armed ``corrupt_gradient`` /
+        ``flip_param_bits`` fault perturbs the answer instead,
+        simulating the silently-corrupting host the vote must flag."""
+        from . import faults
+
+        t = self.coordinator.transport
+        for key in t.keys(_SDC):
+            parts = key[len(_SDC):].split("/")  # <inc>/<step>/<host>
+            if len(parts) != 3 or parts[2] != self.leader:
+                continue
+            inc_s, step_s, _ = parts
+            if not step_s.isdigit():
+                continue
+            mine = f"{_SDC}{inc_s}/{step_s}/{self.host}"
+            if t.get(mine) is not None:
+                continue
+            value = t.get(key)
+            if value is None:
+                continue
+            t.put(mine, faults.corrupt_checksum(self.host, int(step_s),
+                                                value))

@@ -372,6 +372,114 @@ def hang_collective(host: str, at_step: int, seconds: float = 60.0):
                            "fired": 0, "seconds": float(seconds)})
 
 
+# ---------------------------------------------------------------------------
+# integrity (silent-data-corruption) faults
+# ---------------------------------------------------------------------------
+# Two deterministic SDC injectors keyed, like the elastic faults, off a
+# host name and the global step.  ``corrupt_gradient`` perturbs the
+# checksum a (simulated) host publishes into the integrity vote — the
+# "this host's compute is silently wrong" case the cross-host majority
+# must localize.  ``flip_param_bits`` is consumed by the real driver:
+# when armed for its host it flips one mantissa bit in the live
+# parameter tree right after the step — plausible-but-wrong numbers the
+# NaN guard can never see, which the fingerprint journal + replay must
+# localize.
+
+_INTEGRITY_LOCK = threading.Lock()
+_INTEGRITY_FAULTS: list = []  # [dict(kind, host, at_step, remaining, fired)]
+
+
+def corrupt_gradient(host: str, at_step: int, times: int = 1 << 30):
+    """From global step ``at_step``, ``host``'s published
+    gradient/param checksums are deterministically perturbed for
+    ``times`` votes — simulating a host whose compute went silently
+    wrong.  The integrity vote's majority must flag and evict it."""
+    return _elastic_fault_entry(_INTEGRITY_LOCK, _INTEGRITY_FAULTS, {
+        "kind": "checksum", "host": str(host), "at_step": int(at_step),
+        "remaining": int(times), "fired": 0})
+
+
+def flip_param_bits(host: str, at_step: int, times: int = 1):
+    """Flip one mantissa bit in ``host``'s live parameter tree at
+    global step ``at_step`` (the driver applies :func:`flip_tree_bits`
+    when it sees this armed) — the classic SDC case: every value stays
+    finite and plausible, only the fingerprints can tell."""
+    return _elastic_fault_entry(_INTEGRITY_LOCK, _INTEGRITY_FAULTS, {
+        "kind": "flip", "host": str(host), "at_step": int(at_step),
+        "remaining": int(times), "fired": 0})
+
+
+@contextlib.contextmanager
+def _elastic_fault_entry(lock, registry, entry):
+    with lock:
+        registry.append(entry)
+    try:
+        yield entry
+    finally:
+        with lock:
+            registry.remove(entry)
+
+
+def corrupt_checksum(host: str, step: int, value: str) -> str:
+    """Called by simulated hosts before publishing an integrity-vote
+    checksum: returns a deterministically perturbed value while a
+    matching ``corrupt_gradient``/``flip_param_bits`` fault is armed,
+    ``value`` unchanged otherwise."""
+    if not _INTEGRITY_FAULTS:
+        return value
+    with _INTEGRITY_LOCK:
+        for f in _INTEGRITY_FAULTS:
+            if (f["host"] == host and f["remaining"] > 0
+                    and step >= f["at_step"]):
+                f["remaining"] -= 1
+                f["fired"] += 1
+                try:
+                    return f"{int(value, 16) ^ 0x5DC0FFEE:08x}"
+                except ValueError:
+                    return value[::-1] + "!"
+    return value
+
+
+def check_param_corruption(host: str, step: int) -> bool:
+    """Called by the real driver once per step: True when an armed
+    ``flip_param_bits`` fault fires for this host at this step (the
+    caller then applies :func:`flip_tree_bits` to its live params).
+    No-op (and free) when nothing is registered."""
+    if not _INTEGRITY_FAULTS:
+        return False
+    with _INTEGRITY_LOCK:
+        for f in _INTEGRITY_FAULTS:
+            if (f["kind"] == "flip" and f["host"] == host
+                    and f["remaining"] > 0 and step >= f["at_step"]):
+                f["remaining"] -= 1
+                f["fired"] += 1
+                return True
+    return False
+
+
+def flip_tree_bits(tree, seed: int = 0):
+    """A copy of ``tree`` with ONE mantissa bit flipped in its largest
+    leaf — values stay finite and plausibly sized (the bit is in the
+    middle of the mantissa, a ~2^-9 relative nudge), so NaN/Inf guards
+    ride straight past it: only fingerprints catch it."""
+    import jax
+    import jax.numpy as jnp
+
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    float_ix = [i for i, l in enumerate(leaves)
+                if np.issubdtype(np.asarray(l).dtype, np.floating)]
+    if not float_ix:
+        return tree
+    idx = max(float_ix, key=lambda i: np.asarray(leaves[i]).size)
+    a = np.array(leaves[idx])  # host copy, contiguous
+    flat = a.view(np.uint8).reshape(-1)
+    off = (flat.size // 2 + seed * a.itemsize) % flat.size
+    off -= off % a.itemsize  # leaf-element start (little-endian)
+    flat[off + 1] ^= 0x80    # mid-mantissa bit: finite, plausible, wrong
+    leaves[idx] = jnp.asarray(a)
+    return jax.tree_util.tree_unflatten(treedef, leaves)
+
+
 def poison_params(tree):
     """A NaN-poisoned copy of a param tree (every float leaf) — the
     hot-swap canary must reject it and roll back."""

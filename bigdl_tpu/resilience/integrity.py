@@ -1,0 +1,195 @@
+"""Step fingerprints + silent-data-corruption (SDC) defense.
+
+The reference framework's recovery story is deterministic,
+coarse-grained recomputation (BigDL, arXiv:1804.05839): any lost work
+can be replayed bit-for-bit from the last checkpoint.  That property
+makes a second, harder failure mode tractable — a host that computes
+*plausible but wrong* numbers (a flaky DIMM, a marginal MXU, a cosmic
+ray): because every step is a pure function of checkpointable state,
+"is this number right?" has a ground truth.  This module owns the two
+mechanisms built on that:
+
+* **Flight recorder** — :class:`FlightRecorder` keeps an append-only
+  JSONL journal of cheap per-step fingerprints: the loss's exact bit
+  pattern, the global gradient norm's bit pattern, a crc32c of the
+  batch bytes, and (at a configurable cadence) a crc32c of the full
+  parameter tree.  ~100 bytes/step of evidence; :mod:`.replay`
+  re-executes from a checkpoint and diffs journals to localize the
+  first divergent step.
+* **Cross-host integrity votes** — at a configurable cadence every
+  host publishes its parameter/gradient checksum through the elastic
+  KV transport (``sdc/<step>/<host>``); in synchronous SPMD training
+  every healthy host holds bit-identical post-all-gather parameters,
+  so a strict majority defines truth.  A minority host is flagged as
+  silently corrupting and escalated to the existing eviction +
+  verified-restore path (:class:`MembershipChangedError`); when no
+  strict majority exists the run stops with the fatal
+  :class:`IntegrityError` — continuing without a ground truth would
+  train on unknown-quality numbers.
+
+The deterministic fault injectors driving the tests live in
+:mod:`.faults` (``corrupt_gradient`` / ``flip_param_bits``); the full
+protocol and cadence/overhead guidance are in ``docs/determinism.md``.
+"""
+from __future__ import annotations
+
+import json
+import os
+import struct
+from typing import Any, List, Optional
+
+from .retry import FatalTrainingError
+
+
+class IntegrityError(FatalTrainingError):
+    """The cross-host integrity vote found no strict majority — there
+    is no quorum to define which parameters are correct, so neither
+    continuing nor evicting is sound.  Fatal: the run stops and the
+    operator decides (the journals + checkpoints hold the evidence)."""
+
+
+class SilentDataCorruptionError(RuntimeError):
+    """THIS host's parameter checksum was flagged by a healthy-host
+    majority: our own numbers are the wrong ones.  Retryable (``code``
+    ``"UNAVAILABLE"``): the retry loop restores the last verified
+    checkpoint, replacing the corrupt state with known-good bytes."""
+
+    code = "UNAVAILABLE"
+
+
+# ---------------------------------------------------------------------------
+# fingerprint primitives
+# ---------------------------------------------------------------------------
+
+def _crc_fn():
+    from .checkpoint import _native_crc
+
+    return _native_crc()
+
+
+def float_bits(x: Optional[float]) -> Optional[str]:
+    """The exact IEEE-754 float64 bit pattern of ``x`` as hex —
+    "equal" fingerprints mean bitwise-equal values, which is the whole
+    point: an SDC that perturbs the 20th mantissa bit still diverges."""
+    if x is None:
+        return None
+    return struct.pack("<d", float(x)).hex()
+
+
+def checksum_tree(tree: Any) -> str:
+    """crc32c over every leaf's raw bytes (deterministic pytree order)
+    — the cheap "are these parameters/gradients bit-identical?"
+    digest behind both the journal's param fingerprint and the
+    cross-host vote.  Device arrays are fetched to host; call at a
+    cadence, not every step, on large models."""
+    import jax
+    import numpy as np
+
+    crc_fn = _crc_fn()
+    crc = 0
+    for leaf in jax.tree_util.tree_leaves(tree):
+        a = np.asarray(leaf)
+        if not a.flags["C_CONTIGUOUS"]:
+            a = np.ascontiguousarray(a)
+        crc = crc_fn(a.tobytes(), crc)
+    return f"{crc:08x}"
+
+
+#: the batch-bytes digest is the same computation — a distinct name at
+#: call sites so journals read unambiguously
+batch_fingerprint = checksum_tree
+
+
+# ---------------------------------------------------------------------------
+# flight recorder
+# ---------------------------------------------------------------------------
+
+class FlightRecorder:
+    """Append-only JSONL journal of per-step fingerprints.
+
+    Two record kinds, aligned by ``step`` (= the iteration's ``neval``):
+
+    * ``{"kind": "step", "step", "epoch", "loss", "loss_bits",
+      "grad_norm", "grad_norm_bits", "batch_id", "skipped"}``
+    * ``{"kind": "param", "step", "param_crc"}`` — emitted every
+      ``param_crc_every`` steps (0 = only when the driver checkpoints).
+
+    Every line is flushed as written: after a crash the journal is
+    complete up to the last finished step (a torn trailing line is
+    skipped by :func:`bigdl_tpu.resilience.replay.load_journal`).
+    """
+
+    def __init__(self, path: str, param_crc_every: int = 0):
+        self.path = str(path)
+        self.param_crc_every = int(param_crc_every)
+        d = os.path.dirname(self.path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        self._f = open(self.path, "a")
+        self.steps_recorded = 0
+
+    # ------------------------------------------------------------------
+    def record_step(self, step: int, epoch: int, loss: float,
+                    grad_norm: Optional[float] = None,
+                    batch_id: Optional[str] = None,
+                    skipped: bool = False):
+        self._write({
+            "kind": "step", "step": int(step), "epoch": int(epoch),
+            "loss": float(loss), "loss_bits": float_bits(loss),
+            "grad_norm": None if grad_norm is None else float(grad_norm),
+            "grad_norm_bits": float_bits(grad_norm),
+            "batch_id": batch_id, "skipped": bool(skipped)})
+        self.steps_recorded += 1
+
+    def wants_param_crc(self, step: int) -> bool:
+        return self.param_crc_every > 0 and \
+            int(step) % self.param_crc_every == 0
+
+    def record_param(self, step: int, param_crc: str):
+        self._write({"kind": "param", "step": int(step),
+                     "param_crc": str(param_crc)})
+
+    # ------------------------------------------------------------------
+    def _write(self, rec: dict):
+        if self._f is None:
+            raise ValueError(f"record on closed FlightRecorder "
+                             f"({self.path})")
+        self._f.write(json.dumps(rec) + "\n")
+        self._f.flush()
+
+    def close(self):
+        if self._f is not None:
+            self._f.close()
+            self._f = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+
+def majority_vote(votes: dict, members: List[str]):
+    """Strict-majority vote over ``{host: checksum}``.
+
+    Returns ``(truth_checksum, corrupt_hosts)`` where ``corrupt_hosts``
+    are publishers disagreeing with the majority value.  Raises
+    :class:`IntegrityError` when no checksum is held by a strict
+    majority of ``members`` (silent hosts count against quorum — a
+    2-2 split or a gang too partitioned to vote has no ground truth).
+    """
+    from collections import Counter
+
+    counted = Counter(v for v in votes.values() if v is not None)
+    if not counted:
+        raise IntegrityError(
+            f"integrity vote received no checksums from {members}")
+    top, n_top = counted.most_common(1)[0]
+    if 2 * n_top <= len(members):
+        raise IntegrityError(
+            f"no integrity quorum: {dict(counted)} across "
+            f"{len(members)} member(s) — cannot decide which "
+            "parameters are correct")
+    corrupt = sorted(h for h, v in votes.items() if v != top)
+    return top, corrupt

@@ -1,0 +1,148 @@
+"""Deterministic replay: localize the first divergent step.
+
+Because training state is *total* — parameters, optimizer slots, the
+host RNG stream, and the input pipeline's order/cursor all live inside
+the verified checkpoint (docs/determinism.md) — re-executing from
+checkpoint K is bit-faithful: a healthy machine reproduces the flight
+recorder's journal exactly.  So when a run's numbers are suspect (an
+integrity vote fired, a loss curve bent oddly, a repro request), replay
+is the microscope: restore checkpoint K, re-run to step N with a fresh
+recorder, and diff the two journals.  The first fingerprint that
+differs names the first divergent step AND the field that diverged —
+``batch_id`` (the input pipeline fed different bytes), ``loss_bits`` /
+``grad_norm_bits`` (the compute produced different numbers from the
+same input), or ``param_crc`` (the state itself was perturbed between
+steps).
+
+This is the per-host complement of the cross-host vote in
+:mod:`.integrity`: votes localize *which host* corrupts in a gang;
+replay localizes *which step* (and which stage) on one host.
+"""
+from __future__ import annotations
+
+import json
+import logging
+from typing import Callable, List, Optional
+
+log = logging.getLogger("bigdl_tpu")
+
+#: journal fields compared, in blame order: a batch_id mismatch
+#: explains every later mismatch, so it is reported first
+DIFF_FIELDS = ("batch_id", "loss_bits", "grad_norm_bits", "param_crc")
+
+
+def load_journal(path: str) -> List[dict]:
+    """Parse a flight-recorder JSONL journal; a torn trailing line
+    (crash mid-write) is skipped, matching the append+flush contract."""
+    out = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                out.append(json.loads(line))
+            except ValueError:
+                log.warning("journal %s: skipping torn line %r",
+                            path, line[:80])
+    return out
+
+
+def diff_journals(expected: List[dict], actual: List[dict]
+                  ) -> Optional[dict]:
+    """First fingerprint divergence between two journals, or None.
+
+    Records align on ``(kind, step)``; only steps present in BOTH
+    journals are compared (replay starts mid-journal), scanned in step
+    order so the returned divergence is the *first* one.  Returns
+    ``{"step", "kind", "field", "expected", "actual"}``.
+    """
+    index = {(r.get("kind", "step"), r["step"]): r for r in actual}
+    for rec in sorted(expected, key=lambda r: (r["step"],
+                                               r.get("kind", "step"))):
+        other = index.get((rec.get("kind", "step"), rec["step"]))
+        if other is None:
+            continue
+        for field in DIFF_FIELDS:
+            a, b = rec.get(field), other.get(field)
+            if a is None or b is None:
+                continue
+            if a != b:
+                return {"step": int(rec["step"]),
+                        "kind": rec.get("kind", "step"),
+                        "field": field, "expected": a, "actual": b}
+    return None
+
+
+def replay(make_optimizer: Callable, checkpoint_dir: str,
+           journal_path: str, from_step: Optional[int] = None,
+           end_step: Optional[int] = None,
+           replay_journal: Optional[str] = None,
+           param_crc_every: int = 0) -> dict:
+    """Re-execute training from a checkpoint and localize divergence.
+
+    ``make_optimizer`` must return a freshly configured optimizer
+    (model, dataset, criterion, optim method — the same recipe as the
+    original run); replay then
+
+    1. restores the newest checkpoint at or below ``from_step`` from
+       ``checkpoint_dir`` (verified walk-back; params, slots, RNG and
+       pipeline cursor all come back),
+    2. re-runs to ``end_step`` (default: the original journal's last
+       step) with a fresh :class:`~.integrity.FlightRecorder` —
+       checkpoint WRITES are disabled so the evidence directory is
+       never touched,
+    3. diffs the replayed journal against the original.
+
+    Returns ``{"from_step", "end_step", "steps_compared",
+    "divergence", "replay_journal"}`` where ``divergence`` is
+    :func:`diff_journals`' verdict (None = the original run verifies
+    bit-for-bit over the replayed window).
+    """
+    from ..optim.trigger import max_iteration
+    from .integrity import FlightRecorder
+
+    original = load_journal(journal_path)
+    if not original:
+        raise ValueError(f"journal {journal_path} is empty — nothing "
+                         "to replay against")
+    last = max(r["step"] for r in original)
+    end_step = int(end_step or last)
+
+    opt = make_optimizer()
+    opt.checkpoint_path = str(checkpoint_dir)
+    if not opt.resume_from_checkpoint(step=from_step):
+        raise ValueError(
+            f"no restorable checkpoint at or below step {from_step} "
+            f"in {checkpoint_dir}")
+    # replay is read-only on the evidence: never write new checkpoints
+    # (or train state) into the directory under investigation
+    opt.checkpoint_path = None
+    opt.checkpoint_trigger = None
+
+    rec_path = replay_journal or f"{journal_path}.replay"
+    recorder = FlightRecorder(rec_path, param_crc_every=param_crc_every)
+    opt.set_flight_recorder(recorder)
+    opt.set_end_when(max_iteration(end_step))
+    try:
+        opt.optimize()
+    finally:
+        recorder.close()
+
+    replayed = load_journal(rec_path)
+    steps = {r["step"] for r in replayed}
+    window = [r for r in original if r["step"] in steps]
+    divergence = diff_journals(window, replayed)
+    report = {
+        "from_step": from_step, "end_step": end_step,
+        "steps_compared": len({r["step"] for r in window}),
+        "divergence": divergence, "replay_journal": rec_path,
+    }
+    if divergence is None:
+        log.info("replay: %d step(s) reproduced bit-for-bit — no "
+                 "divergence", report["steps_compared"])
+    else:
+        log.warning("replay: first divergence at step %d (%s: %s -> %s)",
+                    divergence["step"], divergence["field"],
+                    divergence["expected"], divergence["actual"])
+    return report

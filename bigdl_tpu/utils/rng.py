@@ -32,6 +32,21 @@ class RandomGenerator:
     def get_seed(self) -> int:
         return self._seed
 
+    # -- checkpointable state (the determinism contract) ---------------
+    def state_dict(self) -> dict:
+        """Total generator state: the seed plus the MT19937
+        bit-generator state (position in the stream included), so a
+        restored generator continues the exact bit sequence — the host
+        RNG's half of bitwise-faithful resume (docs/determinism.md)."""
+        return {"seed": self._seed,
+                "bit_generator": self._rng.bit_generator.state}
+
+    def load_state_dict(self, state: dict) -> "RandomGenerator":
+        self._seed = state["seed"]
+        self._rng = np.random.Generator(np.random.MT19937(self._seed))
+        self._rng.bit_generator.state = state["bit_generator"]
+        return self
+
     def clone(self) -> "RandomGenerator":
         c = RandomGenerator(self._seed)
         c._rng.bit_generator.state = self._rng.bit_generator.state
@@ -71,8 +86,35 @@ def RNG() -> RandomGenerator:
     return _local.rng
 
 
+# the last seed EXPLICITLY requested through set_global_seed (None until
+# then): derived streams (synthetic datasets, per-dataset shard
+# shufflers) key off it so one call re-seeds every stream, while code
+# that never opts in keeps its historical fixed seeds
+_explicit_seed = None
+
+
 def set_global_seed(seed: int):
+    global _explicit_seed
+    _explicit_seed = int(seed)
     RNG().set_seed(seed)
+
+
+def derive_seed(fallback: int) -> int:
+    """Seed for a named sub-stream: the historical ``fallback`` when no
+    global seed was ever set (exact legacy behavior), otherwise a
+    deterministic mix of the global seed and the stream id — so
+    ``set_global_seed`` actually governs every generator in the tree
+    without collapsing distinct streams onto one sequence."""
+    if _explicit_seed is None:
+        return int(fallback)
+    return (_explicit_seed * 0x9E3779B1 + int(fallback)) % (2**31 - 1)
+
+
+def np_stream(fallback: int) -> "np.random.RandomState":
+    """A ``RandomState`` for a derived sub-stream (see
+    :func:`derive_seed`) — the routing point for the synthetic dataset
+    generators in ``dataset/datasets.py``."""
+    return np.random.RandomState(derive_seed(fallback))
 
 
 def next_jax_key():

@@ -109,6 +109,21 @@ class ElasticSummary(Summary):
         super().__init__(log_dir, os.path.join(app_name, "elastic"))
 
 
+class IntegritySummary(Summary):
+    """Integrity/determinism metrics stream (``<app>/integrity``) — the
+    export target of the SDC-defense layer
+    (``resilience.integrity`` + ``ElasticContext.integrity_vote``):
+    ``IntegrityVotes`` (cross-host checksum rounds completed),
+    ``IntegrityDisagreements`` (rounds where a minority checksum was
+    flagged), ``IntegrityEvictions`` (hosts evicted for silent data
+    corruption) and ``FingerprintSteps`` (flight-recorder journal
+    length), so corruption evidence lands next to the train/validation
+    curves in the same tensorboard layout."""
+
+    def __init__(self, log_dir: str, app_name: str):
+        super().__init__(log_dir, os.path.join(app_name, "integrity"))
+
+
 def read_scalars(log_dir: str, tag: str) -> List[Tuple[int, float]]:
     """Read scalar events back (reference tensorboard/FileReader —
     serves the python ``summary_read_scalar`` API)."""

@@ -2,6 +2,7 @@
 docs/ACCURACY.md records the full 60-epoch run at 0.9899): LeNet-5 on
 real handwritten digits through the complete Optimizer lifecycle —
 triggers, validation, summaries, checkpoints, restore."""
+import pytest
 
 
 def test_lenet_digits_full_lifecycle_accuracy():
@@ -13,6 +14,7 @@ def test_lenet_digits_full_lifecycle_accuracy():
     assert acc >= 0.97, f"LeNet digits accuracy regressed: {acc}"
 
 
+@pytest.mark.slow
 def test_resnet_distributed_lifecycle_accuracy():
     """VERDICT r2 #8: the DISTRIBUTED driver trains a ResNet-CIFAR
     topology to accuracy on the 8-device mesh — sharded momentum slots,

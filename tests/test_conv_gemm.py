@@ -139,6 +139,7 @@ def _walk(m):
             yield from _walk(node.element)
 
 
+@pytest.mark.slow
 def test_jax_twin_forward_and_step():
     """The independent plain-JAX twin runs: forward shapes, one train
     step, finite loss (perf numbers are measured on hardware by
@@ -169,6 +170,7 @@ def test_jax_twin_gemm_impl_matches_xla():
     np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-4)
 
 
+@pytest.mark.slow
 def test_jax_twin_nchw_layout_matches_nhwc():
     """The layout-decomposition probe is the same function: NCHW-flowing
     activations produce the NHWC twin's outputs exactly (same NHWC

@@ -38,6 +38,7 @@ def _gpt2_checkpoint_path():
     return path
 
 
+@pytest.mark.slow
 def test_gpt2_10m_checkpoint_roundtrip_100_prompts():
     """load(ckpt) → save_gpt2 → load_gpt2 must reproduce torch's own
     logits on 100 prompts at a ~10M-parameter scale."""

@@ -9,6 +9,7 @@ same fix: the jitted step updates parameters in place.
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from bigdl_tpu import nn
 from bigdl_tpu.dataset import Sample, array
@@ -47,6 +48,7 @@ def test_local_bf16_converges_with_f32_master_weights():
     assert acc > 0.9, f"bf16 XOR accuracy {acc}"
 
 
+@pytest.mark.slow
 def test_distri_bf16_converges():
     Engine.init()
     ds = array(xor_samples())

@@ -175,6 +175,7 @@ def test_nan_injection_skipped_and_converges_local():
     assert res[0][0].result()[0] > 0.85
 
 
+@pytest.mark.slow
 def test_nan_injection_skipped_distri():
     """Same contract through the shard_mapped reduce-scatter step: the
     skip predicate must agree across all 8 shards (pmin)."""
