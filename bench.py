@@ -763,13 +763,20 @@ def _serving_measurements(rate_rps: float = 800.0, duration_s: float = 4.0,
                 n += 1
             time.sleep(0.0005)
         steady = [f.result(timeout=120) for f in futs]
-        ok_lat = sorted(r.latency_s for r in steady if r.ok)
+        ok_lat = [r.latency_s for r in steady if r.ok]
         shed = sum(r.status is Status.OVERLOADED for r in steady)
 
+        # the one quantile implementation (telemetry.Histogram — exact
+        # over its sample window), not a third hand-rolled percentile
+        from bigdl_tpu.telemetry import Histogram
+
+        lat_hist = Histogram(window=max(1, len(ok_lat)))
+        for v in ok_lat:
+            lat_hist.observe(v)
+
         def pct(q):
-            return round(ok_lat[min(len(ok_lat) - 1,
-                                    int(q * len(ok_lat)))] * 1e3, 3) \
-                if ok_lat else None
+            p = lat_hist.quantile(q)
+            return round(p * 1e3, 3) if p is not None else None
 
         # burst: 2x the queue bound submitted as fast as possible —
         # admission control must shed the overflow fast and typed
@@ -1163,6 +1170,133 @@ def run_integrity_bench() -> None:
 
 
 # --------------------------------------------------------------------------
+# Telemetry leg: tracer+registry overhead on the compiled step loop
+# --------------------------------------------------------------------------
+
+TELEMETRY_TIMEOUT = float(os.environ.get("BENCH_TELEMETRY_TIMEOUT", "240"))
+TELEMETRY_RESULT = "TELEMETRY_r01.json"
+
+
+def _telemetry_measurements(steps: int = 300, batch: int = 512,
+                            hidden: int = 128, repeats: int = 3):
+    """Cost of the full telemetry spine (registry histograms + goodput
+    ledger + tracer spans at the default every-step cadence) on the
+    compiled step loop: the same LocalOptimizer workload run
+    alternately bare and with a Telemetry bundle attached (fresh model
+    each pass, so every pass pays exactly one compile), overhead taken
+    between the MIN walls over ``repeats`` alternating pairs (min, not
+    mean: scheduler noise only ever adds time).  The defaults run
+    enough post-compile steps that the steady-state loop dominates the
+    one compile, so the delta measures the per-step tax, not compile
+    jitter.  Plus per-op microbenches pinning the primitive costs the
+    loop pays per step."""
+    from bigdl_tpu import nn
+    from bigdl_tpu.dataset import Sample, array
+    from bigdl_tpu.optim import SGD, max_iteration
+    from bigdl_tpu.optim.optimizer import LocalOptimizer
+    from bigdl_tpu.telemetry import MetricsRegistry, Telemetry, Tracer
+
+    import numpy as np
+
+    rng = np.random.RandomState(0)
+    x = rng.rand(1024, 16).astype(np.float32)
+    w = rng.rand(16, 1).astype(np.float32)
+    y = (x @ w + 0.3).astype(np.float32)
+    samples = [Sample(x[i], y[i]) for i in range(len(x))]
+    data = array(samples)
+
+    def run(telemetry):
+        model = nn.Sequential(nn.Linear(16, hidden), nn.Tanh(),
+                              nn.Linear(hidden, 1))
+        opt = LocalOptimizer(model, data, nn.MSECriterion(),
+                             batch_size=batch)
+        opt.set_optim_method(SGD(learning_rate=0.01))
+        opt.set_end_when(max_iteration(steps))
+        if telemetry is not None:
+            opt.set_telemetry(telemetry)
+        t0 = time.monotonic()
+        opt.optimize()
+        return time.monotonic() - t0
+
+    bare_walls, tel_walls = [], []
+    tm = None
+    for _ in range(max(1, repeats)):
+        bare_walls.append(run(None))
+        tm = Telemetry(registry=MetricsRegistry())
+        tel_walls.append(run(tm))
+    bare, tel = min(bare_walls), min(tel_walls)
+    pct = 100.0 * (tel - bare) / max(bare, 1e-9)
+
+    # per-op costs: what one driver iteration actually pays
+    reg = MetricsRegistry()
+    hist = reg.histogram("bench_seconds", window=1024)
+    cnt = reg.counter("bench_total")
+    n = 50_000
+    t0 = time.perf_counter()
+    for i in range(n):
+        hist.observe(i * 1e-6)
+    observe_ns = (time.perf_counter() - t0) / n * 1e9
+    t0 = time.perf_counter()
+    for _ in range(n):
+        cnt.inc()
+    counter_ns = (time.perf_counter() - t0) / n * 1e9
+    tr = Tracer(capacity=1024)
+    t0 = time.perf_counter()
+    for i in range(n):
+        tr.record("step", "step", i * 1e-3, 1e-3)
+    span_ns = (time.perf_counter() - t0) / n * 1e9
+
+    gp = tm.ledger.snapshot() if tm is not None else {}
+    return {
+        "telemetry_steps": steps,
+        "telemetry_batch": batch,
+        "trace_every": 1,
+        "bare_wall_s": round(bare, 3),
+        "telemetry_wall_s": round(tel, 3),
+        "overhead_pct": round(pct, 2),
+        "histogram_observe_ns": round(observe_ns, 0),
+        "counter_inc_ns": round(counter_ns, 0),
+        "tracer_record_ns": round(span_ns, 0),
+        "goodput_accounted_fraction": round(
+            float(gp.get("accounted_fraction", 0.0)), 4),
+        "goodput_productive_fraction": round(
+            float(gp.get("productive_fraction", 0.0)), 4),
+        "trace_events": len(tm.tracer.spans()) if tm is not None else 0,
+    }
+
+
+def run_telemetry_bench() -> None:
+    """--telemetry mode: measure the spine's overhead on the compiled
+    step loop (target <3% at the default every-step tracing cadence),
+    write TELEMETRY_r01.json, print the one JSON line."""
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    out = {"bench": "telemetry", "backend": "cpu",
+           "measured_at": _utc_now()}
+    try:
+        out.update(_telemetry_measurements())
+        out.update({
+            "metric": "telemetry spine overhead on the compiled "
+                      "step loop",
+            "value": out.get("overhead_pct", 0.0),
+            "unit": "%",
+            "target": "<3%",
+        })
+    except Exception as e:
+        out["error"] = f"{type(e).__name__}: {e}"[:500]
+        out.update({"metric": "telemetry spine overhead on the "
+                              "compiled step loop",
+                    "value": 0.0, "unit": "%", "target": "<3%"})
+    try:
+        with open(os.path.join(_here(), TELEMETRY_RESULT), "w") as f:
+            json.dump(out, f, indent=1)
+    except OSError:
+        pass
+    print(json.dumps(out), flush=True)
+
+
+# --------------------------------------------------------------------------
 # Probe: initialize the backend, print device info (runs in a subprocess)
 # --------------------------------------------------------------------------
 
@@ -1434,6 +1568,28 @@ def main() -> None:
                          or "integrity leg returned nothing"}
     result["integrity"] = integrity
 
+    # telemetry leg: tracer+registry overhead on the compiled step loop
+    # (<3% target at default cadence; backend-independent, lands in
+    # TELEMETRY_r01.json) — best-effort like the other legs;
+    # BENCH_TELEMETRY_TIMEOUT=0 disables it.
+    if TELEMETRY_TIMEOUT <= 0:
+        telemetry = {"skipped": "BENCH_TELEMETRY_TIMEOUT=0"}
+    else:
+        ok, tres, note = _run_sub(["--telemetry"], TELEMETRY_TIMEOUT)
+        if ok and tres and "error" not in tres:
+            telemetry = {
+                "overhead_pct": tres.get("overhead_pct"),
+                "tracer_record_ns": tres.get("tracer_record_ns"),
+                "histogram_observe_ns": tres.get("histogram_observe_ns"),
+                "goodput_accounted_fraction": tres.get(
+                    "goodput_accounted_fraction"),
+                "source": TELEMETRY_RESULT,
+            }
+        else:
+            telemetry = {"error": (tres or {}).get("error") or note
+                         or "telemetry leg returned nothing"}
+    result["telemetry"] = telemetry
+
     if not from_tpu:
         # the tunnel dies for hours at a time: the judged artifact must
         # still CARRY the chip numbers, honestly stamped — merge the
@@ -1474,6 +1630,7 @@ if __name__ == "__main__":
     p.add_argument("--serving", action="store_true")
     p.add_argument("--elastic", action="store_true")
     p.add_argument("--integrity", action="store_true")
+    p.add_argument("--telemetry", action="store_true")
     p.add_argument("--worker", choices=["tpu", "cpu"])
     a = p.parse_args()
     if a.probe:
@@ -1484,6 +1641,8 @@ if __name__ == "__main__":
         run_elastic_bench()
     elif a.integrity:
         run_integrity_bench()
+    elif a.telemetry:
+        run_telemetry_bench()
     elif a.worker:
         run_worker(a.worker)
     else:

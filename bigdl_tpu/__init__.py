@@ -8,3 +8,12 @@ device mesh, XLA collectives instead of a block-manager all-reduce.
 """
 
 __version__ = "0.2.0"
+
+# Default logging: one stderr handler with the canonical format, unless
+# the embedding application already configured handlers (then this is a
+# no-op).  Library modules themselves never call logging.basicConfig —
+# the observability lint in tests/test_determinism.py enforces it.
+from .telemetry.slog import configure_logging as _configure_logging
+
+_configure_logging()
+del _configure_logging

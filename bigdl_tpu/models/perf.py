@@ -2,7 +2,7 @@
 DistriOptimizerPerf.scala:32 — SURVEY §2.5 'Perf harness').
 
 Times the full train step (forward + backward + update) of the zoo's
-ImageNet workloads on constant/random input, printing per-iteration
+ImageNet workloads on constant/random input, logging per-iteration
 wall time and average records/second, matching the reference's
 measured quantity (DistriOptimizer.scala:295-297 log line).
 
@@ -13,9 +13,12 @@ Usage:
 from __future__ import annotations
 
 import argparse
+import logging
 import time
 
 import numpy as np
+
+log = logging.getLogger("bigdl_tpu")
 
 
 MODELS = ("inception_v1", "inception_v2", "vgg16", "vgg19", "resnet50",
@@ -110,12 +113,15 @@ def performance(model_name: str, batch_size: int, iterations: int,
         loss_v = float(loss)  # value fetch = execution barrier
         dt = time.perf_counter() - t0
         times.append(dt)
-        print(f"Iteration {i + 1} {model_name} batch {batch_size}: "
-              f"{dt * 1000:.1f} ms, throughput {batch_size / dt:.2f} "
-              f"records/second, loss {loss_v:.4f}")
+        log.info(
+            "Iteration %d %s batch %d: %.1f ms, throughput %.2f "
+            "records/second, loss %.4f", i + 1, model_name, batch_size,
+            dt * 1000, batch_size / dt, loss_v)
     avg = float(np.mean(times))
-    print(f"Average throughput is {batch_size / avg:.2f} records/second "
-          f"(avg iteration {avg * 1000:.1f} ms over {iterations} runs)")
+    log.info(
+        "Average throughput is %.2f records/second (avg iteration "
+        "%.1f ms over %d runs)", batch_size / avg, avg * 1000,
+        iterations)
     return batch_size / avg
 
 

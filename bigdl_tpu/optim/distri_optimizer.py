@@ -342,6 +342,7 @@ class DistriOptimizer(Optimizer):
         from ..parallel.spmd import make_train_step
         from .optimizer import _epoch_records, _resume_slots
 
+        self._tm_attempt_begin()
         model, optim = self.model, self.optim_method
         model.training()
         n_data = mesh.shape.get("data", 1)
@@ -373,6 +374,7 @@ class DistriOptimizer(Optimizer):
                                                          epoch_size)
         wall_start = time.time()
 
+        first_step = True  # first dispatch = XLA build (telemetry)
         while not self.end_when(state):
             state["epoch_finished"] = False
             self._elastic_step_start(state)
@@ -414,6 +416,9 @@ class DistriOptimizer(Optimizer):
                              rng=next_jax_key(), **mask_kw), state)
             loss = float(loss)  # value fetch = execution barrier
             train_time = time.time() - t0
+            self._tm_step(state, train_time, infeed_time, n_records,
+                          compiled=first_step)
+            first_step = False
             self._check_loss_anomaly(loss, skipped=False)
             params = self._maybe_corrupt_params(state, params)
             # fused multi-axis step: grad norm is not a program output
@@ -496,6 +501,7 @@ class DistriOptimizer(Optimizer):
         optim._slots = jax.device_get(slots)
         model.evaluate()
         self._orbax_close()
+        self._tm_finish(state)
         return model
 
     # ------------------------------------------------------------------
@@ -534,6 +540,7 @@ class DistriOptimizer(Optimizer):
                                          pack_params, unpack_params)
         from .optimizer import _epoch_records, _resume_slots
 
+        self._tm_attempt_begin()
         model, optim = self.model, self.optim_method
         model.training()
         n_data = mesh.shape.get("data", 1)
@@ -572,6 +579,7 @@ class DistriOptimizer(Optimizer):
             unpack_params(jax.device_get(packed), model)
             optim._slots = jax.device_get(slots)
 
+        first_step = True  # first dispatch = XLA build (telemetry)
         while not self.end_when(state):
             state["epoch_finished"] = False
             self._elastic_step_start(state)
@@ -603,6 +611,9 @@ class DistriOptimizer(Optimizer):
                              rng=next_jax_key(), **mask_kw), state)
             loss = float(loss)  # value fetch = execution barrier
             train_time = time.time() - t0
+            self._tm_step(state, train_time, infeed_time, n_records,
+                          compiled=first_step)
+            first_step = False
             self._check_loss_anomaly(loss, skipped=False)
             packed = self._maybe_corrupt_params(state, packed)
             # fused pipeline step: grad norm is not a program output
@@ -680,6 +691,7 @@ class DistriOptimizer(Optimizer):
         _sync_to_model()
         model.evaluate()
         self._orbax_close()
+        self._tm_finish(state)
         return model
 
     def _validate_multi_axis(self, state, eval_fwd, params, buffers,
@@ -738,6 +750,7 @@ class DistriOptimizer(Optimizer):
 
     # ------------------------------------------------------------------
     def _optimize_once(self, mesh, n_dev) -> AbstractModule:
+        self._tm_attempt_begin()
         model, optim = self.model, self.optim_method
         model.training()
 
@@ -783,6 +796,7 @@ class DistriOptimizer(Optimizer):
         wall_start = time.time()
 
         pending = None
+        first_step = True  # first dispatch = XLA build (telemetry)
         while not self.end_when(state):
             state["epoch_finished"] = False
             self._elastic_step_start(state)
@@ -807,7 +821,12 @@ class DistriOptimizer(Optimizer):
                         "your dataset to a batch multiple of the mesh")
                 x, y, w = pad_batch(x, y, n_records,
                                     round_up(n_records, n_dev))
+            t_h2d0 = time.time()
             x, y = shard_batch(mesh, (x, y))
+            h2d_time = time.time() - t_h2d0
+            if self.telemetry is not None:
+                self.telemetry.on_host_to_device(h2d_time,
+                                                 step=state["neval"])
             infeed_time = time.time() - t_data0
 
             # profile past the compile iteration so timings are warm
@@ -870,6 +889,13 @@ class DistriOptimizer(Optimizer):
                 train_time = time.time() - t0
             _, params, buffers, slots, step_ok, gnorm = out
             skipped = not bool(step_ok)
+            # the h2d slice of infeed_time was attributed above — feed
+            # only the remainder as data wait (no double counting)
+            self._tm_step(state, train_time,
+                          max(0.0, infeed_time - h2d_time), n_records,
+                          compiled=first_step, phase_split=trace_split,
+                          skipped=skipped)
+            first_step = False
             self._check_loss_anomaly(loss, skipped)
             params = self._maybe_corrupt_params(state, params)
             self._record_fingerprint(state, loss, float(gnorm), (x, y),
@@ -975,6 +1001,7 @@ class DistriOptimizer(Optimizer):
         optim._slots = slots
         model.evaluate()
         self._orbax_close()
+        self._tm_finish(state)
         return model
 
     def _validate_on_mesh(self, state, mesh, params, buffers):

@@ -32,7 +32,7 @@ class Metrics:
         return None if v is None else v[0] / v[1]
 
     def summary(self, unit: str = "s", scale: float = 1.0) -> str:
-        """Pretty print (reference Metrics.summary:103-121)."""
+        """Pretty-printable table (reference Metrics.summary:103-121)."""
         lines = ["========== Metrics Summary =========="]
         for name, (value, parallel) in sorted(self._scalars.items()):
             lines.append(f"{name} : {value / parallel / scale} {unit}")

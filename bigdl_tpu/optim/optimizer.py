@@ -34,10 +34,10 @@ from .regularizer import collect_regularizer_paths, regularizer_loss
 from .trigger import Trigger
 from .validation import ValidationMethod
 
+# the library never configures root logging at import time (the
+# print/basicConfig lint enforces it); applications and the package's
+# own entry points opt in via telemetry.slog.configure_logging()
 log = logging.getLogger("bigdl_tpu")
-logging.basicConfig(
-    level=logging.INFO,
-    format="%(asctime)s %(levelname)s %(name)s: %(message)s")
 
 
 class Optimizer:
@@ -121,6 +121,10 @@ class Optimizer:
         # off unless set_flight_recorder attaches one
         self.flight_recorder = None
         self.integrity_summary = None
+        # unified telemetry spine (bigdl_tpu/telemetry): metrics
+        # registry + structured tracer + goodput ledger — off unless
+        # set_telemetry attaches one
+        self.telemetry = None
         # input-pipeline resume cursor (records already trained in the
         # interrupted epoch) — set by resume_from_checkpoint when the
         # checkpoint carries train state, consumed once by the loop
@@ -292,6 +296,19 @@ class Optimizer:
             self.elastic.integrity_summary = summary
         return self
 
+    def set_telemetry(self, telemetry):
+        """Attach a :class:`bigdl_tpu.telemetry.Telemetry` bundle: the
+        step loop then feeds the metrics registry (step/data-wait/
+        checkpoint histograms, step/record counters), records
+        categorized spans into the tracer (Chrome-trace/Perfetto
+        export), and classifies run wall clock in the goodput ledger
+        (productive/compile/data-stall/checkpoint/recovery/idle —
+        docs/observability.md).  Pass ``None`` to detach."""
+        self.telemetry = telemetry
+        if self.elastic is not None:
+            self.elastic.telemetry = telemetry
+        return self
+
     def set_elastic(self, context):
         """Attach an elastic-cluster context
         (``resilience.elastic.ElasticContext``): the step loop then
@@ -306,6 +323,8 @@ class Optimizer:
         if context is not None:
             if self.integrity_summary is not None:
                 context.integrity_summary = self.integrity_summary
+            if self.telemetry is not None:
+                context.telemetry = self.telemetry
             if self.batch_size is not None:
                 context.attach(batch_size=self.batch_size)
             if self.drop_percentage > 0:
@@ -351,6 +370,38 @@ class Optimizer:
 
     def _restore_latest(self):
         self.resume_from_checkpoint()
+
+    # -- telemetry plumbing shared by the drivers -----------------------
+    def _tm_attempt_begin(self):
+        """Top of every optimize attempt: start the goodput run clock
+        (idempotent — only the first attempt stamps it)."""
+        if self.telemetry is not None:
+            self.telemetry.on_attempt_begin()
+
+    def _tm_step(self, state, train_time: float, data_time: float,
+                 records: int, compiled: bool = False,
+                 phase_split=None, skipped: bool = False):
+        """One driver iteration for the telemetry spine: data-wait +
+        step time into the registry histograms and goodput ledger,
+        categorized spans into the tracer (``compiled=True`` marks the
+        first step of a fresh program — mostly XLA build time;
+        ``phase_split`` attributes a profiled step's device time to
+        compute/collective children)."""
+        tm = self.telemetry
+        if tm is None:
+            return
+        step = state["neval"]
+        if data_time > 0:
+            tm.on_data_wait(data_time, step=step)
+        tm.on_step(train_time, records=records, step=step,
+                   compiled=compiled, phase_split=phase_split,
+                   skipped=skipped)
+
+    def _tm_finish(self, state):
+        """End of a training loop: drop the host's snapshot file when a
+        snapshot directory is configured (tools/run_report.py input)."""
+        if self.telemetry is not None:
+            self.telemetry.write_snapshot(step=state.get("neval"))
 
     # -- determinism + integrity plumbing (docs/determinism.md) ---------
     def _fault_host(self) -> str:
@@ -480,6 +531,9 @@ class Optimizer:
 
         def on_retry(exc, attempt):
             self.rollbacks += 1
+            if self.telemetry is not None:
+                # everything until the next completed step is recovery
+                self.telemetry.on_recovery_begin()
             if self.spike_detector is not None:
                 self.spike_detector.reset()
             self._restore_latest()
@@ -531,6 +585,7 @@ class Optimizer:
 
         if self.checkpoint_path is None:
             return
+        t_ck0 = time.time()
         n = state["neval"] - 1
         suffix = "" if self.is_overwrite else f".{n}"
         file_io.save(self.model,
@@ -548,6 +603,8 @@ class Optimizer:
                                   f"trainState{suffix}"),
                      overwrite=True, atomic=True, checksum=True)
         self._record_checkpoint_param_crc(state, self.model.param_tree())
+        if self.telemetry is not None:
+            self.telemetry.on_checkpoint(time.time() - t_ck0, step=n)
 
     # -- orbax sharded checkpoints (utils/orbax_io.py) -------------------
     @staticmethod
@@ -573,6 +630,7 @@ class Optimizer:
 
         if self._orbax is None:
             self._orbax = ShardedCheckpointer(self.checkpoint_path)
+        t_ck0 = time.time()
         n = state["neval"] - 1
         # retention safety: snapshot the newest COMMITTED step before
         # kicking off step n's async save — probing after the save
@@ -618,6 +676,10 @@ class Optimizer:
                             p = os.path.join(self._orbax.directory, name)
                             (shutil.rmtree if is_dir
                              else os.remove)(p)
+        if self.telemetry is not None:
+            # the async save's host-side dispatch cost; the shard
+            # writes overlap the next steps by design
+            self.telemetry.on_checkpoint(time.time() - t_ck0, step=n)
 
     def _orbax_restore_into_model(self) -> bool:
         """Restore the newest orbax step host-side into the live
@@ -828,6 +890,7 @@ class LocalOptimizer(Optimizer):
 
     def _optimize_loop(self) -> AbstractModule:
         self._elastic_begin()
+        self._tm_attempt_begin()
         model, criterion, optim = self.model, self.criterion, self.optim_method
         model.training()
         from ..parallel.moe import aux_loss_term, collect_aux_paths
@@ -925,6 +988,9 @@ class LocalOptimizer(Optimizer):
             return b.size(), x, y, time.time() - t0
 
         pending = None
+        first_step = True  # the first dispatch of a fresh program is
+        #                    dominated by the XLA build (telemetry:
+        #                    compile, not productive)
         while not self.end_when(state):
             state["epoch_finished"] = False
             self._elastic_step_start(state)
@@ -945,6 +1011,9 @@ class LocalOptimizer(Optimizer):
             loss = float(loss)  # device sync
             skipped = not bool(step_ok)
             train_time = time.time() - t0
+            self._tm_step(state, train_time, data_time, n_records,
+                          compiled=first_step, skipped=skipped)
+            first_step = False
             self._check_loss_anomaly(loss, skipped)
             params = self._maybe_corrupt_params(state, params)
             self._record_fingerprint(state, loss, float(gnorm), (x, y),
@@ -1013,6 +1082,7 @@ class LocalOptimizer(Optimizer):
         optim._slots = slots
         model.evaluate()
         self._orbax_close()
+        self._tm_finish(state)
         return model
 
     @staticmethod

@@ -17,7 +17,25 @@ import glob
 import os
 import shutil
 import tempfile
-from typing import Callable, Optional, Tuple
+from typing import Callable, NamedTuple, Optional
+
+
+class PhaseSplit(NamedTuple):
+    """Device-time attribution of one profiled step.  A NamedTuple so
+    every existing ``compute_s, collective_s = split`` unpacking keeps
+    working while new callers (the telemetry tracer's compute/
+    collective children) get named fields."""
+
+    compute_s: float
+    collective_s: float
+
+    @property
+    def total_s(self) -> float:
+        return self.compute_s + self.collective_s
+
+    @property
+    def compute_fraction(self) -> float:
+        return self.compute_s / max(self.total_s, 1e-12)
 
 # Substrings identifying communication ops in XLA/xplane event names
 # (TPU planes use HLO names: all-reduce.N, all-gather.N, ...; the CPU
@@ -117,7 +135,7 @@ def _load_profile(path: str):
     return _Space(space)
 
 
-def split_from_xplane(path: str) -> Tuple[float, float]:
+def split_from_xplane(path: str) -> PhaseSplit:
     """Sum (compute_seconds, collective_seconds) over a trace file."""
     pd = _load_profile(path)
     compute_ns = 0
@@ -129,17 +147,21 @@ def split_from_xplane(path: str) -> Tuple[float, float]:
                 compute_ns += ev.duration_ns
             elif kind == "collective":
                 collective_ns += ev.duration_ns
-    return compute_ns / 1e9, collective_ns / 1e9
+    return PhaseSplit(compute_ns / 1e9, collective_ns / 1e9)
 
 
-def trace_phase_split(run: Callable[[], None]) -> Optional[Tuple[float, float]]:
+def trace_phase_split(run: Callable[[], None]) -> Optional[PhaseSplit]:
     """Run ``run()`` under a jax.profiler trace; return the device-time
     (compute_s, collective_s) split, or None when the trace has no
     classifiable device events (caller falls back to the probe).
 
     ``run`` ALWAYS executes exactly once, and its exceptions propagate —
     the driver's failure-retry loop depends on seeing training errors.
-    Only the profiling machinery itself is allowed to fail silently."""
+    Only the profiling machinery itself is allowed to fail silently.
+
+    The temp trace directory is removed on EVERY path — trace-start
+    failure, a raising ``run``, an unparsable trace — via the
+    enclosing try/finally."""
     import jax
 
     tmp = tempfile.mkdtemp(prefix="bigdl_phase_")

@@ -83,6 +83,12 @@ def survivor_mesh(n_shards: int, devices=None):
             f"survivor mesh needs 1..{len(devs)} shards, got {n}")
     from jax.sharding import Mesh
 
+    from ..telemetry.registry import default_registry
+
+    default_registry().counter(
+        "bigdl_mesh_rebuilds_total",
+        "survivor-mesh rebuilds (elastic shrink/regrow re-entries)"
+    ).inc()
     return Mesh(np.array(devs[:n]), ("data",))
 
 

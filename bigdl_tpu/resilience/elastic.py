@@ -48,9 +48,17 @@ import time
 import urllib.parse
 from typing import Callable, Dict, List, Optional, Sequence, Set, Tuple
 
+from ..telemetry.registry import default_registry
 from .watchdog import CollectiveWatchdog, HungCollectiveError
 
 log = logging.getLogger("bigdl_tpu")
+
+
+def _count(name: str, help: str, n: float = 1.0):
+    """Bump a process-wide counter (the telemetry default registry) —
+    cluster events must land in the one scrapeable snapshot whether or
+    not a Telemetry bundle is attached."""
+    default_registry().counter(name, help).inc(n)
 
 __all__ = [
     "ElasticContext", "ElasticCoordinator", "FileKV", "InMemoryKV",
@@ -469,6 +477,7 @@ class ElasticContext:
                  integrity_cadence: int = 0,
                  integrity_timeout: float = 2.0,
                  integrity_summary=None,
+                 telemetry=None, telemetry_cadence: int = 10,
                  sleep: Callable[[float], None] = time.sleep):
         self.coordinator = coordinator
         self.watchdog = watchdog or CollectiveWatchdog()
@@ -485,6 +494,15 @@ class ElasticContext:
         self.integrity_cadence = max(0, int(integrity_cadence))
         self.integrity_timeout = float(integrity_timeout)
         self.integrity_summary = integrity_summary
+        # cross-host telemetry (bigdl_tpu/telemetry): every
+        # ``telemetry_cadence`` steps this host publishes its metric/
+        # goodput snapshot under ``tm/<incarnation>/<host>`` (keyed
+        # like the SDC votes, so a reconfigured cluster never reads a
+        # departed membership's numbers); the leader merges the gang's
+        # payloads via cluster_snapshot().  Attached by
+        # Optimizer.set_telemetry; 0 disables publishing.
+        self.telemetry = telemetry
+        self.telemetry_cadence = max(0, int(telemetry_cadence))
         self._sleep = sleep
         self._mesh_factory = mesh_factory
         self._n_devices: Optional[int] = None
@@ -623,15 +641,45 @@ class ElasticContext:
         self.members = tuple(sorted(members))
         if count:
             self.incarnation_changes += 1
+            _count("bigdl_elastic_incarnation_changes_total",
+                   "cluster membership reconfigurations adopted")
         log.warning("elastic: running incarnation %d with %d member(s) %s",
                     self.incarnation, len(self.members), self.members)
         self._scalar("Incarnation", self.incarnation)
         self._scalar("ClusterSize", len(self.members))
 
+    def publish_telemetry(self, step: int):
+        """Publish this host's telemetry payload for the current
+        incarnation (no-op without an attached Telemetry)."""
+        if self.telemetry is None:
+            return
+        from ..telemetry.aggregate import publish_snapshot
+
+        self.telemetry.incarnation = self.incarnation or 0
+        publish_snapshot(self.coordinator.transport, self.host,
+                         self.telemetry.payload(step),
+                         incarnation=self.incarnation or 0)
+
+    def cluster_snapshot(self) -> dict:
+        """The leader's merged cluster telemetry view: newest payload
+        per CURRENT member for the current incarnation, folded by
+        :func:`~bigdl_tpu.telemetry.merge_cluster` (counters sum,
+        histogram buckets add, goodput ledgers sum host-seconds)."""
+        from ..telemetry.aggregate import collect_snapshots, merge_cluster
+
+        self.publish_telemetry(self._last_step)
+        payloads = collect_snapshots(
+            self.coordinator.transport, self.incarnation or 0,
+            members=self.members or None)
+        return merge_cluster(payloads)
+
     def on_step_start(self, step: int):
         c = self.coordinator
         self._last_step = int(step)
         c.heartbeat(step=step, step_time=self._last_dt)
+        if self.telemetry is not None and self.telemetry_cadence > 0 \
+                and step % self.telemetry_cadence == 0:
+            self.publish_telemetry(step)
         n, members = c.membership()
         if self.incarnation is None:
             c.ack(n)
@@ -698,6 +746,8 @@ class ElasticContext:
         c = self.coordinator
         self.straggler.record_eviction(victim)
         self.evictions += 1
+        _count("bigdl_elastic_evictions_total",
+               "hosts voted out (stragglers + SDC minorities)")
         self.evicted_hosts.append(victim)
         c.evict(victim, "chronic straggler")
         survivors = [m for m in self.members if m != victim]
@@ -787,12 +837,16 @@ class ElasticContext:
                 break
             self._sleep(0.005)
         self.sdc_votes += 1
+        _count("bigdl_integrity_votes_total",
+               "cross-host SDC checksum vote rounds")
         self.vote_log.append((int(step), time.monotonic() - t0))
         self._iscalar("IntegrityVotes", self.sdc_votes, step)
         truth, corrupt = majority_vote(votes, sorted(want))
         if not corrupt:
             return
         self.sdc_disagreements += 1
+        _count("bigdl_integrity_disagreements_total",
+               "SDC vote rounds that flagged a minority checksum")
         self.sdc_detected_steps.append(int(step))
         self._iscalar("IntegrityDisagreements", self.sdc_disagreements,
                       step)
@@ -809,6 +863,9 @@ class ElasticContext:
             c.evict(h, "silent data corruption")
         self.sdc_evictions += len(corrupt)
         self.evictions += len(corrupt)
+        _count("bigdl_elastic_evictions_total",
+               "hosts voted out (stragglers + SDC minorities)",
+               len(corrupt))
         self.evicted_hosts.extend(corrupt)
         survivors = [m for m in self.members if m not in corrupt]
         n2 = c.propose(survivors, f"sdc eviction: {corrupt}",
@@ -883,6 +940,16 @@ class SimulatedHost:
         self.dead = False
         self.deaths = 0
         self._acked = -1
+        # every fake member carries its own telemetry bundle (private
+        # registry — fake hosts must not pollute the process default)
+        # and publishes payloads like a real host would, so a
+        # single-process simulation exercises the leader's merge path
+        from ..telemetry import MetricsRegistry, Telemetry
+
+        self.telemetry = Telemetry(registry=MetricsRegistry(),
+                                   host=str(host))
+        self._tm_publish_every = 5
+        self._tm_last: Optional[float] = None
         self._stop = threading.Event()
         self._thread = threading.Thread(target=self._run, daemon=True,
                                         name=f"elastic-sim-{host}")
@@ -904,6 +971,7 @@ class SimulatedHost:
         while not self._stop.is_set():
             leader_step = c.leader_step(self.leader)
             if self.dead:
+                self._tm_last = None  # dead wall is not productive
                 if (self.rejoin_at_leader_step is not None
                         and leader_step >= self.rejoin_at_leader_step):
                     self.dead = False
@@ -951,7 +1019,28 @@ class SimulatedHost:
                 self._acked = n
             if member:
                 self._answer_integrity_votes(leader_step)
+                self._pump_telemetry(n, step, dt)
             self._stop.wait(self.interval)
+
+    def _pump_telemetry(self, incarnation: int, step: int, dt: float):
+        """Keep the fake host's telemetry honest and published: its
+        published step time feeds the step histogram (the skew view),
+        while the goodput ledger is attributed real elapsed wall — a
+        fake host is 'keeping pace', so its wall is productive."""
+        from ..telemetry.aggregate import publish_snapshot
+
+        tm = self.telemetry
+        tm.ledger.start()
+        now = time.monotonic()
+        if self._tm_last is not None:
+            tm.ledger.add("productive", now - self._tm_last)
+        self._tm_last = now
+        tm.steps.inc()
+        tm.step_seconds.observe(dt)
+        if step % self._tm_publish_every == 0:
+            tm.incarnation = incarnation
+            publish_snapshot(self.coordinator.transport, self.host,
+                             tm.payload(step), incarnation=incarnation)
 
     def _answer_integrity_votes(self, leader_step: int):
         """Echo the leader's published integrity checksum for any open

@@ -153,6 +153,12 @@ class RetryPolicy:
                 attempts += 1
                 if attempts > self.max_retries:
                     raise
+                from ..telemetry.registry import default_registry
+
+                default_registry().counter(
+                    "bigdl_retry_attempts_total",
+                    "retryable failures answered with a backoff "
+                    "retry").inc()
                 d = self.delay(attempts)
                 log.warning(
                     "Error during training: %s — retry %d/%d after %.2fs "

@@ -142,6 +142,11 @@ class CollectiveWatchdog:
         if not done.wait(deadline):
             cancel.set()
             self.trips += 1
+            from ..telemetry.registry import default_registry
+
+            default_registry().counter(
+                "bigdl_watchdog_trips_total",
+                "hung-collective watchdog deadline expiries").inc()
             raise HungCollectiveError(
                 f"distributed step exceeded its {deadline:.2f}s watchdog "
                 "deadline — presuming a dead peer in the collective "

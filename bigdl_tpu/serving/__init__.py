@@ -31,7 +31,9 @@ verified checkpoints) applied to an in-process request path:
   crc32c-verified checkpoint path, pass a canary batch, and swap
   atomically between batches — rolling back if the canary fails.
 * :mod:`.metrics` — per-request counters + latency quantiles
-  (p50/p99), exported through ``visualization.summary``.
+  (p50/p99) backed by the unified telemetry registry
+  (:mod:`bigdl_tpu.telemetry` — Prometheus text export, mergeable
+  histograms), exported through ``visualization.summary``.
 
 Deterministic serving fault injectors (fail-next-N steps, injected
 step latency, poisoned params) live with the training injectors in

@@ -27,6 +27,17 @@ CLOSED = "closed"
 OPEN = "open"
 HALF_OPEN = "half_open"
 
+
+def _count_transition(to_state: str):
+    """Breaker state transitions land in the process-wide telemetry
+    registry (docs/observability.md) — labeled by destination state."""
+    from ..telemetry.registry import default_registry
+
+    default_registry().counter(
+        "bigdl_breaker_transitions_total",
+        "circuit breaker state transitions",
+        labels=("to",)).labels(to=to_state).inc()
+
 #: acquire() verdicts
 ADMIT = "admit"
 PROBE = "probe"
@@ -68,6 +79,7 @@ class CircuitBreaker:
                     return REJECT
                 self._state = HALF_OPEN
                 self._probe_in_flight = False
+                _count_transition("half_open")
             # half-open: one probe at a time
             if self._probe_in_flight:
                 return REJECT
@@ -78,6 +90,7 @@ class CircuitBreaker:
         with self._lock:
             if self._state == HALF_OPEN:
                 self.recoveries += 1
+                _count_transition("closed")
             self._state = CLOSED
             self._consecutive_failures = 0
             self._probe_in_flight = False
@@ -95,6 +108,7 @@ class CircuitBreaker:
                 self._state = OPEN
                 self._opened_at = self._clock()
                 self.trips += 1
+                _count_transition("open")
 
     # ------------------------------------------------------------------
     def snapshot(self) -> dict:

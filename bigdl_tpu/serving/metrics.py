@@ -1,36 +1,66 @@
-"""Per-request serving metrics: counters + latency quantiles.
+"""Per-request serving metrics: counters + latency quantiles, backed
+by the unified telemetry registry.
 
 Counts every terminal status (a shed request increments ``shed`` and
 nothing else — never a silent drop), tracks queue depth at admission,
-and keeps a bounded window of per-request latencies for p50/p99.
+and answers p50/p99 from a :class:`~bigdl_tpu.telemetry.Histogram`
+whose bounded exact-sample window reproduces numpy-percentile
+semantics over the most recent ``window`` requests — the same numbers
+the pre-registry deque implementation reported.  The histograms'
+log-bucket state additionally merges across hosts in the cross-host
+telemetry view (docs/observability.md).
+
 ``to_summary`` exports the snapshot through the tensorboard-compatible
 ``visualization.summary`` writer so serving health lands next to the
-training curves.
+training curves; the backing registry (one private registry per
+server by default, so two servers in one process never blend their
+counts) exports Prometheus text via ``metrics.registry
+.to_prometheus()``.
 """
 from __future__ import annotations
 
 import threading
-from collections import deque
 from typing import Dict, Optional
 
-import numpy as np
-
+from ..telemetry.registry import MetricsRegistry, default_buckets
 from .status import Status
 
 #: latency window — big enough for stable p99, bounded so a long-lived
 #: server never grows without limit
 _WINDOW = 8192
 
+#: latency bucket ladder: 100µs … ~100s (log-spaced, mergeable)
+_LATENCY_BUCKETS = default_buckets(start=1e-4, factor=2.0, count=21)
+#: queue-depth ladder: 1 … 2^15
+_DEPTH_BUCKETS = default_buckets(start=1.0, factor=2.0, count=16)
+
 
 class ServingMetrics:
-    def __init__(self, window: int = _WINDOW):
+    def __init__(self, window: int = _WINDOW,
+                 registry: Optional[MetricsRegistry] = None):
         self._lock = threading.Lock()
-        self._lat = deque(maxlen=window)       # OK latencies (seconds)
-        self._queued = deque(maxlen=window)    # OK queued portions
-        self._depth = deque(maxlen=window)     # queue depth at admission
+        self.registry = registry or MetricsRegistry()
+        self._requests = self.registry.counter(
+            "bigdl_serving_requests_total",
+            "terminal request statuses", labels=("status",))
+        self._lat = self.registry.histogram(
+            "bigdl_serving_latency_seconds",
+            "end-to-end latency of OK requests",
+            bounds=_LATENCY_BUCKETS, window=window)
+        self._queued = self.registry.histogram(
+            "bigdl_serving_queued_seconds",
+            "queue-wait portion of OK requests",
+            bounds=_LATENCY_BUCKETS, window=window)
+        self._depth = self.registry.histogram(
+            "bigdl_serving_queue_depth",
+            "admission-time queue depth",
+            bounds=_DEPTH_BUCKETS, window=window)
+        self._batches = self.registry.counter(
+            "bigdl_serving_batches_total", "compiled batches executed")
+        self._padded = self.registry.counter(
+            "bigdl_serving_padded_rows_total",
+            "bucket-padding rows executed")
         self.counts: Dict[str, int] = {s.value: 0 for s in Status}
-        self.batches = 0
-        self.padded_rows = 0
         self.swaps = 0
         self.swap_rollbacks = 0
 
@@ -39,52 +69,55 @@ class ServingMetrics:
                queued_s: float = 0.0):
         with self._lock:
             self.counts[status.value] += 1
-            if status is Status.OK:
-                self._lat.append(latency_s)
-                self._queued.append(queued_s)
+        self._requests.labels(status=status.value).inc()
+        if status is Status.OK:
+            self._lat.observe(latency_s)
+            self._queued.observe(queued_s)
 
     def record_depth(self, depth: int):
-        with self._lock:
-            self._depth.append(depth)
+        self._depth.observe(depth)
 
     def record_batch(self, real: int, bucket: int):
-        with self._lock:
-            self.batches += 1
-            self.padded_rows += bucket - real
+        self._batches.inc()
+        self._padded.inc(bucket - real)
 
     # ------------------------------------------------------------------
-    def _pct(self, q: float) -> Optional[float]:
-        return float(np.percentile(self._lat, q)) if self._lat else None
+    @property
+    def batches(self) -> int:
+        return int(self._batches.value)
+
+    @property
+    def padded_rows(self) -> int:
+        return int(self._padded.value)
 
     def snapshot(self) -> dict:
         with self._lock:
-            ok = self.counts[Status.OK.value]
-            total = sum(self.counts.values())
-            return {
-                "served_ok": ok,
-                "total": total,
-                "shed": self.counts[Status.OVERLOADED.value],
-                "deadline_exceeded":
-                    self.counts[Status.DEADLINE_EXCEEDED.value],
-                "unavailable": self.counts[Status.UNAVAILABLE.value],
-                "internal_error":
-                    self.counts[Status.INTERNAL_ERROR.value],
-                "cancelled": self.counts[Status.CANCELLED.value],
-                "shed_rate": (self.counts[Status.OVERLOADED.value]
-                              / total) if total else 0.0,
-                "latency_p50_s": self._pct(50),
-                "latency_p99_s": self._pct(99),
-                "queued_mean_s": (float(np.mean(self._queued))
-                                  if self._queued else None),
-                "queue_depth_mean": (float(np.mean(self._depth))
-                                     if self._depth else None),
-                "queue_depth_max": (int(max(self._depth))
-                                    if self._depth else 0),
-                "batches": self.batches,
-                "padded_rows": self.padded_rows,
-                "swaps": self.swaps,
-                "swap_rollbacks": self.swap_rollbacks,
-            }
+            counts = dict(self.counts)
+        ok = counts[Status.OK.value]
+        total = sum(counts.values())
+        return {
+            "served_ok": ok,
+            "total": total,
+            "shed": counts[Status.OVERLOADED.value],
+            "deadline_exceeded":
+                counts[Status.DEADLINE_EXCEEDED.value],
+            "unavailable": counts[Status.UNAVAILABLE.value],
+            "internal_error":
+                counts[Status.INTERNAL_ERROR.value],
+            "cancelled": counts[Status.CANCELLED.value],
+            "shed_rate": (counts[Status.OVERLOADED.value]
+                          / total) if total else 0.0,
+            "latency_p50_s": self._lat.quantile(0.50),
+            "latency_p99_s": self._lat.quantile(0.99),
+            "queued_mean_s": self._queued.mean,
+            "queue_depth_mean": self._depth.mean,
+            "queue_depth_max": (int(self._depth.max)
+                                if self._depth.count else 0),
+            "batches": self.batches,
+            "padded_rows": self.padded_rows,
+            "swaps": self.swaps,
+            "swap_rollbacks": self.swap_rollbacks,
+        }
 
     def to_summary(self, summary, step: int):
         """Write the snapshot's numeric fields as scalar events (tags
@@ -96,3 +129,7 @@ class ServingMetrics:
                 continue
             summary.add_scalar(f"serving/{key}", float(val), step)
         return summary
+
+    def to_prometheus(self) -> str:
+        """Prometheus text exposition of the backing registry."""
+        return self.registry.to_prometheus()

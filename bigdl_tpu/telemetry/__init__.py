@@ -1,0 +1,244 @@
+"""Unified telemetry spine: metrics registry, structured step tracing,
+Perfetto export, goodput accounting, cross-host aggregation.
+
+The reproduction had grown four siloed observability fragments
+(TensorBoard scalars, the xplane phase split, FlightRecorder journals,
+ad-hoc serving/elastic counter bags); this package is the one spine
+they hang off:
+
+* :mod:`.registry`  — thread-safe Counter/Gauge/Histogram with label
+  sets, JSON snapshots + Prometheus text export, injectable clock.
+* :mod:`.tracer`    — nested spans with explicit categories into a
+  bounded ring buffer, exported as Chrome-trace/Perfetto JSON.
+* :mod:`.goodput`   — :class:`GoodputLedger` classifying every second
+  of run wall clock (productive / compile / data-stall / checkpoint /
+  recovery / idle).
+* :mod:`.aggregate` — hosts publish snapshots over the elastic KV
+  transport (incarnation-keyed); the leader merges a cluster view;
+  snapshot directories feed ``tools/run_report.py``.
+* :mod:`.slog`      — structured logging entry points (the library
+  never calls ``logging.basicConfig`` at import time).
+
+:class:`Telemetry` is the driver-facing bundle: ``Optimizer
+.set_telemetry(Telemetry(...))`` wires all four optimizer mesh paths,
+the serving path and the resilience hooks into the same registry,
+tracer and ledger.
+"""
+from __future__ import annotations
+
+import time
+from typing import Optional
+
+from .aggregate import (
+    collect_snapshots, merge_cluster, merge_metrics, publish_snapshot,
+    read_snapshot_dir, write_snapshot,
+)
+from .goodput import GOODPUT_CATEGORIES, GoodputLedger
+from .registry import (
+    Counter, Gauge, Histogram, MetricsRegistry, default_buckets,
+    default_registry, reset_default_registry,
+)
+from .slog import configure_logging, get_logger
+from .tracer import CATEGORIES, Span, Tracer
+
+__all__ = [
+    "CATEGORIES", "GOODPUT_CATEGORIES", "Counter", "Gauge", "Histogram",
+    "MetricsRegistry", "GoodputLedger", "Span", "Telemetry", "Tracer",
+    "collect_snapshots", "configure_logging", "default_buckets",
+    "default_registry", "get_logger", "merge_cluster", "merge_metrics",
+    "publish_snapshot", "read_snapshot_dir", "reset_default_registry",
+    "write_snapshot",
+]
+
+#: log-spaced bounds sized for step/phase durations (100µs … ~100s)
+STEP_BUCKETS = default_buckets(start=1e-4, factor=2.0, count=21)
+
+
+class Telemetry:
+    """The bundle the training/serving drivers speak to.
+
+    Without arguments it adopts the process-wide default registry (so
+    the resilience layer's counters land in the same snapshot), a
+    fresh tracer and a fresh goodput ledger.  ``trace_every`` sets the
+    tracing cadence: spans are recorded for every Nth step (1 = every
+    step, the default; 0 disables span recording while keeping
+    metrics + goodput).  ``snapshot_dir`` makes :meth:`write_snapshot`
+    drop ``<host>.json`` payloads for ``tools/run_report.py``.
+    """
+
+    def __init__(self, registry: Optional[MetricsRegistry] = None,
+                 tracer: Optional[Tracer] = None,
+                 ledger: Optional[GoodputLedger] = None,
+                 host: str = "local",
+                 snapshot_dir: Optional[str] = None,
+                 trace_every: int = 1):
+        self.registry = registry if registry is not None \
+            else default_registry()
+        self.tracer = tracer or Tracer()
+        self.ledger = ledger or GoodputLedger()
+        self.host = str(host)
+        self.snapshot_dir = snapshot_dir
+        self.trace_every = max(0, int(trace_every))
+        self.incarnation = 0
+        self._steps_seen = 0
+        r = self.registry
+        self.steps = r.counter(
+            "bigdl_train_steps_total", "compiled train steps run")
+        self.records = r.counter(
+            "bigdl_train_records_total", "records trained")
+        self.step_seconds = r.histogram(
+            "bigdl_train_step_seconds",
+            "compiled step wall time (post-compile)",
+            bounds=STEP_BUCKETS, window=1024)
+        self.compile_seconds = r.histogram(
+            "bigdl_train_compile_seconds",
+            "first-step wall time of each fresh program (XLA build)",
+            bounds=STEP_BUCKETS)
+        self.data_wait_seconds = r.histogram(
+            "bigdl_train_data_wait_seconds",
+            "host wait on the input pipeline per iteration",
+            bounds=STEP_BUCKETS, window=1024)
+        self.h2d_seconds = r.histogram(
+            "bigdl_train_host_to_device_seconds",
+            "host-to-device placement (infeed sharding) per iteration",
+            bounds=STEP_BUCKETS)
+        self.checkpoint_seconds = r.histogram(
+            "bigdl_checkpoint_write_seconds",
+            "checkpoint write/dispatch wall time",
+            bounds=STEP_BUCKETS)
+        self.recoveries = r.counter(
+            "bigdl_recovery_windows_total",
+            "fault-to-first-productive-step recovery windows")
+        self.skipped_steps = r.counter(
+            "bigdl_guard_skipped_steps_total",
+            "steps skipped by the NaN/Inf gradient guard")
+
+    # -- driver hooks ----------------------------------------------------
+    def _trace_due(self) -> bool:
+        return (self.trace_every > 0
+                and self._steps_seen % self.trace_every == 0)
+
+    def on_attempt_begin(self):
+        """Start of an optimize attempt: the run clock starts (first
+        attempt only — the ledger is idempotent)."""
+        self.ledger.start()
+
+    def on_data_wait(self, seconds: float, step: Optional[int] = None):
+        """Host time spent waiting on the input pipeline."""
+        seconds = max(0.0, float(seconds))
+        self.data_wait_seconds.observe(seconds)
+        self.ledger.add("data_stall", seconds)
+        if self._trace_due():
+            end = self.tracer.clock()
+            self.tracer.record("data_wait", "data_wait", end - seconds,
+                               seconds, step=step)
+
+    def on_host_to_device(self, seconds: float,
+                          step: Optional[int] = None):
+        """Host→device placement (infeed sharding) — ledgered as part
+        of the data stall, traced under its own category."""
+        seconds = max(0.0, float(seconds))
+        self.h2d_seconds.observe(seconds)
+        self.ledger.add("data_stall", seconds)
+        if self._trace_due():
+            end = self.tracer.clock()
+            self.tracer.record("host_to_device", "host_to_device",
+                               end - seconds, seconds, step=step)
+
+    def on_step(self, seconds: float, records: int = 0,
+                step: Optional[int] = None, compiled: bool = False,
+                phase_split=None, skipped: bool = False):
+        """One compiled-step dispatch completed.  ``compiled=True``
+        classifies it as compile time (the first step of every fresh
+        program); ``phase_split`` is the optional
+        :class:`~bigdl_tpu.optim.profiling.PhaseSplit` attributing the
+        step's device time to compute vs collective children."""
+        seconds = max(0.0, float(seconds))
+        if self.ledger.in_recovery:
+            # the window closes where this step BEGAN — the step's own
+            # seconds are attributed below, not as recovery
+            rec = self.ledger.recovery_end(exclude=seconds)
+            if rec and self.trace_every > 0:
+                end = self.tracer.clock() - seconds
+                self.tracer.record("recovery", "recovery", end - rec,
+                                   rec)
+        self.ledger.add("compile" if compiled else "productive", seconds)
+        self.steps.inc()
+        if records:
+            self.records.inc(records)
+        if skipped:
+            self.skipped_steps.inc()
+        (self.compile_seconds if compiled
+         else self.step_seconds).observe(seconds)
+        if self._trace_due():
+            end = self.tracer.clock()
+            parent = self.tracer.record(
+                "compile" if compiled else "step",
+                "compile" if compiled else "step",
+                end - seconds, seconds, step=step)
+            if phase_split is not None and parent is not None:
+                compute_s, collective_s = phase_split
+                self.tracer.record("compute", "compute", parent.start,
+                                   compute_s, parent=parent, step=step)
+                self.tracer.record("collective", "collective",
+                                   parent.start + compute_s,
+                                   collective_s, parent=parent,
+                                   step=step)
+        self._steps_seen += 1
+
+    def on_checkpoint(self, seconds: float, step: Optional[int] = None):
+        seconds = max(0.0, float(seconds))
+        self.checkpoint_seconds.observe(seconds)
+        self.ledger.add("checkpoint", seconds)
+        if self.trace_every > 0:
+            end = self.tracer.clock()
+            self.tracer.record("checkpoint", "checkpoint",
+                               end - seconds, seconds, step=step)
+
+    def on_recovery_begin(self):
+        """A fault was detected (retry rollback, membership change):
+        wall clock is recovery until the next completed step."""
+        if not self.ledger.in_recovery:
+            self.recoveries.inc()
+        self.ledger.recovery_begin()
+
+    # -- export ----------------------------------------------------------
+    def payload(self, step: Optional[int] = None) -> dict:
+        """The publishable telemetry payload (what lands on the KV
+        transport and in snapshot directories)."""
+        return {
+            "host": self.host,
+            "step": step,
+            "incarnation": int(self.incarnation),
+            "ts": time.time(),
+            "goodput": self.ledger.snapshot(),
+            "metrics": self.registry.snapshot()["metrics"],
+            "span_totals": self.tracer.category_totals(),
+        }
+
+    def write_snapshot(self, directory: Optional[str] = None,
+                       step: Optional[int] = None) -> Optional[str]:
+        """Drop ``<host>.json`` into ``directory`` (default: the
+        configured ``snapshot_dir``); no-op without one."""
+        directory = directory or self.snapshot_dir
+        if directory is None:
+            return None
+        return write_snapshot(directory, self.host, self.payload(step))
+
+    def to_summary(self, summary, step: int):
+        """Write the goodput ledger + headline counters as scalar
+        events (tags ``telemetry/<field>``) through a
+        ``visualization.summary.Summary`` (e.g.
+        :class:`~bigdl_tpu.visualization.TelemetrySummary`)."""
+        snap = self.ledger.snapshot()
+        summary.add_scalar("telemetry/goodput_fraction",
+                           snap["productive_fraction"], step)
+        summary.add_scalar("telemetry/accounted_fraction",
+                           snap["accounted_fraction"], step)
+        for cat, secs in snap["seconds"].items():
+            summary.add_scalar(f"telemetry/{cat}_s", secs, step)
+        summary.add_scalar("telemetry/steps_total", self.steps.value,
+                           step)
+        summary.add_scalar("telemetry/recovery_windows",
+                           self.recoveries.value, step)
+        return summary

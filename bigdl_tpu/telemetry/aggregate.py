@@ -1,0 +1,216 @@
+"""Cross-host telemetry aggregation over the elastic KV transport.
+
+Every host periodically publishes its telemetry payload (metrics
+snapshot + goodput ledger + span-category totals) under
+``tm/<incarnation>/<host>`` — incarnation-keyed exactly like the SDC
+votes, so a post-reconfiguration cluster view never mixes in snapshots
+from a membership that no longer exists.  The leader collects the
+newest payload per member and merges them into one cluster view:
+
+* counters sum; gauges report per-host values plus min/mean/max;
+* histograms with identical bucket geometry merge by adding bucket
+  counts (the :class:`~.registry.Histogram` merge contract);
+* goodput ledgers sum per-category host-seconds
+  (:meth:`~.goodput.GoodputLedger.merge_snapshots`);
+* per-host step-time skew is derived from each host's published
+  ``bigdl_train_step_seconds`` mean vs the cluster median.
+
+The same payloads also serialize to a **snapshot directory** (one
+``<host>.json`` per host) — what ``tools/run_report.py`` renders.
+"""
+from __future__ import annotations
+
+import json
+import os
+import statistics
+from typing import Dict, List, Optional, Sequence
+
+from .goodput import GoodputLedger
+
+__all__ = [
+    "TM_PREFIX", "collect_snapshots", "merge_cluster", "merge_metrics",
+    "publish_snapshot", "read_snapshot_dir", "write_snapshot",
+]
+
+TM_PREFIX = "tm/"
+
+
+# ---------------------------------------------------------------------------
+# transport plumbing
+# ---------------------------------------------------------------------------
+
+def publish_snapshot(transport, host: str, payload: dict,
+                     incarnation: int = 0):
+    """Publish one host's telemetry payload for the current
+    incarnation (overwrites the host's previous snapshot — the view is
+    "newest per host", not a journal)."""
+    transport.put(f"{TM_PREFIX}{int(incarnation)}/{host}",
+                  json.dumps(payload))
+
+
+def collect_snapshots(transport, incarnation: int = 0,
+                      members: Optional[Sequence[str]] = None
+                      ) -> Dict[str, dict]:
+    """The leader's read side: newest payload per host for the given
+    incarnation (restricted to ``members`` when given — a departed
+    host's stale snapshot must not haunt the cluster view)."""
+    prefix = f"{TM_PREFIX}{int(incarnation)}/"
+    out: Dict[str, dict] = {}
+    for key in transport.keys(prefix):
+        host = key[len(prefix):]
+        if members is not None and host not in members:
+            continue
+        raw = transport.get(key)
+        if raw is None:
+            continue
+        try:
+            out[host] = json.loads(raw)
+        except ValueError:
+            continue
+    return out
+
+
+# ---------------------------------------------------------------------------
+# snapshot directories (the run-report input)
+# ---------------------------------------------------------------------------
+
+def write_snapshot(directory: str, host: str, payload: dict) -> str:
+    """Write one host's payload as ``<dir>/<host>.json`` (atomic:
+    tmp + rename, same discipline as FileKV)."""
+    os.makedirs(directory, exist_ok=True)
+    path = os.path.join(directory, f"{host}.json")
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump(payload, f, indent=1)
+    os.replace(tmp, path)
+    return path
+
+
+def read_snapshot_dir(directory: str) -> Dict[str, dict]:
+    out: Dict[str, dict] = {}
+    if not os.path.isdir(directory):
+        return out
+    for name in sorted(os.listdir(directory)):
+        if not name.endswith(".json") or ".tmp." in name:
+            continue
+        try:
+            with open(os.path.join(directory, name)) as f:
+                out[name[:-len(".json")]] = json.load(f)
+        except (OSError, ValueError):
+            continue
+    return out
+
+
+# ---------------------------------------------------------------------------
+# merging
+# ---------------------------------------------------------------------------
+
+def merge_metrics(metric_snaps: Sequence[dict]) -> dict:
+    """Fold per-host ``MetricsRegistry.snapshot()['metrics']`` dicts
+    into one cluster view.  Series are keyed by (name, labels);
+    counters sum, histograms bucket-add (mismatched geometry falls back
+    to count/sum only), gauges keep min/mean/max across hosts."""
+    out: dict = {}
+    for snap in metric_snaps:
+        for name, fam in (snap or {}).items():
+            dst = out.setdefault(name, {"type": fam.get("type"),
+                                        "help": fam.get("help"),
+                                        "series": {}})
+            for series in fam.get("series", ()):
+                key = json.dumps(series.get("labels") or {},
+                                 sort_keys=True)
+                cur = dst["series"].get(key)
+                if cur is None:
+                    dst["series"][key] = _copy_series(series,
+                                                      fam.get("type"))
+                else:
+                    _fold_series(cur, series, fam.get("type"))
+    # dict-of-series back to the list shape snapshots use
+    for fam in out.values():
+        fam["series"] = [
+            dict(s, labels=json.loads(k))
+            for k, s in sorted(fam["series"].items())]
+    return out
+
+
+def _copy_series(series: dict, kind: str) -> dict:
+    s = {k: v for k, v in series.items() if k != "labels"}
+    if kind == "gauge":
+        s["per_host_values"] = [series.get("value", 0.0)]
+    return s
+
+
+def _fold_series(cur: dict, series: dict, kind: str):
+    if kind == "counter":
+        cur["value"] = cur.get("value", 0.0) + series.get("value", 0.0)
+    elif kind == "gauge":
+        vals = cur.setdefault("per_host_values", [cur.get("value", 0.0)])
+        vals.append(series.get("value", 0.0))
+        cur["value"] = max(vals)
+        cur["min"] = min(vals)
+        cur["mean"] = sum(vals) / len(vals)
+    elif kind == "histogram":
+        cur["count"] = cur.get("count", 0) + series.get("count", 0)
+        cur["sum"] = cur.get("sum", 0.0) + series.get("sum", 0.0)
+        mins = [m for m in (cur.get("min"), series.get("min"))
+                if m is not None]
+        maxs = [m for m in (cur.get("max"), series.get("max"))
+                if m is not None]
+        cur["min"] = min(mins) if mins else None
+        cur["max"] = max(maxs) if maxs else None
+        if cur.get("bounds") == series.get("bounds") and \
+                cur.get("buckets") and series.get("buckets"):
+            cur["buckets"] = [a + b for a, b in zip(cur["buckets"],
+                                                    series["buckets"])]
+        else:  # geometry drift: keep count/sum, drop the buckets
+            cur.pop("buckets", None)
+        # per-series quantiles do not merge; the cluster view keeps
+        # count/sum/min/max (+ merged buckets when geometries match)
+        cur.pop("p50", None)
+        cur.pop("p99", None)
+
+
+def host_skew(payloads: Dict[str, dict]) -> Dict[str, dict]:
+    """Per-host mean step time and skew vs the cluster median, from
+    each host's published ``bigdl_train_step_seconds`` histogram."""
+    means: Dict[str, float] = {}
+    for host, payload in payloads.items():
+        fam = ((payload.get("metrics") or {})
+               .get("bigdl_train_step_seconds"))
+        if not fam:
+            continue
+        for series in fam.get("series", ()):
+            count = series.get("count") or 0
+            if count > 0:
+                means[host] = float(series.get("sum", 0.0)) / count
+                break
+    if not means:
+        return {}
+    med = statistics.median(means.values())
+    return {h: {"mean_step_s": m,
+                "skew": (m / med) if med > 0 else 1.0}
+            for h, m in sorted(means.items())}
+
+
+def merge_cluster(payloads: Dict[str, dict]) -> dict:
+    """Fold per-host telemetry payloads (host → the dict
+    ``Telemetry.payload()`` publishes) into the one cluster view the
+    run report renders."""
+    hosts = sorted(payloads)
+    goodput = GoodputLedger.merge_snapshots(
+        [p.get("goodput") or {} for p in payloads.values()])
+    spans: Dict[str, float] = {}
+    for p in payloads.values():
+        for cat, secs in (p.get("span_totals") or {}).items():
+            spans[cat] = spans.get(cat, 0.0) + float(secs)
+    return {
+        "hosts": hosts,
+        "incarnation": max(
+            (int(p.get("incarnation", 0)) for p in payloads.values()),
+            default=0),
+        "goodput": goodput,
+        "metrics": merge_metrics(
+            [p.get("metrics") or {} for p in payloads.values()]),
+        "span_totals": dict(sorted(spans.items())),
+        "per_host_skew": host_skew(payloads),
+    }

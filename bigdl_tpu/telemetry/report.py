@@ -1,0 +1,71 @@
+"""Run-report rendering: a text table answering "where did the time
+go?" from a snapshot directory (or an already-merged cluster view).
+
+``tools/run_report.py`` is the CLI wrapper; the rendering lives here
+so tests and notebooks can call it on in-memory payloads.
+"""
+from __future__ import annotations
+
+from typing import Dict, List
+
+from .aggregate import merge_cluster, read_snapshot_dir
+
+__all__ = ["render_report", "report_from_dir"]
+
+
+def _bar(frac: float, width: int = 24) -> str:
+    n = int(round(max(0.0, min(1.0, frac)) * width))
+    return "#" * n + "." * (width - n)
+
+
+def render_report(cluster: dict, top_n: int = 6) -> str:
+    """Text run report from a merged cluster view
+    (:func:`~.aggregate.merge_cluster`): goodput breakdown, top span
+    categories, per-host step-time skew."""
+    lines: List[str] = []
+    hosts = cluster.get("hosts") or []
+    gp = cluster.get("goodput") or {}
+    wall = float(gp.get("wall_s") or 0.0)
+    lines.append("================ bigdl_tpu run report ================")
+    lines.append(f"hosts: {len(hosts)} ({', '.join(hosts)})  "
+                 f"incarnation: {cluster.get('incarnation', 0)}")
+    lines.append(f"wall clock (host-seconds): {wall:.2f}s   "
+                 f"goodput: {100.0 * float(gp.get('productive_fraction') or 0.0):.1f}%   "
+                 f"accounted: {100.0 * float(gp.get('accounted_fraction') or 0.0):.1f}%")
+    lines.append("")
+    lines.append("-- goodput ledger ------------------------------------")
+    secs: Dict[str, float] = gp.get("seconds") or {}
+    for cat, s in sorted(secs.items(), key=lambda kv: -kv[1]):
+        frac = s / wall if wall > 0 else 0.0
+        lines.append(f"  {cat:<12} {s:>10.2f}s  {100 * frac:>5.1f}%  "
+                     f"|{_bar(frac)}|")
+    spans: Dict[str, float] = cluster.get("span_totals") or {}
+    if spans:
+        lines.append("")
+        lines.append(f"-- top span categories (of {len(spans)}) "
+                     "-----------------------")
+        total = sum(spans.values()) or 1.0
+        for cat, s in sorted(spans.items(),
+                             key=lambda kv: -kv[1])[:top_n]:
+            lines.append(f"  {cat:<12} {s:>10.2f}s  "
+                         f"{100 * s / total:>5.1f}%")
+    skew = cluster.get("per_host_skew") or {}
+    if skew:
+        lines.append("")
+        lines.append("-- per-host step-time skew ---------------------------")
+        for host, rec in skew.items():
+            lines.append(
+                f"  {host:<12} mean step "
+                f"{1e3 * float(rec.get('mean_step_s') or 0.0):>8.2f}ms"
+                f"   {float(rec.get('skew') or 0.0):>5.2f}x median")
+    lines.append("======================================================")
+    return "\n".join(lines)
+
+
+def report_from_dir(directory: str, top_n: int = 6) -> str:
+    """Render the report for a snapshot directory (one ``<host>.json``
+    per host, as written by ``Telemetry.write_snapshot``)."""
+    payloads = read_snapshot_dir(directory)
+    if not payloads:
+        return f"no telemetry snapshots found under {directory!r}"
+    return render_report(merge_cluster(payloads), top_n=top_n)

@@ -1,6 +1,6 @@
 from .crc32c import crc32c, masked_crc32c
 from .summary import (
     ElasticSummary, IntegritySummary, ServingSummary, Summary,
-    TrainSummary, ValidationSummary, read_scalars,
+    TelemetrySummary, TrainSummary, ValidationSummary, read_scalars,
 )
 from .writer import EventWriter, FileWriter, RecordWriter

@@ -109,6 +109,19 @@ class ElasticSummary(Summary):
         super().__init__(log_dir, os.path.join(app_name, "elastic"))
 
 
+class TelemetrySummary(Summary):
+    """Telemetry stream (``<app>/telemetry``) — the export target of
+    :meth:`bigdl_tpu.telemetry.Telemetry.to_summary`: the goodput
+    ledger (``telemetry/goodput_fraction``, ``telemetry/accounted_
+    fraction``, per-category seconds) and headline counters
+    (``telemetry/steps_total``, ``telemetry/recovery_windows``), so
+    "where did the wall clock go" lands next to the train/validation
+    curves in the same tensorboard layout."""
+
+    def __init__(self, log_dir: str, app_name: str):
+        super().__init__(log_dir, os.path.join(app_name, "telemetry"))
+
+
 class IntegritySummary(Summary):
     """Integrity/determinism metrics stream (``<app>/integrity``) — the
     export target of the SDC-defense layer

@@ -608,3 +608,54 @@ def test_no_unseeded_module_level_rng_in_package():
     assert not offenders, (
         "unseeded module-level RNG calls (route through utils.rng — "
         "see docs/determinism.md):\n" + "\n".join(offenders))
+
+
+# ---------------------------------------------------------------------------
+# lint: library code speaks through the structured logger/tracer, not
+# stdout, and never configures root logging at import time
+# ---------------------------------------------------------------------------
+
+_BARE_PRINT = re.compile(r"(?<![\w.])print\s*\(")
+_MODULE_BASICCONFIG = re.compile(r"^logging\.basicConfig\s*\(")
+#: machine-interface emitters: their stdout IS a consumed artifact
+#: (JSON lines a driver parses), so print is their contract — every
+#: entry needs that justification to stay here
+_PRINT_ALLOWED = {
+    os.path.join("models", "resnet_mfu_lab.py"),  # MFU_LAB.jsonl rows
+}
+
+
+def test_no_print_or_import_time_logging_config_in_library():
+    """Library code must use the structured logger/tracer
+    (telemetry.slog / telemetry.Tracer — docs/observability.md): a bare
+    ``print(`` is invisible to every exporter and unfilterable by the
+    embedding application, and a module-level ``logging.basicConfig``
+    hijacks the application's logging the moment the package imports.
+    ``bigdl_tpu/examples/`` is exempt from the print rule only — they
+    are runnable scripts whose stdout IS their interface (several emit
+    JSON lines the bench driver consumes).  Fails with file:line."""
+    pkg = os.path.join(os.path.dirname(__file__), "..", "bigdl_tpu")
+    offenders = []
+    for dirpath, _dirs, files in os.walk(pkg):
+        rel_dir = os.path.relpath(dirpath, pkg)
+        is_example = rel_dir == "examples" or \
+            rel_dir.startswith("examples" + os.sep)
+        for fname in sorted(files):
+            if not fname.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fname)
+            allowed = os.path.relpath(path, pkg) in _PRINT_ALLOWED
+            with open(path) as f:
+                for lineno, line in enumerate(f, 1):
+                    code = line.split("#", 1)[0]
+                    bad = _MODULE_BASICCONFIG.search(code) or (
+                        not is_example and not allowed
+                        and _BARE_PRINT.search(code))
+                    if bad:
+                        rel = os.path.relpath(path, pkg)
+                        offenders.append(
+                            f"bigdl_tpu/{rel}:{lineno}: {line.strip()}")
+    assert not offenders, (
+        "bare print( / import-time logging.basicConfig in library code "
+        "(use telemetry.slog.get_logger / configure_logging — see "
+        "docs/observability.md):\n" + "\n".join(offenders))

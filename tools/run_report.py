@@ -1,0 +1,54 @@
+#!/usr/bin/env python
+"""Render a run report from a telemetry snapshot directory.
+
+The training drivers (and ``ElasticContext.publish_telemetry``
+consumers) drop one ``<host>.json`` payload per host into a snapshot
+directory when ``Telemetry(snapshot_dir=...)`` is configured; this
+tool merges them into the cluster view and prints the text table:
+goodput breakdown (productive / compile / data-stall / checkpoint /
+recovery / idle), top span categories, per-host step-time skew.
+
+Usage:
+    python tools/run_report.py <snapshot_dir> [--top N]
+    python tools/run_report.py <snapshot_dir> --json   # merged view
+
+See docs/observability.md for the payload format and cadence guidance.
+"""
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("snapshot_dir",
+                   help="directory of <host>.json telemetry payloads")
+    p.add_argument("--top", type=int, default=6,
+                   help="span categories to show (default 6)")
+    p.add_argument("--json", action="store_true",
+                   help="emit the merged cluster view as JSON instead "
+                        "of the text table")
+    args = p.parse_args(argv)
+
+    from bigdl_tpu.telemetry.aggregate import (merge_cluster,
+                                               read_snapshot_dir)
+    from bigdl_tpu.telemetry.report import render_report
+
+    payloads = read_snapshot_dir(args.snapshot_dir)
+    if not payloads:
+        print(f"no telemetry snapshots found under "
+              f"{args.snapshot_dir!r}", file=sys.stderr)
+        return 1
+    cluster = merge_cluster(payloads)
+    if args.json:
+        print(json.dumps(cluster, indent=1))
+    else:
+        print(render_report(cluster, top_n=args.top))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
