@@ -52,19 +52,23 @@ VS_BASELINE_BASIS = (
     "published reference number"
 )
 
-# Analytic fallbacks (multiply-add = 2 FLOPs; backward ~= 2x forward).
-RESNET50_FWD_FLOPS_PER_IMAGE = 4.09e9  # 224x224, standard count
+# Analytic CROSS-CHECK constants (no longer on the reporting path —
+# MFU is derived from XLA's cost model of the exact compiled step; a
+# tier-1 test keeps derived-vs-analytic within 5%).  NOTE the r6
+# correction: the widely-quoted "4.09 GFLOPs" for ResNet-50 at 224² is
+# 4.09 G*MACs*; in the multiply-add=2 convention every MFU denominator
+# uses (TPU peak specs count FMA as 2), the forward is 8.18 GFLOP per
+# image.  Rounds 1-5 divided MACs by an FMA=2 peak, understating
+# ResNet MFU ~2x (BENCH_r05's 0.135 is ~0.27 on the corrected basis).
+RESNET50_FWD_MACS_PER_IMAGE = 4.09e9  # 224x224, standard count
+RESNET50_FWD_FLOPS_PER_IMAGE = 2 * RESNET50_FWD_MACS_PER_IMAGE
 TRAIN_FWD_MULTIPLIER = 3.0  # fwd + bwd(2x fwd)
 
-# bf16 peak FLOP/s per chip by device kind substring (public TPU specs).
-PEAK_FLOPS_TABLE = (
-    ("v6e", 918e12), ("trillium", 918e12),
-    ("v5p", 459e12),
-    ("v5e", 197e12), ("v5litepod", 197e12), ("v5 lite", 197e12),
-    ("v4", 275e12),
-    ("v3", 123e12),
-    ("v2", 45e12),
-)
+# bf16 peak FLOP/s per chip — the one table now lives in
+# telemetry/device_info.py (with HBM capacity/bandwidth for the
+# roofline); these names stay as compat shims for existing callers.
+from bigdl_tpu.telemetry.device_info import (  # noqa: E402
+    PEAK_FLOPS_TABLE, peak_flops_per_sec)
 
 PROBE_TIMEOUT = float(os.environ.get("BENCH_PROBE_TIMEOUT", "300"))
 TPU_TIMEOUT = float(os.environ.get("BENCH_TPU_TIMEOUT", "2700"))
@@ -128,7 +132,11 @@ def _train_step_fn(model, criterion, optim, compute_dtype=None):
 
 def bench_model(model, criterion, x, y, iters=20, warmup=3, lr=0.01,
                 compute_dtype=None, steps_per_dispatch=1):
-    """Returns (records_per_sec, flops_per_step_or_None).
+    """Returns ``(records_per_sec, cost)`` — ``cost`` is a
+    :class:`bigdl_tpu.telemetry.perf.StepCost` for ONE training step
+    (XLA cost-model FLOPs/bytes of the exact program timed; memory
+    analysis attached when the AOT compile succeeded) or None when
+    analysis failed.
 
     ``steps_per_dispatch > 1`` chains K train steps inside ONE jitted
     program (lax.fori_loop; the reference perf harness also repeats a
@@ -169,18 +177,38 @@ def bench_model(model, criterion, x, y, iters=20, warmup=3, lr=0.01,
 
     # AOT-compile once; reuse the executable so cost_analysis sees the
     # exact program we time (and we never compile twice).
-    flops = None
+    from bigdl_tpu.telemetry.perf import cost_from_analysis
+
+    compiled = None
     try:
         compiled = step.lower(params, buffers, slots, lr_arr, rng, x, y
                               ).compile()
-        ca = compiled.cost_analysis()
-        if isinstance(ca, (list, tuple)):
-            ca = ca[0] if ca else {}
-        f = float(ca.get("flops", 0.0)) if hasattr(ca, "get") else 0.0
-        flops = f if f > 0 else None
         run = compiled
     except Exception:
         run = step  # fall back to the jit cache path
+
+    # per-STEP cost from XLA's own model.  K>1 chains steps inside a
+    # fori_loop whose body the cost analysis does not scale by trip
+    # count, so the per-step figure comes from lowering the single-step
+    # program instead (lowering traces only — no second compile).
+    cost = None
+    try:
+        if K == 1 and compiled is not None:
+            try:
+                memory = compiled.memory_analysis()
+            except Exception:
+                memory = None
+            cost = cost_from_analysis(compiled.cost_analysis(),
+                                      memory=memory, source="compiled")
+        else:
+            lowered = one_step.lower(params, buffers, slots, lr_arr,
+                                     rng, x, y)
+            cost = cost_from_analysis(lowered.cost_analysis(),
+                                      source="lowered")
+        if cost is not None and cost.flops <= 0:
+            cost = None
+    except Exception:
+        cost = None
 
     # Execution barrier: fetch the scalar loss value.  On the tunneled
     # axon backend ``block_until_ready`` returns before the computation
@@ -197,11 +225,7 @@ def bench_model(model, criterion, x, y, iters=20, warmup=3, lr=0.01,
             params, buffers, slots, lr_arr, rng, x, y)
     float(loss)
     dt = time.perf_counter() - t0
-    if K > 1:
-        # XLA cost analysis does not scale fori_loop bodies by trip
-        # count — a per-step figure can't be recovered from it
-        flops = None
-    return x.shape[0] * iters * K / dt, flops
+    return x.shape[0] * iters * K / dt, cost
 
 
 def _bench_resnet(batch, iters, warmup, compute_dtype, rng, spd=1,
@@ -228,9 +252,17 @@ def _bench_resnet(batch, iters, warmup, compute_dtype, rng, spd=1,
 
 def _bench_transformer_lm(rng, iters=16, spd=2, seq_len=1024, batch=16,
                           embed_dim=1024, num_heads=8, num_layers=8,
-                          moe_experts=0, moe_aux_coef=0.0):
+                          moe_experts=0, moe_aux_coef=0.0,
+                          seq_strategy="flash"):
     """Flagship LM: flash attention + fused xent, bf16.  Returns
-    (tokens_per_sec, model_flops_per_sec_6nd, flops_per_sec_attn_incl).
+    (tokens_per_sec, model_flops_per_sec_6nd, flops_per_sec_attn_incl,
+    step_cost_or_None).  The 6ND figures are derived from the live
+    param count (the standard LM MFU convention), the cost figure from
+    XLA's model of the step program — note Pallas kernels (the flash
+    path) are opaque custom calls the XLA cost model counts at zero
+    flops, so the derived count under-reports attention math there;
+    ``seq_strategy="dense"`` makes the two directly comparable (the
+    tier-1 cross-check uses it).
 
     The 6ND convention counts NO attention-score FLOPs, which grow
     linearly in T and are real MXU work — the attention-inclusive rate
@@ -252,7 +284,8 @@ def _bench_transformer_lm(rng, iters=16, spd=2, seq_len=1024, batch=16,
     # T=4096 (33.7 vs 27.5 TFLOP/s fwd+bwd, block 1024) with identical
     # d_model and parameter count.
     model = TransformerLM(V, embed_dim=D, num_heads=num_heads,
-                          num_layers=L, max_len=T, seq_strategy="flash",
+                          num_layers=L, max_len=T,
+                          seq_strategy=seq_strategy,
                           output="logits", moe_experts=moe_experts,
                           moe_aux_coef=moe_aux_coef)
     crit = nn.TimeDistributedCriterion(nn.CrossEntropyCriterion(), True)
@@ -266,13 +299,14 @@ def _bench_transformer_lm(rng, iters=16, spd=2, seq_len=1024, batch=16,
             active -= ex * (m.n_experts - 1) // m.n_experts
     x = rng.randint(1, V, (B, T)).astype("float32")
     y = rng.randint(1, V + 1, (B, T)).astype("float32")
-    rps, _ = bench_model(model, crit, x, y, iters=iters, warmup=2,
-                         compute_dtype=jnp.bfloat16,
-                         steps_per_dispatch=spd)
+    rps, cost = bench_model(model, crit, x, y, iters=iters, warmup=2,
+                            compute_dtype=jnp.bfloat16,
+                            steps_per_dispatch=spd)
     tokens_per_sec = rps * T
     attn_flops_per_token = 6.0 * T * D * L  # causal, train (fwd x3)
     return (tokens_per_sec, 6.0 * active * tokens_per_sec,
-            (6.0 * active + attn_flops_per_token) * tokens_per_sec)
+            (6.0 * active + attn_flops_per_token) * tokens_per_sec,
+            cost)
 
 
 def _bench_resnet_adaptive(batch, iters, warmup, compute_dtype, rng, spd=1,
@@ -344,6 +378,27 @@ def run_worker(backend: str) -> None:
     on_tpu = dev.platform != "cpu"
     peak = peak_flops_per_sec(device_kind) if on_tpu else None
 
+    # XLA cost-model work accounting for the whole battery: per-
+    # workload StepCosts land in one accountant (private registry) and
+    # the payload rides the emitted line under "perf" — the telemetry
+    # snapshot view of the bench (mfu family, roofline bounds, HBM
+    # watermarks where the backend reports them)
+    from bigdl_tpu.telemetry import MetricsRegistry
+    from bigdl_tpu.telemetry.device_info import current_device_spec
+    from bigdl_tpu.telemetry.perf import PerfAccountant
+
+    pa = PerfAccountant(registry=MetricsRegistry(),
+                        spec=current_device_spec(dev))
+
+    def account(label, cost, seconds_per_step):
+        """Best-effort: the accountant must never cost a bench row."""
+        try:
+            if cost is not None and seconds_per_step > 0:
+                pa.on_program(label, cost)
+                pa.on_step(seconds_per_step)
+        except Exception:
+            pass
+
     out = {
         "device": str(dev),
         "device_kind": device_kind,
@@ -411,6 +466,7 @@ def run_worker(backend: str) -> None:
         bf16_err = "skipped on cpu"
         f32_ips, f32_flops, f32_batch, f32_err = _bench_resnet_adaptive(
             4, 2, 1, None, rng)
+        flush("resnet50_cpu")
 
     # Space-to-depth stem: the SAME network function (exactness pinned in
     # tests/test_resnet_s2d.py) with the MXU-starved 7x7x3 stem conv
@@ -488,15 +544,31 @@ def run_worker(backend: str) -> None:
         out["resnet50_bf16_error"] = bf16_err
 
     if head_ips and head_batch:
-        # MFU from the ANALYTIC model FLOP count (the standard MFU
-        # convention: useful model flops, not XLA's executed-op count,
-        # which includes remat/transforms and overstates by ~2x here —
-        # the XLA number is reported alongside for the record).
-        model_fps = RESNET50_FWD_FLOPS_PER_IMAGE * TRAIN_FWD_MULTIPLIER \
-            * head_ips
-        if head_flops:
-            out["resnet50_xla_flops_per_step"] = head_flops
+        # MFU from XLA's cost model of the exact compiled step — no
+        # hand-coded FLOP constant on the reporting path (r6; the old
+        # 4.09e9 "FLOPs" constant was MACs, understating MFU ~2x).
+        # The pre-optimization HLO count is the math as written: the
+        # analytic figure rides along as a cross-check, and a tier-1
+        # test holds the two within 5% on CPU.
+        analytic_fps = (RESNET50_FWD_FLOPS_PER_IMAGE
+                        * TRAIN_FWD_MULTIPLIER * head_ips)
+        if head_flops is not None:
+            out["resnet50_flops_per_step"] = head_flops.flops
+            out["resnet50_bytes_per_step"] = head_flops.bytes_accessed
+            if head_flops.peak_bytes:
+                out["resnet50_step_peak_bytes"] = head_flops.peak_bytes
+            model_fps = head_flops.flops / head_batch * head_ips
+            out["mfu_basis"] = (
+                "xla_cost_analysis per-step flops (FMA=2) — corrected "
+                "basis, ~2x the r1-r5 MACs-as-FLOPs analytic")
+        else:
+            model_fps = analytic_fps
+            out["mfu_basis"] = ("analytic fallback "
+                                "(cost analysis unavailable)")
+        account("resnet50_train_step", head_flops,
+                head_batch / head_ips)
         out["resnet50_model_flops_per_sec"] = round(model_fps, 3)
+        out["resnet50_analytic_flops_per_sec"] = round(analytic_fps, 3)
         out["mfu"] = round(model_fps / peak, 4) if peak else None
         out["peak_flops_per_sec"] = peak
         out["mfu_target"] = 0.45
@@ -506,9 +578,18 @@ def run_worker(backend: str) -> None:
     # shows the framework's MFU ceiling next to the conv-bound ResNet)
     if on_tpu:
         try:
-            lm_tps, lm_fps, lm_fps_attn = _bench_transformer_lm(rng)
+            lm_tps, lm_fps, lm_fps_attn, lm_cost = \
+                _bench_transformer_lm(rng)
             out["transformerlm_tokens_per_sec"] = round(lm_tps, 1)
             out["transformerlm_model_flops_per_sec"] = round(lm_fps, 1)
+            if lm_cost is not None:
+                # flash Pallas kernels are opaque to the cost model
+                # (counted 0 flops) — reported for the record, 6ND
+                # stays the LM MFU basis (derived from the live param
+                # count, not a hand-coded constant)
+                out["transformerlm_flops_per_step"] = lm_cost.flops
+            account("transformerlm_train_step", lm_cost,
+                    16 * 1024 / max(lm_tps, 1e-9))
             if peak:
                 out["transformerlm_mfu"] = round(lm_fps / peak, 4)
                 out["transformerlm_mfu_attn_incl"] = round(
@@ -522,8 +603,9 @@ def run_worker(backend: str) -> None:
             out["transformerlm_T4096_skipped"] = "worker time budget"
         else:
             try:
-                long_tps, long_fps, long_fps_attn = _bench_transformer_lm(
-                    rng, iters=8, spd=2, seq_len=4096, batch=4)
+                long_tps, long_fps, long_fps_attn, _ = \
+                    _bench_transformer_lm(
+                        rng, iters=8, spd=2, seq_len=4096, batch=4)
                 out["transformerlm_T4096_tokens_per_sec"] = round(long_tps, 1)
                 if peak:
                     out["transformerlm_T4096_mfu"] = round(long_fps / peak, 4)
@@ -539,7 +621,7 @@ def run_worker(backend: str) -> None:
             out["transformerlm_T8192_skipped"] = "worker time budget"
         else:
             try:
-                l8_tps, l8_fps, l8_fps_attn = _bench_transformer_lm(
+                l8_tps, l8_fps, l8_fps_attn, _ = _bench_transformer_lm(
                     rng, iters=6, spd=2, seq_len=8192, batch=2)
                 out["transformerlm_T8192_tokens_per_sec"] = round(l8_tps, 1)
                 if peak:
@@ -559,7 +641,7 @@ def run_worker(backend: str) -> None:
             out["moe_transformerlm_skipped"] = "worker time budget"
         else:
             try:
-                m_tps, m_fps, _ = _bench_transformer_lm(
+                m_tps, m_fps, _, _ = _bench_transformer_lm(
                     rng, iters=8, spd=2, seq_len=1024, batch=16,
                     embed_dim=512, num_heads=4, num_layers=4,
                     moe_experts=8, moe_aux_coef=0.01)
@@ -665,6 +747,26 @@ def run_worker(backend: str) -> None:
                 except Exception as e:
                     out["prefill_error"] = f"{type(e).__name__}: {e}"[:300]
         flush("decode")
+    else:
+        # CPU reference leg for the second bench workload: a tiny
+        # dense-attention TransformerLM, so a CPU-backend run reports
+        # derived mfu-family metrics for BOTH bench workloads (dense
+        # attention so the XLA cost model sees the attention math —
+        # flash Pallas custom calls count zero flops)
+        try:
+            c_tps, _, _, c_cost = _bench_transformer_lm(
+                rng, iters=2, spd=1, seq_len=128, batch=2,
+                embed_dim=128, num_heads=2, num_layers=2,
+                seq_strategy="dense")
+            out["transformerlm_cpu_tokens_per_sec"] = round(c_tps, 1)
+            if c_cost is not None:
+                out["transformerlm_cpu_flops_per_step"] = c_cost.flops
+            account("transformerlm_train_step", c_cost,
+                    2 * 128 / max(c_tps, 1e-9))
+        except Exception as e:
+            out["transformerlm_cpu_error"] = \
+                f"{type(e).__name__}: {e}"[:300]
+        flush("transformerlm_cpu")
 
     # --- SimpleRNN: the reference's published workload (batch 12) -------
     try:
@@ -700,6 +802,13 @@ def run_worker(backend: str) -> None:
         out["lenet5_steps_per_dispatch"] = lenet_spd
     except Exception as e:
         out["lenet5_error"] = f"{type(e).__name__}: {e}"
+
+    try:
+        # the bench's telemetry-snapshot view: per-workload cost-model
+        # flops/bytes, mfu, roofline bound, HBM watermarks if any
+        out["perf"] = pa.payload()
+    except Exception:
+        pass
 
     out.update({
         "metric": "ResNet-50 train throughput"
@@ -1297,6 +1406,65 @@ def run_telemetry_bench() -> None:
 
 
 # --------------------------------------------------------------------------
+# Perf ledger: the append-only trajectory record the sentinel guards
+# --------------------------------------------------------------------------
+
+LEDGER_FILE = "PERF_LEDGER.jsonl"
+LEDGER_SCHEMA = 1
+
+#: the schema-stable field set every ledger record carries (absent
+#: measurements are explicit nulls, never missing keys — the sentinel
+#: and any trend tooling can rely on the shape).  tools/perf_sentinel.py
+#: checks a subset of these against PERF_BASELINE.json.
+LEDGER_FIELDS = (
+    "tpu", "stale", "backend", "device_kind", "metric", "value", "unit",
+    "mfu", "mfu_basis", "resnet50_flops_per_step",
+    "transformerlm_mfu", "transformerlm_T4096_mfu",
+    "transformerlm_cpu_tokens_per_sec",
+    "simplernn_records_per_sec", "lenet5_images_per_sec",
+    "decode_tokens_per_sec", "prefill_tokens_per_sec",
+    "serving_p99_ms", "serving_p50_ms", "elastic_recovery_s",
+    "sdc_detection_latency_steps", "telemetry_overhead_pct",
+    "vs_baseline",
+)
+
+
+def ledger_record(result: dict) -> dict:
+    """Flatten one bench emit into the schema-stable ledger record."""
+    flat = dict(result)
+    flat["backend"] = "tpu" if result.get("tpu") else "cpu"
+    serving = result.get("serving") or {}
+    flat["serving_p99_ms"] = serving.get("p99_ms")
+    flat["serving_p50_ms"] = serving.get("p50_ms")
+    elastic = result.get("elastic") or {}
+    flat["elastic_recovery_s"] = elastic.get("recovery_wall_clock_s")
+    integrity = result.get("integrity") or {}
+    flat["sdc_detection_latency_steps"] = integrity.get(
+        "sdc_detection_latency_steps")
+    telemetry = result.get("telemetry") or {}
+    flat["telemetry_overhead_pct"] = telemetry.get("overhead_pct")
+    rec = {"schema": LEDGER_SCHEMA,
+           "ts": result.get("measured_at") or _utc_now(),
+           "recorded_at": _utc_now()}
+    for key in LEDGER_FIELDS:
+        rec[key] = flat.get(key)
+    return rec
+
+
+def append_ledger(result: dict, path=None) -> dict:
+    """Append this run's record to the ledger (default:
+    ``PERF_LEDGER.jsonl`` next to bench.py).  Best-effort on IO."""
+    rec = ledger_record(result)
+    path = path or os.path.join(_here(), LEDGER_FILE)
+    try:
+        with open(path, "a") as f:
+            f.write(json.dumps(rec) + "\n")
+    except OSError:
+        pass
+    return rec
+
+
+# --------------------------------------------------------------------------
 # Probe: initialize the backend, print device info (runs in a subprocess)
 # --------------------------------------------------------------------------
 
@@ -1437,7 +1605,7 @@ def _salvage_partial(notes):
     return merged
 
 
-def main() -> None:
+def main(ledger: bool = True) -> None:
     t0 = time.time()
     ok, info, note = _run_sub(["--probe"], PROBE_TIMEOUT)
     probe_secs = round(time.time() - t0, 1)
@@ -1616,11 +1784,25 @@ def main() -> None:
                           "simplernn_records_per_sec",
                           "lenet5_images_per_sec", "error")
                 if result.get(k) is not None}
+            # the control-plane legs (serving/elastic/integrity/
+            # telemetry) are backend-independent and were measured
+            # LIVE this run — they must not be shadowed by whatever
+            # the stale chip record carried
+            for leg in ("serving", "elastic", "integrity",
+                        "telemetry"):
+                if result.get(leg) is not None:
+                    merged[leg] = result[leg]
             result = merged
+        if ledger:
+            append_ledger(result)
         print(json.dumps(result), flush=True)
         return
     result["tpu_live"] = True
     result["stale"] = False
+    if ledger:
+        # every orchestrated run appends its schema-stable record —
+        # the trajectory tools/perf_sentinel.py guards
+        append_ledger(result)
     print(json.dumps(result), flush=True)
 
 
@@ -1632,6 +1814,11 @@ if __name__ == "__main__":
     p.add_argument("--integrity", action="store_true")
     p.add_argument("--telemetry", action="store_true")
     p.add_argument("--worker", choices=["tpu", "cpu"])
+    # every orchestrated run appends to PERF_LEDGER.jsonl by default;
+    # --no-ledger keeps scratch runs out of the judged trajectory
+    p.add_argument("--ledger", dest="ledger", action="store_true",
+                   default=True)
+    p.add_argument("--no-ledger", dest="ledger", action="store_false")
     a = p.parse_args()
     if a.probe:
         run_probe()
@@ -1646,4 +1833,4 @@ if __name__ == "__main__":
     elif a.worker:
         run_worker(a.worker)
     else:
-        main()
+        main(ledger=a.ledger)

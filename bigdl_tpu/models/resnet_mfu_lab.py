@@ -27,7 +27,10 @@ import json
 import os
 import time
 
-RESNET50_FWD_FLOPS_PER_IMAGE = 4.09e9
+# analytic FALLBACK only (rows carry mfu_basis when used): 4.09 GMACs
+# x 2 flops/MAC — the r6 basis correction; the r1-r5 rows in
+# MFU_LAB.jsonl divided MACs by an FMA=2 peak and read ~2x low
+RESNET50_FWD_FLOPS_PER_IMAGE = 2 * 4.09e9
 
 
 def _bench_module():
@@ -39,6 +42,19 @@ def _bench_module():
     mod = importlib.util.module_from_spec(spec)
     spec.loader.exec_module(mod)
     return mod
+
+
+def _step_cost(jitted, *args):
+    """Per-step XLA cost-model flops of a jitted step (lowering only —
+    no compile, no execution); None when analysis fails."""
+    try:
+        from ..telemetry.perf import cost_from_analysis
+
+        cost = cost_from_analysis(
+            jitted.lower(*args).cost_analysis())
+        return cost if cost.flops > 0 else None
+    except Exception:
+        return None
 
 # distinct (cin, cout, k, stride, spatial_in) conv shapes of ResNet-50
 # at 224² with their per-image multiplicity
@@ -58,8 +74,13 @@ RESNET50_CONV_SHAPES = [
 
 def _peak():
     import jax
+
+    # the ONE peak table (telemetry/device_info.py; bench.py consumes
+    # the same rows through its compat shim)
+    from ..telemetry.device_info import peak_flops_per_sec
+
     kind = getattr(jax.devices()[0], "device_kind", "") or ""
-    return _bench_module().peak_flops_per_sec(kind)  # ONE peak table
+    return peak_flops_per_sec(kind)
 
 
 def _device_str():
@@ -97,6 +118,7 @@ def run_twin(impl, batches=(64, 128, 256), iters=20, warmup=4,
     out = {"exp": "twin", "impl": impl, "layout": layout,
            "device": _device_str(), "sweep": {}}
     best = 0.0
+    flops_per_image = None
     for B in batches:
         try:
             SPD = 4  # match the framework bench's dispatch amortization
@@ -107,6 +129,14 @@ def run_twin(impl, batches=(64, 128, 256), iters=20, warmup=4,
             rng = np.random.RandomState(0)
             x = jnp.asarray(rng.rand(B, 224, 224, 3), jnp.bfloat16)
             y = jnp.asarray(rng.randint(0, 1000, B), jnp.int32)
+            if flops_per_image is None:
+                # derived per-step cost of the single-step twin program
+                # (lowering only; before any donation runs)
+                c = _step_cost(
+                    make_train_step(impl=impl, steps_per_dispatch=1,
+                                    layout=layout), params, vel, x, y)
+                if c is not None:
+                    flops_per_image = c.flops / B
             for _ in range(warmup):
                 loss, params, vel = step(params, vel, x, y)
             float(loss)
@@ -125,8 +155,10 @@ def run_twin(impl, batches=(64, 128, 256), iters=20, warmup=4,
                "batch": B, "result": out["sweep"][str(B)]})
     out["images_per_sec"] = round(best, 2)
     if peak and best:
-        out["mfu"] = round(best * RESNET50_FWD_FLOPS_PER_IMAGE * 3 / peak,
-                           4)
+        fpi = flops_per_image or RESNET50_FWD_FLOPS_PER_IMAGE * 3
+        out["mfu"] = round(best * fpi / peak, 4)
+        out["mfu_basis"] = ("xla_cost_analysis" if flops_per_image
+                            else "analytic_fallback")
         out["peak_flops_per_sec"] = peak
     _emit(out)
 
@@ -220,14 +252,17 @@ def run_framework(impl, batches=(64, 128, 256)):
     out = {"exp": "framework", "impl": impl, "device": _device_str(),
            "sweep": {}}
     best = 0.0
+    flops_per_image = None
     for B in batches:
         try:
             x = rng.rand(B, 3, 224, 224).astype("bfloat16")
             y = rng.randint(1, 1001, B).astype("float32")
-            ips, _ = bench.bench_model(
+            ips, cost = bench.bench_model(
                 ResNet50(1000), nn.ClassNLLCriterion(), x, y,
                 iters=20, warmup=4, compute_dtype=jnp.bfloat16,
                 steps_per_dispatch=4)
+            if cost is not None and flops_per_image is None:
+                flops_per_image = cost.flops / B
             out["sweep"][str(B)] = round(ips, 2)
             best = max(best, ips)
         except Exception as e:
@@ -236,8 +271,10 @@ def run_framework(impl, batches=(64, 128, 256)):
                "result": out["sweep"][str(B)]})
     out["images_per_sec"] = round(best, 2)
     if peak and best:
-        out["mfu"] = round(best * RESNET50_FWD_FLOPS_PER_IMAGE * 3 / peak,
-                           4)
+        fpi = flops_per_image or RESNET50_FWD_FLOPS_PER_IMAGE * 3
+        out["mfu"] = round(best * fpi / peak, 4)
+        out["mfu_basis"] = ("xla_cost_analysis" if flops_per_image
+                            else "analytic_fallback")
     _emit(out)
 
 

@@ -409,8 +409,21 @@ class DistriOptimizer(Optimizer):
                         "sequences to a multiple")
             infeed_time = time.time() - t_data0
 
-            t0 = time.time()
             lr = optim.get_current_lr()
+            if first_step and not mask_kw and self.telemetry is not None:
+                # cost-model analysis of the fused multi-axis program;
+                # the constant key only shapes the trace.  Wire-byte
+                # estimate: the data-axis gradient all-reduce
+                # (~2(n-1)/n of param bytes); tensor/seq activation
+                # collectives ride inside the program uncounted.
+                self._tm_analyze(
+                    step.jitted_for(x, y, False), params, slots,
+                    buffers, jnp.float32(lr), jax.random.PRNGKey(0),
+                    x, y,
+                    collective_bytes=(2.0 * (n_data - 1)
+                                      / max(n_data, 1)
+                                      * self._tree_bytes(params)))
+            t0 = time.time()
             loss, params, slots, buffers = self._elastic_dispatch(
                 lambda: step(params, slots, buffers, lr, x, y,
                              rng=next_jax_key(), **mask_kw), state)
@@ -604,8 +617,18 @@ class DistriOptimizer(Optimizer):
                 mask_kw = {"w": w, "total_w": float(n_records)}
             infeed_time = time.time() - t_data0
 
-            t0 = time.time()
             lr = optim.get_current_lr()
+            if first_step and not mask_kw and self.telemetry is not None:
+                # cost-model analysis of the GPipe program (host-side
+                # lowering; constant key — see the data path)
+                self._tm_analyze(
+                    step.jitted_for(False), packed, slots,
+                    jnp.float32(lr), jax.random.PRNGKey(0),
+                    jnp.asarray(x), jnp.asarray(y),
+                    collective_bytes=(2.0 * (n_data - 1)
+                                      / max(n_data, 1)
+                                      * self._tree_bytes(packed)))
+            t0 = time.time()
             loss, packed, slots = self._elastic_dispatch(
                 lambda: step(packed, slots, lr, x, y,
                              rng=next_jax_key(), **mask_kw), state)
@@ -834,12 +857,24 @@ class DistriOptimizer(Optimizer):
                         and state["neval"] % profile_interval == 0
                         and not masked)
 
-            t0 = time.time()
             lr = optim.get_current_lr()
             if masked and jitted_masked is None:
                 jitted_masked = self._build_step(mesh, arp, masked=True)
             if masked:
                 w = shard_batch(mesh, (w,))[0]
+            if first_step and not masked and self.telemetry is not None:
+                # cost-model analysis of the exact data-parallel
+                # program (host-side lowering, before the timed
+                # region); the constant key only shapes the trace —
+                # never draw from the checkpointed key stream here.
+                # Wire bytes: reduce-scatter + all-gather move
+                # ~2(n-1)/n of the param bytes each step.
+                self._tm_analyze(
+                    jitted, params, buffers, slots, jnp.float32(lr),
+                    jax.random.PRNGKey(0), x, y,
+                    collective_bytes=(2.0 * (n_dev - 1) / max(n_dev, 1)
+                                      * self._tree_bytes(params)))
+            t0 = time.time()
 
             def dispatch():
                 if masked:

@@ -403,6 +403,32 @@ class Optimizer:
         if self.telemetry is not None:
             self.telemetry.write_snapshot(step=state.get("neval"))
 
+    def _tm_analyze(self, fn, *args, label: str = "train_step",
+                    collective_bytes: float = 0.0, **kwargs):
+        """Feed the step program to the telemetry PerfAccountant: XLA
+        cost-model FLOPs/bytes from lowering ``fn`` with the driver's
+        concrete args (no compile, no execution — lowering only traces
+        avals, so donated buffers are untouched).  Called once per
+        fresh program, at the first dispatch of every mesh path;
+        best-effort by contract — analysis failure never touches the
+        step loop."""
+        tm = self.telemetry
+        if tm is None or fn is None:
+            return
+        tm.perf.analyze_jitted(fn, *args, label=label,
+                               collective_bytes=collective_bytes,
+                               **kwargs)
+
+    @staticmethod
+    def _tree_bytes(tree) -> float:
+        """Total leaf bytes of a pytree — the collective-volume input
+        (data-parallel wire bytes ~= 2(n-1)/n x param bytes for the
+        reduce-scatter + all-gather pair)."""
+        return float(sum(
+            int(a.size) * jnp.dtype(a.dtype).itemsize
+            for a in jax.tree_util.tree_leaves(tree)
+            if hasattr(a, "size") and hasattr(a, "dtype")))
+
     # -- determinism + integrity plumbing (docs/determinism.md) ---------
     def _fault_host(self) -> str:
         """The host name the SDC fault injectors key off: the elastic
@@ -997,9 +1023,15 @@ class LocalOptimizer(Optimizer):
             n_records, x, y, data_time = pending or fetch()
             pending = None
 
-            t0 = time.time()
             lr = optim.get_current_lr()
             rng = next_jax_key()
+            if first_step and self.telemetry is not None:
+                # XLA cost-model work accounting for the exact program
+                # about to compile (before t0: analysis is host-side
+                # lowering, not step time)
+                self._tm_analyze(jitted, params, buffers, slots,
+                                 jnp.float32(lr), rng, x, y)
+            t0 = time.time()
             loss, params, buffers, slots, step_ok, gnorm = \
                 self._elastic_dispatch(
                     lambda: jitted(params, buffers, slots,

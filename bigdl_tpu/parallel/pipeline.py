@@ -602,6 +602,9 @@ def make_pipeline_train_step(model, criterion, optim, mesh,
     step.n_microbatch = M
     step.pack = lambda: pack_params(model, S, model_axis)
     step.unpack = lambda packed: unpack_params(packed, model)
+    # underlying jit object (by masked variant) for the telemetry
+    # PerfAccountant's cost-model lowering
+    step.jitted_for = _jitted_for
     return step
 
 

@@ -432,6 +432,10 @@ def make_train_step(model, criterion, optim, mesh,
     step.param_specs = pspecs
     step.slot_specs = sslots
     step.input_spec = x_spec
+    # the underlying jit object for a given batch signature — lets the
+    # telemetry PerfAccountant lower the exact program for cost-model
+    # FLOP/byte accounting without a second jit cache
+    step.jitted_for = _jitted_for
     return step
 
 

@@ -20,6 +20,7 @@ counts) exports Prometheus text via ``metrics.registry
 from __future__ import annotations
 
 import threading
+import time
 from typing import Dict, Optional
 
 from ..telemetry.registry import MetricsRegistry, default_buckets
@@ -60,6 +61,15 @@ class ServingMetrics:
         self._padded = self.registry.counter(
             "bigdl_serving_padded_rows_total",
             "bucket-padding rows executed")
+        self._flops = self.registry.counter(
+            "bigdl_serving_flops_total",
+            "XLA cost-model FLOPs dispatched (per-bucket static "
+            "cost x batches)", labels=("bucket",))
+        # per-bucket static cost (XLA cost model) + the wall window the
+        # flops were spent in — what goodput-per-chip divides by
+        self._bucket_flops: Dict[int, float] = {}
+        self._t_first_batch: Optional[float] = None
+        self._t_last_batch: Optional[float] = None
         self.counts: Dict[str, int] = {s.value: 0 for s in Status}
         self.swaps = 0
         self.swap_rollbacks = 0
@@ -77,9 +87,23 @@ class ServingMetrics:
     def record_depth(self, depth: int):
         self._depth.observe(depth)
 
+    def record_bucket_cost(self, bucket: int, flops: float):
+        """Install the static cost of one bucket's compiled forward
+        (analyzed once per bucket by the server)."""
+        with self._lock:
+            self._bucket_flops[int(bucket)] = float(flops)
+
     def record_batch(self, real: int, bucket: int):
         self._batches.inc()
         self._padded.inc(bucket - real)
+        now = time.monotonic()
+        with self._lock:
+            flops = self._bucket_flops.get(int(bucket), 0.0)
+            if self._t_first_batch is None:
+                self._t_first_batch = now
+            self._t_last_batch = now
+        if flops:
+            self._flops.labels(bucket=str(int(bucket))).inc(flops)
 
     # ------------------------------------------------------------------
     @property
@@ -90,7 +114,39 @@ class ServingMetrics:
     def padded_rows(self) -> int:
         return int(self._padded.value)
 
+    @property
+    def flops_total(self) -> float:
+        fam = self.registry.get("bigdl_serving_flops_total")
+        return float(sum(child.value for _, child in fam.series())) \
+            if fam is not None else 0.0
+
+    def goodput_per_chip(self) -> dict:
+        """Model-FLOP/s actually served over the first→last batch wall
+        window, and that rate as a fraction of the chip's peak — the
+        serving analogue of training MFU.  Zeros before any analyzed
+        bucket has dispatched (CPU-only servers with no cost analysis
+        report flops_total 0, never an error)."""
+        with self._lock:
+            t0, t1 = self._t_first_batch, self._t_last_batch
+        total = self.flops_total
+        wall = (t1 - t0) if (t0 is not None and t1 is not None) else 0.0
+        rate = total / wall if wall > 0 else 0.0
+        out = {"flops_total": total, "wall_s": wall,
+               "model_flops_per_sec": rate, "mfu": None}
+        if rate > 0:
+            try:
+                from ..telemetry.device_info import current_device_spec
+
+                spec = current_device_spec()
+                if spec.peak_flops_per_sec:
+                    out["mfu"] = rate / spec.peak_flops_per_sec
+                    out["nominal_device"] = spec.nominal
+            except Exception:
+                pass
+        return out
+
     def snapshot(self) -> dict:
+        gpc = self.goodput_per_chip()
         with self._lock:
             counts = dict(self.counts)
         ok = counts[Status.OK.value]
@@ -117,6 +173,9 @@ class ServingMetrics:
             "padded_rows": self.padded_rows,
             "swaps": self.swaps,
             "swap_rollbacks": self.swap_rollbacks,
+            "flops_total": gpc["flops_total"],
+            "model_flops_per_sec": gpc["model_flops_per_sec"],
+            "serving_mfu": gpc["mfu"],
         }
 
     def to_summary(self, summary, step: int):

@@ -165,6 +165,9 @@ class InferenceServer:
         self._drained = threading.Event()
         self._preemption: Optional[PreemptionHandler] = None
         self._fwd = None
+        # classify buckets whose compiled-forward cost was already
+        # analyzed (one XLA cost-model lowering per bucket, ever)
+        self._costed_buckets: set = set()
 
     # ------------------------------------------------------------ lifecycle
     def start(self, install_signal_handler: bool = False
@@ -474,6 +477,7 @@ class InferenceServer:
                 x, bucket = self.batcher.coalesce(
                     [r.payload for r in reqs])
                 xj = jnp.asarray(x)
+                self._account_bucket_cost(bucket, params, buffers, xj)
                 out = self._fwd(params, buffers, xj)
                 # host transfer doubles as the execution barrier —
                 # device-side failures surface here, inside the try
@@ -502,6 +506,27 @@ class InferenceServer:
                 Status.OK, output=jax.tree_util.tree_map(
                     lambda a: a[i], out_np),
                 queued_s=q, bucket=bucket))
+
+    def _account_bucket_cost(self, bucket: int, params, buffers, xj):
+        """Per-bucket FLOP accounting: one XLA cost-model lowering the
+        first time each classify bucket dispatches, installed into the
+        metrics so `snapshot()` can report goodput-per-chip (served
+        model-FLOP/s over the chip peak).  Best-effort: cost analysis
+        failing must never fail the batch."""
+        key = (int(bucket), tuple(xj.shape[1:]))
+        if key in self._costed_buckets or self._fwd is None:
+            return
+        self._costed_buckets.add(key)
+        try:
+            from ..telemetry.perf import cost_from_analysis
+
+            lowered = self._fwd.lower(params, buffers, xj)
+            cost = cost_from_analysis(lowered.cost_analysis())
+            if cost.flops > 0:
+                self.metrics.record_bucket_cost(bucket, cost.flops)
+        except Exception as e:  # non-lowerable fwd, analysis quirks
+            log.debug("serving: bucket %d cost analysis skipped: %s",
+                      bucket, e)
 
     def _run_generate(self, params, reqs):
         """One compiled decode program per (bucket, prompt_len,

@@ -33,7 +33,9 @@ from .aggregate import (
     collect_snapshots, merge_cluster, merge_metrics, publish_snapshot,
     read_snapshot_dir, write_snapshot,
 )
+from .device_info import DeviceSpec, device_spec, peak_flops_per_sec
 from .goodput import GOODPUT_CATEGORIES, GoodputLedger
+from .perf import PerfAccountant, StepCost, classify_roofline
 from .registry import (
     Counter, Gauge, Histogram, MetricsRegistry, default_buckets,
     default_registry, reset_default_registry,
@@ -42,10 +44,12 @@ from .slog import configure_logging, get_logger
 from .tracer import CATEGORIES, Span, Tracer
 
 __all__ = [
-    "CATEGORIES", "GOODPUT_CATEGORIES", "Counter", "Gauge", "Histogram",
-    "MetricsRegistry", "GoodputLedger", "Span", "Telemetry", "Tracer",
-    "collect_snapshots", "configure_logging", "default_buckets",
-    "default_registry", "get_logger", "merge_cluster", "merge_metrics",
+    "CATEGORIES", "GOODPUT_CATEGORIES", "Counter", "DeviceSpec",
+    "Gauge", "Histogram", "MetricsRegistry", "GoodputLedger",
+    "PerfAccountant", "Span", "StepCost", "Telemetry", "Tracer",
+    "classify_roofline", "collect_snapshots", "configure_logging",
+    "default_buckets", "default_registry", "device_spec", "get_logger",
+    "merge_cluster", "merge_metrics", "peak_flops_per_sec",
     "publish_snapshot", "read_snapshot_dir", "reset_default_registry",
     "write_snapshot",
 ]
@@ -71,11 +75,16 @@ class Telemetry:
                  ledger: Optional[GoodputLedger] = None,
                  host: str = "local",
                  snapshot_dir: Optional[str] = None,
-                 trace_every: int = 1):
+                 trace_every: int = 1,
+                 perf: Optional[PerfAccountant] = None):
         self.registry = registry if registry is not None \
             else default_registry()
         self.tracer = tracer or Tracer()
         self.ledger = ledger or GoodputLedger()
+        # XLA cost-model work accounting (telemetry/perf.py): built on
+        # the same registry so the mfu family lands in one snapshot
+        self.perf = perf if perf is not None \
+            else PerfAccountant(registry=self.registry)
         self.host = str(host)
         self.snapshot_dir = snapshot_dir
         self.trace_every = max(0, int(trace_every))
@@ -170,12 +179,17 @@ class Telemetry:
             self.skipped_steps.inc()
         (self.compile_seconds if compiled
          else self.step_seconds).observe(seconds)
+        self.perf.on_step(seconds, compiled=compiled)
         if self._trace_due():
             end = self.tracer.clock()
+            # static FLOPs/bytes/intensity from the cost model ride on
+            # EVERY step span — Perfetto traces carry the work
+            # attribution even when the xplane profiler never ran
             parent = self.tracer.record(
                 "compile" if compiled else "step",
                 "compile" if compiled else "step",
-                end - seconds, seconds, step=step)
+                end - seconds, seconds, step=step,
+                **self.perf.span_args())
             if phase_split is not None and parent is not None:
                 compute_s, collective_s = phase_split
                 self.tracer.record("compute", "compute", parent.start,
@@ -214,6 +228,7 @@ class Telemetry:
             "goodput": self.ledger.snapshot(),
             "metrics": self.registry.snapshot()["metrics"],
             "span_totals": self.tracer.category_totals(),
+            "perf": self.perf.payload(),
         }
 
     def write_snapshot(self, directory: Optional[str] = None,

@@ -29,7 +29,8 @@ from .goodput import GoodputLedger
 
 __all__ = [
     "TM_PREFIX", "collect_snapshots", "merge_cluster", "merge_metrics",
-    "publish_snapshot", "read_snapshot_dir", "write_snapshot",
+    "merge_perf", "publish_snapshot", "read_snapshot_dir",
+    "write_snapshot",
 ]
 
 TM_PREFIX = "tm/"
@@ -192,6 +193,63 @@ def host_skew(payloads: Dict[str, dict]) -> Dict[str, dict]:
             for h, m in sorted(means.items())}
 
 
+def merge_perf(payloads: Dict[str, dict]) -> Optional[dict]:
+    """Fold per-host ``perf`` payload sections (the PerfAccountant's
+    cost-model view) into the cluster perf summary: per-host FLOP
+    totals sum, cluster MFU is total flops over Σ(host wall × host
+    peak), program cost entries union (identical programs on every
+    data-parallel host — first publisher wins, tagged with how many
+    hosts reported it), HBM watermarks keep the per-host maxima."""
+    per_host = {}
+    programs: dict = {}
+    program_hosts: Dict[str, int] = {}
+    total_flops = 0.0
+    denom = 0.0  # sum over hosts of wall_s x peak_flops
+    hbm_peak = None
+    nominal = False
+    device = None
+    for host, p in sorted(payloads.items()):
+        perf = p.get("perf")
+        if not perf:
+            continue
+        dev = perf.get("device") or {}
+        device = device or dev
+        nominal = nominal or bool(dev.get("nominal"))
+        flops = float(perf.get("flops_total") or 0.0)
+        wall = float((p.get("goodput") or {}).get("wall_s") or 0.0)
+        peak = dev.get("peak_flops_per_sec") or 0.0
+        entry = {"flops_total": flops, "wall_s": wall}
+        if wall > 0 and peak:
+            entry["mfu"] = flops / wall / peak
+            denom += wall * peak
+        total_flops += flops
+        hbm = perf.get("hbm") or {}
+        if hbm.get("peak_bytes_in_use") is not None:
+            entry["hbm_peak_bytes"] = hbm["peak_bytes_in_use"]
+            hbm_peak = max(hbm_peak or 0.0, hbm["peak_bytes_in_use"])
+            if hbm.get("bytes_limit") is not None:
+                entry["hbm_limit_bytes"] = hbm["bytes_limit"]
+        per_host[host] = entry
+        for label, prog in (perf.get("programs") or {}).items():
+            programs.setdefault(label, dict(prog))
+            program_hosts[label] = program_hosts.get(label, 0) + 1
+    if not per_host:
+        return None
+    for label, n in program_hosts.items():
+        programs[label]["reporting_hosts"] = n
+    out = {
+        "flops_total": total_flops,
+        "cluster_mfu": (total_flops / denom) if denom > 0 else None,
+        "nominal_device": nominal,
+        "device": device,
+        "per_host": per_host,
+        "programs": programs,
+    }
+    if hbm_peak is not None:
+        out["hbm_peak_bytes"] = hbm_peak
+    return out
+
+
 def merge_cluster(payloads: Dict[str, dict]) -> dict:
     """Fold per-host telemetry payloads (host → the dict
     ``Telemetry.payload()`` publishes) into the one cluster view the
@@ -213,4 +271,5 @@ def merge_cluster(payloads: Dict[str, dict]) -> dict:
             [p.get("metrics") or {} for p in payloads.values()]),
         "span_totals": dict(sorted(spans.items())),
         "per_host_skew": host_skew(payloads),
+        "perf": merge_perf(payloads),
     }

@@ -58,8 +58,65 @@ def render_report(cluster: dict, top_n: int = 6) -> str:
                 f"  {host:<12} mean step "
                 f"{1e3 * float(rec.get('mean_step_s') or 0.0):>8.2f}ms"
                 f"   {float(rec.get('skew') or 0.0):>5.2f}x median")
+    perf = cluster.get("perf")
+    if perf:
+        lines.extend(_render_perf(perf))
     lines.append("======================================================")
     return "\n".join(lines)
+
+
+def _human_flops(v: float) -> str:
+    for unit, scale in (("PF", 1e15), ("TF", 1e12), ("GF", 1e9),
+                        ("MF", 1e6)):
+        if abs(v) >= scale:
+            return f"{v / scale:.2f} {unit}"
+    return f"{v:.0f} F"
+
+
+def _human_bytes(v: float) -> str:
+    for unit, scale in (("GiB", 1024 ** 3), ("MiB", 1024 ** 2),
+                        ("KiB", 1024)):
+        if abs(v) >= scale:
+            return f"{v / scale:.2f} {unit}"
+    return f"{v:.0f} B"
+
+
+def _render_perf(perf: dict) -> List[str]:
+    """The XLA cost-model section: cluster MFU next to the goodput
+    ledger, and the per-program roofline table."""
+    lines: List[str] = [""]
+    lines.append("-- performance (XLA cost model) ----------------------")
+    dev = perf.get("device") or {}
+    mfu = perf.get("cluster_mfu")
+    head = "  cluster MFU: " + (f"{100 * mfu:.1f}%" if mfu is not None
+                                else "n/a")
+    head += f"   total flops: {_human_flops(perf.get('flops_total') or 0.0)}"
+    if dev.get("peak_flops_per_sec"):
+        head += (f"   peak/chip: "
+                 f"{dev['peak_flops_per_sec'] / 1e12:.4g} TFLOP/s "
+                 f"({dev.get('kind', '?')})")
+    if perf.get("nominal_device"):
+        head += "   [nominal peak]"
+    lines.append(head)
+    hbm = perf.get("hbm_peak_bytes")
+    if hbm is not None:
+        lines.append(f"  hbm peak: {_human_bytes(hbm)}")
+    programs = perf.get("programs") or {}
+    if programs:
+        lines.append(f"  {'program':<24} {'flops/step':>10} "
+                     f"{'bytes/step':>10} {'intensity':>9} "
+                     f"{'mfu':>6}  bound")
+        for label, prog in sorted(programs.items()):
+            ai = prog.get("arithmetic_intensity")
+            pmfu = prog.get("mfu")
+            lines.append(
+                f"  {label:<24} "
+                f"{_human_flops(prog.get('flops') or 0.0):>10} "
+                f"{_human_bytes(prog.get('bytes_accessed') or 0.0):>10} "
+                f"{(f'{ai:.1f}' if ai is not None else 'n/a'):>9} "
+                f"{(f'{100 * pmfu:.1f}%' if pmfu is not None else 'n/a'):>6}"
+                f"  {prog.get('bound', 'unknown')}-bound")
+    return lines
 
 
 def report_from_dir(directory: str, top_n: int = 6) -> str:

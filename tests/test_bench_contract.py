@@ -35,15 +35,23 @@ def test_bench_model_runs_and_counts_steps():
     rng = np.random.RandomState(0)
     x = rng.rand(32, 784).astype(np.float32)
     y = rng.randint(1, 11, 32).astype(np.float32)
-    r1, f1 = bench.bench_model(LeNet5(10), nn.ClassNLLCriterion(), x, y,
+    r1, c1 = bench.bench_model(LeNet5(10), nn.ClassNLLCriterion(), x, y,
                                iters=4, warmup=1)
     assert r1 > 0
-    assert f1 is None or f1 > 0
-    # K-step chaining path compiles and reports records*K throughput
-    r2, f2 = bench.bench_model(LeNet5(10), nn.ClassNLLCriterion(), x, y,
+    # XLA cost-model StepCost of the exact timed program (AOT path
+    # carries the memory analysis too)
+    assert c1 is not None and c1.flops > 0 and c1.bytes_accessed > 0
+    # K-step chaining path compiles and reports records*K throughput;
+    # per-step cost now comes from lowering the SINGLE-step program
+    # (the r5 "unrecoverable from a loop" limitation is gone)
+    r2, c2 = bench.bench_model(LeNet5(10), nn.ClassNLLCriterion(), x, y,
                                iters=4, warmup=1, steps_per_dispatch=2)
     assert r2 > 0
-    assert f2 is None  # per-step flops unrecoverable from a loop
+    assert c2 is not None and c2.flops > 0
+    # same per-step math either way — the compiled (post-optimization)
+    # count runs a little above the as-written lowered count (layout
+    # rewrites), ~10% on LeNet; same order, not same op set
+    assert abs(c2.flops - c1.flops) / c1.flops < 0.2
 
 
 def test_newest_tpu_measurement_found():
@@ -71,9 +79,11 @@ def test_fallback_merges_persisted_tpu_numbers(tmp_path):
                 "BENCH_ELASTIC_TIMEOUT": "0",
                 "BENCH_INTEGRITY_TIMEOUT": "0",
                 "BENCH_TELEMETRY_TIMEOUT": "0"})
+    # --no-ledger: a test invocation must not append to the repo's
+    # judged PERF_LEDGER.jsonl trajectory
     out = subprocess.run(
-        [sys.executable, "bench.py"], capture_output=True, text=True,
-        timeout=300, cwd=".", env=env)
+        [sys.executable, "bench.py", "--no-ledger"],
+        capture_output=True, text=True, timeout=300, cwd=".", env=env)
     assert out.returncode == 0, out.stderr
     lines = [l for l in out.stdout.splitlines() if l.startswith("{")]
     assert lines, f"no JSON line:\n{out.stdout}\n{out.stderr}"

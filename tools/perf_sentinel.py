@@ -1,0 +1,218 @@
+#!/usr/bin/env python
+"""Perf regression sentinel — diff the latest PERF_LEDGER.jsonl record
+against the committed baseline, fail loudly on regression.
+
+Every orchestrated ``bench.py`` run appends one schema-stable record to
+``PERF_LEDGER.jsonl`` (see ``bench.LEDGER_FIELDS``).  This tool reads
+the newest record and compares each guarded metric against
+``PERF_BASELINE.json`` under that metric's own tolerance and
+direction — throughput regressing 20% fails, latency regressing 20%
+fails, a throughput *improvement* never does.  It exits non-zero on
+any regression, which is what makes perf a tested invariant: a tier-1
+test runs ``--check`` against the committed files, so a bench record
+that regressed past tolerance fails the suite before a kernel PR
+lands.
+
+Comparability guard: a record measured on a different backend than the
+baseline (cpu vs tpu) is skipped with exit 0 and a notice — a tunnel
+outage must not read as a 100x regression.
+
+Usage:
+    python tools/perf_sentinel.py --check [--ledger F] [--baseline F]
+    python tools/perf_sentinel.py --update-baseline [--note TEXT]
+    python tools/perf_sentinel.py --show
+
+Exit codes: 0 pass/skip, 1 regression, 2 usage or unreadable inputs.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+from typing import Optional
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+DEFAULT_LEDGER = os.path.join(REPO, "PERF_LEDGER.jsonl")
+DEFAULT_BASELINE = os.path.join(REPO, "PERF_BASELINE.json")
+
+#: metric -> (direction, relative tolerance).  "higher" = bigger is
+#: better (throughput, MFU); "lower" = smaller is better (latency,
+#: overhead).  Tolerance is the allowed relative regression before the
+#: sentinel fails; a baseline file may override per metric.
+DEFAULT_TOLERANCES = {
+    "value": ("higher", 0.10),
+    "mfu": ("higher", 0.10),
+    "transformerlm_mfu": ("higher", 0.10),
+    "transformerlm_T4096_mfu": ("higher", 0.10),
+    "transformerlm_cpu_tokens_per_sec": ("higher", 0.50),
+    "simplernn_records_per_sec": ("higher", 0.30),
+    "lenet5_images_per_sec": ("higher", 0.30),
+    "decode_tokens_per_sec": ("higher", 0.15),
+    "prefill_tokens_per_sec": ("higher", 0.15),
+    "serving_p99_ms": ("lower", 0.50),
+    "elastic_recovery_s": ("lower", 1.00),
+    "telemetry_overhead_pct": ("lower", 2.00),
+}
+
+
+def read_latest_record(path: str) -> Optional[dict]:
+    """Newest parseable record in the ledger (last valid JSON line)."""
+    try:
+        with open(path) as f:
+            lines = [ln.strip() for ln in f if ln.strip()]
+    except OSError:
+        return None
+    for ln in reversed(lines):
+        try:
+            rec = json.loads(ln)
+        except ValueError:
+            continue
+        if isinstance(rec, dict):
+            return rec
+    return None
+
+
+def read_baseline(path: str) -> Optional[dict]:
+    try:
+        with open(path) as f:
+            data = json.load(f)
+    except (OSError, ValueError):
+        return None
+    return data if isinstance(data, dict) and "record" in data else None
+
+
+def compare(record: dict, baseline: dict) -> dict:
+    """Pure comparison (tested directly): returns
+    ``{"status": "pass"|"fail"|"skipped", "checks": [...], ...}``."""
+    base_rec = baseline.get("record") or {}
+    tolerances = dict(DEFAULT_TOLERANCES)
+    for name, spec in (baseline.get("tolerances") or {}).items():
+        tolerances[name] = (spec.get("direction", "higher"),
+                            float(spec.get("rel_tol", 0.10)))
+    if record.get("backend") != base_rec.get("backend"):
+        return {
+            "status": "skipped",
+            "reason": "backend mismatch: record %r vs baseline %r — "
+                      "not comparable" % (record.get("backend"),
+                                          base_rec.get("backend")),
+            "checks": [],
+        }
+    checks = []
+    failures = 0
+    for name, (direction, tol) in sorted(tolerances.items()):
+        base = base_rec.get(name)
+        cur = record.get(name)
+        if base is None or not isinstance(base, (int, float)):
+            continue  # baseline never measured it: nothing to guard
+        check = {"metric": name, "baseline": base, "current": cur,
+                 "direction": direction, "rel_tol": tol}
+        if cur is None or not isinstance(cur, (int, float)):
+            # a guarded metric VANISHING is a regression (a broken
+            # bench section must not read as a pass)
+            check.update(status="fail", reason="missing from record")
+            failures += 1
+        else:
+            if base == 0:
+                delta = 0.0 if cur == 0 else float("inf")
+            else:
+                delta = (cur - base) / abs(base)
+            regression = -delta if direction == "higher" else delta
+            check["delta"] = round(delta, 4)
+            if regression > tol:
+                check.update(status="fail",
+                             reason="%s regressed %.1f%% (tol %.0f%%)"
+                                    % (name, 100 * regression,
+                                       100 * tol))
+                failures += 1
+            else:
+                check["status"] = "pass"
+        checks.append(check)
+    return {"status": "fail" if failures else "pass",
+            "failures": failures, "checks": checks}
+
+
+def _utc_now() -> str:
+    return time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+
+
+def make_baseline(record: dict, note: str = "") -> dict:
+    return {
+        "schema": 1,
+        "frozen_at": _utc_now(),
+        "note": note,
+        "tolerances": {
+            name: {"direction": d, "rel_tol": t}
+            for name, (d, t) in sorted(DEFAULT_TOLERANCES.items())},
+        "record": record,
+    }
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--ledger", default=DEFAULT_LEDGER)
+    p.add_argument("--baseline", default=DEFAULT_BASELINE)
+    mode = p.add_mutually_exclusive_group(required=True)
+    mode.add_argument("--check", action="store_true",
+                      help="compare latest ledger record vs baseline; "
+                           "exit 1 on regression")
+    mode.add_argument("--update-baseline", action="store_true",
+                      help="freeze the latest ledger record as the "
+                           "new baseline")
+    mode.add_argument("--show", action="store_true",
+                      help="print the latest record and baseline")
+    p.add_argument("--note", default="",
+                   help="provenance note for --update-baseline")
+    p.add_argument("--json", action="store_true",
+                   help="machine-readable --check output")
+    args = p.parse_args(argv)
+
+    record = read_latest_record(args.ledger)
+    if record is None:
+        print("perf-sentinel: no readable record in %s" % args.ledger,
+              file=sys.stderr)
+        return 2
+
+    if args.update_baseline:
+        baseline = make_baseline(record, note=args.note)
+        with open(args.baseline, "w") as f:
+            json.dump(baseline, f, indent=1)
+            f.write("\n")
+        print("perf-sentinel: baseline frozen from record ts=%s -> %s"
+              % (record.get("ts"), args.baseline))
+        return 0
+
+    baseline = read_baseline(args.baseline)
+    if args.show:
+        print(json.dumps({"record": record, "baseline": baseline},
+                         indent=1))
+        return 0
+
+    if baseline is None:
+        print("perf-sentinel: no baseline at %s (freeze one with "
+              "--update-baseline)" % args.baseline, file=sys.stderr)
+        return 2
+
+    result = compare(record, baseline)
+    if args.json:
+        print(json.dumps(result, indent=1))
+    else:
+        if result["status"] == "skipped":
+            print("perf-sentinel: SKIPPED — %s" % result["reason"])
+        else:
+            for c in result["checks"]:
+                mark = "FAIL" if c["status"] == "fail" else " ok "
+                print("[%s] %-34s base=%-12g cur=%-12s %s" % (
+                    mark, c["metric"], c["baseline"],
+                    ("%g" % c["current"]) if isinstance(
+                        c.get("current"), (int, float)) else "missing",
+                    c.get("reason", "")))
+            print("perf-sentinel: %s (%d checked, %d failed)"
+                  % (result["status"].upper(), len(result["checks"]),
+                     result.get("failures", 0)))
+    return 1 if result["status"] == "fail" else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -6,7 +6,11 @@ consumers) drop one ``<host>.json`` payload per host into a snapshot
 directory when ``Telemetry(snapshot_dir=...)`` is configured; this
 tool merges them into the cluster view and prints the text table:
 goodput breakdown (productive / compile / data-stall / checkpoint /
-recovery / idle), top span categories, per-host step-time skew.
+recovery / idle), top span categories, per-host step-time skew, and —
+when hosts published PerfAccountant payloads — the performance
+section: cluster-wide MFU, total cost-model FLOPs, HBM watermark, and
+the per-program roofline table (flops/bytes/intensity/bound).  The
+``--json`` view carries the same merged data under the ``perf`` key.
 
 Usage:
     python tools/run_report.py <snapshot_dir> [--top N]
