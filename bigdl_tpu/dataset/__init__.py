@@ -11,6 +11,7 @@ from .ingest import (
     write_seq_files,
 )
 from . import datasets, image, ingest, text
+from .prefetch import DevicePrefetcher, InlineFeed, make_feed
 
 
 class DataSet:

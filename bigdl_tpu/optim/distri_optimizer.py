@@ -239,8 +239,10 @@ class DistriOptimizer(Optimizer):
             with self._preemption_scope():
                 return self._optimize_routed()
         finally:
-            # an in-flight async orbax save must commit even when the
-            # loop exits abnormally (Ctrl-C, exhausted retries)
+            # in-flight async saves must commit even when the loop
+            # exits abnormally (Ctrl-C, exhausted retries): background
+            # checkpoint writer first, then the orbax checkpointer
+            self._shutdown_async_writer()
             self._orbax_close()
 
     def _optimize_routed(self) -> AbstractModule:
@@ -351,7 +353,6 @@ class DistriOptimizer(Optimizer):
         step = make_train_step(model, self.criterion, optim, mesh,
                                input_seq_dim=1 if n_seq > 1 else None,
                                compute_dtype=self.compute_dtype, donate=True)
-        eval_fwd = None  # built lazily on the first validation trigger
         put = lambda tree, specs: jax.tree_util.tree_map(
             lambda a, s: jax.device_put(a, NamedSharding(mesh, s)),
             tree, specs)
@@ -373,146 +374,182 @@ class DistriOptimizer(Optimizer):
         records_this_epoch = self._consume_resume_cursor(data_iter,
                                                          epoch_size)
         wall_start = time.time()
+        return self._multi_axis_loop(
+            mesh, model, optim, step, n_data, n_seq, state, epoch_size,
+            data_iter, records_this_epoch, wall_start, params, slots,
+            buffers)
 
+    def _multi_axis_loop(self, mesh, model, optim, step, n_data, n_seq,
+                         state, epoch_size, data_iter,
+                         records_this_epoch, wall_start, params, slots,
+                         buffers) -> AbstractModule:
+        """The multi-axis driver loop, feed-based: batch N+1's host
+        prep overlaps the compiled step on batch N (this path used to
+        fetch synchronously every iteration)."""
+        eval_fwd = None  # built lazily on the first validation trigger
+        feed = self._make_feed(data_iter, epoch_size, records_this_epoch)
         first_step = True  # first dispatch = XLA build (telemetry)
-        while not self.end_when(state):
-            state["epoch_finished"] = False
-            self._elastic_step_start(state)
-            t_data0 = time.time()
-            batch = next(data_iter)
-            x, y = _device_batch(batch)
-            n_records = batch.size()
-            mask_kw = {}
-            if n_records % n_data != 0:
-                # trailing partial batch: pad whole records to the
-                # data-axis multiple and train the real ones via the
-                # per-record weight mask (every-record guarantee on the
-                # multi-axis mesh too; pad rows only touch the data
-                # axis, so seq/model sharding composes unchanged)
-                if not _maskable(y, n_records):
-                    raise ValueError(
-                        "multi-axis training got a trailing partial "
-                        f"batch of {n_records} records but the targets "
-                        "are not record-leading arrays for pad-and-mask; "
-                        "size the dataset to a batch multiple")
-                x, y, w = pad_batch(x, y, n_records,
-                                    round_up(n_records, n_data))
-                mask_kw = {"w": w, "total_w": float(n_records)}
-            if n_seq > 1:
-                bad = [a.shape for a in jax.tree_util.tree_leaves(x)
-                       if getattr(a, "ndim", 0) > 1
-                       and a.shape[1] % n_seq != 0]
-                if bad:
-                    raise ValueError(
-                        f"sequence dim of inputs {bad} must be divisible "
-                        f"by the mesh's seq-axis size {n_seq}; pad "
-                        "sequences to a multiple")
-            infeed_time = time.time() - t_data0
+        try:
+            while not self.end_when(state):
+                state["epoch_finished"] = False
+                self._elastic_step_start(state)
+                item, stall_time = feed.get()
+                batch, x, y = item
+                n_records = batch.size()
+                mask_kw = {}
+                if n_records % n_data != 0:
+                    # trailing partial batch: pad whole records to the
+                    # data-axis multiple and train the real ones via
+                    # the per-record weight mask (every-record
+                    # guarantee on the multi-axis mesh too; pad rows
+                    # only touch the data axis, so seq/model sharding
+                    # composes unchanged)
+                    if not _maskable(y, n_records):
+                        raise ValueError(
+                            "multi-axis training got a trailing partial "
+                            f"batch of {n_records} records but the "
+                            "targets are not record-leading arrays for "
+                            "pad-and-mask; size the dataset to a batch "
+                            "multiple")
+                    x, y, w = pad_batch(x, y, n_records,
+                                        round_up(n_records, n_data))
+                    mask_kw = {"w": w, "total_w": float(n_records)}
+                if n_seq > 1:
+                    bad = [a.shape for a in jax.tree_util.tree_leaves(x)
+                           if getattr(a, "ndim", 0) > 1
+                           and a.shape[1] % n_seq != 0]
+                    if bad:
+                        raise ValueError(
+                            f"sequence dim of inputs {bad} must be "
+                            f"divisible by the mesh's seq-axis size "
+                            f"{n_seq}; pad sequences to a multiple")
+                # host prep overlapped the previous step on the feed's
+                # producer thread — only the real buffer stall remains
+                infeed_time = stall_time
 
-            lr = optim.get_current_lr()
-            if first_step and not mask_kw and self.telemetry is not None:
-                # cost-model analysis of the fused multi-axis program;
-                # the constant key only shapes the trace.  Wire-byte
-                # estimate: the data-axis gradient all-reduce
-                # (~2(n-1)/n of param bytes); tensor/seq activation
-                # collectives ride inside the program uncounted.
-                self._tm_analyze(
-                    step.jitted_for(x, y, False), params, slots,
-                    buffers, jnp.float32(lr), jax.random.PRNGKey(0),
-                    x, y,
-                    collective_bytes=(2.0 * (n_data - 1)
-                                      / max(n_data, 1)
-                                      * self._tree_bytes(params)))
-            t0 = time.time()
-            loss, params, slots, buffers = self._elastic_dispatch(
-                lambda: step(params, slots, buffers, lr, x, y,
-                             rng=next_jax_key(), **mask_kw), state)
-            loss = float(loss)  # value fetch = execution barrier
-            train_time = time.time() - t0
-            self._tm_step(state, train_time, infeed_time, n_records,
-                          compiled=first_step)
-            first_step = False
-            self._check_loss_anomaly(loss, skipped=False)
-            params = self._maybe_corrupt_params(state, params)
-            # fused multi-axis step: grad norm is not a program output
-            self._record_fingerprint(state, loss, None, (x, y),
-                                     lambda: params)
-            self._integrity_step(state, lambda: params)
+                lr = optim.get_current_lr()
+                t0 = time.time()
+                if first_step and not mask_kw \
+                        and self.telemetry is not None:
+                    # cost-model analysis of the fused multi-axis
+                    # program (inside the first step's timed window,
+                    # ledgered as COMPILE); the constant key only
+                    # shapes the trace.  Wire-byte estimate: the
+                    # data-axis gradient all-reduce (~2(n-1)/n of param
+                    # bytes); tensor/seq activation collectives ride
+                    # inside the program uncounted.
+                    self._tm_analyze(
+                        step.jitted_for(x, y, False), params, slots,
+                        buffers, jnp.float32(lr), jax.random.PRNGKey(0),
+                        x, y,
+                        collective_bytes=(2.0 * (n_data - 1)
+                                          / max(n_data, 1)
+                                          * self._tree_bytes(params)))
+                loss, params, slots, buffers = self._elastic_dispatch(
+                    lambda: step(params, slots, buffers, lr, x, y,
+                                 rng=next_jax_key(), **mask_kw), state)
+                loss = float(loss)  # value fetch = execution barrier
+                train_time = time.time() - t0
+                self._tm_step(state, train_time, infeed_time, n_records,
+                              compiled=first_step)
+                first_step = False
+                self._check_loss_anomaly(loss, skipped=False)
+                params = self._maybe_corrupt_params(state, params)
+                # fused multi-axis step: grad norm is not a program
+                # output
+                self._record_fingerprint(state, loss, None, (x, y),
+                                         lambda: params)
+                self._integrity_step(state, lambda: params)
 
-            records_this_epoch += n_records
-            state["records_this_epoch"] = records_this_epoch
-            state["loss"] = loss
-            # metric-name contract (reference DistriOptimizer.scala:146-151);
-            # collectives are fused into the one program here, so the wall
-            # time is attributed to compute (no trace split on this path)
-            self.metrics.add("computing time average", train_time)
-            self.metrics.add("aggregate gradient time", 0.0)
-            self.metrics.add("get weights average", infeed_time)
-            log.info(
-                "[Epoch %d %d/%d][Iteration %d][Wall Clock %.3fs] "
-                "Train %d in %.4f seconds. Throughput is %.1f "
-                "records/second. Loss is %.5f.",
-                state["epoch"], records_this_epoch, epoch_size,
-                state["neval"], time.time() - wall_start, n_records,
-                train_time + infeed_time,
-                n_records / max(train_time + infeed_time, 1e-9), loss)
-            if self.train_summary is not None:
-                self.train_summary.add_scalar("Loss", loss, state["neval"])
-                self.train_summary.add_scalar(
-                    "Throughput",
+                records_this_epoch += n_records
+                state["records_this_epoch"] = records_this_epoch
+                state["loss"] = loss
+                # metric-name contract (reference
+                # DistriOptimizer.scala:146-151); collectives are fused
+                # into the one program here, so the wall time is
+                # attributed to compute (no trace split on this path)
+                self.metrics.add("computing time average", train_time)
+                self.metrics.add("aggregate gradient time", 0.0)
+                self.metrics.add("get weights average", infeed_time)
+                log.info(
+                    "[Epoch %d %d/%d][Iteration %d][Wall Clock %.3fs] "
+                    "Train %d in %.4f seconds. Throughput is %.1f "
+                    "records/second. Loss is %.5f.",
+                    state["epoch"], records_this_epoch, epoch_size,
+                    state["neval"], time.time() - wall_start, n_records,
+                    train_time + infeed_time,
                     n_records / max(train_time + infeed_time, 1e-9),
-                    state["neval"])
+                    loss)
+                if self.train_summary is not None:
+                    self.train_summary.add_scalar("Loss", loss,
+                                                  state["neval"])
+                    self.train_summary.add_scalar(
+                        "Throughput",
+                        n_records / max(train_time + infeed_time, 1e-9),
+                        state["neval"])
 
-            state["neval"] += 1
-            optim.state = state
-            if records_this_epoch >= epoch_size:
-                state["epoch"] += 1
-                state["epoch_finished"] = True
-                records_this_epoch = 0
-                state["records_this_epoch"] = 0
-                self.dataset.shuffle()
-                data_iter = self.dataset.data(train=True)
+                state["neval"] += 1
+                optim.state = state
+                if records_this_epoch >= epoch_size:
+                    state["epoch"] += 1
+                    state["epoch_finished"] = True
+                    records_this_epoch = 0
+                    state["records_this_epoch"] = 0
+                    # the producer met its epoch budget and is parked —
+                    # the shuffle cannot race a fetch; reset re-arms
+                    # the same producer thread on the fresh iterator
+                    self.dataset.shuffle()
+                    data_iter = self.dataset.data(train=True)
+                    feed.reset(data_iter, epoch_size, 0)
 
-            # evaluate each trigger exactly once per iteration: stateful
-            # user triggers must not see a second call, and the action
-            # below must never run without the host-param sync above it
-            do_validate = (self.validation_trigger is not None
-                           and self.validation_trigger(state))
-            do_checkpoint = (self.checkpoint_trigger is not None
-                             and self.checkpoint_trigger(state))
-            if do_validate:
-                if eval_fwd is None:
-                    from ..parallel.spmd import make_eval_forward
+                # evaluate each trigger exactly once per iteration:
+                # stateful user triggers must not see a second call,
+                # and the action below must never run without the
+                # host-param sync above it
+                do_validate = (self.validation_trigger is not None
+                               and self.validation_trigger(state))
+                do_checkpoint = (self.checkpoint_trigger is not None
+                                 and self.checkpoint_trigger(state))
+                if do_validate:
+                    if eval_fwd is None:
+                        from ..parallel.spmd import make_eval_forward
 
-                    eval_fwd = make_eval_forward(
-                        model, mesh,
-                        input_seq_dim=1 if n_seq > 1 else None,
-                        compute_dtype=self.compute_dtype,
-                        output_seq_dim=self.validation_output_seq_dim)
-                self._validate_multi_axis(state, eval_fwd, params, buffers,
-                                          n_data, n_seq)
-            if do_checkpoint or self._preempted():
-                if self.checkpoint_format == "orbax":
-                    # sharded async save straight from the device trees
-                    self._orbax_save(state, self._orbax_tree(
-                        params, slots, buffers), kind="model")
-                else:
-                    # host-gather the sharded params for the checkpoint
-                    # (model-sharded leaves reassemble on fetch)
-                    model.set_param_tree(jax.device_get(params))
-                    model.set_buffer_tree(jax.device_get(buffers))
-                    optim._slots = jax.device_get(slots)
-                    self._checkpoint(state)
-            if self._preempted():
-                log.warning("preemption requested — checkpointed at "
-                            "iteration %d; exiting resumable",
-                            state["neval"] - 1)
-                break
+                        eval_fwd = make_eval_forward(
+                            model, mesh,
+                            input_seq_dim=1 if n_seq > 1 else None,
+                            compute_dtype=self.compute_dtype,
+                            output_seq_dim=self.validation_output_seq_dim)
+                    self._validate_multi_axis(state, eval_fwd, params,
+                                              buffers, n_data, n_seq)
+                if do_checkpoint or self._preempted():
+                    if self.checkpoint_format == "orbax":
+                        # sharded async save straight from the device
+                        # trees
+                        self._orbax_save(state, self._orbax_tree(
+                            params, slots, buffers), kind="model")
+                    else:
+                        # host-gather the sharded params for the
+                        # checkpoint (model-sharded leaves reassemble
+                        # on fetch)
+                        model.set_param_tree(jax.device_get(params))
+                        model.set_buffer_tree(jax.device_get(buffers))
+                        optim._slots = jax.device_get(slots)
+                        self._checkpoint(state)
+                if self._preempted():
+                    self._drain_checkpoints()
+                    log.warning("preemption requested — checkpointed at "
+                                "iteration %d; exiting resumable",
+                                state["neval"] - 1)
+                    break
+        finally:
+            feed.close()
 
         model.set_param_tree(jax.device_get(params))
         model.set_buffer_tree(jax.device_get(buffers))
         optim._slots = jax.device_get(slots)
         model.evaluate()
+        # drain-on-exit barrier: every triggered checkpoint is durable
+        self._drain_checkpoints()
         self._orbax_close()
         self._tm_finish(state)
         return model
@@ -592,127 +629,147 @@ class DistriOptimizer(Optimizer):
             unpack_params(jax.device_get(packed), model)
             optim._slots = jax.device_get(slots)
 
+        # bounded prefetch-to-device infeed (dataset/prefetch.py): the
+        # pipeline path used to fetch synchronously every iteration
+        feed = self._make_feed(data_iter, epoch_size, records_this_epoch)
         first_step = True  # first dispatch = XLA build (telemetry)
-        while not self.end_when(state):
-            state["epoch_finished"] = False
-            self._elastic_step_start(state)
-            t_data0 = time.time()
-            batch = next(data_iter)
-            x, y = _device_batch(batch)
-            n_records = batch.size()
-            mask_kw = {}
-            if n_records % pad_multiple != 0:
-                # trailing partial batch: pad whole records to the
-                # data x microbatch multiple and train the real ones via
-                # the per-record weight mask (every-record guarantee on
-                # the pipeline mesh too)
-                if not _maskable(y, n_records):
-                    raise ValueError(
-                        "pipeline training got a trailing partial batch "
-                        f"of {n_records} records but the targets are not "
-                        "record-leading arrays for pad-and-mask; size "
-                        "the dataset to a batch multiple")
-                x, y, w = pad_batch(x, y, n_records,
-                                    round_up(n_records, pad_multiple))
-                mask_kw = {"w": w, "total_w": float(n_records)}
-            infeed_time = time.time() - t_data0
+        try:
+            while not self.end_when(state):
+                state["epoch_finished"] = False
+                self._elastic_step_start(state)
+                item, stall_time = feed.get()
+                batch, x, y = item
+                n_records = batch.size()
+                mask_kw = {}
+                if n_records % pad_multiple != 0:
+                    # trailing partial batch: pad whole records to the
+                    # data x microbatch multiple and train the real
+                    # ones via the per-record weight mask (every-record
+                    # guarantee on the pipeline mesh too)
+                    if not _maskable(y, n_records):
+                        raise ValueError(
+                            "pipeline training got a trailing partial "
+                            f"batch of {n_records} records but the "
+                            "targets are not record-leading arrays for "
+                            "pad-and-mask; size the dataset to a batch "
+                            "multiple")
+                    x, y, w = pad_batch(x, y, n_records,
+                                        round_up(n_records, pad_multiple))
+                    mask_kw = {"w": w, "total_w": float(n_records)}
+                # host prep overlapped the previous step on the feed's
+                # producer thread — only the real buffer stall remains
+                infeed_time = stall_time
 
-            lr = optim.get_current_lr()
-            if first_step and not mask_kw and self.telemetry is not None:
-                # cost-model analysis of the GPipe program (host-side
-                # lowering; constant key — see the data path)
-                self._tm_analyze(
-                    step.jitted_for(False), packed, slots,
-                    jnp.float32(lr), jax.random.PRNGKey(0),
-                    jnp.asarray(x), jnp.asarray(y),
-                    collective_bytes=(2.0 * (n_data - 1)
-                                      / max(n_data, 1)
-                                      * self._tree_bytes(packed)))
-            t0 = time.time()
-            loss, packed, slots = self._elastic_dispatch(
-                lambda: step(packed, slots, lr, x, y,
-                             rng=next_jax_key(), **mask_kw), state)
-            loss = float(loss)  # value fetch = execution barrier
-            train_time = time.time() - t0
-            self._tm_step(state, train_time, infeed_time, n_records,
-                          compiled=first_step)
-            first_step = False
-            self._check_loss_anomaly(loss, skipped=False)
-            packed = self._maybe_corrupt_params(state, packed)
-            # fused pipeline step: grad norm is not a program output
-            self._record_fingerprint(state, loss, None, (x, y),
-                                     lambda: packed)
-            self._integrity_step(state, lambda: packed)
+                lr = optim.get_current_lr()
+                t0 = time.time()
+                if first_step and not mask_kw \
+                        and self.telemetry is not None:
+                    # cost-model analysis of the GPipe program (inside
+                    # the first step's timed window, ledgered as
+                    # COMPILE; constant key — see the data path)
+                    self._tm_analyze(
+                        step.jitted_for(False), packed, slots,
+                        jnp.float32(lr), jax.random.PRNGKey(0),
+                        jnp.asarray(x), jnp.asarray(y),
+                        collective_bytes=(2.0 * (n_data - 1)
+                                          / max(n_data, 1)
+                                          * self._tree_bytes(packed)))
+                loss, packed, slots = self._elastic_dispatch(
+                    lambda: step(packed, slots, lr, x, y,
+                                 rng=next_jax_key(), **mask_kw), state)
+                loss = float(loss)  # value fetch = execution barrier
+                train_time = time.time() - t0
+                self._tm_step(state, train_time, infeed_time, n_records,
+                              compiled=first_step)
+                first_step = False
+                self._check_loss_anomaly(loss, skipped=False)
+                packed = self._maybe_corrupt_params(state, packed)
+                # fused pipeline step: grad norm is not a program output
+                self._record_fingerprint(state, loss, None, (x, y),
+                                         lambda: packed)
+                self._integrity_step(state, lambda: packed)
 
-            records_this_epoch += n_records
-            state["records_this_epoch"] = records_this_epoch
-            state["loss"] = loss
-            # metric-name contract (reference DistriOptimizer.scala:146-151)
-            self.metrics.add("computing time average", train_time)
-            self.metrics.add("aggregate gradient time", 0.0)
-            self.metrics.add("get weights average", infeed_time)
-            log.info(
-                "[Epoch %d %d/%d][Iteration %d][Wall Clock %.3fs] "
-                "Train %d in %.4f seconds. Throughput is %.1f "
-                "records/second. Loss is %.5f.",
-                state["epoch"], records_this_epoch, epoch_size,
-                state["neval"], time.time() - wall_start, n_records,
-                train_time + infeed_time,
-                n_records / max(train_time + infeed_time, 1e-9), loss)
-            if self.train_summary is not None:
-                self.train_summary.add_scalar("Loss", loss, state["neval"])
-                self.train_summary.add_scalar(
-                    "Throughput",
+                records_this_epoch += n_records
+                state["records_this_epoch"] = records_this_epoch
+                state["loss"] = loss
+                # metric-name contract (reference
+                # DistriOptimizer.scala:146-151)
+                self.metrics.add("computing time average", train_time)
+                self.metrics.add("aggregate gradient time", 0.0)
+                self.metrics.add("get weights average", infeed_time)
+                log.info(
+                    "[Epoch %d %d/%d][Iteration %d][Wall Clock %.3fs] "
+                    "Train %d in %.4f seconds. Throughput is %.1f "
+                    "records/second. Loss is %.5f.",
+                    state["epoch"], records_this_epoch, epoch_size,
+                    state["neval"], time.time() - wall_start, n_records,
+                    train_time + infeed_time,
                     n_records / max(train_time + infeed_time, 1e-9),
-                    state["neval"])
+                    loss)
+                if self.train_summary is not None:
+                    self.train_summary.add_scalar("Loss", loss,
+                                                  state["neval"])
+                    self.train_summary.add_scalar(
+                        "Throughput",
+                        n_records / max(train_time + infeed_time, 1e-9),
+                        state["neval"])
 
-            state["neval"] += 1
-            optim.state = state
-            if records_this_epoch >= epoch_size:
-                state["epoch"] += 1
-                state["epoch_finished"] = True
-                records_this_epoch = 0
-                self.dataset.shuffle()
-                data_iter = self.dataset.data(train=True)
+                state["neval"] += 1
+                optim.state = state
+                if records_this_epoch >= epoch_size:
+                    state["epoch"] += 1
+                    state["epoch_finished"] = True
+                    records_this_epoch = 0
+                    # the producer met its epoch budget and is parked —
+                    # the shuffle cannot race a fetch; reset re-arms
+                    # the same producer thread on the fresh iterator
+                    self.dataset.shuffle()
+                    data_iter = self.dataset.data(train=True)
+                    feed.reset(data_iter, epoch_size, 0)
 
-            do_validate = (self.validation_trigger is not None
-                           and self.validation_trigger(state))
-            do_checkpoint = (self.checkpoint_trigger is not None
-                             and self.checkpoint_trigger(state))
-            if do_validate and self.validation_dataset is not None:
-                if eval_fwd is None:
-                    pfwd = make_pipeline_eval_forward(
-                        model, mesh, n_microbatch=n_mb,
-                        model_axis=model_axis,
-                        compute_dtype=self.compute_dtype)
-                    eval_fwd = lambda p, b, xx: pfwd(p, xx)
-                from .evaluator import evaluate_dataset
+                do_validate = (self.validation_trigger is not None
+                               and self.validation_trigger(state))
+                do_checkpoint = (self.checkpoint_trigger is not None
+                                 and self.checkpoint_trigger(state))
+                if do_validate and self.validation_dataset is not None:
+                    if eval_fwd is None:
+                        pfwd = make_pipeline_eval_forward(
+                            model, mesh, n_microbatch=n_mb,
+                            model_axis=model_axis,
+                            compute_dtype=self.compute_dtype)
+                        eval_fwd = lambda p, b, xx: pfwd(p, xx)
+                    from .evaluator import evaluate_dataset
 
-                results = evaluate_dataset(
-                    model, self.validation_dataset,
-                    self.validation_methods,
-                    batch_size=self.batch_size or 128,
-                    params=packed, buffers=model.buffer_tree(),
-                    fwd=eval_fwd, n_shard=n_data * n_mb)
-                model.training()
-                self._report_validation(state, results)
-            if do_checkpoint or self._preempted():
-                if self.checkpoint_format == "orbax":
-                    # sharded async save straight from the device trees
-                    # — no host gather, no unpack
-                    self._orbax_save(state, self._orbax_tree(
-                        packed, slots), kind="packed")
-                else:
-                    _sync_to_model()
-                    self._checkpoint(state)
-            if self._preempted():
-                log.warning("preemption requested — checkpointed at "
-                            "iteration %d; exiting resumable",
-                            state["neval"] - 1)
-                break
+                    results = evaluate_dataset(
+                        model, self.validation_dataset,
+                        self.validation_methods,
+                        batch_size=self.batch_size or 128,
+                        params=packed, buffers=model.buffer_tree(),
+                        fwd=eval_fwd, n_shard=n_data * n_mb)
+                    model.training()
+                    self._report_validation(state, results)
+                if do_checkpoint or self._preempted():
+                    if self.checkpoint_format == "orbax":
+                        # sharded async save straight from the device
+                        # trees — no host gather, no unpack
+                        self._orbax_save(state, self._orbax_tree(
+                            packed, slots), kind="packed")
+                    else:
+                        _sync_to_model()
+                        self._checkpoint(state)
+                if self._preempted():
+                    self._drain_checkpoints()
+                    log.warning("preemption requested — checkpointed at "
+                                "iteration %d; exiting resumable",
+                                state["neval"] - 1)
+                    break
+        finally:
+            feed.close()
 
         _sync_to_model()
         model.evaluate()
+        # drain-on-exit barrier: every triggered checkpoint is durable
+        self._drain_checkpoints()
         self._orbax_close()
         self._tm_finish(state)
         return model
@@ -818,223 +875,242 @@ class DistriOptimizer(Optimizer):
                                                          epoch_size)
         wall_start = time.time()
 
-        pending = None
+        # bounded prefetch-to-device infeed (dataset/prefetch.py),
+        # generalizing the one-deep ad-hoc prefetch this loop used to
+        # carry: host prep + device_put of batch N+1 overlap the
+        # compiled step on batch N; data_time is the REAL stall only
+        feed = self._make_feed(data_iter, epoch_size, records_this_epoch)
         first_step = True  # first dispatch = XLA build (telemetry)
-        while not self.end_when(state):
-            state["epoch_finished"] = False
-            self._elastic_step_start(state)
-            t_data0 = time.time()
-            if pending is not None:
-                batch, x, y = pending
-                pending = None
-            else:
-                batch = next(data_iter)
-                x, y = _device_batch(batch)
-            n_records = batch.size()
-            masked = n_records % n_dev != 0
-            if masked:
-                # trailing partial batch: pad to the mesh multiple and
-                # train the real records via a per-record weight mask —
-                # every record of the epoch trains exactly once at static
-                # shape (reference DataSet.scala:255-288 trains all)
-                if not _maskable(y, n_records):
-                    raise ValueError(
-                        "partial batch targets must be a pytree of "
-                        "record-leading arrays for pad-and-mask; size "
-                        "your dataset to a batch multiple of the mesh")
-                x, y, w = pad_batch(x, y, n_records,
-                                    round_up(n_records, n_dev))
-            t_h2d0 = time.time()
-            x, y = shard_batch(mesh, (x, y))
-            h2d_time = time.time() - t_h2d0
-            if self.telemetry is not None:
-                self.telemetry.on_host_to_device(h2d_time,
-                                                 step=state["neval"])
-            infeed_time = time.time() - t_data0
-
-            # profile past the compile iteration so timings are warm
-            profiled = (profile_interval > 0 and state["neval"] > 1
-                        and state["neval"] % profile_interval == 0
-                        and not masked)
-
-            lr = optim.get_current_lr()
-            if masked and jitted_masked is None:
-                jitted_masked = self._build_step(mesh, arp, masked=True)
-            if masked:
-                w = shard_batch(mesh, (w,))[0]
-            if first_step and not masked and self.telemetry is not None:
-                # cost-model analysis of the exact data-parallel
-                # program (host-side lowering, before the timed
-                # region); the constant key only shapes the trace —
-                # never draw from the checkpointed key stream here.
-                # Wire bytes: reduce-scatter + all-gather move
-                # ~2(n-1)/n of the param bytes each step.
-                self._tm_analyze(
-                    jitted, params, buffers, slots, jnp.float32(lr),
-                    jax.random.PRNGKey(0), x, y,
-                    collective_bytes=(2.0 * (n_dev - 1) / max(n_dev, 1)
-                                      * self._tree_bytes(params)))
-            t0 = time.time()
-
-            def dispatch():
+        try:
+            while not self.end_when(state):
+                state["epoch_finished"] = False
+                self._elastic_step_start(state)
+                item, stall_time = feed.get()
+                batch, x, y = item
+                n_records = batch.size()
+                masked = n_records % n_dev != 0
                 if masked:
-                    return jitted_masked(
-                        params, buffers, slots, jnp.float32(lr),
-                        next_jax_key(), x, y, w, jnp.float32(n_records))
-                return jitted(params, buffers, slots, jnp.float32(lr),
-                              next_jax_key(), x, y)
+                    # trailing partial batch: pad to the mesh multiple
+                    # and train the real records via a per-record
+                    # weight mask — every record of the epoch trains
+                    # exactly once at static shape (reference
+                    # DataSet.scala:255-288 trains all)
+                    if not _maskable(y, n_records):
+                        raise ValueError(
+                            "partial batch targets must be a pytree of "
+                            "record-leading arrays for pad-and-mask; "
+                            "size your dataset to a batch multiple of "
+                            "the mesh")
+                    x, y, w = pad_batch(x, y, n_records,
+                                        round_up(n_records, n_dev))
+                t_h2d0 = time.time()
+                x, y = shard_batch(mesh, (x, y))
+                h2d_time = time.time() - t_h2d0
+                if self.telemetry is not None:
+                    self.telemetry.on_host_to_device(h2d_time,
+                                                     step=state["neval"])
+                # the host batch prep overlapped the previous step on
+                # the feed's producer thread: only the measured stall
+                # (empty buffer) plus the h2d placement is infeed time
+                infeed_time = stall_time + h2d_time
 
-            def prefetch():
-                # overlap next-batch host prep + infeed with this device
-                # step (in-epoch only, preserving rollover/shuffle)
-                nonlocal pending
-                if records_this_epoch + batch.size() < epoch_size:
-                    nb = next(data_iter)
-                    pending = (nb, *_device_batch(nb))
+                # profile past the compile iteration so timings are warm
+                profiled = (profile_interval > 0 and state["neval"] > 1
+                            and state["neval"] % profile_interval == 0
+                            and not masked)
 
-            trace_split = None
-            if profiled:
-                # phase split measured from the profiler trace of THIS
-                # step's execution: collective vs compute device time
-                # (reference Metrics.scala:103-121 measures per phase).
-                # The value fetch (= execution barrier; block_until_ready
-                # returns early on the tunneled TPU backend) must happen
-                # inside the trace so device events are captured; the
-                # step is timed inside run_traced so trace start/parse
-                # overhead never pollutes the phase metrics.
-                from .profiling import trace_phase_split
+                lr = optim.get_current_lr()
+                if masked and jitted_masked is None:
+                    jitted_masked = self._build_step(mesh, arp,
+                                                     masked=True)
+                if masked:
+                    w = shard_batch(mesh, (w,))[0]
+                t0 = time.time()
+                if first_step and not masked \
+                        and self.telemetry is not None:
+                    # cost-model analysis of the exact data-parallel
+                    # program (inside the first step's timed window,
+                    # ledgered as COMPILE — lowering is program-build
+                    # cost); the constant key only shapes the trace —
+                    # never draw from the checkpointed key stream here.
+                    # Wire bytes: reduce-scatter + all-gather move
+                    # ~2(n-1)/n of the param bytes each step.
+                    self._tm_analyze(
+                        jitted, params, buffers, slots, jnp.float32(lr),
+                        jax.random.PRNGKey(0), x, y,
+                        collective_bytes=(2.0 * (n_dev - 1)
+                                          / max(n_dev, 1)
+                                          * self._tree_bytes(params)))
 
-                step_out = []
+                def dispatch():
+                    if masked:
+                        return jitted_masked(
+                            params, buffers, slots, jnp.float32(lr),
+                            next_jax_key(), x, y, w,
+                            jnp.float32(n_records))
+                    return jitted(params, buffers, slots,
+                                  jnp.float32(lr), next_jax_key(), x, y)
 
-                def run_traced():
-                    tr = time.time()
-                    out = dispatch()
-                    loss_v = float(out[0])
-                    step_out.append((out, loss_v, time.time() - tr))
-                trace_split = trace_phase_split(run_traced)
-                out, loss, train_time = step_out[0]
-                prefetch()
-            else:
-                # under elastic the dispatch runs inside the watchdog
-                # deadline (which blocks on the loss — hang coverage
-                # trades away the prefetch overlap for that iteration)
-                out = self._elastic_dispatch(dispatch, state)
-                prefetch()
-                loss = float(out[0])  # device sync after prefetch overlap
-                train_time = time.time() - t0
-            _, params, buffers, slots, step_ok, gnorm = out
-            skipped = not bool(step_ok)
-            # the h2d slice of infeed_time was attributed above — feed
-            # only the remainder as data wait (no double counting)
-            self._tm_step(state, train_time,
-                          max(0.0, infeed_time - h2d_time), n_records,
-                          compiled=first_step, phase_split=trace_split,
-                          skipped=skipped)
-            first_step = False
-            self._check_loss_anomaly(loss, skipped)
-            params = self._maybe_corrupt_params(state, params)
-            self._record_fingerprint(state, loss, float(gnorm), (x, y),
-                                     lambda: params, skipped=skipped)
-            self._integrity_step(state, lambda: params)
+                trace_split = None
+                if profiled:
+                    # phase split measured from the profiler trace of
+                    # THIS step's execution: collective vs compute
+                    # device time (reference Metrics.scala:103-121
+                    # measures per phase).  The value fetch (= execution
+                    # barrier; block_until_ready returns early on the
+                    # tunneled TPU backend) must happen inside the trace
+                    # so device events are captured; the step is timed
+                    # inside run_traced so trace start/parse overhead
+                    # never pollutes the phase metrics.
+                    from .profiling import trace_phase_split
 
-            if profiled and trace_split is None:
-                # fallback: collective-free fwd+bwd probe pins the pure
-                # compute time (runs on the post-step params — identical
-                # shapes/program, so identical timing)
-                probe_key = jax.random.PRNGKey(0)
-                if grad_probe is None:
-                    grad_probe = self._build_grad_probe(mesh)
+                    step_out = []
+
+                    def run_traced():
+                        tr = time.time()
+                        out = dispatch()
+                        loss_v = float(out[0])
+                        step_out.append((out, loss_v, time.time() - tr))
+                    trace_split = trace_phase_split(run_traced)
+                    out, loss, train_time = step_out[0]
+                else:
+                    # the feed's producer keeps prefetching in the
+                    # background, so the watchdog's block-on-loss no
+                    # longer trades away the overlap
+                    out = self._elastic_dispatch(dispatch, state)
+                    loss = float(out[0])  # device sync
+                    train_time = time.time() - t0
+                _, params, buffers, slots, step_ok, gnorm = out
+                skipped = not bool(step_ok)
+                # h2d was attributed above — feed only the measured
+                # buffer stall as data wait (no double counting)
+                self._tm_step(state, train_time, stall_time, n_records,
+                              compiled=first_step,
+                              phase_split=trace_split, skipped=skipped)
+                first_step = False
+                self._check_loss_anomaly(loss, skipped)
+                params = self._maybe_corrupt_params(state, params)
+                self._record_fingerprint(state, loss, float(gnorm),
+                                         (x, y), lambda: params,
+                                         skipped=skipped)
+                self._integrity_step(state, lambda: params)
+
+                if profiled and trace_split is None:
+                    # fallback: collective-free fwd+bwd probe pins the
+                    # pure compute time (runs on the post-step params —
+                    # identical shapes/program, so identical timing)
+                    probe_key = jax.random.PRNGKey(0)
+                    if grad_probe is None:
+                        grad_probe = self._build_grad_probe(mesh)
+                        _l, _g = grad_probe(params, buffers, probe_key,
+                                            x, y)
+                        float(_l), float(_g)
+                    tp = time.time()
                     _l, _g = grad_probe(params, buffers, probe_key, x, y)
                     float(_l), float(_g)
-                tp = time.time()
-                _l, _g = grad_probe(params, buffers, probe_key, x, y)
-                float(_l), float(_g)
-                compute_time = time.time() - tp
+                    compute_time = time.time() - tp
 
-            records_this_epoch += n_records
-            state["records_this_epoch"] = records_this_epoch
-            state["loss"] = loss
-            # metric-name contract (reference DistriOptimizer.scala:146-151)
-            # with measured per-phase numbers: the profiled iterations pin
-            # the compute/aggregate split; in between, the last measured
-            # ratio attributes the fused step's wall time
-            if profiled:
-                if trace_split is not None:
-                    c_s, agg_s = trace_split
-                    compute_ratio = c_s / max(c_s + agg_s, 1e-12)
-                    self.phase_source = "trace"
+                records_this_epoch += n_records
+                state["records_this_epoch"] = records_this_epoch
+                state["loss"] = loss
+                # metric-name contract (reference
+                # DistriOptimizer.scala:146-151) with measured per-phase
+                # numbers: the profiled iterations pin the
+                # compute/aggregate split; in between, the last measured
+                # ratio attributes the fused step's wall time
+                if profiled:
+                    if trace_split is not None:
+                        c_s, agg_s = trace_split
+                        compute_ratio = c_s / max(c_s + agg_s, 1e-12)
+                        self.phase_source = "trace"
+                    else:
+                        compute_ratio = min(
+                            compute_time / max(train_time, 1e-9), 1.0)
+                        self.phase_source = "probe"
+                if compute_ratio is not None:
+                    self.metrics.add("computing time average",
+                                     train_time * compute_ratio)
+                    self.metrics.add("aggregate gradient time",
+                                     train_time * (1.0 - compute_ratio))
                 else:
-                    compute_ratio = min(
-                        compute_time / max(train_time, 1e-9), 1.0)
-                    self.phase_source = "probe"
-            if compute_ratio is not None:
-                self.metrics.add("computing time average",
-                                 train_time * compute_ratio)
-                self.metrics.add("aggregate gradient time",
-                                 train_time * (1.0 - compute_ratio))
-            else:
-                # metric-name contract holds before the first profiled
-                # iteration too (reference always emits all three)
-                self.metrics.add("computing time average", train_time)
-                self.metrics.add("aggregate gradient time", 0.0)
-            self.metrics.add("get weights average", infeed_time)
-            log.info(
-                "[Epoch %d %d/%d][Iteration %d][Wall Clock %.3fs] "
-                "Train %d in %.4f seconds. Throughput is %.1f records/second. "
-                "Loss is %.5f.",
-                state["epoch"], records_this_epoch, epoch_size, state["neval"],
-                time.time() - wall_start, n_records, train_time + infeed_time,
-                n_records / max(train_time + infeed_time, 1e-9), loss)
-
-            if self.train_summary is not None:
-                self.train_summary.add_scalar("Loss", loss, state["neval"])
-                self.train_summary.add_scalar(
-                    "Throughput",
+                    # metric-name contract holds before the first
+                    # profiled iteration too (reference always emits
+                    # all three)
+                    self.metrics.add("computing time average",
+                                     train_time)
+                    self.metrics.add("aggregate gradient time", 0.0)
+                self.metrics.add("get weights average", infeed_time)
+                log.info(
+                    "[Epoch %d %d/%d][Iteration %d][Wall Clock %.3fs] "
+                    "Train %d in %.4f seconds. Throughput is %.1f "
+                    "records/second. Loss is %.5f.",
+                    state["epoch"], records_this_epoch, epoch_size,
+                    state["neval"], time.time() - wall_start, n_records,
+                    train_time + infeed_time,
                     n_records / max(train_time + infeed_time, 1e-9),
-                    state["neval"])
-                if self.gradient_guard:
+                    loss)
+
+                if self.train_summary is not None:
+                    self.train_summary.add_scalar("Loss", loss,
+                                                  state["neval"])
                     self.train_summary.add_scalar(
-                        "SkippedSteps", float(self.skipped_steps),
+                        "Throughput",
+                        n_records / max(train_time + infeed_time, 1e-9),
                         state["neval"])
+                    if self.gradient_guard:
+                        self.train_summary.add_scalar(
+                            "SkippedSteps", float(self.skipped_steps),
+                            state["neval"])
 
-            state["neval"] += 1
-            optim.state = state
+                state["neval"] += 1
+                optim.state = state
 
-            if records_this_epoch >= epoch_size:
-                state["epoch"] += 1
-                state["epoch_finished"] = True
-                records_this_epoch = 0
-                state["records_this_epoch"] = 0
-                self.dataset.shuffle()
-                data_iter = self.dataset.data(train=True)
+                if records_this_epoch >= epoch_size:
+                    state["epoch"] += 1
+                    state["epoch_finished"] = True
+                    records_this_epoch = 0
+                    state["records_this_epoch"] = 0
+                    # the producer met its epoch budget and is parked —
+                    # the shuffle cannot race a fetch; reset re-arms
+                    # the same producer thread on the fresh iterator
+                    self.dataset.shuffle()
+                    data_iter = self.dataset.data(train=True)
+                    feed.reset(data_iter, epoch_size, 0)
 
-            # validation runs ON-MESH with the device-resident params (no
-            # host pull, reference DistriValidator.scala:35); only a
-            # checkpoint needs the host-side model sync
-            if self.validation_trigger is not None and \
-                    self.validation_trigger(state):
-                self._validate_on_mesh(state, mesh, params, buffers)
-            do_checkpoint = (self.checkpoint_trigger is not None
-                             and self.checkpoint_trigger(state))
-            if do_checkpoint or self._preempted():
-                if self.checkpoint_format == "orbax":
-                    self._orbax_save(state, self._orbax_tree(
-                        params, slots, buffers), kind="model")
-                else:
-                    model.set_param_tree(params)
-                    model.set_buffer_tree(buffers)
-                    optim._slots = slots
-                    self._checkpoint(state)
-            if self._preempted():
-                log.warning("preemption requested — checkpointed at "
-                            "iteration %d; exiting resumable",
-                            state["neval"] - 1)
-                break
+                # validation runs ON-MESH with the device-resident
+                # params (no host pull, reference
+                # DistriValidator.scala:35); only a checkpoint needs
+                # the host-side model sync
+                if self.validation_trigger is not None and \
+                        self.validation_trigger(state):
+                    self._validate_on_mesh(state, mesh, params, buffers)
+                do_checkpoint = (self.checkpoint_trigger is not None
+                                 and self.checkpoint_trigger(state))
+                if do_checkpoint or self._preempted():
+                    if self.checkpoint_format == "orbax":
+                        self._orbax_save(state, self._orbax_tree(
+                            params, slots, buffers), kind="model")
+                    else:
+                        model.set_param_tree(params)
+                        model.set_buffer_tree(buffers)
+                        optim._slots = slots
+                        self._checkpoint(state)
+                if self._preempted():
+                    self._drain_checkpoints()
+                    log.warning("preemption requested — checkpointed at "
+                                "iteration %d; exiting resumable",
+                                state["neval"] - 1)
+                    break
+        finally:
+            feed.close()
 
         model.set_param_tree(params)
         model.set_buffer_tree(buffers)
         optim._slots = slots
         model.evaluate()
+        # drain-on-exit barrier: every triggered checkpoint is durable
+        # (or its write error surfaces here, into the retry loop)
+        self._drain_checkpoints()
         self._orbax_close()
         self._tm_finish(state)
         return model

@@ -125,6 +125,22 @@ class Optimizer:
         # registry + structured tracer + goodput ledger — off unless
         # set_telemetry attaches one
         self.telemetry = None
+        # --- async everything (docs/async.md) -------------------------
+        # background snapshot-then-write checkpointing: serialize at
+        # the step boundary (synchronous — bitwise-identical bytes),
+        # hand the atomic crc32c write to a background writer thread.
+        # On by default: only the I/O is deferred, so resume semantics
+        # are unchanged (bigdl.checkpoint.async=false restores the
+        # fully synchronous write)
+        self.async_checkpoint = str(get_property(
+            "bigdl.checkpoint.async", "true")).lower() in (
+            "1", "true", "yes", "on")
+        self._ckpt_writer = None  # lazy AsyncCheckpointWriter
+        self._ckpt_queue_depth = 1
+        # bounded prefetch-to-device infeed depth shared by every mesh
+        # path (dataset/prefetch.py): 2 = double buffering (default),
+        # 0 disables (synchronous fetch, every fetch a real stall)
+        self.infeed_depth = int(get_property("bigdl.infeed.depth", 2))
         # input-pipeline resume cursor (records already trained in the
         # interrupted epoch) — set by resume_from_checkpoint when the
         # checkpoint carries train state, consumed once by the loop
@@ -265,6 +281,36 @@ class Optimizer:
             self.retry_window = policy.window
         return self
 
+    def set_async_checkpoint(self, enabled: bool = True,
+                             queue_depth: int = 1):
+        """Background snapshot-then-write checkpointing (on by
+        default; ``bigdl.checkpoint.async`` property sets the
+        default).  The checkpoint's bytes are serialized synchronously
+        at the step boundary — so deterministic resume stays bitwise —
+        and the atomic crc32c-verified write happens on a single
+        background writer thread with back-pressure (``queue_depth``
+        pending writes; a trigger arriving while the queue is full
+        blocks, and that time is ledgered as ``checkpoint``).  The
+        writer drains at loop exit, before every restore, and on
+        preemption.  See docs/async.md."""
+        self.async_checkpoint = bool(enabled)
+        if self._ckpt_writer is not None \
+                and self._ckpt_writer.queue_depth != int(queue_depth):
+            self._ckpt_writer.close()
+            self._ckpt_writer = None
+        self._ckpt_queue_depth = max(1, int(queue_depth))
+        return self
+
+    def set_infeed_prefetch(self, depth: int = 2):
+        """Bounded prefetch-to-device infeed depth for every mesh path
+        (``bigdl.infeed.depth`` property sets the default, 2 = double
+        buffering): a background thread overlaps batch N+1's host prep
+        + ``device_put`` with the compiled step on batch N, and
+        ``data_stall`` is ledgered only when the buffer was actually
+        empty.  ``depth=0`` restores the synchronous fetch."""
+        self.infeed_depth = max(0, int(depth))
+        return self
+
     def set_preemption_handling(self, enabled: bool = True):
         """Install SIGTERM/SIGINT handlers for the duration of
         ``optimize()``: on signal, finish the in-flight step, write a
@@ -370,6 +416,59 @@ class Optimizer:
 
     def _restore_latest(self):
         self.resume_from_checkpoint()
+
+    # -- async checkpoint plumbing (resilience/async_checkpoint.py) -----
+    def _checkpoint_writer(self):
+        """The lazily-built background checkpoint writer (one per
+        optimizer; recreated after close)."""
+        from ..resilience.async_checkpoint import AsyncCheckpointWriter
+
+        if self._ckpt_writer is None:
+            self._ckpt_writer = AsyncCheckpointWriter(
+                queue_depth=self._ckpt_queue_depth)
+        return self._ckpt_writer
+
+    def _drain_checkpoints(self, raise_errors: bool = True):
+        """Barrier: every submitted checkpoint byte is committed (or
+        its write error raised here, on the training thread).  Runs
+        before any restore — a rollback must see the newest snapshot —
+        and at preemption/loop exit.  The restore path passes
+        ``raise_errors=False``: a failed background write there means
+        the newest checkpoint is simply absent, which the verified
+        walk-back restore already handles by design."""
+        if self._ckpt_writer is not None:
+            self._ckpt_writer.drain(raise_errors=raise_errors)
+
+    def _close_ckpt_writer(self):
+        if self._ckpt_writer is not None:
+            self._ckpt_writer.close()
+            self._ckpt_writer = None
+
+    def _shutdown_async_writer(self):
+        """Best-effort writer close on the way out of ``optimize()`` —
+        never raises (an abnormal exit's original exception must not be
+        masked); write failures already surfaced through the drain
+        barriers on the normal path."""
+        w, self._ckpt_writer = self._ckpt_writer, None
+        if w is None:
+            return
+        try:
+            w.close()
+        except Exception:
+            log.exception("async checkpoint writer close failed")
+
+    def _make_feed(self, data_iter, epoch_size: int,
+                   start_records: int = 0, transform=None):
+        """Feed over one epoch of ``data_iter`` at the configured
+        prefetch depth (dataset/prefetch.py); the driver closes it
+        before shuffle/rollover and at loop exit.  The default
+        transform is the host→device batch conversion."""
+        from ..dataset.prefetch import make_feed
+
+        return make_feed(data_iter, epoch_size=epoch_size,
+                         start_records=start_records,
+                         depth=self.infeed_depth,
+                         transform=transform or _device_batch)
 
     # -- telemetry plumbing shared by the drivers -----------------------
     def _tm_attempt_begin(self):
@@ -604,9 +703,17 @@ class Optimizer:
                 "back to the last good checkpoint")
 
     def _write_pickle_checkpoint(self, state):
-        """Atomic, checksummed model/optimMethod pickle checkpoint
-        (tmp + fsync + rename, crc32c sidecars — the write side of the
-        verified-restore contract in resilience.checkpoint)."""
+        """Atomic, checksummed model/optimMethod/trainState pickle
+        checkpoint (tmp + fsync + rename, crc32c sidecars — the write
+        side of the verified-restore contract in resilience.checkpoint).
+
+        With ``async_checkpoint`` (the default) this is snapshot-then-
+        write: the three legs are SERIALIZED here, synchronously at the
+        step boundary (so the bytes — and therefore any later resume —
+        are bit-identical to a synchronous write), and the atomic
+        writes happen on the background writer thread.  Only the
+        serialize cost and any writer back-pressure stay on the
+        critical path (docs/async.md)."""
         from ..utils import file_io
 
         if self.checkpoint_path is None:
@@ -614,23 +721,35 @@ class Optimizer:
         t_ck0 = time.time()
         n = state["neval"] - 1
         suffix = "" if self.is_overwrite else f".{n}"
-        file_io.save(self.model,
-                     file_io.join(self.checkpoint_path, f"model{suffix}"),
-                     overwrite=True, atomic=True, checksum=True)
-        file_io.save(self.optim_method,
-                     file_io.join(self.checkpoint_path,
-                                  f"optimMethod{suffix}"),
-                     overwrite=True, atomic=True, checksum=True)
         # the third leg of total state: host RNG stream + input-pipeline
         # order/cursor — what makes the resume land on the exact next
         # batch instead of restarting the epoch (docs/determinism.md)
-        file_io.save(self._train_state_dict(state),
-                     file_io.join(self.checkpoint_path,
-                                  f"trainState{suffix}"),
-                     overwrite=True, atomic=True, checksum=True)
+        legs = (("model", self.model),
+                ("optimMethod", self.optim_method),
+                ("trainState", self._train_state_dict(state)))
+        if not self.async_checkpoint:
+            for name, obj in legs:
+                file_io.save(obj,
+                             file_io.join(self.checkpoint_path,
+                                          f"{name}{suffix}"),
+                             overwrite=True, atomic=True, checksum=True)
+            self._record_checkpoint_param_crc(state,
+                                              self.model.param_tree())
+            if self.telemetry is not None:
+                self.telemetry.on_checkpoint(time.time() - t_ck0, step=n)
+            return
+        files = tuple(
+            (file_io.join(self.checkpoint_path, f"{name}{suffix}"),
+             file_io.serialize(obj))
+            for name, obj in legs)
         self._record_checkpoint_param_crc(state, self.model.param_tree())
+        snap_s = time.time() - t_ck0
+        blocked = self._checkpoint_writer().submit(n, files)
         if self.telemetry is not None:
-            self.telemetry.on_checkpoint(time.time() - t_ck0, step=n)
+            # the snapshot (serialize) cost is the checkpoint's real
+            # critical-path tax; back-pressure is ledgered separately
+            self.telemetry.on_checkpoint(snap_s, step=n)
+            self.telemetry.on_checkpoint_blocked(blocked, step=n)
 
     # -- orbax sharded checkpoints (utils/orbax_io.py) -------------------
     @staticmethod
@@ -667,8 +786,13 @@ class Optimizer:
         # internal wait would then commit it right before retention
         # deletes it as not-in-keep.
         committed_before = None
+        blocked = 0.0
         if self.is_overwrite:
+            # draining the PREVIOUS async save is back-pressure, not
+            # fresh checkpoint work — ledger it as such
+            t_w0 = time.time()
             self._orbax.wait()
+            blocked = time.time() - t_w0
             committed_before = latest_step(self._orbax.directory)
         self._orbax.save(n, tree)
         meta = {"kind": kind, "state": dict(state),
@@ -676,9 +800,18 @@ class Optimizer:
                 "abstract": jax.tree_util.tree_map(
                     lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype),
                     tree)}
-        with open(os.path.join(self._orbax.directory,
-                               f"meta-{n}.pkl"), "wb") as f:
-            pickle.dump(meta, f)
+        # snapshot-then-write for the sidecar too: the bytes are fixed
+        # here (meta holds host state + abstract shapes only); the file
+        # write rides the background checkpoint writer.  FIFO order
+        # keeps meta-N committed before any later checkpoint's legs,
+        # and restore paths drain the writer first.
+        meta_path = os.path.join(self._orbax.directory, f"meta-{n}.pkl")
+        meta_bytes = pickle.dumps(meta)
+        if self.async_checkpoint:
+            blocked += self._checkpoint_writer().submit(
+                n, fn=lambda: _write_plain(meta_path, meta_bytes))
+        else:
+            _write_plain(meta_path, meta_bytes)
         self._record_checkpoint_param_crc(state, tree)
         if self.is_overwrite:
             # bounded retention (the pickle path's overwrite analogue):
@@ -704,8 +837,11 @@ class Optimizer:
                              else os.remove)(p)
         if self.telemetry is not None:
             # the async save's host-side dispatch cost; the shard
-            # writes overlap the next steps by design
-            self.telemetry.on_checkpoint(time.time() - t_ck0, step=n)
+            # writes overlap the next steps by design.  Back-pressure
+            # (waiting out the previous save) is its own ledger line.
+            self.telemetry.on_checkpoint(
+                max(0.0, time.time() - t_ck0 - blocked), step=n)
+            self.telemetry.on_checkpoint_blocked(blocked, step=n)
 
     def _orbax_restore_into_model(self) -> bool:
         """Restore the newest orbax step host-side into the live
@@ -802,6 +938,10 @@ class Optimizer:
         point's knob); optimMethod/trainState are always pinned to the
         step the model actually restored from, so the trio can never
         mix steps on a partially corrupt directory."""
+        # a restore must see every checkpoint already triggered: commit
+        # any in-flight background write first (a write that FAILED is
+        # simply absent — the verified walk-back below handles that)
+        self._drain_checkpoints(raise_errors=False)
         if self.checkpoint_format == "orbax":
             if step is not None:
                 log.warning("resume_from_checkpoint(step=%s) is pickle-"
@@ -834,6 +974,13 @@ class Optimizer:
 
     def optimize(self) -> AbstractModule:
         raise NotImplementedError
+
+
+def _write_plain(path: str, data: bytes):
+    """Plain local byte write (the orbax meta sidecar — its integrity
+    story is the per-step shard manifest, not a crc sidecar)."""
+    with open(path, "wb") as f:
+        f.write(data)
 
 
 def _yields_minibatch(dataset) -> bool:
@@ -911,7 +1058,9 @@ class LocalOptimizer(Optimizer):
             with self._preemption_scope():
                 return self._with_retry(self._optimize_loop)
         finally:
-            # commit any in-flight async orbax save on abnormal exits
+            # commit any in-flight async save on abnormal exits —
+            # background writer first, then the orbax checkpointer
+            self._shutdown_async_writer()
             self._orbax_close()
 
     def _optimize_loop(self) -> AbstractModule:
@@ -1007,112 +1156,133 @@ class LocalOptimizer(Optimizer):
                                                          epoch_size)
         wall_start = time.time()
 
-        def fetch():
-            t0 = time.time()
-            b = next(data_iter)
-            x, y = _device_batch(b)  # device transfer dispatches async
-            return b.size(), x, y, time.time() - t0
-
-        pending = None
+        # bounded prefetch-to-device infeed (dataset/prefetch.py):
+        # batch N+1's host prep + device_put overlap the compiled step
+        # on batch N; data_time below is the REAL stall — the seconds
+        # get() actually blocked on an empty buffer
+        feed = self._make_feed(data_iter, epoch_size, records_this_epoch)
         first_step = True  # the first dispatch of a fresh program is
         #                    dominated by the XLA build (telemetry:
         #                    compile, not productive)
-        while not self.end_when(state):
-            state["epoch_finished"] = False
-            self._elastic_step_start(state)
-            n_records, x, y, data_time = pending or fetch()
-            pending = None
+        try:
+            while not self.end_when(state):
+                state["epoch_finished"] = False
+                self._elastic_step_start(state)
+                item, data_time = feed.get()
+                batch, x, y = item
+                n_records = batch.size()
 
-            lr = optim.get_current_lr()
-            rng = next_jax_key()
-            if first_step and self.telemetry is not None:
-                # XLA cost-model work accounting for the exact program
-                # about to compile (before t0: analysis is host-side
-                # lowering, not step time)
-                self._tm_analyze(jitted, params, buffers, slots,
-                                 jnp.float32(lr), rng, x, y)
-            t0 = time.time()
-            loss, params, buffers, slots, step_ok, gnorm = \
-                self._elastic_dispatch(
-                    lambda: jitted(params, buffers, slots,
-                                   jnp.float32(lr), rng, x, y), state)
-            # prefetch the next batch while the device runs this step —
-            # only within the epoch, so rollover/shuffle semantics hold
-            if records_this_epoch + n_records < epoch_size:
-                pending = fetch()
-            loss = float(loss)  # device sync
-            skipped = not bool(step_ok)
-            train_time = time.time() - t0
-            self._tm_step(state, train_time, data_time, n_records,
-                          compiled=first_step, skipped=skipped)
-            first_step = False
-            self._check_loss_anomaly(loss, skipped)
-            params = self._maybe_corrupt_params(state, params)
-            self._record_fingerprint(state, loss, float(gnorm), (x, y),
-                                     lambda: params, skipped=skipped)
-            self._integrity_step(state, lambda: params)
+                lr = optim.get_current_lr()
+                t0 = time.time()
+                if first_step and self.telemetry is not None:
+                    # XLA cost-model work accounting for the exact
+                    # program about to compile (inside the first step's
+                    # timed window, which is ledgered as COMPILE — the
+                    # analysis is host-side lowering, part of the
+                    # program-build cost; the constant key never
+                    # consumes the checkpointed stream)
+                    self._tm_analyze(jitted, params, buffers, slots,
+                                     jnp.float32(lr),
+                                     jax.random.PRNGKey(0), x, y)
+                # the key derivation is step-dispatch work (the other
+                # mesh paths derive it inside their dispatch closure
+                # too) — timed with the step, not left as idle
+                rng = next_jax_key()
+                loss, params, buffers, slots, step_ok, gnorm = \
+                    self._elastic_dispatch(
+                        lambda: jitted(params, buffers, slots,
+                                       jnp.float32(lr), rng, x, y), state)
+                loss = float(loss)  # device sync; the feed's producer
+                #                     keeps fetching meanwhile
+                skipped = not bool(step_ok)
+                train_time = time.time() - t0
+                self._tm_step(state, train_time, data_time, n_records,
+                              compiled=first_step, skipped=skipped)
+                first_step = False
+                self._check_loss_anomaly(loss, skipped)
+                params = self._maybe_corrupt_params(state, params)
+                self._record_fingerprint(state, loss, float(gnorm),
+                                         (x, y), lambda: params,
+                                         skipped=skipped)
+                self._integrity_step(state, lambda: params)
 
-            self.metrics.add("computing time average", train_time)
-            self.metrics.add("data fetch time", data_time)
-            records_this_epoch += n_records
-            state["records_this_epoch"] = records_this_epoch
-            state["loss"] = loss
-            log.info(
-                "[Epoch %d %d/%d][Iteration %d][Wall Clock %.3fs] "
-                "Train %d in %.4f seconds. Throughput is %.1f records/second. "
-                "Loss is %.5f.",
-                state["epoch"], records_this_epoch, epoch_size, state["neval"],
-                time.time() - wall_start, n_records, train_time + data_time,
-                n_records / max(train_time + data_time, 1e-9), loss)
+                self.metrics.add("computing time average", train_time)
+                self.metrics.add("data fetch time", data_time)
+                records_this_epoch += n_records
+                state["records_this_epoch"] = records_this_epoch
+                state["loss"] = loss
+                log.info(
+                    "[Epoch %d %d/%d][Iteration %d][Wall Clock %.3fs] "
+                    "Train %d in %.4f seconds. Throughput is %.1f "
+                    "records/second. Loss is %.5f.",
+                    state["epoch"], records_this_epoch, epoch_size,
+                    state["neval"], time.time() - wall_start, n_records,
+                    train_time + data_time,
+                    n_records / max(train_time + data_time, 1e-9), loss)
 
-            if self.train_summary is not None:
-                self.train_summary.add_scalar("Loss", loss, state["neval"])
-                self.train_summary.add_scalar(
-                    "Throughput", n_records / max(train_time + data_time, 1e-9),
-                    state["neval"])
-                if "LearningRate" in getattr(self.train_summary, "triggers", {}):
-                    self.train_summary.add_scalar("LearningRate", lr, state["neval"])
-                if self.gradient_guard:
+                if self.train_summary is not None:
+                    self.train_summary.add_scalar("Loss", loss,
+                                                  state["neval"])
                     self.train_summary.add_scalar(
-                        "SkippedSteps", float(self.skipped_steps),
+                        "Throughput",
+                        n_records / max(train_time + data_time, 1e-9),
                         state["neval"])
+                    if "LearningRate" in getattr(self.train_summary,
+                                                 "triggers", {}):
+                        self.train_summary.add_scalar(
+                            "LearningRate", lr, state["neval"])
+                    if self.gradient_guard:
+                        self.train_summary.add_scalar(
+                            "SkippedSteps", float(self.skipped_steps),
+                            state["neval"])
 
-            state["neval"] += 1
-            optim.state = state
+                state["neval"] += 1
+                optim.state = state
 
-            if records_this_epoch >= epoch_size:
-                state["epoch"] += 1
-                state["epoch_finished"] = True
-                records_this_epoch = 0
-                state["records_this_epoch"] = 0
-                self.dataset.shuffle()
-                data_iter = self.dataset.data(train=True)
+                if records_this_epoch >= epoch_size:
+                    state["epoch"] += 1
+                    state["epoch_finished"] = True
+                    records_this_epoch = 0
+                    state["records_this_epoch"] = 0
+                    # the producer met its epoch budget and is parked —
+                    # the shuffle cannot race a fetch; reset re-arms
+                    # the same producer thread on the fresh iterator
+                    self.dataset.shuffle()
+                    data_iter = self.dataset.data(train=True)
+                    feed.reset(data_iter, epoch_size, 0)
 
-            # sync module state before validation/checkpoint consumers
-            if self._should(self.validation_trigger, state) or \
-               self._should(self.checkpoint_trigger, state):
-                model.set_param_tree(params)
-                model.set_buffer_tree(buffers)
-                optim._slots = slots
-            self._validate(state)
-            self._checkpoint(state)
+                # sync module state before validation/checkpoint consumers
+                if self._should(self.validation_trigger, state) or \
+                   self._should(self.checkpoint_trigger, state):
+                    model.set_param_tree(params)
+                    model.set_buffer_tree(buffers)
+                    optim._slots = slots
+                self._validate(state)
+                self._checkpoint(state)
 
-            if self._preempted():
-                # graceful preemption: checkpoint the live state at this
-                # step boundary and return resumable
-                model.set_param_tree(params)
-                model.set_buffer_tree(buffers)
-                optim._slots = slots
-                self._checkpoint_now(state)
-                log.warning("preemption requested — checkpointed at "
-                            "iteration %d; exiting resumable",
-                            state["neval"] - 1)
-                break
+                if self._preempted():
+                    # graceful preemption: checkpoint the live state at
+                    # this step boundary, drain the background writer
+                    # (the preemption barrier) and return resumable
+                    model.set_param_tree(params)
+                    model.set_buffer_tree(buffers)
+                    optim._slots = slots
+                    self._checkpoint_now(state)
+                    self._drain_checkpoints()
+                    log.warning("preemption requested — checkpointed at "
+                                "iteration %d; exiting resumable",
+                                state["neval"] - 1)
+                    break
+        finally:
+            feed.close()
 
         model.set_param_tree(params)
         model.set_buffer_tree(buffers)
         optim._slots = slots
         model.evaluate()
+        # drain-on-exit barrier: every triggered checkpoint is durable
+        # (or its write error surfaces here, into the retry loop)
+        self._drain_checkpoints()
         self._orbax_close()
         self._tm_finish(state)
         return model

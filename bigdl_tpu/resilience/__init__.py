@@ -37,11 +37,17 @@ resilience from, so this package owns it end to end:
   patterns, batch + param checksums) and the cross-host integrity vote
   (majority checksum defines truth; a minority host is evicted, no
   quorum is the fatal :class:`IntegrityError`).
+* :mod:`.async_checkpoint` — background snapshot-then-write
+  checkpointing: bytes serialized synchronously at the step boundary
+  (bitwise-identical to a sync write), atomic crc32c writes on a
+  single writer thread with back-pressure and drain barriers at loop
+  exit / restore / preemption (docs/async.md).
 * :mod:`.replay`      — deterministic replay: re-execute from a
   verified checkpoint and diff fingerprint journals to localize the
   first divergent step (total train state — params, slots, RNG stream,
   pipeline cursor — makes the re-execution bit-faithful).
 """
+from .async_checkpoint import AsyncCheckpointError, AsyncCheckpointWriter
 from .guards import LossSpikeDetector, tree_finite, where_tree
 from .retry import (FatalTrainingError, LossSpikeError, RetryPolicy,
                     classify_error)
@@ -60,6 +66,7 @@ from .integrity import (FlightRecorder, IntegrityError,
 from .replay import diff_journals, load_journal, replay
 
 __all__ = [
+    "AsyncCheckpointError", "AsyncCheckpointWriter",
     "LossSpikeDetector", "tree_finite", "where_tree",
     "FatalTrainingError", "LossSpikeError", "RetryPolicy", "classify_error",
     "PreemptionHandler", "request_preemption",

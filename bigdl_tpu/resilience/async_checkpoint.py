@@ -1,0 +1,234 @@
+"""Background snapshot-then-write checkpointing.
+
+A synchronous checkpoint puts the whole serialize + fsync + rename
+sequence on the step critical path; at production cadence that is a
+named goodput category stealing seconds from every trigger (the
+`checkpoint` row of the goodput ledger).  The fix is the classic
+two-phase split (the same overlap move the BigDL parameter manager
+makes for gradient aggregation, arXiv:1804.05839 — hide I/O behind
+compute):
+
+* **snapshot** (synchronous, at the step boundary): pull device state
+  to host and pickle it (``utils.file_io.serialize``).  After this
+  instant the checkpoint's bytes are immutable — the training loop may
+  donate, overwrite or shrink the live arrays without touching what
+  will be written.  This is what keeps deterministic resume *bitwise*:
+  an async-written checkpoint is byte-identical to the sync-written
+  one, only its I/O happens later.
+* **write** (asynchronous): a single daemon writer thread performs the
+  atomic tmp + fsync + rename + crc32c-sidecar write
+  (``utils.file_io.save_bytes``) off the critical path.
+
+Ordering/robustness contract:
+
+* one writer thread ⇒ jobs commit in submission order (step N's files
+  can never land after step N+1's);
+* the queue is bounded (default depth 1) ⇒ **back-pressure**: a new
+  checkpoint triggered while the previous write is still in flight
+  blocks in :meth:`~AsyncCheckpointWriter.submit`, and that blocked
+  time is returned so the driver can ledger it as the only checkpoint
+  seconds left on the critical path;
+* a background write failure is **stored and re-raised on the training
+  thread** at the next ``submit``/``drain`` — asynchrony must not turn
+  a failing checkpoint path into silence (the retry loop then treats
+  it exactly like a synchronous write failure);
+* :meth:`~AsyncCheckpointWriter.drain` is the barrier the driver runs
+  at loop exit, before any restore, and on preemption — after it
+  returns, every submitted byte is committed (or its error raised).
+
+Torn-write protection is inherited from ``save_bytes``: a writer
+killed mid-write leaves only a temp file, never a torn file under the
+final name, and a torn file smuggled in by a harder crash fails its
+crc32c sidecar on restore and is quarantined (resilience.checkpoint).
+"""
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from collections import deque
+from typing import Callable, Optional, Sequence, Tuple
+
+log = logging.getLogger("bigdl_tpu")
+
+__all__ = ["AsyncCheckpointError", "AsyncCheckpointWriter"]
+
+
+class AsyncCheckpointError(IOError):
+    """A background checkpoint write failed.  Raised on the *training*
+    thread at the next submit/drain so the failure enters the same
+    retry/rollback machinery a synchronous write failure would."""
+
+
+def _count(name: str, help: str, n: float = 1.0):
+    """Best-effort counter into the process default registry (the same
+    pattern the elastic/retry internals use)."""
+    try:
+        from ..telemetry import default_registry
+
+        default_registry().counter(name, help).inc(n)
+    except Exception:
+        pass
+
+
+class AsyncCheckpointWriter:
+    """Single background writer thread with a bounded job queue.
+
+    A job is a sequence of ``(path, bytes)`` files (written in order
+    through ``file_io.save_bytes`` — atomic + crc32c) and/or a zero-arg
+    callable for writes that are not plain bytes-at-path (the orbax
+    meta sidecar).  The thread starts lazily on the first submit and is
+    a daemon, so an abandoned writer never blocks interpreter exit; the
+    drain barrier is what guarantees durability at the points that need
+    it.
+    """
+
+    def __init__(self, queue_depth: int = 1, name: str = "bigdl-ckpt-writer"):
+        self.queue_depth = max(1, int(queue_depth))
+        self._name = name
+        self._lock = threading.Lock()
+        self._cv = threading.Condition(self._lock)
+        self._jobs: deque = deque()
+        self._pending = 0          # queued + in-flight jobs
+        self._error: Optional[BaseException] = None
+        self._error_step: Optional[int] = None
+        self._stop = False
+        self._thread: Optional[threading.Thread] = None
+        # -- counters (observability; also exported to the default
+        #    registry as bigdl_checkpoint_async_* metrics) -------------
+        self.writes = 0            # jobs fully committed
+        self.write_seconds = 0.0   # background wall spent writing
+        self.blocked_seconds = 0.0  # cumulative submit back-pressure
+
+    # -- lifecycle -------------------------------------------------------
+    def _ensure_thread(self):
+        if self._thread is None or not self._thread.is_alive():
+            self._stop = False
+            self._thread = threading.Thread(target=self._run, daemon=True,
+                                            name=self._name)
+            self._thread.start()
+
+    def _run(self):
+        while True:
+            with self._cv:
+                while not self._jobs and not self._stop:
+                    self._cv.wait()
+                if self._stop and not self._jobs:
+                    return
+                step, files, fn = self._jobs.popleft()
+                self._cv.notify_all()  # wake a submit blocked on depth
+            t0 = time.monotonic()
+            try:
+                self._write(files, fn)
+                with self._cv:
+                    self.writes += 1
+                    self.write_seconds += time.monotonic() - t0
+                _count("bigdl_checkpoint_async_writes_total",
+                       "checkpoint jobs committed by the background "
+                       "writer")
+                _count("bigdl_checkpoint_async_write_seconds_total",
+                       "background wall seconds spent writing "
+                       "checkpoints (off the step critical path)",
+                       time.monotonic() - t0)
+            except BaseException as e:  # noqa: BLE001 — re-raised on the
+                #                         training thread via _raise_pending
+                log.error("async checkpoint write for step %s failed: "
+                          "%s: %s", step, type(e).__name__, e)
+                with self._cv:
+                    if self._error is None:
+                        self._error, self._error_step = e, step
+            finally:
+                with self._cv:
+                    self._pending -= 1
+                    self._cv.notify_all()
+
+    @staticmethod
+    def _write(files: Sequence[Tuple[str, bytes]],
+               fn: Optional[Callable[[], None]]):
+        from ..utils import file_io
+
+        for path, data in files or ():
+            file_io.save_bytes(data, path, atomic=True, checksum=True)
+        if fn is not None:
+            fn()
+
+    # -- training-thread API --------------------------------------------
+    def _raise_pending(self):
+        with self._cv:
+            err, step = self._error, self._error_step
+            self._error = self._error_step = None
+        if err is not None:
+            raise AsyncCheckpointError(
+                f"background checkpoint write for step {step} failed: "
+                f"{type(err).__name__}: {err}") from err
+
+    def submit(self, step: int,
+               files: Sequence[Tuple[str, bytes]] = (),
+               fn: Optional[Callable[[], None]] = None) -> float:
+        """Queue one checkpoint's committed bytes for background write.
+
+        Blocks while the queue is at depth (back-pressure: checkpoints
+        must not pile up faster than storage absorbs them) and returns
+        the seconds blocked — the only checkpoint-write time left on
+        the caller's critical path.  Raises :class:`AsyncCheckpointError`
+        first if a previous background write failed."""
+        self._raise_pending()
+        self._ensure_thread()
+        t0 = time.monotonic()
+        with self._cv:
+            while self._pending >= self.queue_depth and not self._stop:
+                self._cv.wait(0.05)
+            self._jobs.append((int(step), tuple(files or ()), fn))
+            self._pending += 1
+            self._cv.notify_all()
+        blocked = time.monotonic() - t0
+        with self._cv:
+            self.blocked_seconds += blocked
+        return blocked
+
+    def drain(self, timeout: Optional[float] = None,
+              raise_errors: bool = True) -> bool:
+        """Barrier: block until every submitted job has committed (or
+        failed).  Returns False on timeout.  With ``raise_errors`` a
+        stored background failure surfaces here — the drain points
+        (loop exit, pre-restore, preemption) are exactly where a lost
+        checkpoint must not go unnoticed."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self._cv:
+            while self._pending > 0:
+                remaining = None if deadline is None \
+                    else deadline - time.monotonic()
+                if remaining is not None and remaining <= 0:
+                    return False
+                self._cv.wait(remaining if remaining is not None else 0.5)
+        if raise_errors:
+            self._raise_pending()
+        return True
+
+    def close(self, timeout: float = 30.0):
+        """Drain and stop the writer thread (idempotent).  Errors from
+        in-flight writes still raise — closing must not eat them."""
+        drained = self.drain(timeout=timeout, raise_errors=False)
+        with self._cv:
+            self._stop = True
+            self._cv.notify_all()
+        t = self._thread
+        if t is not None and t.is_alive():
+            t.join(timeout=5.0)
+        self._thread = None
+        if not drained:
+            log.warning("async checkpoint writer closed with writes "
+                        "still pending after %.0fs", timeout)
+        self._raise_pending()
+
+    @property
+    def pending(self) -> int:
+        with self._cv:
+            return self._pending
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        self.close()
+        return False

@@ -46,7 +46,8 @@ import statistics
 import threading
 import time
 import urllib.parse
-from typing import Callable, Dict, List, Optional, Sequence, Set, Tuple
+from typing import (Callable, Dict, List, Optional, Sequence, Set,
+                    Tuple)
 
 from ..telemetry.registry import default_registry
 from .watchdog import CollectiveWatchdog, HungCollectiveError
@@ -188,6 +189,30 @@ _INC = "inc"
 _ACK = "ack/"
 _EVICTED = "evicted/"
 _SDC = "sdc/"
+
+
+class BoundedLog(list):
+    """A list that keeps only its newest ``maxlen`` items — the
+    bounded-memory event log (keeps plain-list semantics: slicing,
+    equality with lists, `json`-serializable) for accumulators that
+    would otherwise grow for the life of a long run."""
+
+    def __init__(self, maxlen: int, iterable=()):
+        super().__init__(iterable)
+        self.maxlen = int(maxlen)
+        self._trim()
+
+    def _trim(self):
+        if len(self) > self.maxlen:
+            del self[:len(self) - self.maxlen]
+
+    def append(self, item):
+        super().append(item)
+        self._trim()
+
+    def extend(self, items):
+        super().extend(items)
+        self._trim()
 
 
 class ElasticCoordinator:
@@ -507,6 +532,11 @@ class ElasticContext:
         self._mesh_factory = mesh_factory
         self._n_devices: Optional[int] = None
         self._drop_knobs: Optional[Tuple[float, float, int]] = None
+        # background publisher (telemetry/publish.py): KV-transport
+        # puts for telemetry snapshots and vote checksums run off the
+        # step critical path, with incarnation-keyed staleness discard.
+        # Built lazily; close() joins it.
+        self._publisher = None
         # -- state ------------------------------------------------------
         self.incarnation: Optional[int] = None
         self.members: Tuple[str, ...] = ()
@@ -516,17 +546,23 @@ class ElasticContext:
         self._steps_since_change = 0
         self._fault_at: Optional[float] = None
         # -- counters ---------------------------------------------------
+        # event logs are BOUNDED (keep-newest window): a week-long run
+        # retains the recent window instead of growing RSS without
+        # limit (the LONGRUN leak audit — a 150-min run appended ~141k
+        # step_log tuples here)
         self.incarnation_changes = 0
         self.evictions = 0
-        self.evicted_hosts: List[str] = []
-        self.recoveries: List[float] = []
-        self.step_log: List[Tuple[int, int, float, float]] = []
-        self.shard_history: List[int] = []
+        self.evicted_hosts: List[str] = BoundedLog(1024)
+        self.recoveries: List[float] = BoundedLog(1024)
+        self.step_log: List[Tuple[int, int, float, float]] = \
+            BoundedLog(2048)
+        self.shard_history: List[int] = BoundedLog(1024)
         self.sdc_votes = 0
         self.sdc_disagreements = 0
         self.sdc_evictions = 0
-        self.sdc_detected_steps: List[int] = []
-        self.vote_log: List[Tuple[int, float]] = []  # (step, vote wall s)
+        self.sdc_detected_steps: List[int] = BoundedLog(1024)
+        # (step, vote wall s)
+        self.vote_log: List[Tuple[int, float]] = BoundedLog(2048)
 
     # -- configuration --------------------------------------------------
     @property
@@ -648,17 +684,40 @@ class ElasticContext:
         self._scalar("Incarnation", self.incarnation)
         self._scalar("ClusterSize", len(self.members))
 
+    def publisher(self):
+        """The lazily-built background publisher (one per context);
+        staleness is judged against this context's live incarnation."""
+        from ..telemetry.publish import BackgroundPublisher
+
+        if self._publisher is None:
+            self._publisher = BackgroundPublisher(
+                incarnation_of=lambda: self.incarnation or 0)
+        return self._publisher
+
     def publish_telemetry(self, step: int):
         """Publish this host's telemetry payload for the current
-        incarnation (no-op without an attached Telemetry)."""
+        incarnation (no-op without an attached Telemetry).  The
+        payload snapshot AND the transport put both run on the
+        background publisher — KV I/O never blocks a step; a payload
+        queued under an incarnation that has since died is discarded
+        instead of published (stale snapshots must not haunt the new
+        membership's view)."""
         if self.telemetry is None:
             return
         from ..telemetry.aggregate import publish_snapshot
 
-        self.telemetry.incarnation = self.incarnation or 0
-        publish_snapshot(self.coordinator.transport, self.host,
-                         self.telemetry.payload(step),
-                         incarnation=self.incarnation or 0)
+        tm, transport, host = (self.telemetry,
+                               self.coordinator.transport, self.host)
+        inc = self.incarnation or 0
+        tm.incarnation = inc
+
+        def publish():
+            publish_snapshot(transport, host, tm.payload(step),
+                             incarnation=inc)
+
+        if not self.publisher().submit(publish, incarnation=inc,
+                                       key="tm"):
+            publish()  # publisher closed: degrade to synchronous
 
     def cluster_snapshot(self) -> dict:
         """The leader's merged cluster telemetry view: newest payload
@@ -668,10 +727,21 @@ class ElasticContext:
         from ..telemetry.aggregate import collect_snapshots, merge_cluster
 
         self.publish_telemetry(self._last_step)
+        if self._publisher is not None:
+            # the reader's barrier: our own freshest payload must be
+            # visible before the collect
+            self._publisher.drain()
         payloads = collect_snapshots(
             self.coordinator.transport, self.incarnation or 0,
             members=self.members or None)
         return merge_cluster(payloads)
+
+    def close(self):
+        """Join the background publisher (flushing queued payloads).
+        The context stays usable — publishing after close degrades to
+        synchronous puts."""
+        if self._publisher is not None:
+            self._publisher.close()
 
     def on_step_start(self, step: int):
         c = self.coordinator
@@ -823,7 +893,15 @@ class ElasticContext:
         # legitimately changes the bits: fewer shards, different
         # reduction order)
         prefix = f"{_SDC}{self.incarnation}/{int(step)}/"
-        c.transport.put(prefix + c.host, str(checksum))
+        # our own vote publishes through the background publisher too
+        # (urgent: this round's bounded wait below is watching for it),
+        # so a slow KV transport never stalls the step loop beyond the
+        # vote round itself
+        vote_key, vote_value = prefix + c.host, str(checksum)
+        if not self.publisher().submit(
+                lambda: c.transport.put(vote_key, vote_value),
+                incarnation=self.incarnation, urgent=True):
+            c.transport.put(vote_key, vote_value)
         want = set(self.members) or {c.host}
         t0 = time.monotonic()
         deadline = t0 + self.integrity_timeout

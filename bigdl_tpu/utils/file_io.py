@@ -168,6 +168,52 @@ def _to_device(obj):
         lambda x: jnp.asarray(x) if isinstance(x, np.ndarray) else x, obj)
 
 
+def serialize(obj: Any) -> bytes:
+    """Pickle ``obj`` with device arrays pulled to host — the SNAPSHOT
+    half of a snapshot-then-write checkpoint.  The returned bytes own
+    no live device buffers, so a background writer may hold them across
+    step boundaries while the training loop donates/overwrites the
+    arrays they were copied from."""
+    return pickle.dumps(_to_host(obj))
+
+
+def save_bytes(data: bytes, path: str, *, atomic: bool = True,
+               checksum: bool = True):
+    """The WRITE half of a snapshot-then-write checkpoint: put
+    already-serialized ``data`` at ``path`` with the same torn-write
+    protection ``save(atomic=True, checksum=True)`` gives — temp file
+    in the target directory, fsync, rename (local backends), plus the
+    ``<path>.crc32c`` sidecar.  Safe to call from a background thread:
+    it touches nothing but its arguments."""
+    from ..resilience import faults
+
+    faults.check_io_fault(path)
+    fs = filesystem_for(path)
+    d = _dirname(path)
+    if d:
+        fs.makedirs(d)
+    if atomic and isinstance(fs, _LocalBackend):
+        p = _strip_file_scheme(path)
+        tmp = f"{p}.tmp-{os.getpid()}"
+        try:
+            with open(tmp, "wb") as f:
+                f.write(data)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, p)
+            _fsync_dir(os.path.dirname(p) or ".")
+        finally:
+            if os.path.exists(tmp):
+                os.remove(tmp)
+    else:
+        with fs.open(path, "wb") as f:
+            f.write(data)
+    if checksum:
+        from ..resilience.checkpoint import _native_crc, write_sidecar
+
+        write_sidecar(path, _native_crc()(data), len(data))
+
+
 def save(obj: Any, path: str, overwrite: bool = False, *,
          atomic: bool = False, checksum: bool = False):
     """Pickle ``obj`` to ``path``.
@@ -193,27 +239,7 @@ def save(obj: Any, path: str, overwrite: bool = False, *,
         with fs.open(path, "wb") as f:
             pickle.dump(_to_host(obj), f)
         return
-    data = pickle.dumps(_to_host(obj))
-    if atomic and isinstance(fs, _LocalBackend):
-        p = _strip_file_scheme(path)
-        tmp = f"{p}.tmp-{os.getpid()}"
-        try:
-            with open(tmp, "wb") as f:
-                f.write(data)
-                f.flush()
-                os.fsync(f.fileno())
-            os.replace(tmp, p)
-            _fsync_dir(os.path.dirname(p) or ".")
-        finally:
-            if os.path.exists(tmp):
-                os.remove(tmp)
-    else:
-        with fs.open(path, "wb") as f:
-            f.write(data)
-    if checksum:
-        from ..resilience.checkpoint import _native_crc, write_sidecar
-
-        write_sidecar(path, _native_crc()(data), len(data))
+    save_bytes(serialize(obj), path, atomic=atomic, checksum=checksum)
 
 
 def _fsync_dir(path: str):

@@ -37,10 +37,15 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 DEFAULT_LEDGER = os.path.join(REPO, "PERF_LEDGER.jsonl")
 DEFAULT_BASELINE = os.path.join(REPO, "PERF_BASELINE.json")
 
-#: metric -> (direction, relative tolerance).  "higher" = bigger is
-#: better (throughput, MFU); "lower" = smaller is better (latency,
-#: overhead).  Tolerance is the allowed relative regression before the
-#: sentinel fails; a baseline file may override per metric.
+#: metric -> (direction, relative tolerance[, absolute floor]).
+#: "higher" = bigger is better (throughput, MFU, goodput fraction);
+#: "lower" = smaller is better (latency, overhead, stall seconds).
+#: Tolerance is the allowed relative regression before the sentinel
+#: fails; the optional absolute floor passes any regression whose
+#: absolute delta stays under it — without it, a metric whose baseline
+#: is ~0 (e.g. ``checkpoint_blocked_s`` after the async-checkpoint
+#: work) would fail on any nonzero jitter.  A baseline file may
+#: override per metric.
 DEFAULT_TOLERANCES = {
     "value": ("higher", 0.10),
     "mfu": ("higher", 0.10),
@@ -54,6 +59,14 @@ DEFAULT_TOLERANCES = {
     "serving_p99_ms": ("lower", 0.50),
     "elastic_recovery_s": ("lower", 1.00),
     "telemetry_overhead_pct": ("lower", 2.00),
+    # async-everything goodput family (ISSUE 7): the productive
+    # fraction may only rise; stall/blocked seconds may only fall
+    # (small absolute floors absorb scheduler jitter around ~0)
+    "goodput_productive_fraction": ("higher", 0.05),
+    "goodput_accounted_fraction": ("higher", 0.02),
+    "goodput_checkpoint_fraction": ("lower", 0.50, 0.01),
+    "data_stall_s": ("lower", 0.50, 0.50),
+    "checkpoint_blocked_s": ("lower", 0.50, 0.25),
 }
 
 
@@ -90,7 +103,8 @@ def compare(record: dict, baseline: dict) -> dict:
     tolerances = dict(DEFAULT_TOLERANCES)
     for name, spec in (baseline.get("tolerances") or {}).items():
         tolerances[name] = (spec.get("direction", "higher"),
-                            float(spec.get("rel_tol", 0.10)))
+                            float(spec.get("rel_tol", 0.10)),
+                            float(spec.get("abs_tol", 0.0)))
     if record.get("backend") != base_rec.get("backend"):
         return {
             "status": "skipped",
@@ -101,13 +115,17 @@ def compare(record: dict, baseline: dict) -> dict:
         }
     checks = []
     failures = 0
-    for name, (direction, tol) in sorted(tolerances.items()):
+    for name, spec in sorted(tolerances.items()):
+        direction, tol = spec[0], spec[1]
+        abs_tol = spec[2] if len(spec) > 2 else 0.0
         base = base_rec.get(name)
         cur = record.get(name)
         if base is None or not isinstance(base, (int, float)):
             continue  # baseline never measured it: nothing to guard
         check = {"metric": name, "baseline": base, "current": cur,
                  "direction": direction, "rel_tol": tol}
+        if abs_tol:
+            check["abs_tol"] = abs_tol
         if cur is None or not isinstance(cur, (int, float)):
             # a guarded metric VANISHING is a regression (a broken
             # bench section must not read as a pass)
@@ -119,11 +137,17 @@ def compare(record: dict, baseline: dict) -> dict:
             else:
                 delta = (cur - base) / abs(base)
             regression = -delta if direction == "higher" else delta
-            check["delta"] = round(delta, 4)
-            if regression > tol:
+            # absolute worsening, signed toward "worse" for the metric's
+            # direction — what the abs floor is compared against
+            worse_abs = (base - cur) if direction == "higher" \
+                else (cur - base)
+            check["delta"] = (round(delta, 4)
+                              if delta != float("inf") else "inf")
+            if regression > tol and worse_abs > abs_tol:
                 check.update(status="fail",
                              reason="%s regressed %.1f%% (tol %.0f%%)"
-                                    % (name, 100 * regression,
+                                    % (name, min(100 * regression,
+                                                 9999.0),
                                        100 * tol))
                 failures += 1
             else:
@@ -143,8 +167,10 @@ def make_baseline(record: dict, note: str = "") -> dict:
         "frozen_at": _utc_now(),
         "note": note,
         "tolerances": {
-            name: {"direction": d, "rel_tol": t}
-            for name, (d, t) in sorted(DEFAULT_TOLERANCES.items())},
+            name: dict({"direction": spec[0], "rel_tol": spec[1]},
+                       **({"abs_tol": spec[2]} if len(spec) > 2
+                          else {}))
+            for name, spec in sorted(DEFAULT_TOLERANCES.items())},
         "record": record,
     }
 
