@@ -1495,6 +1495,177 @@ def run_telemetry_bench() -> None:
 
 
 # --------------------------------------------------------------------------
+# Sharding leg: the unified plan engine on a composed forced-host mesh
+# --------------------------------------------------------------------------
+
+SHARDING_TIMEOUT = float(os.environ.get("BENCH_SHARDING_TIMEOUT", "300"))
+SHARDING_RESULT = "SHARDING_r01.json"
+
+
+def _sharding_measurements(composed_steps: int = 16, fsdp_steps: int = 10,
+                           batch: int = 8):
+    """The plan-engine leg (ISSUE 8), on 8 forced-host CPU devices:
+
+    * **composed mesh** — a TransformerLM trained over data=2 x pipe=2
+      x model=2 composed on ONE mesh through the one
+      ``compile_step_with_plan`` builder (steps/sec post-compile, loss
+      descending — the 3-D composition the four hand-wired paths could
+      never express);
+    * **FSDP** — a model whose replicated tree would occupy every
+      device in full trains with data-axis param sharding instead;
+      the judged number is the measured per-device addressable param
+      fraction (~1/8 + replicated crumbs) from the telemetry registry.
+    """
+    import jax
+    import numpy as np
+
+    from bigdl_tpu import nn
+    from bigdl_tpu.dataset import Sample
+    from bigdl_tpu.dataset.dataset import array
+    from bigdl_tpu.models.transformer import TransformerLM
+    from bigdl_tpu.optim import SGD, max_iteration
+    from bigdl_tpu.optim.distri_optimizer import DistriOptimizer
+    from bigdl_tpu.telemetry import MetricsRegistry, Telemetry
+    from bigdl_tpu.utils.rng import RNG
+    from jax.sharding import Mesh
+
+    import logging
+
+    if jax.device_count() < 8:
+        raise RuntimeError(
+            f"sharding leg needs 8 forced-host devices, have "
+            f"{jax.device_count()}")
+    bigdl_log = logging.getLogger("bigdl_tpu")
+    prev_level = bigdl_log.level
+    bigdl_log.setLevel(logging.WARNING)
+
+    class _Losses:
+        def __init__(self):
+            self.values = []
+
+        def add_scalar(self, name, value, step):
+            if name == "Loss":
+                self.values.append(float(value))
+
+    def run(model, mesh, steps, data, criterion, lr, fsdp=None):
+        tm = Telemetry(registry=MetricsRegistry())
+        rec = _Losses()
+        opt = DistriOptimizer(model, data, criterion, batch_size=batch,
+                              mesh=mesh)
+        opt.set_optim_method(SGD(learning_rate=lr))
+        opt.set_end_when(max_iteration(steps))
+        opt.set_telemetry(tm)
+        opt.set_train_summary(rec)
+        if fsdp:
+            opt.set_fsdp(fsdp)
+        t0 = time.monotonic()
+        opt.optimize()
+        wall = time.monotonic() - t0
+        compile_s = float(tm.compile_seconds.sum)
+        sps = (steps - 1) / max(wall - compile_s, 1e-9)
+        snap = tm.registry.snapshot()["metrics"]
+
+        def gauge(name):
+            series = (snap.get(name) or {}).get("series") or []
+            return float(series[0]["value"]) if series else None
+
+        return {"wall_s": round(wall, 3), "compile_s": round(compile_s, 3),
+                "steps_per_sec": round(sps, 3), "losses": rec.values,
+                "param_bytes_per_device": gauge(
+                    "bigdl_plan_param_bytes_per_device"),
+                "param_bytes_total": gauge("bigdl_plan_param_bytes_total")}
+
+    try:
+        # --- composed data=2 x pipe=2 x model=2 ------------------------
+        V, T = 17, 8
+        RNG().set_seed(6)
+        lm = TransformerLM(V, embed_dim=8, num_heads=2, num_layers=2,
+                           max_len=T, model_axis="model")
+        rng = np.random.RandomState(3)
+        seqs = rng.randint(1, V, (32, T + 1))
+        lm_data = array([Sample(s[:-1].astype(np.float32),
+                                (s[1:] + 1).astype(np.float32))
+                         for s in seqs])
+        crit = nn.TimeDistributedCriterion(nn.ClassNLLCriterion(), True)
+        mesh = Mesh(np.array(jax.devices()).reshape(2, 2, 2),
+                    ("data", "pipe", "model"))
+        composed = run(lm, mesh, composed_steps, lm_data, crit, lr=0.5)
+
+        # --- FSDP on the full data mesh --------------------------------
+        RNG().set_seed(4)
+        mlp = nn.Sequential(nn.Linear(256, 512), nn.Tanh(),
+                            nn.Linear(512, 512), nn.Tanh(),
+                            nn.Linear(512, 2), nn.LogSoftMax())
+        rng = np.random.RandomState(0)
+        xs = rng.rand(64, 256).astype(np.float32)
+        ys = (1 + (xs.sum(1) > 128)).astype(np.float32)
+        mlp_data = array([Sample(x, y) for x, y in zip(xs, ys)])
+        fsdp = run(mlp, None, fsdp_steps, mlp_data,
+                   nn.ClassNLLCriterion(), lr=0.1, fsdp=64 * 1024)
+    finally:
+        bigdl_log.setLevel(prev_level)
+
+    frac = None
+    if fsdp["param_bytes_per_device"] and fsdp["param_bytes_total"]:
+        frac = fsdp["param_bytes_per_device"] / fsdp["param_bytes_total"]
+    cl = composed["losses"]
+    return {
+        "devices": 8,
+        "composed_mesh": "data=2 x pipe=2 x model=2",
+        "composed_steps": composed_steps,
+        "composed_steps_per_sec": composed["steps_per_sec"],
+        "composed_wall_s": composed["wall_s"],
+        "composed_compile_s": composed["compile_s"],
+        "composed_loss_first": round(cl[0], 5) if cl else None,
+        "composed_loss_last": round(cl[-1], 5) if cl else None,
+        "composed_loss_descending": bool(cl and cl[-1] < cl[0]),
+        "fsdp_steps": fsdp_steps,
+        "fsdp_steps_per_sec": fsdp["steps_per_sec"],
+        "fsdp_param_bytes_per_device": fsdp["param_bytes_per_device"],
+        "fsdp_param_bytes_total": fsdp["param_bytes_total"],
+        "fsdp_param_bytes_frac": round(frac, 4) if frac else None,
+        "fsdp_loss_descending": bool(
+            fsdp["losses"] and fsdp["losses"][-1] < fsdp["losses"][0]),
+    }
+
+
+def run_sharding_bench() -> None:
+    """--sharding mode: run the composed-mesh + FSDP plan-engine legs
+    on 8 forced-host CPU devices, write SHARDING_r01.json, print the
+    one JSON line."""
+    # must run before first backend use: the host-platform device count
+    # is an XLA client flag, not a jax config knob
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8").strip()
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    out = {"bench": "sharding", "backend": "cpu",
+           "forced_host_devices": 8, "measured_at": _utc_now()}
+    try:
+        out.update(_sharding_measurements())
+        out.update({
+            "metric": "composed-mesh (data x pipe x model) plan-engine "
+                      "throughput",
+            "value": out.get("composed_steps_per_sec", 0.0),
+            "unit": "steps/sec",
+        })
+    except Exception as e:
+        out["error"] = f"{type(e).__name__}: {e}"[:500]
+        out.update({"metric": "composed-mesh (data x pipe x model) "
+                              "plan-engine throughput",
+                    "value": 0.0, "unit": "steps/sec"})
+    try:
+        with open(os.path.join(_here(), SHARDING_RESULT), "w") as f:
+            json.dump(out, f, indent=1)
+    except OSError:
+        pass
+    print(json.dumps(out), flush=True)
+
+
+# --------------------------------------------------------------------------
 # Perf ledger: the append-only trajectory record the sentinel guards
 # --------------------------------------------------------------------------
 
@@ -1517,6 +1688,7 @@ LEDGER_FIELDS = (
     "goodput_productive_fraction", "goodput_accounted_fraction",
     "goodput_checkpoint_fraction", "data_stall_s",
     "checkpoint_blocked_s",
+    "sharding_composed_steps_per_sec", "sharding_fsdp_param_bytes_frac",
     "vs_baseline",
 )
 
@@ -1543,6 +1715,13 @@ def ledger_record(result: dict) -> dict:
                 "goodput_checkpoint_fraction", "data_stall_s",
                 "checkpoint_blocked_s"):
         flat[key] = telemetry.get(key)
+    # the sharding-plan engine leg (ISSUE 8): composed-mesh throughput
+    # may only rise; the FSDP per-device param fraction may only fall
+    sharding = result.get("sharding") or {}
+    flat["sharding_composed_steps_per_sec"] = sharding.get(
+        "composed_steps_per_sec")
+    flat["sharding_fsdp_param_bytes_frac"] = sharding.get(
+        "fsdp_param_bytes_frac")
     rec = {"schema": LEDGER_SCHEMA,
            "ts": result.get("measured_at") or _utc_now(),
            "recorded_at": _utc_now()}
@@ -1895,6 +2074,30 @@ def main(ledger: bool = True, probe: bool = True) -> None:
                          or "telemetry leg returned nothing"}
     result["telemetry"] = telemetry
 
+    # sharding leg: the unified plan engine on a composed forced-host
+    # CPU mesh (data x pipe x model + FSDP; backend-independent, lands
+    # in SHARDING_r01.json) — best-effort like the other legs;
+    # BENCH_SHARDING_TIMEOUT=0 disables it.
+    if SHARDING_TIMEOUT <= 0:
+        sharding = {"skipped": "BENCH_SHARDING_TIMEOUT=0"}
+    else:
+        ok, shres, note = _run_sub(["--sharding"], SHARDING_TIMEOUT)
+        if ok and shres and "error" not in shres:
+            sharding = {
+                "composed_steps_per_sec": shres.get(
+                    "composed_steps_per_sec"),
+                "composed_loss_descending": shres.get(
+                    "composed_loss_descending"),
+                "fsdp_param_bytes_frac": shres.get(
+                    "fsdp_param_bytes_frac"),
+                "fsdp_steps_per_sec": shres.get("fsdp_steps_per_sec"),
+                "source": SHARDING_RESULT,
+            }
+        else:
+            sharding = {"error": (shres or {}).get("error") or note
+                        or "sharding leg returned nothing"}
+    result["sharding"] = sharding
+
     if not from_tpu:
         # the tunnel dies for hours at a time: the judged artifact must
         # still CARRY the chip numbers, honestly stamped — merge the
@@ -1922,11 +2125,11 @@ def main(ledger: bool = True, probe: bool = True) -> None:
                           "lenet5_images_per_sec", "error")
                 if result.get(k) is not None}
             # the control-plane legs (serving/elastic/integrity/
-            # telemetry) are backend-independent and were measured
-            # LIVE this run — they must not be shadowed by whatever
-            # the stale chip record carried
+            # telemetry/sharding) are backend-independent and were
+            # measured LIVE this run — they must not be shadowed by
+            # whatever the stale chip record carried
             for leg in ("serving", "elastic", "integrity",
-                        "telemetry"):
+                        "telemetry", "sharding"):
                 if result.get(leg) is not None:
                     merged[leg] = result[leg]
             result = merged
@@ -1950,6 +2153,7 @@ if __name__ == "__main__":
     p.add_argument("--elastic", action="store_true")
     p.add_argument("--integrity", action="store_true")
     p.add_argument("--telemetry", action="store_true")
+    p.add_argument("--sharding", action="store_true")
     p.add_argument("--worker", choices=["tpu", "cpu"])
     # every orchestrated run appends to PERF_LEDGER.jsonl by default;
     # --no-ledger keeps scratch runs out of the judged trajectory
@@ -1972,6 +2176,8 @@ if __name__ == "__main__":
         run_integrity_bench()
     elif a.telemetry:
         run_telemetry_bench()
+    elif a.sharding:
+        run_sharding_bench()
     elif a.worker:
         run_worker(a.worker)
     else:

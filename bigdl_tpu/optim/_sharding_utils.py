@@ -21,6 +21,17 @@ def round_up(n: int, multiple: int) -> int:
     return -(-n // multiple) * multiple
 
 
+def maskable(y, n_records: int) -> bool:
+    """Pad-and-mask vmaps the per-record loss over every target leaf:
+    any pytree (array / tuple / Table) of record-leading arrays works."""
+    import jax
+
+    leaves = jax.tree_util.tree_leaves(y)
+    return bool(leaves) and all(
+        hasattr(v, "shape") and getattr(v, "ndim", 0) >= 1
+        and v.shape[0] == n_records for v in leaves)
+
+
 def pad_batch(x, y, size: int, target: int):
     """Pad a (possibly multi-input) batch to ``target`` records by
     repeating the last record (keeps padded rows numerically valid,

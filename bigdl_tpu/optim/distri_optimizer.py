@@ -2,50 +2,61 @@
 (reference optim/DistriOptimizer.scala:41-846, SURVEY §3.1).
 
 The reference's iteration is two Spark jobs + a block-manager all-reduce.
-Here the ENTIRE iteration — forward, backward, gradient reduce-scatter,
-slice-owned optimizer update, weight all-gather — is one shard_mapped,
-jitted program over the mesh's ``data`` axis, so the collectives ride
-ICI and overlap with compute under XLA's scheduler:
+Here the ENTIRE iteration — forward, backward, gradient reduction,
+optimizer update — is one shard_mapped, jitted program built by the
+unified sharding-plan engine (``parallel.plan.compile_step_with_plan``,
+ISSUE 8):
 
   reference                                    this step
   ---------                                    ---------
-  getWeights (all-gather via BlockManager)  →  lax.all_gather (in-step)
+  getWeights (all-gather via BlockManager)  →  plan-sharded params stay
+                                               device-resident (FSDP
+                                               leaves gather on use)
   forward/backward per core clone           →  vectorized local batch
-  putGradients + aggregateGradientPartition →  lax.psum_scatter
-  optimMethod on owned slice                →  optim.step on slice
-  sendWeightPartition                       →  (next step's all_gather)
+  putGradients + aggregateGradientPartition →  plan-derived pmean/psum_
+                                               scatter per leaf
+  optimMethod on owned slice                →  optim.step on the plan's
+                                               local slice
+  sendWeightPartition                       →  (next step's gather)
 
-Failure handling mirrors the reference's driver retry loop
+One driver loop (``Optimizer._plan_loop``) serves EVERY mesh shape —
+data-only, data x model [x seq], data x pipe [x model] composed on one
+mesh — this class only routes: normalize the mesh, validate batch
+divisibility, and hand the template to the shared plan driver.  Failure
+handling mirrors the reference's driver retry loop
 (DistriOptimizer.scala:750-816): on exception the driver reloads the
-latest checkpoint and resumes, bounded by retry count in a time window.
+latest checkpoint and resumes, bounded by retry count in a time window;
+under an elastic context the mesh AND plan are re-derived per attempt
+from the live membership (shrink keeps the template's model/pipe axes).
 """
 from __future__ import annotations
 
 import logging
-import os
-import time
-from functools import partial
 from typing import Optional
 
-import jax
-import jax.numpy as jnp
 import numpy as np
-from jax.sharding import Mesh, PartitionSpec as P
+from jax.sharding import Mesh
 
 from ..nn.module import AbstractModule
-from ..parallel.all_reduce import AllReduceParameter, shard_batch
-from ..resilience.guards import tree_finite, where_tree
-from ..utils.engine import Engine, get_property
-from ..utils.rng import next_jax_key
-from ..utils.table import T
-from ._sharding_utils import data_mesh, pad_batch, round_up
-from .optimizer import (Optimizer, _cast_floats, _device_batch,
-                        _restore_dtypes)
-from .regularizer import collect_regularizer_paths, regularizer_loss
+from ..utils.engine import Engine
+from ._sharding_utils import maskable as _maskable  # noqa: F401 (compat)
+from .optimizer import Optimizer
 
 log = logging.getLogger("bigdl_tpu")
 
-from ..utils.jax_compat import shard_map
+
+def normalize_mesh(mesh: Mesh) -> Mesh:
+    """Drop size-1 axes (the 4-axis default mesh collapses to the axes
+    actually in use; a pure-data run never routes through the pipeline
+    layout by accident).  An all-ones mesh keeps a 1-device data axis."""
+    names = [a for a in mesh.axis_names if mesh.shape[a] > 1]
+    if tuple(mesh.axis_names) == tuple(names):
+        return mesh
+    devs = np.asarray(mesh.devices).reshape(-1)
+    if not names:
+        return Mesh(devs[:1], ("data",))
+    shape = [int(mesh.shape[a]) for a in names]
+    return Mesh(devs.reshape(shape), tuple(names))
 
 
 class DistriOptimizer(Optimizer):
@@ -56,9 +67,6 @@ class DistriOptimizer(Optimizer):
                  mesh: Optional[Mesh] = None):
         super().__init__(model, dataset, criterion, batch_size, end_trigger)
         self.mesh = mesh
-        # how the last profiled iteration's phase split was measured:
-        # "trace" (jax.profiler device events) or "probe" (fallback)
-        self.phase_source = None
         # retry policy compat aliases (reference
         # DistriOptimizer.scala:750-752); the actual loop lives in
         # resilience.retry.RetryPolicy (exponential backoff + jitter +
@@ -66,178 +74,22 @@ class DistriOptimizer(Optimizer):
         self.max_retry = self.retry_policy.max_retries
         self.retry_window = self.retry_policy.window
 
-    # ------------------------------------------------------------------
-    def _build_step(self, mesh, arp: AllReduceParameter, masked=False):
-        """One compiled, shard_mapped iteration.
-
-        ``masked=True`` builds the trailing-partial-batch variant: the
-        batch arrives padded to the mesh multiple with a per-record
-        weight vector ``w`` (1 real / 0 pad) and a global real-record
-        count ``total_w``; the loss is the weighted per-record mean, so
-        every record of an epoch trains exactly once at static shape
-        (reference trains every record, DataSet.scala:255-288).
-        """
-        model, criterion, optim = self.model, self.criterion, self.optim_method
-        from ..parallel.moe import aux_loss_term, collect_aux_paths
-
-        reg_paths = list(collect_regularizer_paths(model))
-        aux_paths = list(collect_aux_paths(model))
-        scale_tree = model.gradient_scale_tree()
-        needs_scale = any(s != 1.0
-                          for s in jax.tree_util.tree_leaves(scale_tree))
-        axis = "data"
-        n_dev = arp.partition_num
-        cdtype = self.compute_dtype
-        guard = self.gradient_guard
-        # f32-accumulating criterions (fused xent) take bf16 output as-is
-        upcast_out = not getattr(criterion, "accepts_low_precision", False)
-
-        def step(params, buffers, slots, lr, rng, x, y, *mask_args):
-            # decorrelate dropout across shards
-            rng = jax.random.fold_in(rng, jax.lax.axis_index(axis))
-
-            def loss_fn(p):
-                p_c, x_c = p, x
-                if cdtype is not None:
-                    # bf16 compute, f32 master weights: grads return f32
-                    # through the cast's vjp; the slice-owned update below
-                    # stays full precision (TPU analogue of the fp16 wire
-                    # codec, reference FP16CompressedTensor.scala:26)
-                    p_c = _cast_floats(p, cdtype)
-                    x_c = _cast_floats(x, cdtype)
-                out, nb = model.apply_fn(p_c, buffers, x_c, True, rng)
-                if cdtype is not None:
-                    if upcast_out:
-                        out = _cast_floats(out, jnp.float32)
-                    nb = _restore_dtypes(nb, buffers)
-                if masked:
-                    w, total_w = mask_args
-                    add_axis = lambda v: jax.tree_util.tree_map(
-                        lambda a: a[None], v)
-                    per = jax.vmap(
-                        lambda o, t: criterion._loss(add_axis(o),
-                                                     add_axis(t)))(out, y)
-                    # local weighted sum over the GLOBAL real count: the
-                    # later cross-shard gradient sum yields the global
-                    # weighted-mean gradient with no extra divide
-                    loss = jnp.sum(per * w) / total_w
-                    if reg_paths:
-                        loss = loss + regularizer_loss(p, reg_paths) / n_dev
-                    if aux_paths:  # MoE balance term, same /n_dev rule
-                        loss = loss + aux_loss_term(nb, aux_paths) / n_dev
-                else:
-                    loss = criterion._loss(out, y)
-                    if reg_paths:
-                        loss = loss + regularizer_loss(p, reg_paths)
-                    if aux_paths:
-                        loss = loss + aux_loss_term(nb, aux_paths)
-                return loss, nb
-
-            (loss, new_buffers), grads = jax.value_and_grad(
-                loss_fn, has_aux=True)(params)
-            if needs_scale:  # reference setScaleW/setScaleB semantics
-                grads = jax.tree_util.tree_map(lambda g, s: g * s,
-                                               grads, scale_tree)
-            # reduce-scatter: my summed gradient slice; the plain path
-            # averages over shards, the masked path is already globally
-            # normalized by total_w
-            g_slice = arp.reduce_scatter_gradients(grads)
-            if not masked:
-                g_slice = g_slice / n_dev
-            # global gradient norm from the already-reduced slices (the
-            # flight recorder's fingerprint): psum of per-slice sum-sq
-            # is exactly ||global grad||^2, one scalar collective
-            gnorm = jnp.sqrt(jax.lax.psum(
-                sum(jnp.vdot(g, g).astype(jnp.float32)
-                    for g in jax.tree_util.tree_leaves(g_slice)), axis))
-            w_slice = arp.my_weight_slice(params)
-            new_w_slice, new_slots = optim.step(g_slice, w_slice, slots, lr)
-            if guard:
-                # anomaly guard: a NaN/Inf reduced-gradient slice (or
-                # loss) on ANY shard skips the whole update — pmin makes
-                # every shard agree, so the selected slices stay
-                # consistent through the all-gather below
-                ok_local = jnp.logical_and(tree_finite(g_slice),
-                                           jnp.isfinite(loss))
-                ok = jax.lax.pmin(ok_local.astype(jnp.int32), axis) > 0
-                new_w_slice = where_tree(ok, new_w_slice, w_slice)
-                new_slots = where_tree(ok, new_slots, slots)
-            else:
-                ok = jnp.bool_(True)
-            new_params = arp.all_gather_weights(new_w_slice)
-            if masked:
-                # padded rows would pollute batch statistics (BatchNorm
-                # running mean/var): keep the pre-step buffers for the
-                # trailing partial batch
-                new_buffers = buffers
-            else:
-                # BN running stats etc.: average across shards (sync-BN)
-                new_buffers = jax.tree_util.tree_map(
-                    lambda b: jax.lax.pmean(b, axis), new_buffers)
-            if guard:
-                new_buffers = where_tree(ok, new_buffers, buffers)
-            loss = (jax.lax.psum(loss, axis) if masked
-                    else jax.lax.pmean(loss, axis))
-            return loss, new_params, new_buffers, new_slots, ok, gnorm
-
-        in_specs = (P(), P(), P(axis), P(), P(), P(axis), P(axis))
-        if masked:
-            in_specs = in_specs + (P(axis), P())
-        out_specs = (P(), P(), P(), P(axis), P(), P())
-        # check_vma=False: params come back through all_gather of an
-        # axis_index-derived slice, which the static replication checker
-        # can't prove replicated (it is — every shard gathers all slices).
-        sharded = shard_map(step, mesh=mesh, in_specs=in_specs,
-                            out_specs=out_specs, check_vma=False)
-        # donate params/buffers/slots: in-place HBM update — old+new
-        # copies never coexist (the product-driver MFU fix, VERDICT r2)
-        return jax.jit(sharded, donate_argnums=(0, 1, 2))
-
-    def _build_grad_probe(self, mesh):
-        """Collective-free forward+backward used on profiling iterations
-        to split step time into compute vs gradient-aggregation — fills
-        the reference's per-phase Metrics contract with measured numbers
-        (Metrics.scala:103-121, DistriOptimizer.scala:146-151)."""
-        from ..parallel.moe import aux_loss_term, collect_aux_paths
-
-        model, criterion = self.model, self.criterion
-        reg_paths = list(collect_regularizer_paths(model))
-        aux_paths = list(collect_aux_paths(model))
-        axis = "data"
-
-        def grad_only(params, buffers, rng, x, y):
-            rng = jax.random.fold_in(rng, jax.lax.axis_index(axis))
-
-            def loss_fn(p):
-                out, nb = model.apply_fn(p, buffers, x, True, rng)
-                loss = criterion._loss(out, y)
-                if reg_paths:
-                    loss = loss + regularizer_loss(p, reg_paths)
-                if aux_paths:  # mirror the real step's backward exactly
-                    loss = loss + aux_loss_term(nb, aux_paths)
-                return loss, nb
-
-            (loss, _), grads = jax.value_and_grad(
-                loss_fn, has_aux=True)(params)
-            # consume every gradient so none is dead-code-eliminated; the
-            # scalar psum is negligible next to the full-tensor collectives
-            gnorm = jax.lax.psum(
-                sum(jnp.vdot(g, g)
-                    for g in jax.tree_util.tree_leaves(grads)), axis)
-            return jax.lax.pmean(loss, axis), gnorm
-
-        sharded = shard_map(
-            grad_only, mesh=mesh,
-            in_specs=(P(), P(), P(), P(axis), P(axis)),
-            out_specs=(P(), P()), check_vma=False)
-        return jax.jit(sharded)
+    def _with_retry(self, fn):
+        """Driver retry-from-checkpoint loop shared by every mesh shape
+        (reference DistriOptimizer.scala:750-816), routed through
+        resilience.retry.RetryPolicy.  A caller-mutated ``max_retry``/
+        ``retry_window`` (the compat aliases) wins over the policy's
+        property-derived values."""
+        self.retry_policy.max_retries = int(self.max_retry)
+        self.retry_policy.window = float(self.retry_window)
+        return super()._with_retry(fn)
 
     # ------------------------------------------------------------------
     def optimize(self) -> AbstractModule:
         self._warn_drop_knobs_if_inert()
         try:
             with self._preemption_scope():
-                return self._optimize_routed()
+                return self._plan_optimize(self._route_mesh())
         finally:
             # in-flight async saves must commit even when the loop
             # exits abnormally (Ctrl-C, exhausted retries): background
@@ -245,898 +97,38 @@ class DistriOptimizer(Optimizer):
             self._shutdown_async_writer()
             self._orbax_close()
 
-    def _optimize_routed(self) -> AbstractModule:
+    def _route_mesh(self) -> Mesh:
+        """Resolve + validate the training mesh.  All composition
+        decisions now live in the plan engine — this only enforces the
+        reference's batch-divisibility contract and the one unsupported
+        combination (seq x pipe)."""
         mesh = self.mesh
         if mesh is None:
             mesh = Engine.create_mesh()
-        # a mesh with a real model/seq axis routes to the multi-axis SPMD
-        # step (parallel/spmd.py: tensor + sequence parallelism composed
-        # with data parallelism in one program); a pure-data mesh keeps
-        # the reference-shaped AllReduceParameter path below
-        # a mesh with a real pipe axis routes to the GPipe pipeline
-        # driver (parallel/pipeline.py: stage-sharded block stack,
-        # microbatch schedule, derived backward)
-        if "pipe" in mesh.axis_names and mesh.shape["pipe"] > 1:
-            if "seq" in mesh.axis_names and mesh.shape["seq"] > 1:
+        mesh = normalize_mesh(mesh)
+        if "pipe" in mesh.axis_names and "seq" in mesh.axis_names:
+            raise ValueError(
+                "the pipeline layout composes with data and model "
+                "axes; a >1 seq axis is not supported with pipe — "
+                "use a data x pipe [x model] mesh, or a seq mesh "
+                "without pipe.")
+        n_data = mesh.shape.get("data", 1)
+        n_mb = 1
+        if "pipe" in mesh.axis_names:
+            n_mb = self.pipeline_microbatch or mesh.shape["pipe"]
+        if self.batch_size is not None and self.elastic is None \
+                and self.batch_size % (n_data * n_mb) != 0:
+            if n_mb > 1:
                 raise ValueError(
-                    "the pipeline driver composes with data and model "
-                    "axes; a >1 seq axis is not supported with pipe — "
-                    "use a data x pipe [x model] mesh, or a seq mesh "
-                    "without pipe.")
-            return self._optimize_pipeline(mesh)
-        extra_axes = [a for a in ("model", "seq")
-                      if a in mesh.axis_names and mesh.shape[a] > 1]
-        # an expert-parallel model (bound MoEFFN) needs the SPMD path
-        # even on a pure-data mesh: its expert stacks are sharded, which
-        # the replicated AllReduceParameter plane cannot express
-        from ..parallel.moe import MoEFFN
-
-        has_ep = any(isinstance(m, MoEFFN) and m.axis_name
-                     for m in self.model.modules_iter())
-        if extra_axes or has_ep:
-            return self._optimize_multi_axis(mesh)
-        # collapse to a pure-data mesh if caller handed the 4-axis default
-        mesh = data_mesh(mesh)
-        n_dev = mesh.shape["data"]
-        if self.elastic is not None:
-            # elastic data path: the mesh is derived PER ATTEMPT from
-            # the live membership — on a shrink/regrow the retry loop
-            # restores the verified checkpoint and re-enters here with
-            # the survivors' mesh at the largest valid shard count
-            self.elastic.attach(n_devices=len(jax.devices()),
-                                batch_size=self.batch_size)
-
-            def attempt():
-                self._elastic_begin()
-                m = self.elastic.current_mesh()
-                return self._optimize_once(m, m.shape["data"])
-
-            return self._with_retry(attempt)
-        if self.batch_size is not None and self.batch_size % n_dev != 0:
-            raise ValueError(
-                f"batch size {self.batch_size} must be divisible by the "
-                f"mesh's data-axis size {n_dev} (reference Optimizer.scala:417 "
-                "requires batchSize % nodeNumber == 0)")
-
-        return self._with_retry(lambda: self._optimize_once(mesh, n_dev))
-
-    # ------------------------------------------------------------------
-    # multi-axis (data x seq x model) SPMD path
-    # ------------------------------------------------------------------
-    def _optimize_multi_axis(self, mesh) -> AbstractModule:
-        """Full Optimizer lifecycle over a multi-axis mesh: the step is
-        ``parallel.spmd.make_train_step`` (tensor-parallel param specs,
-        sequence sharding, pmean'd grads — one compiled program), the
-        lifecycle (triggers, canonical log line, summaries, checkpoint,
-        retry-from-checkpoint) is the same contract as the data path.
-        Exceeds reference parity by design (the reference is data-only,
-        SURVEY §2.2); the data-parallel path is unchanged."""
-        n_data = mesh.shape.get("data", 1)
-        if self.batch_size is not None and self.batch_size % n_data != 0:
-            raise ValueError(
-                f"batch size {self.batch_size} must be divisible by the "
-                f"mesh's data-axis size {n_data}")
-
-        def attempt():
-            # elastic on a multi-axis mesh: heartbeats, watchdog and
-            # straggler tracking apply; a membership change restores the
-            # checkpoint and re-enters on the SAME mesh (multi-axis
-            # shard shrink is not derived — see docs/elastic.md)
-            self._elastic_begin()
-            return self._optimize_multi_axis_once(mesh)
-
-        return self._with_retry(attempt)
-
-    def _with_retry(self, fn):
-        """Driver retry-from-checkpoint loop shared by every mesh path
-        (reference DistriOptimizer.scala:750-816), now routed through
-        resilience.retry.RetryPolicy: exponential backoff + jitter
-        between attempts, fatal errors never retried.  A caller-mutated
-        ``max_retry``/``retry_window`` (the compat aliases) wins over
-        the policy's property-derived values."""
-        self.retry_policy.max_retries = int(self.max_retry)
-        self.retry_policy.window = float(self.retry_window)
-        return super()._with_retry(fn)
-
-    def _optimize_multi_axis_once(self, mesh) -> AbstractModule:
-        from jax.sharding import NamedSharding
-
-        from ..parallel.spmd import make_train_step
-        from .optimizer import _epoch_records, _resume_slots
-
-        self._tm_attempt_begin()
-        model, optim = self.model, self.optim_method
-        model.training()
-        n_data = mesh.shape.get("data", 1)
-        n_seq = mesh.shape.get("seq", 1)
-
-        step = make_train_step(model, self.criterion, optim, mesh,
-                               input_seq_dim=1 if n_seq > 1 else None,
-                               compute_dtype=self.compute_dtype, donate=True)
-        put = lambda tree, specs: jax.tree_util.tree_map(
-            lambda a, s: jax.device_put(a, NamedSharding(mesh, s)),
-            tree, specs)
-        params = put(model.param_tree(), step.param_specs)
-        slots = _resume_slots(optim, optim.init_state(params))
-        slots = put(slots, step.slot_specs)
-        # device_put COPIES: the step donates its inputs, and a retry
-        # must not hand the model's own (now-deleted) arrays back in
-        buffers = put(model.buffer_tree(),
-                      jax.tree_util.tree_map(lambda _: P(),
-                                             model.buffer_tree()))
-
-        state = optim.state
-        state["epoch"] = state.get("epoch", 1)
-        state["neval"] = state.get("neval", 1)
-        state["epoch_finished"] = False
-        epoch_size = _epoch_records(self.dataset)
-        data_iter = self.dataset.data(train=True)
-        records_this_epoch = self._consume_resume_cursor(data_iter,
-                                                         epoch_size)
-        wall_start = time.time()
-        return self._multi_axis_loop(
-            mesh, model, optim, step, n_data, n_seq, state, epoch_size,
-            data_iter, records_this_epoch, wall_start, params, slots,
-            buffers)
-
-    def _multi_axis_loop(self, mesh, model, optim, step, n_data, n_seq,
-                         state, epoch_size, data_iter,
-                         records_this_epoch, wall_start, params, slots,
-                         buffers) -> AbstractModule:
-        """The multi-axis driver loop, feed-based: batch N+1's host
-        prep overlaps the compiled step on batch N (this path used to
-        fetch synchronously every iteration)."""
-        eval_fwd = None  # built lazily on the first validation trigger
-        feed = self._make_feed(data_iter, epoch_size, records_this_epoch)
-        first_step = True  # first dispatch = XLA build (telemetry)
-        try:
-            while not self.end_when(state):
-                state["epoch_finished"] = False
-                self._elastic_step_start(state)
-                item, stall_time = feed.get()
-                batch, x, y = item
-                n_records = batch.size()
-                mask_kw = {}
-                if n_records % n_data != 0:
-                    # trailing partial batch: pad whole records to the
-                    # data-axis multiple and train the real ones via
-                    # the per-record weight mask (every-record
-                    # guarantee on the multi-axis mesh too; pad rows
-                    # only touch the data axis, so seq/model sharding
-                    # composes unchanged)
-                    if not _maskable(y, n_records):
-                        raise ValueError(
-                            "multi-axis training got a trailing partial "
-                            f"batch of {n_records} records but the "
-                            "targets are not record-leading arrays for "
-                            "pad-and-mask; size the dataset to a batch "
-                            "multiple")
-                    x, y, w = pad_batch(x, y, n_records,
-                                        round_up(n_records, n_data))
-                    mask_kw = {"w": w, "total_w": float(n_records)}
-                if n_seq > 1:
-                    bad = [a.shape for a in jax.tree_util.tree_leaves(x)
-                           if getattr(a, "ndim", 0) > 1
-                           and a.shape[1] % n_seq != 0]
-                    if bad:
-                        raise ValueError(
-                            f"sequence dim of inputs {bad} must be "
-                            f"divisible by the mesh's seq-axis size "
-                            f"{n_seq}; pad sequences to a multiple")
-                # host prep overlapped the previous step on the feed's
-                # producer thread — only the real buffer stall remains
-                infeed_time = stall_time
-
-                lr = optim.get_current_lr()
-                t0 = time.time()
-                if first_step and not mask_kw \
-                        and self.telemetry is not None:
-                    # cost-model analysis of the fused multi-axis
-                    # program (inside the first step's timed window,
-                    # ledgered as COMPILE); the constant key only
-                    # shapes the trace.  Wire-byte estimate: the
-                    # data-axis gradient all-reduce (~2(n-1)/n of param
-                    # bytes); tensor/seq activation collectives ride
-                    # inside the program uncounted.
-                    self._tm_analyze(
-                        step.jitted_for(x, y, False), params, slots,
-                        buffers, jnp.float32(lr), jax.random.PRNGKey(0),
-                        x, y,
-                        collective_bytes=(2.0 * (n_data - 1)
-                                          / max(n_data, 1)
-                                          * self._tree_bytes(params)))
-                loss, params, slots, buffers = self._elastic_dispatch(
-                    lambda: step(params, slots, buffers, lr, x, y,
-                                 rng=next_jax_key(), **mask_kw), state)
-                loss = float(loss)  # value fetch = execution barrier
-                train_time = time.time() - t0
-                self._tm_step(state, train_time, infeed_time, n_records,
-                              compiled=first_step)
-                first_step = False
-                self._check_loss_anomaly(loss, skipped=False)
-                params = self._maybe_corrupt_params(state, params)
-                # fused multi-axis step: grad norm is not a program
-                # output
-                self._record_fingerprint(state, loss, None, (x, y),
-                                         lambda: params)
-                self._integrity_step(state, lambda: params)
-
-                records_this_epoch += n_records
-                state["records_this_epoch"] = records_this_epoch
-                state["loss"] = loss
-                # metric-name contract (reference
-                # DistriOptimizer.scala:146-151); collectives are fused
-                # into the one program here, so the wall time is
-                # attributed to compute (no trace split on this path)
-                self.metrics.add("computing time average", train_time)
-                self.metrics.add("aggregate gradient time", 0.0)
-                self.metrics.add("get weights average", infeed_time)
-                log.info(
-                    "[Epoch %d %d/%d][Iteration %d][Wall Clock %.3fs] "
-                    "Train %d in %.4f seconds. Throughput is %.1f "
-                    "records/second. Loss is %.5f.",
-                    state["epoch"], records_this_epoch, epoch_size,
-                    state["neval"], time.time() - wall_start, n_records,
-                    train_time + infeed_time,
-                    n_records / max(train_time + infeed_time, 1e-9),
-                    loss)
-                if self.train_summary is not None:
-                    self.train_summary.add_scalar("Loss", loss,
-                                                  state["neval"])
-                    self.train_summary.add_scalar(
-                        "Throughput",
-                        n_records / max(train_time + infeed_time, 1e-9),
-                        state["neval"])
-
-                state["neval"] += 1
-                optim.state = state
-                if records_this_epoch >= epoch_size:
-                    state["epoch"] += 1
-                    state["epoch_finished"] = True
-                    records_this_epoch = 0
-                    state["records_this_epoch"] = 0
-                    # the producer met its epoch budget and is parked —
-                    # the shuffle cannot race a fetch; reset re-arms
-                    # the same producer thread on the fresh iterator
-                    self.dataset.shuffle()
-                    data_iter = self.dataset.data(train=True)
-                    feed.reset(data_iter, epoch_size, 0)
-
-                # evaluate each trigger exactly once per iteration:
-                # stateful user triggers must not see a second call,
-                # and the action below must never run without the
-                # host-param sync above it
-                do_validate = (self.validation_trigger is not None
-                               and self.validation_trigger(state))
-                do_checkpoint = (self.checkpoint_trigger is not None
-                                 and self.checkpoint_trigger(state))
-                if do_validate:
-                    if eval_fwd is None:
-                        from ..parallel.spmd import make_eval_forward
-
-                        eval_fwd = make_eval_forward(
-                            model, mesh,
-                            input_seq_dim=1 if n_seq > 1 else None,
-                            compute_dtype=self.compute_dtype,
-                            output_seq_dim=self.validation_output_seq_dim)
-                    self._validate_multi_axis(state, eval_fwd, params,
-                                              buffers, n_data, n_seq)
-                if do_checkpoint or self._preempted():
-                    if self.checkpoint_format == "orbax":
-                        # sharded async save straight from the device
-                        # trees
-                        self._orbax_save(state, self._orbax_tree(
-                            params, slots, buffers), kind="model")
-                    else:
-                        # host-gather the sharded params for the
-                        # checkpoint (model-sharded leaves reassemble
-                        # on fetch)
-                        model.set_param_tree(jax.device_get(params))
-                        model.set_buffer_tree(jax.device_get(buffers))
-                        optim._slots = jax.device_get(slots)
-                        self._checkpoint(state)
-                if self._preempted():
-                    self._drain_checkpoints()
-                    log.warning("preemption requested — checkpointed at "
-                                "iteration %d; exiting resumable",
-                                state["neval"] - 1)
-                    break
-        finally:
-            feed.close()
-
-        model.set_param_tree(jax.device_get(params))
-        model.set_buffer_tree(jax.device_get(buffers))
-        optim._slots = jax.device_get(slots)
-        model.evaluate()
-        # drain-on-exit barrier: every triggered checkpoint is durable
-        self._drain_checkpoints()
-        self._orbax_close()
-        self._tm_finish(state)
-        return model
-
-    # ------------------------------------------------------------------
-    # pipeline (data x pipe) GPipe path
-    # ------------------------------------------------------------------
-    def _optimize_pipeline(self, mesh) -> AbstractModule:
-        """Full Optimizer lifecycle over a data x pipe mesh: the step is
-        ``parallel.pipeline.make_pipeline_train_step`` (stage-sharded
-        transformer blocks, GPipe microbatch schedule, derived backward);
-        triggers, canonical log line, summaries, checkpoint and
-        retry-from-checkpoint keep the same contract as the other mesh
-        paths.  Exceeds reference parity (SURVEY §2.2: the reference is
-        data-parallel only)."""
-        n_data = mesh.shape.get("data", 1)
-        n_mb = self.pipeline_microbatch or mesh.shape["pipe"]
-        if (self.batch_size is not None
-                and self.batch_size % (n_data * n_mb) != 0):
+                    f"batch size {self.batch_size} must be divisible "
+                    f"by data-axis x pipeline microbatches = {n_data} "
+                    f"x {n_mb} = {n_data * n_mb}")
             raise ValueError(
                 f"batch size {self.batch_size} must be divisible by "
-                f"data-axis x pipeline microbatches = {n_data} x {n_mb} "
-                f"= {n_data * n_mb}")
-
-        def attempt():
-            # same elastic contract as the multi-axis path: watchdog +
-            # heartbeats + straggler tracking; mesh kept across attempts
-            self._elastic_begin()
-            return self._optimize_pipeline_once(mesh)
-
-        return self._with_retry(attempt)
-
-    def _optimize_pipeline_once(self, mesh) -> AbstractModule:
-        from jax.sharding import NamedSharding
-
-        from ..parallel.pipeline import (make_pipeline_eval_forward,
-                                         make_pipeline_train_step,
-                                         pack_params, unpack_params)
-        from .optimizer import _epoch_records, _resume_slots
-
-        self._tm_attempt_begin()
-        model, optim = self.model, self.optim_method
-        model.training()
-        n_data = mesh.shape.get("data", 1)
-        n_pipe = mesh.shape["pipe"]
-        n_mb = self.pipeline_microbatch or n_pipe
-        # a >1 model axis composes: blocks' Column/Row weights shard
-        # over BOTH pipe and model (3-D parallelism)
-        model_axis = ("model" if mesh.shape.get("model", 1) > 1 else None)
-
-        step = make_pipeline_train_step(model, self.criterion, optim, mesh,
-                                        n_microbatch=n_mb,
-                                        model_axis=model_axis,
-                                        compute_dtype=self.compute_dtype,
-                                        donate=True)
-        eval_fwd = None  # built lazily on the first validation trigger
-        put = lambda tree, specs: jax.tree_util.tree_map(
-            lambda a, s: jax.device_put(a, NamedSharding(mesh, s)),
-            tree, specs)
-        packed = put(pack_params(model, n_pipe, model_axis),
-                     step.param_specs)
-        slots = _resume_slots(optim, optim.init_state(packed))
-        slots = put(slots, step.slot_specs)
-
-        state = optim.state
-        state["epoch"] = state.get("epoch", 1)
-        state["neval"] = state.get("neval", 1)
-        state["epoch_finished"] = False
-        epoch_size = _epoch_records(self.dataset)
-        data_iter = self.dataset.data(train=True)
-        records_this_epoch = self._consume_resume_cursor(data_iter,
-                                                         epoch_size)
-        wall_start = time.time()
-        pad_multiple = n_data * n_mb
-
-        def _sync_to_model():
-            unpack_params(jax.device_get(packed), model)
-            optim._slots = jax.device_get(slots)
-
-        # bounded prefetch-to-device infeed (dataset/prefetch.py): the
-        # pipeline path used to fetch synchronously every iteration
-        feed = self._make_feed(data_iter, epoch_size, records_this_epoch)
-        first_step = True  # first dispatch = XLA build (telemetry)
-        try:
-            while not self.end_when(state):
-                state["epoch_finished"] = False
-                self._elastic_step_start(state)
-                item, stall_time = feed.get()
-                batch, x, y = item
-                n_records = batch.size()
-                mask_kw = {}
-                if n_records % pad_multiple != 0:
-                    # trailing partial batch: pad whole records to the
-                    # data x microbatch multiple and train the real
-                    # ones via the per-record weight mask (every-record
-                    # guarantee on the pipeline mesh too)
-                    if not _maskable(y, n_records):
-                        raise ValueError(
-                            "pipeline training got a trailing partial "
-                            f"batch of {n_records} records but the "
-                            "targets are not record-leading arrays for "
-                            "pad-and-mask; size the dataset to a batch "
-                            "multiple")
-                    x, y, w = pad_batch(x, y, n_records,
-                                        round_up(n_records, pad_multiple))
-                    mask_kw = {"w": w, "total_w": float(n_records)}
-                # host prep overlapped the previous step on the feed's
-                # producer thread — only the real buffer stall remains
-                infeed_time = stall_time
-
-                lr = optim.get_current_lr()
-                t0 = time.time()
-                if first_step and not mask_kw \
-                        and self.telemetry is not None:
-                    # cost-model analysis of the GPipe program (inside
-                    # the first step's timed window, ledgered as
-                    # COMPILE; constant key — see the data path)
-                    self._tm_analyze(
-                        step.jitted_for(False), packed, slots,
-                        jnp.float32(lr), jax.random.PRNGKey(0),
-                        jnp.asarray(x), jnp.asarray(y),
-                        collective_bytes=(2.0 * (n_data - 1)
-                                          / max(n_data, 1)
-                                          * self._tree_bytes(packed)))
-                loss, packed, slots = self._elastic_dispatch(
-                    lambda: step(packed, slots, lr, x, y,
-                                 rng=next_jax_key(), **mask_kw), state)
-                loss = float(loss)  # value fetch = execution barrier
-                train_time = time.time() - t0
-                self._tm_step(state, train_time, infeed_time, n_records,
-                              compiled=first_step)
-                first_step = False
-                self._check_loss_anomaly(loss, skipped=False)
-                packed = self._maybe_corrupt_params(state, packed)
-                # fused pipeline step: grad norm is not a program output
-                self._record_fingerprint(state, loss, None, (x, y),
-                                         lambda: packed)
-                self._integrity_step(state, lambda: packed)
-
-                records_this_epoch += n_records
-                state["records_this_epoch"] = records_this_epoch
-                state["loss"] = loss
-                # metric-name contract (reference
-                # DistriOptimizer.scala:146-151)
-                self.metrics.add("computing time average", train_time)
-                self.metrics.add("aggregate gradient time", 0.0)
-                self.metrics.add("get weights average", infeed_time)
-                log.info(
-                    "[Epoch %d %d/%d][Iteration %d][Wall Clock %.3fs] "
-                    "Train %d in %.4f seconds. Throughput is %.1f "
-                    "records/second. Loss is %.5f.",
-                    state["epoch"], records_this_epoch, epoch_size,
-                    state["neval"], time.time() - wall_start, n_records,
-                    train_time + infeed_time,
-                    n_records / max(train_time + infeed_time, 1e-9),
-                    loss)
-                if self.train_summary is not None:
-                    self.train_summary.add_scalar("Loss", loss,
-                                                  state["neval"])
-                    self.train_summary.add_scalar(
-                        "Throughput",
-                        n_records / max(train_time + infeed_time, 1e-9),
-                        state["neval"])
-
-                state["neval"] += 1
-                optim.state = state
-                if records_this_epoch >= epoch_size:
-                    state["epoch"] += 1
-                    state["epoch_finished"] = True
-                    records_this_epoch = 0
-                    # the producer met its epoch budget and is parked —
-                    # the shuffle cannot race a fetch; reset re-arms
-                    # the same producer thread on the fresh iterator
-                    self.dataset.shuffle()
-                    data_iter = self.dataset.data(train=True)
-                    feed.reset(data_iter, epoch_size, 0)
-
-                do_validate = (self.validation_trigger is not None
-                               and self.validation_trigger(state))
-                do_checkpoint = (self.checkpoint_trigger is not None
-                                 and self.checkpoint_trigger(state))
-                if do_validate and self.validation_dataset is not None:
-                    if eval_fwd is None:
-                        pfwd = make_pipeline_eval_forward(
-                            model, mesh, n_microbatch=n_mb,
-                            model_axis=model_axis,
-                            compute_dtype=self.compute_dtype)
-                        eval_fwd = lambda p, b, xx: pfwd(p, xx)
-                    from .evaluator import evaluate_dataset
-
-                    results = evaluate_dataset(
-                        model, self.validation_dataset,
-                        self.validation_methods,
-                        batch_size=self.batch_size or 128,
-                        params=packed, buffers=model.buffer_tree(),
-                        fwd=eval_fwd, n_shard=n_data * n_mb)
-                    model.training()
-                    self._report_validation(state, results)
-                if do_checkpoint or self._preempted():
-                    if self.checkpoint_format == "orbax":
-                        # sharded async save straight from the device
-                        # trees — no host gather, no unpack
-                        self._orbax_save(state, self._orbax_tree(
-                            packed, slots), kind="packed")
-                    else:
-                        _sync_to_model()
-                        self._checkpoint(state)
-                if self._preempted():
-                    self._drain_checkpoints()
-                    log.warning("preemption requested — checkpointed at "
-                                "iteration %d; exiting resumable",
-                                state["neval"] - 1)
-                    break
-        finally:
-            feed.close()
-
-        _sync_to_model()
-        model.evaluate()
-        # drain-on-exit barrier: every triggered checkpoint is durable
-        self._drain_checkpoints()
-        self._orbax_close()
-        self._tm_finish(state)
-        return model
-
-    def _validate_multi_axis(self, state, eval_fwd, params, buffers,
-                             n_data, n_seq=1):
-        """On-mesh validation for the multi-axis path: the compiled
-        eval forward (parallel.spmd.make_eval_forward) runs with the
-        device-resident sharded params — no host pull, and models whose
-        forward needs bound mesh axes (ring attention, RowParallel psum)
-        validate correctly.  Reuses evaluate_dataset's batching/padding/
-        accumulation loop via its ``fwd`` override."""
-        from .evaluator import evaluate_dataset
-
-        if self.validation_dataset is None:
-            return
-        if n_seq > 1:
-            # cheap fast-fail probe on the first sample; ragged LATER
-            # samples are caught by the except below, which re-raises
-            # the opaque shard_map shape error with this same hint
-            probe = next(iter(self.validation_dataset.data(train=False)),
-                         None)
-            if probe is not None and not hasattr(probe, "size"):
-                arr = np.asarray(probe.feature)
-                if arr.ndim >= 1 and arr.shape[0] % n_seq != 0:
-                    raise ValueError(
-                        f"validation sequence length {arr.shape[0]} must "
-                        f"be divisible by the mesh's seq-axis size "
-                        f"{n_seq}; pad sequences to a multiple")
-        try:
-            results = evaluate_dataset(self.model, self.validation_dataset,
-                                       self.validation_methods,
-                                       batch_size=self.batch_size or 128,
-                                       params=params, buffers=buffers,
-                                       fwd=eval_fwd, n_shard=n_data)
-        except ValueError as e:
-            if n_seq > 1 and "shard" in str(e).lower():
-                raise ValueError(
-                    f"on-mesh validation failed to shard a batch over "
-                    f"the seq axis (size {n_seq}) — every validation "
-                    f"sequence length must be divisible by {n_seq}; pad "
-                    f"sequences to a multiple (underlying error: {e})"
-                ) from e
-            raise
-        self.model.training()
-        self._report_validation(state, results)
-
-    def _report_validation(self, state, results):
-        """Log + summarize validation results and update the trigger
-        score — the one copy shared by every mesh path's validation."""
-        for method, result in zip(self.validation_methods, results):
-            log.info("%s is %s", method.format(), result)
-            if self.validation_summary is not None:
-                self.validation_summary.add_scalar(
-                    method.format(), result.result()[0], state["neval"] - 1)
-            if method.format() in ("Top1Accuracy", "Top5Accuracy"):
-                state["score"] = result.result()[0]
-
-    # ------------------------------------------------------------------
-    def _optimize_once(self, mesh, n_dev) -> AbstractModule:
-        self._tm_attempt_begin()
-        model, optim = self.model, self.optim_method
-        model.training()
-
-        params = model.param_tree()
-        buffers = model.buffer_tree()
-        arp = AllReduceParameter(params, n_dev)
-        slots = arp.init_slices(optim, params)
-        # replicate slice-slots across shards at infeed; shard_map splits them
-        from jax.sharding import NamedSharding
-
-        slots = jax.tree_util.tree_map(
-            lambda s: (jnp.tile(s, (n_dev,) + (1,) * (s.ndim - 1))
-                       if s.ndim >= 1 else jnp.tile(s[None], (n_dev,))),
-            slots)
-        from .optimizer import _resume_slots
-
-        slots = _resume_slots(optim, slots)
-        # scalar slots (e.g. adam t) become per-shard vectors; shape fixup:
-        slots = jax.tree_util.tree_map(
-            lambda s: jax.device_put(
-                s, NamedSharding(mesh, P("data", *([None] * (s.ndim - 1))))),
-            slots)
-
-        jitted = self._build_step(mesh, arp)
-        jitted_masked = None  # compiled lazily on the first partial batch
-        grad_probe = None     # compiled lazily on the first profiled iter
-        profile_interval = int(get_property("bigdl.metrics.profileInterval",
-                                            10))
-        compute_ratio = None  # last measured compute/total split
-
-        state = optim.state
-        state["epoch"] = state.get("epoch", 1)
-        state["neval"] = state.get("neval", 1)
-        state["epoch_finished"] = False
-
-        from .optimizer import _epoch_records
-
-        epoch_size = _epoch_records(self.dataset)
-        data_iter = self.dataset.data(train=True)
-        # total-state resume: continue mid-epoch on the exact next batch
-        records_this_epoch = self._consume_resume_cursor(data_iter,
-                                                         epoch_size)
-        wall_start = time.time()
-
-        # bounded prefetch-to-device infeed (dataset/prefetch.py),
-        # generalizing the one-deep ad-hoc prefetch this loop used to
-        # carry: host prep + device_put of batch N+1 overlap the
-        # compiled step on batch N; data_time is the REAL stall only
-        feed = self._make_feed(data_iter, epoch_size, records_this_epoch)
-        first_step = True  # first dispatch = XLA build (telemetry)
-        try:
-            while not self.end_when(state):
-                state["epoch_finished"] = False
-                self._elastic_step_start(state)
-                item, stall_time = feed.get()
-                batch, x, y = item
-                n_records = batch.size()
-                masked = n_records % n_dev != 0
-                if masked:
-                    # trailing partial batch: pad to the mesh multiple
-                    # and train the real records via a per-record
-                    # weight mask — every record of the epoch trains
-                    # exactly once at static shape (reference
-                    # DataSet.scala:255-288 trains all)
-                    if not _maskable(y, n_records):
-                        raise ValueError(
-                            "partial batch targets must be a pytree of "
-                            "record-leading arrays for pad-and-mask; "
-                            "size your dataset to a batch multiple of "
-                            "the mesh")
-                    x, y, w = pad_batch(x, y, n_records,
-                                        round_up(n_records, n_dev))
-                t_h2d0 = time.time()
-                x, y = shard_batch(mesh, (x, y))
-                h2d_time = time.time() - t_h2d0
-                if self.telemetry is not None:
-                    self.telemetry.on_host_to_device(h2d_time,
-                                                     step=state["neval"])
-                # the host batch prep overlapped the previous step on
-                # the feed's producer thread: only the measured stall
-                # (empty buffer) plus the h2d placement is infeed time
-                infeed_time = stall_time + h2d_time
-
-                # profile past the compile iteration so timings are warm
-                profiled = (profile_interval > 0 and state["neval"] > 1
-                            and state["neval"] % profile_interval == 0
-                            and not masked)
-
-                lr = optim.get_current_lr()
-                if masked and jitted_masked is None:
-                    jitted_masked = self._build_step(mesh, arp,
-                                                     masked=True)
-                if masked:
-                    w = shard_batch(mesh, (w,))[0]
-                t0 = time.time()
-                if first_step and not masked \
-                        and self.telemetry is not None:
-                    # cost-model analysis of the exact data-parallel
-                    # program (inside the first step's timed window,
-                    # ledgered as COMPILE — lowering is program-build
-                    # cost); the constant key only shapes the trace —
-                    # never draw from the checkpointed key stream here.
-                    # Wire bytes: reduce-scatter + all-gather move
-                    # ~2(n-1)/n of the param bytes each step.
-                    self._tm_analyze(
-                        jitted, params, buffers, slots, jnp.float32(lr),
-                        jax.random.PRNGKey(0), x, y,
-                        collective_bytes=(2.0 * (n_dev - 1)
-                                          / max(n_dev, 1)
-                                          * self._tree_bytes(params)))
-
-                def dispatch():
-                    if masked:
-                        return jitted_masked(
-                            params, buffers, slots, jnp.float32(lr),
-                            next_jax_key(), x, y, w,
-                            jnp.float32(n_records))
-                    return jitted(params, buffers, slots,
-                                  jnp.float32(lr), next_jax_key(), x, y)
-
-                trace_split = None
-                if profiled:
-                    # phase split measured from the profiler trace of
-                    # THIS step's execution: collective vs compute
-                    # device time (reference Metrics.scala:103-121
-                    # measures per phase).  The value fetch (= execution
-                    # barrier; block_until_ready returns early on the
-                    # tunneled TPU backend) must happen inside the trace
-                    # so device events are captured; the step is timed
-                    # inside run_traced so trace start/parse overhead
-                    # never pollutes the phase metrics.
-                    from .profiling import trace_phase_split
-
-                    step_out = []
-
-                    def run_traced():
-                        tr = time.time()
-                        out = dispatch()
-                        loss_v = float(out[0])
-                        step_out.append((out, loss_v, time.time() - tr))
-                    trace_split = trace_phase_split(run_traced)
-                    out, loss, train_time = step_out[0]
-                else:
-                    # the feed's producer keeps prefetching in the
-                    # background, so the watchdog's block-on-loss no
-                    # longer trades away the overlap
-                    out = self._elastic_dispatch(dispatch, state)
-                    loss = float(out[0])  # device sync
-                    train_time = time.time() - t0
-                _, params, buffers, slots, step_ok, gnorm = out
-                skipped = not bool(step_ok)
-                # h2d was attributed above — feed only the measured
-                # buffer stall as data wait (no double counting)
-                self._tm_step(state, train_time, stall_time, n_records,
-                              compiled=first_step,
-                              phase_split=trace_split, skipped=skipped)
-                first_step = False
-                self._check_loss_anomaly(loss, skipped)
-                params = self._maybe_corrupt_params(state, params)
-                self._record_fingerprint(state, loss, float(gnorm),
-                                         (x, y), lambda: params,
-                                         skipped=skipped)
-                self._integrity_step(state, lambda: params)
-
-                if profiled and trace_split is None:
-                    # fallback: collective-free fwd+bwd probe pins the
-                    # pure compute time (runs on the post-step params —
-                    # identical shapes/program, so identical timing)
-                    probe_key = jax.random.PRNGKey(0)
-                    if grad_probe is None:
-                        grad_probe = self._build_grad_probe(mesh)
-                        _l, _g = grad_probe(params, buffers, probe_key,
-                                            x, y)
-                        float(_l), float(_g)
-                    tp = time.time()
-                    _l, _g = grad_probe(params, buffers, probe_key, x, y)
-                    float(_l), float(_g)
-                    compute_time = time.time() - tp
-
-                records_this_epoch += n_records
-                state["records_this_epoch"] = records_this_epoch
-                state["loss"] = loss
-                # metric-name contract (reference
-                # DistriOptimizer.scala:146-151) with measured per-phase
-                # numbers: the profiled iterations pin the
-                # compute/aggregate split; in between, the last measured
-                # ratio attributes the fused step's wall time
-                if profiled:
-                    if trace_split is not None:
-                        c_s, agg_s = trace_split
-                        compute_ratio = c_s / max(c_s + agg_s, 1e-12)
-                        self.phase_source = "trace"
-                    else:
-                        compute_ratio = min(
-                            compute_time / max(train_time, 1e-9), 1.0)
-                        self.phase_source = "probe"
-                if compute_ratio is not None:
-                    self.metrics.add("computing time average",
-                                     train_time * compute_ratio)
-                    self.metrics.add("aggregate gradient time",
-                                     train_time * (1.0 - compute_ratio))
-                else:
-                    # metric-name contract holds before the first
-                    # profiled iteration too (reference always emits
-                    # all three)
-                    self.metrics.add("computing time average",
-                                     train_time)
-                    self.metrics.add("aggregate gradient time", 0.0)
-                self.metrics.add("get weights average", infeed_time)
-                log.info(
-                    "[Epoch %d %d/%d][Iteration %d][Wall Clock %.3fs] "
-                    "Train %d in %.4f seconds. Throughput is %.1f "
-                    "records/second. Loss is %.5f.",
-                    state["epoch"], records_this_epoch, epoch_size,
-                    state["neval"], time.time() - wall_start, n_records,
-                    train_time + infeed_time,
-                    n_records / max(train_time + infeed_time, 1e-9),
-                    loss)
-
-                if self.train_summary is not None:
-                    self.train_summary.add_scalar("Loss", loss,
-                                                  state["neval"])
-                    self.train_summary.add_scalar(
-                        "Throughput",
-                        n_records / max(train_time + infeed_time, 1e-9),
-                        state["neval"])
-                    if self.gradient_guard:
-                        self.train_summary.add_scalar(
-                            "SkippedSteps", float(self.skipped_steps),
-                            state["neval"])
-
-                state["neval"] += 1
-                optim.state = state
-
-                if records_this_epoch >= epoch_size:
-                    state["epoch"] += 1
-                    state["epoch_finished"] = True
-                    records_this_epoch = 0
-                    state["records_this_epoch"] = 0
-                    # the producer met its epoch budget and is parked —
-                    # the shuffle cannot race a fetch; reset re-arms
-                    # the same producer thread on the fresh iterator
-                    self.dataset.shuffle()
-                    data_iter = self.dataset.data(train=True)
-                    feed.reset(data_iter, epoch_size, 0)
-
-                # validation runs ON-MESH with the device-resident
-                # params (no host pull, reference
-                # DistriValidator.scala:35); only a checkpoint needs
-                # the host-side model sync
-                if self.validation_trigger is not None and \
-                        self.validation_trigger(state):
-                    self._validate_on_mesh(state, mesh, params, buffers)
-                do_checkpoint = (self.checkpoint_trigger is not None
-                                 and self.checkpoint_trigger(state))
-                if do_checkpoint or self._preempted():
-                    if self.checkpoint_format == "orbax":
-                        self._orbax_save(state, self._orbax_tree(
-                            params, slots, buffers), kind="model")
-                    else:
-                        model.set_param_tree(params)
-                        model.set_buffer_tree(buffers)
-                        optim._slots = slots
-                        self._checkpoint(state)
-                if self._preempted():
-                    self._drain_checkpoints()
-                    log.warning("preemption requested — checkpointed at "
-                                "iteration %d; exiting resumable",
-                                state["neval"] - 1)
-                    break
-        finally:
-            feed.close()
-
-        model.set_param_tree(params)
-        model.set_buffer_tree(buffers)
-        optim._slots = slots
-        model.evaluate()
-        # drain-on-exit barrier: every triggered checkpoint is durable
-        # (or its write error surfaces here, into the retry loop)
-        self._drain_checkpoints()
-        self._orbax_close()
-        self._tm_finish(state)
-        return model
-
-    def _validate_on_mesh(self, state, mesh, params, buffers):
-        from .evaluator import evaluate_dataset
-
-        if self.validation_dataset is not None:
-            results = evaluate_dataset(self.model, self.validation_dataset,
-                                       self.validation_methods, mesh=mesh,
-                                       params=params, buffers=buffers)
-            self._report_validation(state, results)
-            self.model.training()
-
-    def _checkpoint(self, state):
-        # atomic + crc32c-checksummed (resilience.checkpoint contract)
-        self._write_pickle_checkpoint(state)
-
-
-def _maskable(y, n_records: int) -> bool:
-    """Pad-and-mask vmaps the per-record loss over every target leaf:
-    any pytree (array / tuple / Table) of record-leading arrays works."""
-    leaves = jax.tree_util.tree_leaves(y)
-    return bool(leaves) and all(
-        hasattr(v, "shape") and getattr(v, "ndim", 0) >= 1
-        and v.shape[0] == n_records for v in leaves)
+                f"the mesh's data-axis size {n_data} (reference "
+                "Optimizer.scala:417 requires batchSize % nodeNumber "
+                "== 0)")
+        return mesh
 
 
 def _latest_file(path: str, prefix: str) -> Optional[str]:

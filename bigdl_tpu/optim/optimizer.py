@@ -13,24 +13,21 @@ from __future__ import annotations
 import logging
 import os
 import time
-from typing import List, Optional, Sequence
+from typing import Optional, Sequence
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..dataset.dataset import AbstractDataSet
 from ..dataset.sample import MiniBatch, SampleToMiniBatch
-from ..nn.module import AbstractModule, to_array
-from ..resilience.guards import LossSpikeDetector, tree_finite, where_tree
+from ..nn.module import AbstractModule
+from ..resilience.guards import LossSpikeDetector
 from ..resilience.preemption import PreemptionHandler
 from ..resilience.retry import LossSpikeError, RetryPolicy
 from ..utils.engine import get_property
 from ..utils.rng import next_jax_key
-from ..utils.table import T, Table
 from .metrics import Metrics
 from .optim_method import SGD, OptimMethod
-from .regularizer import collect_regularizer_paths, regularizer_loss
 from .trigger import Trigger
 from .validation import ValidationMethod
 
@@ -87,6 +84,18 @@ class Optimizer:
         # GPipe microbatch count for meshes with a 'pipe' axis (None:
         # the driver defaults to the pipe-axis size)
         self.pipeline_microbatch = None
+        # unified sharding-plan engine (parallel/plan.py, ISSUE 8):
+        # every mesh path compiles through ONE compile_step_with_plan
+        # builder.  ``sharding_plan`` overrides the derived default
+        # rule set; ``fsdp_min_bytes`` arms the threshold FSDP rule
+        # (large replicated params shard over the data axis with
+        # gather-on-use).  bigdl.fsdp.minBytes sets the default.
+        self.sharding_plan = None
+        _fsdp = get_property("bigdl.fsdp.minBytes")
+        self.fsdp_min_bytes = int(_fsdp) if _fsdp else None
+        # how the last profiled iteration's phase split was measured:
+        # "trace" (jax.profiler device events) or None (not profiled)
+        self.phase_source = None
         # --- resilience (bigdl_tpu/resilience/) -----------------------
         # gradient anomaly guard: NaN/Inf steps are skipped in-program
         # (params/slots/buffers ride through intact) and counted
@@ -222,6 +231,29 @@ class Optimizer:
         if int(n) < 1:
             raise ValueError(f"pipeline microbatch must be >= 1, got {n}")
         self.pipeline_microbatch = int(n)
+        return self
+
+    def set_sharding_plan(self, plan):
+        """Install an explicit :class:`~bigdl_tpu.parallel.plan.Plan`
+        (ordered regex rules mapping param-tree path names to
+        PartitionSpecs).  ``None`` restores the derived default —
+        module introspection plus the FSDP threshold rule when
+        :meth:`set_fsdp` armed one.  The plan re-binds to the live mesh
+        every attempt, so elastic shrink/regrow is one mesh+plan
+        re-derivation."""
+        self.sharding_plan = plan
+        return self
+
+    def set_fsdp(self, min_bytes: Optional[int] = 1 << 20):
+        """Arm FSDP-style parameter sharding: any parameter of at least
+        ``min_bytes`` that the plan would otherwise replicate over the
+        ``data`` axis is sharded over it instead (largest divisible
+        dim), gathered on use inside the step, with the gradient
+        reduce-scatter riding the gather's AD transpose — parameters
+        whose full tree does not fit one chip train anyway.  ``None``
+        disables.  (``bigdl.fsdp.minBytes`` property sets the
+        default.)"""
+        self.fsdp_min_bytes = int(min_bytes) if min_bytes else None
         return self
 
     def set_drop_module_property(self, drop_percentage, max_drop_percentage,
@@ -517,16 +549,6 @@ class Optimizer:
         tm.perf.analyze_jitted(fn, *args, label=label,
                                collective_bytes=collective_bytes,
                                **kwargs)
-
-    @staticmethod
-    def _tree_bytes(tree) -> float:
-        """Total leaf bytes of a pytree — the collective-volume input
-        (data-parallel wire bytes ~= 2(n-1)/n x param bytes for the
-        reduce-scatter + all-gather pair)."""
-        return float(sum(
-            int(a.size) * jnp.dtype(a.dtype).itemsize
-            for a in jax.tree_util.tree_leaves(tree)
-            if hasattr(a, "size") and hasattr(a, "dtype")))
 
     # -- determinism + integrity plumbing (docs/determinism.md) ---------
     def _fault_host(self) -> str:
@@ -972,6 +994,418 @@ class Optimizer:
             self._apply_train_state(ts)
         return restored_any
 
+    # ------------------------------------------------------------------
+    # the unified plan driver (parallel/plan.py, ISSUE 8): ONE loop for
+    # every mesh shape — the four hand-wired paths (Local + Distri
+    # data/multi-axis/pipeline) collapsed into this single code path,
+    # so elastic hooks, watchdog, integrity fingerprints, telemetry
+    # spans, prefetch infeed and async checkpointing are threaded
+    # through exactly once.
+    # ------------------------------------------------------------------
+    @staticmethod
+    def _should(trigger, state) -> bool:
+        return trigger is not None and trigger(state)
+
+    def _report_validation(self, state, results):
+        """Log + summarize validation results and update the trigger
+        score — the one copy shared by every mesh shape."""
+        for method, result in zip(self.validation_methods, results):
+            log.info("%s is %s", method.format(), result)
+            if self.validation_summary is not None:
+                self.validation_summary.add_scalar(
+                    method.format(), result.result()[0],
+                    state["neval"] - 1)
+            if method.format() in ("Top1Accuracy", "Top5Accuracy"):
+                state["score"] = result.result()[0]
+
+    def _plan_optimize(self, mesh) -> AbstractModule:
+        """Retry wrapper around the unified loop.  With an elastic
+        context the mesh (and therefore the plan) is re-derived PER
+        ATTEMPT from the live membership — shrink/regrow on ANY mesh
+        shape is one mesh+plan re-derivation, keeping the template's
+        model/pipe axes (the old shrink silently degraded a multi-axis
+        mesh to data-only)."""
+        if self.elastic is not None:
+            self.elastic.attach(n_devices=len(jax.devices()),
+                                batch_size=self.batch_size,
+                                mesh_template=mesh)
+
+            def attempt():
+                self._elastic_begin()
+                return self._plan_loop(self.elastic.current_mesh())
+
+            return self._with_retry(attempt)
+        return self._with_retry(lambda: self._plan_loop(mesh))
+
+    def _plan_engine(self, mesh):
+        """Compile the one step for this attempt's mesh."""
+        from ..parallel.plan import compile_step_with_plan
+
+        n_seq = mesh.shape.get("seq", 1)
+        return compile_step_with_plan(
+            self.model, self.criterion, self.optim_method, mesh,
+            plan=self.sharding_plan,
+            input_seq_dim=1 if n_seq > 1 else None,
+            compute_dtype=self.compute_dtype, donate=True,
+            guard=self.gradient_guard, with_gnorm=True,
+            n_microbatch=self.pipeline_microbatch,
+            fsdp_min_bytes=self.fsdp_min_bytes)
+
+    def _publish_plan_metrics(self, engine, params):
+        """Addressable-param-bytes gauges: the FSDP acceptance
+        measurement (per-device bytes ~ total/N under an FSDP plan)
+        and a live view of what the plan actually placed where."""
+        from ..telemetry.registry import default_registry
+
+        reg = (self.telemetry.registry if self.telemetry is not None
+               else default_registry())
+        try:
+            by_dev = engine.param_bytes_by_device(params)
+            total = float(sum(
+                int(np.prod(a.shape)) * jnp.dtype(a.dtype).itemsize
+                for a in jax.tree_util.tree_leaves(params)))
+            if by_dev:
+                reg.gauge(
+                    "bigdl_plan_param_bytes_per_device",
+                    "max addressable parameter bytes on one device "
+                    "under the active sharding plan"
+                ).set(float(max(by_dev.values())))
+            reg.gauge(
+                "bigdl_plan_param_bytes_total",
+                "logical parameter bytes of the model"
+            ).set(total)
+        except Exception:  # accounting must never take down training
+            log.debug("plan param-bytes accounting failed", exc_info=True)
+
+    def _plan_loop(self, mesh) -> AbstractModule:
+        from ._sharding_utils import maskable, pad_batch, round_up
+        from .optim_method import OptimMethod  # noqa: F401 (doc link)
+
+        self._tm_attempt_begin()
+        model, optim = self.model, self.optim_method
+        model.training()
+        engine = self._plan_engine(mesh)
+        params, slots, buffers = engine.init_state()
+        self._publish_plan_metrics(engine, params)
+        pad_multiple = engine.pad_multiple
+        n_seq = engine.n_seq
+        multi_device = int(np.prod(mesh.devices.shape)) > 1
+
+        state = optim.state
+        state["epoch"] = state.get("epoch", 1)
+        state["neval"] = state.get("neval", 1)
+        state["epoch_finished"] = False
+        epoch_size = _epoch_records(self.dataset)
+        data_iter = self.dataset.data(train=True)
+        # a total-state resume continues mid-epoch on the exact next
+        # batch (the restored order makes the skipped prefix identical)
+        records_this_epoch = self._consume_resume_cursor(data_iter,
+                                                         epoch_size)
+        wall_start = time.time()
+
+        profile_interval = int(get_property(
+            "bigdl.metrics.profileInterval", 10))
+        compute_ratio = None   # last measured compute/total split
+        eval_cache = {}        # lazily built validation forward
+        # bounded prefetch-to-device infeed (dataset/prefetch.py):
+        # batch N+1's host prep overlaps the compiled step on batch N;
+        # data_time below is the REAL empty-buffer stall only
+        feed = self._make_feed(data_iter, epoch_size, records_this_epoch)
+        first_step = True  # first dispatch = XLA build (telemetry)
+        try:
+            while not self.end_when(state):
+                state["epoch_finished"] = False
+                self._elastic_step_start(state)
+                item, stall_time = feed.get()
+                batch, x, y = item
+                n_records = batch.size()
+                mask_kw = {}
+                if n_records % pad_multiple != 0:
+                    # trailing partial batch: pad whole records to the
+                    # mesh multiple and train the real ones via the
+                    # per-record weight mask — every record of an epoch
+                    # trains exactly once at static shape, on EVERY
+                    # mesh shape (reference DataSet.scala:255-288)
+                    if not maskable(y, n_records):
+                        raise ValueError(
+                            "training got a trailing partial batch of "
+                            f"{n_records} records but the targets are "
+                            "not record-leading arrays for pad-and-"
+                            "mask; size the dataset to a multiple of "
+                            f"{pad_multiple}")
+                    x, y, w = pad_batch(x, y, n_records,
+                                        round_up(n_records, pad_multiple))
+                    mask_kw = {"w": w, "total_w": float(n_records)}
+                if n_seq > 1:
+                    bad = [a.shape for a in jax.tree_util.tree_leaves(x)
+                           if getattr(a, "ndim", 0) > 1
+                           and a.shape[1] % n_seq != 0]
+                    if bad:
+                        raise ValueError(
+                            f"sequence dim of inputs {bad} must be "
+                            f"divisible by the mesh's seq-axis size "
+                            f"{n_seq}; pad sequences to a multiple")
+                h2d_time = 0.0
+                if multi_device:
+                    # pre-place the batch at the step's input sharding
+                    # (h2d attributed separately from the data stall)
+                    t_h2d0 = time.time()
+                    x = engine.place_batch(x)
+                    y = engine.place_batch(y)
+                    if mask_kw:
+                        mask_kw["w"] = engine.place_batch(mask_kw["w"])
+                    h2d_time = time.time() - t_h2d0
+                    if self.telemetry is not None and h2d_time > 0:
+                        self.telemetry.on_host_to_device(
+                            h2d_time, step=state["neval"])
+                infeed_time = stall_time + h2d_time
+
+                # profile past the compile iteration so timings are
+                # warm; single-device meshes skip (nothing to split)
+                profiled = (multi_device and profile_interval > 0
+                            and state["neval"] > 1
+                            and state["neval"] % profile_interval == 0
+                            and not mask_kw)
+
+                lr = optim.get_current_lr()
+                t0 = time.time()
+                if first_step and not mask_kw \
+                        and self.telemetry is not None:
+                    # XLA cost-model accounting for the exact program
+                    # about to compile (inside the first step's timed
+                    # window, ledgered as COMPILE; the constant key
+                    # never consumes the checkpointed stream).  Wire
+                    # bytes come from the PLAN now — tensor-parallel
+                    # and FSDP traffic is counted per leaf, not assumed
+                    # to be a data-parallel ring.
+                    self._tm_analyze(
+                        engine.jitted_for(x, y, False), params, slots,
+                        buffers, jnp.float32(lr), jax.random.PRNGKey(0),
+                        x, y,
+                        collective_bytes=engine.collective_bytes)
+
+                def dispatch():
+                    return engine.step(params, slots, buffers, lr, x, y,
+                                       rng=next_jax_key(), **mask_kw)
+
+                trace_split = None
+                if profiled:
+                    # phase split measured from the profiler trace of
+                    # THIS step's execution: collective vs compute
+                    # device time (reference Metrics.scala:103-121).
+                    # The loss fetch (execution barrier) happens inside
+                    # the trace so device events are captured.
+                    from .profiling import trace_phase_split
+
+                    step_out = []
+
+                    def run_traced():
+                        tr = time.time()
+                        out = dispatch()
+                        loss_v = float(out[0])
+                        step_out.append((out, loss_v, time.time() - tr))
+                    trace_split = trace_phase_split(run_traced)
+                    out, loss, train_time = step_out[0]
+                else:
+                    out = self._elastic_dispatch(dispatch, state)
+                    loss = float(out[0])  # device sync; the feed's
+                    #                       producer keeps prefetching
+                    train_time = time.time() - t0
+                _, params, slots, buffers, step_ok, gnorm = out
+                skipped = not bool(step_ok)
+                self._tm_step(state, train_time, stall_time, n_records,
+                              compiled=first_step,
+                              phase_split=trace_split, skipped=skipped)
+                first_step = False
+                self._check_loss_anomaly(loss, skipped)
+                params = self._maybe_corrupt_params(state, params)
+                self._record_fingerprint(state, loss, float(gnorm),
+                                         (x, y), lambda: params,
+                                         skipped=skipped)
+                self._integrity_step(state, lambda: params)
+
+                records_this_epoch += n_records
+                state["records_this_epoch"] = records_this_epoch
+                state["loss"] = loss
+                # metric-name contract (reference
+                # DistriOptimizer.scala:146-151): profiled iterations
+                # pin the compute/aggregate split from the trace; in
+                # between, the last measured ratio attributes the fused
+                # step's wall time
+                if profiled and trace_split is not None:
+                    c_s, agg_s = trace_split
+                    compute_ratio = c_s / max(c_s + agg_s, 1e-12)
+                    self.phase_source = "trace"
+                if compute_ratio is not None:
+                    self.metrics.add("computing time average",
+                                     train_time * compute_ratio)
+                    self.metrics.add("aggregate gradient time",
+                                     train_time * (1.0 - compute_ratio))
+                else:
+                    self.metrics.add("computing time average",
+                                     train_time)
+                    self.metrics.add("aggregate gradient time", 0.0)
+                self.metrics.add("get weights average", infeed_time)
+                self.metrics.add("data fetch time", stall_time)
+                log.info(
+                    "[Epoch %d %d/%d][Iteration %d][Wall Clock %.3fs] "
+                    "Train %d in %.4f seconds. Throughput is %.1f "
+                    "records/second. Loss is %.5f.",
+                    state["epoch"], records_this_epoch, epoch_size,
+                    state["neval"], time.time() - wall_start, n_records,
+                    train_time + infeed_time,
+                    n_records / max(train_time + infeed_time, 1e-9),
+                    loss)
+
+                if self.train_summary is not None:
+                    self.train_summary.add_scalar("Loss", loss,
+                                                  state["neval"])
+                    self.train_summary.add_scalar(
+                        "Throughput",
+                        n_records / max(train_time + infeed_time, 1e-9),
+                        state["neval"])
+                    if "LearningRate" in getattr(self.train_summary,
+                                                 "triggers", {}):
+                        self.train_summary.add_scalar(
+                            "LearningRate", lr, state["neval"])
+                    if self.gradient_guard:
+                        self.train_summary.add_scalar(
+                            "SkippedSteps", float(self.skipped_steps),
+                            state["neval"])
+
+                state["neval"] += 1
+                optim.state = state
+
+                if records_this_epoch >= epoch_size:
+                    state["epoch"] += 1
+                    state["epoch_finished"] = True
+                    records_this_epoch = 0
+                    state["records_this_epoch"] = 0
+                    # the producer met its epoch budget and is parked —
+                    # the shuffle cannot race a fetch; reset re-arms
+                    # the same producer thread on the fresh iterator
+                    self.dataset.shuffle()
+                    data_iter = self.dataset.data(train=True)
+                    feed.reset(data_iter, epoch_size, 0)
+
+                # evaluate each trigger exactly once per iteration
+                # (stateful user triggers must not see a second call)
+                do_validate = self._should(self.validation_trigger, state)
+                do_checkpoint = self._should(self.checkpoint_trigger,
+                                             state)
+                if do_validate:
+                    self._plan_validate(engine, state, params, buffers,
+                                        eval_cache)
+                if do_checkpoint or self._preempted():
+                    self._plan_checkpoint(engine, state, params, slots,
+                                          buffers)
+                if self._preempted():
+                    self._drain_checkpoints()
+                    log.warning("preemption requested — checkpointed at "
+                                "iteration %d; exiting resumable",
+                                state["neval"] - 1)
+                    break
+        finally:
+            feed.close()
+
+        engine.sync_to_model(params, slots, buffers)
+        model.evaluate()
+        # drain-on-exit barrier: every triggered checkpoint is durable
+        # (or its write error surfaces here, into the retry loop)
+        self._drain_checkpoints()
+        self._orbax_close()
+        self._tm_finish(state)
+        return model
+
+    def _plan_checkpoint(self, engine, state, params, slots, buffers):
+        if self.checkpoint_path is None:
+            return
+        if self.checkpoint_format == "orbax":
+            # sharded async save straight from the device trees — no
+            # host gather, no unpack
+            tree, kind = engine.checkpoint_tree(params, slots, buffers)
+            self._orbax_save(state, tree, kind=kind)
+            return
+        # host-gather for the whole-module pickle checkpoint
+        # (model-sharded and FSDP leaves reassemble on fetch)
+        engine.sync_to_model(params, slots, buffers)
+        self._write_pickle_checkpoint(state)
+
+    def _plan_validate(self, engine, state, params, buffers, cache):
+        """On-mesh validation matched to the engine's layout: the
+        pipeline eval schedule for packed params, the multi-axis eval
+        forward when seq/model axes are live, and the shard_mapped
+        data-axis eval (reference DistriValidator) otherwise — always
+        with the device-resident params, never a host pull."""
+        if self.validation_dataset is None or not self.validation_methods:
+            return
+        from .evaluator import evaluate_dataset
+
+        mesh = engine.mesh
+        if engine.kind == "packed":
+            if cache.get("fwd") is None:
+                from ..parallel.pipeline import make_pipeline_eval_forward
+
+                pfwd = make_pipeline_eval_forward(
+                    self.model, mesh, n_microbatch=engine.n_microbatch,
+                    model_axis=engine.model_axis,
+                    compute_dtype=self.compute_dtype)
+                cache["fwd"] = lambda p, b, xx: pfwd(p, xx)
+            results = evaluate_dataset(
+                self.model, self.validation_dataset,
+                self.validation_methods,
+                batch_size=self.batch_size or 128, params=params,
+                buffers=self.model.buffer_tree(), fwd=cache["fwd"],
+                n_shard=engine.pad_multiple)
+        elif engine.n_seq > 1 or engine.n_model > 1:
+            if cache.get("fwd") is None:
+                from ..parallel.spmd import make_eval_forward
+
+                cache["fwd"] = make_eval_forward(
+                    self.model, mesh,
+                    input_seq_dim=1 if engine.n_seq > 1 else None,
+                    compute_dtype=self.compute_dtype,
+                    output_seq_dim=self.validation_output_seq_dim)
+            n_seq = engine.n_seq
+            if n_seq > 1:
+                # cheap fast-fail probe on the first sample; ragged
+                # LATER samples are caught by the except below
+                probe = next(iter(
+                    self.validation_dataset.data(train=False)), None)
+                if probe is not None and not hasattr(probe, "size"):
+                    arr = np.asarray(probe.feature)
+                    if arr.ndim >= 1 and arr.shape[0] % n_seq != 0:
+                        raise ValueError(
+                            f"validation sequence length {arr.shape[0]} "
+                            f"must be divisible by the mesh's seq-axis "
+                            f"size {n_seq}; pad sequences to a multiple")
+            try:
+                results = evaluate_dataset(
+                    self.model, self.validation_dataset,
+                    self.validation_methods,
+                    batch_size=self.batch_size or 128, params=params,
+                    buffers=buffers, fwd=cache["fwd"],
+                    n_shard=engine.n_data)
+            except ValueError as e:
+                if n_seq > 1 and "shard" in str(e).lower():
+                    raise ValueError(
+                        f"on-mesh validation failed to shard a batch "
+                        f"over the seq axis (size {n_seq}) — every "
+                        f"validation sequence length must be divisible "
+                        f"by {n_seq}; pad sequences to a multiple "
+                        f"(underlying error: {e})") from e
+                raise
+        else:
+            # pure data mesh (FSDP params reshard transparently on
+            # entry to the replicated-spec eval program)
+            results = evaluate_dataset(
+                self.model, self.validation_dataset,
+                self.validation_methods,
+                batch_size=self.batch_size or 128, mesh=mesh,
+                params=params, buffers=buffers)
+        self.model.training()
+        self._report_validation(state, results)
+
     def optimize(self) -> AbstractModule:
         raise NotImplementedError
 
@@ -1050,280 +1484,22 @@ class LocalOptimizer(Optimizer):
     """Single-host training driver (reference optim/LocalOptimizer.scala:41):
     the whole iteration is one jitted step on one chip (or all local chips
     via vectorized batch — the reference's per-core model clones collapse
-    into the batch dimension, SURVEY §2.2 P2)."""
+    into the batch dimension, SURVEY §2.2 P2).
+
+    Since ISSUE 8 this is the unified plan driver over a single-device
+    mesh — the same ``compile_step_with_plan`` program every other mesh
+    shape runs, with the size-1 data axis compiled away by XLA."""
 
     def optimize(self) -> AbstractModule:
         self._warn_drop_knobs_if_inert()
         try:
             with self._preemption_scope():
-                return self._with_retry(self._optimize_loop)
+                from jax.sharding import Mesh
+
+                mesh = Mesh(np.array(jax.devices()[:1]), ("data",))
+                return self._plan_optimize(mesh)
         finally:
             # commit any in-flight async save on abnormal exits —
             # background writer first, then the orbax checkpointer
             self._shutdown_async_writer()
             self._orbax_close()
-
-    def _optimize_loop(self) -> AbstractModule:
-        self._elastic_begin()
-        self._tm_attempt_begin()
-        model, criterion, optim = self.model, self.criterion, self.optim_method
-        model.training()
-        from ..parallel.moe import aux_loss_term, collect_aux_paths
-
-        reg_paths = list(collect_regularizer_paths(model))
-        aux_paths = list(collect_aux_paths(model))
-        scale_tree = model.gradient_scale_tree()
-        needs_scale = any(s != 1.0
-                          for s in jax.tree_util.tree_leaves(scale_tree))
-
-        cdtype = self.compute_dtype
-        # f32-accumulating criterions (fused xent) take the low-precision
-        # output directly — upcasting [N, V] logits first would undo the
-        # fused path's HBM saving
-        upcast_out = not getattr(criterion, "accepts_low_precision", False)
-        guard = self.gradient_guard
-
-        def train_step(params, buffers, slots, lr, rng, x, y):
-            def loss_fn(p):
-                p_c, x_c = p, x
-                if cdtype is not None:
-                    # cast inside the differentiated fn: the cast's vjp
-                    # returns f32 grads against the f32 master weights
-                    p_c = _cast_floats(p, cdtype)
-                    x_c = _cast_floats(x, cdtype)
-                out, nb = model.apply_fn(p_c, buffers, x_c, True, rng)
-                if cdtype is not None:
-                    if upcast_out:
-                        out = _cast_floats(out, jnp.float32)
-                    nb = _restore_dtypes(nb, buffers)
-                loss = criterion._loss(out, y)
-                if reg_paths:  # regularize the f32 master weights
-                    loss = loss + regularizer_loss(p, reg_paths)
-                if aux_paths:  # MoE balance term off the buffer thread
-                    loss = loss + aux_loss_term(nb, aux_paths)
-                return loss, nb
-            (loss, new_buffers), grads = jax.value_and_grad(
-                loss_fn, has_aux=True)(params)
-            if needs_scale:  # reference setScaleW/setScaleB semantics
-                grads = jax.tree_util.tree_map(lambda g, s: g * s,
-                                               grads, scale_tree)
-            # global gradient norm: one reduction over grads already in
-            # registers — the flight recorder's per-step fingerprint
-            gnorm = jnp.sqrt(sum(
-                jnp.vdot(g, g).astype(jnp.float32)
-                for g in jax.tree_util.tree_leaves(grads)))
-            new_params, new_slots = optim.step(grads, params, slots, lr)
-            if guard:
-                # anomaly guard: a NaN/Inf gradient (or loss) skips the
-                # whole update — params/slots/buffers ride through
-                # bit-identical (select, not branch: jit-compatible)
-                ok = jnp.logical_and(tree_finite(grads),
-                                     jnp.isfinite(loss))
-                new_params = where_tree(ok, new_params, params)
-                new_slots = where_tree(ok, new_slots, slots)
-                new_buffers = where_tree(ok, new_buffers, buffers)
-            else:
-                ok = jnp.bool_(True)
-            return loss, new_params, new_buffers, new_slots, ok, gnorm
-
-        # donate params/buffers/slots: the update is in-place in HBM —
-        # without this every step keeps old+new parameters live and pays
-        # a copy (a direct MFU tax at ResNet scale)
-        jitted = jax.jit(train_step, donate_argnums=(0, 1, 2))
-
-        # the step donates these (in-place HBM update); a retry restart
-        # re-enters here AFTER resume_from_checkpoint has repointed the
-        # model at freshly-loaded arrays, so the donated originals are
-        # never handed back in
-        params = model.param_tree()
-        buffers = model.buffer_tree()
-        # resume optimizer slots (Adam moments etc.) from a loaded
-        # checkpoint when their structure matches the parameters
-        # (reference OptimMethod state survives checkpoints,
-        # OptimMethod.scala:80-96)
-        slots = _resume_slots(optim, optim.init_state(params))
-
-        state = optim.state
-        state["epoch"] = state.get("epoch", 1)
-        state["neval"] = state.get("neval", 1)
-        state["epoch_finished"] = False
-
-        epoch_size = _epoch_records(self.dataset)
-        data_iter = self.dataset.data(train=True)
-        # a total-state resume continues mid-epoch on the exact next
-        # batch (the restored order makes the skipped prefix identical)
-        records_this_epoch = self._consume_resume_cursor(data_iter,
-                                                         epoch_size)
-        wall_start = time.time()
-
-        # bounded prefetch-to-device infeed (dataset/prefetch.py):
-        # batch N+1's host prep + device_put overlap the compiled step
-        # on batch N; data_time below is the REAL stall — the seconds
-        # get() actually blocked on an empty buffer
-        feed = self._make_feed(data_iter, epoch_size, records_this_epoch)
-        first_step = True  # the first dispatch of a fresh program is
-        #                    dominated by the XLA build (telemetry:
-        #                    compile, not productive)
-        try:
-            while not self.end_when(state):
-                state["epoch_finished"] = False
-                self._elastic_step_start(state)
-                item, data_time = feed.get()
-                batch, x, y = item
-                n_records = batch.size()
-
-                lr = optim.get_current_lr()
-                t0 = time.time()
-                if first_step and self.telemetry is not None:
-                    # XLA cost-model work accounting for the exact
-                    # program about to compile (inside the first step's
-                    # timed window, which is ledgered as COMPILE — the
-                    # analysis is host-side lowering, part of the
-                    # program-build cost; the constant key never
-                    # consumes the checkpointed stream)
-                    self._tm_analyze(jitted, params, buffers, slots,
-                                     jnp.float32(lr),
-                                     jax.random.PRNGKey(0), x, y)
-                # the key derivation is step-dispatch work (the other
-                # mesh paths derive it inside their dispatch closure
-                # too) — timed with the step, not left as idle
-                rng = next_jax_key()
-                loss, params, buffers, slots, step_ok, gnorm = \
-                    self._elastic_dispatch(
-                        lambda: jitted(params, buffers, slots,
-                                       jnp.float32(lr), rng, x, y), state)
-                loss = float(loss)  # device sync; the feed's producer
-                #                     keeps fetching meanwhile
-                skipped = not bool(step_ok)
-                train_time = time.time() - t0
-                self._tm_step(state, train_time, data_time, n_records,
-                              compiled=first_step, skipped=skipped)
-                first_step = False
-                self._check_loss_anomaly(loss, skipped)
-                params = self._maybe_corrupt_params(state, params)
-                self._record_fingerprint(state, loss, float(gnorm),
-                                         (x, y), lambda: params,
-                                         skipped=skipped)
-                self._integrity_step(state, lambda: params)
-
-                self.metrics.add("computing time average", train_time)
-                self.metrics.add("data fetch time", data_time)
-                records_this_epoch += n_records
-                state["records_this_epoch"] = records_this_epoch
-                state["loss"] = loss
-                log.info(
-                    "[Epoch %d %d/%d][Iteration %d][Wall Clock %.3fs] "
-                    "Train %d in %.4f seconds. Throughput is %.1f "
-                    "records/second. Loss is %.5f.",
-                    state["epoch"], records_this_epoch, epoch_size,
-                    state["neval"], time.time() - wall_start, n_records,
-                    train_time + data_time,
-                    n_records / max(train_time + data_time, 1e-9), loss)
-
-                if self.train_summary is not None:
-                    self.train_summary.add_scalar("Loss", loss,
-                                                  state["neval"])
-                    self.train_summary.add_scalar(
-                        "Throughput",
-                        n_records / max(train_time + data_time, 1e-9),
-                        state["neval"])
-                    if "LearningRate" in getattr(self.train_summary,
-                                                 "triggers", {}):
-                        self.train_summary.add_scalar(
-                            "LearningRate", lr, state["neval"])
-                    if self.gradient_guard:
-                        self.train_summary.add_scalar(
-                            "SkippedSteps", float(self.skipped_steps),
-                            state["neval"])
-
-                state["neval"] += 1
-                optim.state = state
-
-                if records_this_epoch >= epoch_size:
-                    state["epoch"] += 1
-                    state["epoch_finished"] = True
-                    records_this_epoch = 0
-                    state["records_this_epoch"] = 0
-                    # the producer met its epoch budget and is parked —
-                    # the shuffle cannot race a fetch; reset re-arms
-                    # the same producer thread on the fresh iterator
-                    self.dataset.shuffle()
-                    data_iter = self.dataset.data(train=True)
-                    feed.reset(data_iter, epoch_size, 0)
-
-                # sync module state before validation/checkpoint consumers
-                if self._should(self.validation_trigger, state) or \
-                   self._should(self.checkpoint_trigger, state):
-                    model.set_param_tree(params)
-                    model.set_buffer_tree(buffers)
-                    optim._slots = slots
-                self._validate(state)
-                self._checkpoint(state)
-
-                if self._preempted():
-                    # graceful preemption: checkpoint the live state at
-                    # this step boundary, drain the background writer
-                    # (the preemption barrier) and return resumable
-                    model.set_param_tree(params)
-                    model.set_buffer_tree(buffers)
-                    optim._slots = slots
-                    self._checkpoint_now(state)
-                    self._drain_checkpoints()
-                    log.warning("preemption requested — checkpointed at "
-                                "iteration %d; exiting resumable",
-                                state["neval"] - 1)
-                    break
-        finally:
-            feed.close()
-
-        model.set_param_tree(params)
-        model.set_buffer_tree(buffers)
-        optim._slots = slots
-        model.evaluate()
-        # drain-on-exit barrier: every triggered checkpoint is durable
-        # (or its write error surfaces here, into the retry loop)
-        self._drain_checkpoints()
-        self._orbax_close()
-        self._tm_finish(state)
-        return model
-
-    @staticmethod
-    def _should(trigger, state) -> bool:
-        return trigger is not None and trigger(state)
-
-    def _validate(self, state):
-        if not self._should(self.validation_trigger, state):
-            return
-        if self.validation_dataset is None or not self.validation_methods:
-            return
-        from .evaluator import evaluate_dataset
-
-        results = evaluate_dataset(self.model, self.validation_dataset,
-                                   self.validation_methods)
-        for method, result in zip(self.validation_methods, results):
-            log.info("%s is %s", method.format(), result)
-            if self.validation_summary is not None:
-                value = result.result()[0]
-                self.validation_summary.add_scalar(
-                    method.format(), value, state["neval"] - 1)
-            if method.format() in ("Top1Accuracy", "Top5Accuracy"):
-                state["score"] = result.result()[0]
-        self.model.training()
-
-    def _checkpoint(self, state):
-        if not self._should(self.checkpoint_trigger, state):
-            return
-        self._checkpoint_now(state)
-
-    def _checkpoint_now(self, state):
-        """Write a checkpoint regardless of triggers (the preemption
-        path uses this directly at the final step boundary)."""
-        if self.checkpoint_path is None:
-            return
-        if self.checkpoint_format == "orbax":
-            self._orbax_save(state, self._orbax_tree(
-                self.model.param_tree(), self.optim_method._slots,
-                self.model.buffer_tree()), kind="model")
-            return
-        self._write_pickle_checkpoint(state)
-

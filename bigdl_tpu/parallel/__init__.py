@@ -4,6 +4,8 @@ from .compressed import (CompressedTensor, FP16CompressedTensor,
 from .moe import MoEFFN, aux_loss_term, collect_aux_paths
 from .pipeline import (make_pipeline_eval_forward, make_pipeline_train_step,
                        pack_params, unpack_params)
+from .plan import (CompiledPlanStep, Plan, Rule, compile_step_with_plan,
+                   derive_plan, match_partition_rules)
 from .ring_attention import (attention, blockwise_attention,
                              make_ring_attention_sharded, ring_attention,
                              ulysses_attention)

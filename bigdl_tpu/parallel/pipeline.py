@@ -460,151 +460,52 @@ def make_pipeline_train_step(model, criterion, optim, mesh,
                              model_axis: Optional[str] = None,
                              compute_dtype=None, donate: bool = False,
                              remat: Optional[bool] = None):
-    """Build the jitted data×pipe train step.
+    """Build the jitted data x pipe train step.
+
+    Compatibility entry point: the implementation is the unified
+    sharding-plan engine (``parallel.plan.compile_step_with_plan``,
+    ISSUE 8) with the guard/grad-norm extras off, so the compiled
+    program matches what this builder historically produced.
 
     Returns ``step(packed_params, slots, lr, x, y, rng=None) ->
     (loss, packed_params, slots)`` with ``.param_specs`` /
     ``.slot_specs`` / ``.pack`` / ``.unpack`` attached.  ``slots`` come
     from ``optim.init_state(packed_params)`` — stage-owned layers keep
-    stage-owned optimizer state (the ZeRO-flavored layout the data
-    driver's slice-owned update already established).
+    stage-owned optimizer state.
 
     ``remat`` — rematerialize each tick's stage computation in the
-    backward pass (GPipe's activation stash shrinks from
-    ``(M+S-1)·L/S`` block activations to the tick boundaries).  Default
-    ``None`` inherits ``model.remat`` (the flag spmd/apply_fn honor), so
-    a ``TransformerLM(remat=True)`` remats here too.
+    backward pass.  Default ``None`` inherits ``model.remat``.
     """
-    from ..optim.regularizer import collect_regularizer_paths
-
     if pipe_axis not in mesh.axis_names:
         raise ValueError(f"mesh has no {pipe_axis!r} axis")
-    data_axis = data_axis if data_axis in mesh.axis_names else None
     if model_axis is not None and model_axis not in mesh.axis_names:
         raise ValueError(f"mesh has no {model_axis!r} axis")
-    S = mesh.shape[pipe_axis]
-    n_model = mesh.shape[model_axis] if model_axis else 1
-    M = int(n_microbatch)
-    first, count = _check_model(model, S, model_axis)
-    if list(collect_regularizer_paths(model)):
-        raise NotImplementedError(
-            "regularizers are not supported on the pipeline path yet")
-    if any(s != 1.0 for s in
-           jax.tree_util.tree_leaves(model.gradient_scale_tree())):
-        raise NotImplementedError(
-            "scaleW/scaleB are not supported on the pipeline path yet")
-    if remat is None:
-        remat = bool(getattr(model, "remat", False))
-    upcast_out = not getattr(criterion, "accepts_low_precision", False)
-    local_fwd = _make_local_forward(model, first, count, S, M, pipe_axis,
-                                    compute_dtype, remat)
+    from .plan import compile_step_with_plan
 
-    packed0 = pack_params(model, S, model_axis)
-    pspecs = param_specs(packed0, pipe_axis,
-                         block=model.modules[first], model_axis=model_axis)
-    from .spmd import slot_specs as _slot_specs
-
-    sslots = _slot_specs(optim.init_state(packed0), pspecs)
-
-    def _make_local_step(masked):
-        def local_step(packed, slots, lr, rng, x, y, *mask_args):
-            if rng is not None and data_axis:
-                # decorrelate dropout across batch shards (spmd.py does
-                # the same); pipe/model peers keep the same base key —
-                # they hold slices of one logical model (the stage
-                # already folds tick+stage)
-                rng = jax.random.fold_in(rng, lax.axis_index(data_axis))
-
-            def loss_fn(p_master):
-                out = local_fwd(p_master, x, True, rng, upcast_out)
-                if masked:
-                    # trailing partial batch: per-record loss weighted
-                    # by the 1-real/0-pad mask over the GLOBAL real
-                    # count — every record trains exactly once at static
-                    # shape (same contract as spmd.py's masked step;
-                    # pad rows are whole records, so they only touch the
-                    # batch dim and compose with microbatching freely)
-                    w, total_w = mask_args
-                    add_axis = lambda v: jax.tree_util.tree_map(
-                        lambda a: a[None], v)
-                    per = jax.vmap(
-                        lambda o, t: criterion._loss(add_axis(o),
-                                                     add_axis(t)))(out, y)
-                    return jnp.sum(per * w) / total_w
-                return criterion._loss(out, y)
-
-            loss, grads = jax.value_and_grad(loss_fn)(packed)
-
-            def _has(spec, axis):
-                return axis is not None and any(
-                    ax == axis or (isinstance(ax, tuple) and axis in ax)
-                    for ax in spec if ax is not None)
-
-            def reduce_grad(g, spec):
-                piped = _has(spec, pipe_axis)
-                modeled = _has(spec, model_axis)
-                # data axis: pmean by the mean-loss convention, or a
-                # SUM when the masked loss is already normalized by the
-                # global real count
-                if data_axis:
-                    g = (lax.psum(g, data_axis) if masked
-                         else lax.pmean(g, data_axis))
-                # sharded axes divide out the replicated-loss cotangent
-                # amplification; replicated-over axes pmean the copies
-                if piped:
-                    g = g / S
-                else:
-                    g = lax.pmean(g, pipe_axis)
-                if model_axis:
-                    g = g / n_model if modeled else lax.pmean(g,
-                                                              model_axis)
-                return g
-
-            grads = jax.tree_util.tree_map(reduce_grad, grads, pspecs)
-            if data_axis:
-                loss = (lax.psum(loss, data_axis) if masked
-                        else lax.pmean(loss, data_axis))
-            new_p, new_slots = optim.step(grads, packed, slots, lr)
-            return loss, new_p, new_slots
-
-        return local_step
-
-    in_batch = P(data_axis) if data_axis else P()
-    _jitted = {}
-
-    def _jitted_for(masked):
-        if masked not in _jitted:
-            in_specs = (pspecs, sslots, P(), P(), in_batch, in_batch)
-            if masked:
-                # weight vector shards over data only (pad rows are
-                # whole records); the real count replicates
-                in_specs = in_specs + (P(data_axis) if data_axis else P(),
-                                       P())
-            sharded = shard_map(
-                _make_local_step(masked), mesh=mesh, in_specs=in_specs,
-                out_specs=(P(), pspecs, sslots), check_vma=False)
-            _jitted[masked] = jax.jit(
-                sharded, donate_argnums=(0, 1) if donate else ())
-        return _jitted[masked]
+    eng = compile_step_with_plan(
+        model, criterion, optim, mesh, data_axis=data_axis,
+        seq_axis=None, model_axis=model_axis, pipe_axis=pipe_axis,
+        n_microbatch=n_microbatch, compute_dtype=compute_dtype,
+        donate=donate, remat=remat, guard=False, with_gnorm=False)
+    buffers = model.buffer_tree()  # validated empty by _check_model
 
     def step(packed, slots, lr, x, y, rng=None, w=None, total_w=None):
-        args = (packed, slots, jnp.float32(lr),
-                rng if rng is not None else jax.random.PRNGKey(0),
-                jnp.asarray(x), jnp.asarray(y))
-        if w is not None:
-            args = args + (jnp.asarray(w, jnp.float32),
-                           jnp.float32(total_w))
-        return _jitted_for(w is not None)(*args)
+        loss, packed, slots, _buf, _ok, _gn = eng.step(
+            packed, slots, buffers, lr, x, y, rng=rng, w=w,
+            total_w=total_w)
+        return loss, packed, slots
 
-    step.param_specs = pspecs
-    step.slot_specs = sslots
+    S = eng.n_pipe
+    step.param_specs = eng.param_specs
+    step.slot_specs = eng.slot_specs
     step.n_stages = S
-    step.n_microbatch = M
+    step.n_microbatch = eng.n_microbatch
     step.pack = lambda: pack_params(model, S, model_axis)
     step.unpack = lambda packed: unpack_params(packed, model)
     # underlying jit object (by masked variant) for the telemetry
     # PerfAccountant's cost-model lowering
-    step.jitted_for = _jitted_for
+    step.jitted_for = lambda masked: eng.jitted_for(None, None, masked)
+    step.engine = eng
     return step
 
 

@@ -530,6 +530,7 @@ class ElasticContext:
         self.telemetry_cadence = max(0, int(telemetry_cadence))
         self._sleep = sleep
         self._mesh_factory = mesh_factory
+        self._mesh_template = None
         self._n_devices: Optional[int] = None
         self._drop_knobs: Optional[Tuple[float, float, int]] = None
         # background publisher (telemetry/publish.py): KV-transport
@@ -570,13 +571,19 @@ class ElasticContext:
         return self.coordinator.host
 
     def attach(self, n_devices: Optional[int] = None,
-               batch_size: Optional[int] = None):
-        """Driver hook: record the local device pool and batch size the
-        shrink math must respect."""
+               batch_size: Optional[int] = None, mesh_template=None):
+        """Driver hook: record the local device pool, the batch size
+        the shrink math must respect, and the mesh TEMPLATE whose
+        non-data axes a shrink must keep (ISSUE 8: a shrink on a
+        data x model [x pipe] mesh re-derives a mesh that still
+        tensor/pipeline-parallelizes instead of silently degrading to
+        data-only)."""
         if n_devices is not None:
             self._n_devices = int(n_devices)
         if batch_size is not None:
             self.batch_size = int(batch_size)
+        if mesh_template is not None:
+            self._mesh_template = mesh_template
         return self
 
     def configure_straggler_from_knobs(self, drop_percentage: float,
@@ -608,21 +615,31 @@ class ElasticContext:
 
     # -- mesh -----------------------------------------------------------
     def current_mesh(self):
-        """The mesh this incarnation trains on: largest valid shard
-        count for the member set over the local device pool (the
-        factory defaults to :func:`parallel.spmd.survivor_mesh`)."""
+        """The mesh this incarnation trains on: largest valid DATA
+        shard count for the member set over the local device pool,
+        with the attached template's non-data axes (model/seq/pipe)
+        kept at full size — shrink/regrow is one mesh(+plan)
+        re-derivation for ANY mesh shape (the factory defaults to
+        :func:`parallel.spmd.survivor_mesh`)."""
         import jax
 
         n_dev = self._n_devices or len(jax.devices())
+        template = self._mesh_template
+        rest = 1
+        if template is not None:
+            for a in template.axis_names:
+                if a != "data":
+                    rest *= int(template.shape[a])
         k = largest_valid_shards(len(self.members) or 1,
-                                 self.batch_size, n_dev)
+                                 self.batch_size,
+                                 max(1, n_dev // rest))
         self.current_shards = k
         self.shard_history.append(k)
         if self._mesh_factory is not None:
             return self._mesh_factory(k)
         from ..parallel.spmd import survivor_mesh
 
-        return survivor_mesh(k)
+        return survivor_mesh(k, template=template)
 
     # -- lifecycle hooks -------------------------------------------------
     def begin_attempt(self):

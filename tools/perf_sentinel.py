@@ -67,6 +67,13 @@ DEFAULT_TOLERANCES = {
     "goodput_checkpoint_fraction": ("lower", 0.50, 0.01),
     "data_stall_s": ("lower", 0.50, 0.50),
     "checkpoint_blocked_s": ("lower", 0.50, 0.25),
+    # sharding-plan engine (ISSUE 8): composed-mesh steps/sec on the
+    # forced-host CPU leg is noisy (single core, 3-D collectives), so
+    # the tolerance is wide; the FSDP per-device param fraction is a
+    # deterministic layout property — a rise means param sharding
+    # silently stopped sharding
+    "sharding_composed_steps_per_sec": ("higher", 0.50),
+    "sharding_fsdp_param_bytes_frac": ("lower", 0.25),
 }
 
 
