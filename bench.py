@@ -954,6 +954,197 @@ def run_serving_bench() -> None:
 
 
 # --------------------------------------------------------------------------
+# Fleet leg: open-loop Zipf load over a 4-replica serving fleet
+# --------------------------------------------------------------------------
+
+FLEET_TIMEOUT = float(os.environ.get("BENCH_FLEET_TIMEOUT", "300"))
+FLEET_RESULT = "SERVING_r02.json"
+
+
+def _fleet_measurements(n_replicas: int = 4, rate_rps: float = 500.0,
+                        duration_s: float = 2.5, feature_dim: int = 64,
+                        max_batch: int = 32, max_queue: int = 128,
+                        users: int = 128, zipf_a: float = 1.1,
+                        deadline_s: float = 2.0):
+    """Open-loop load with a Zipf-distributed request mix through the
+    replica fleet (``serving.ServingFleet`` + ``FleetRouter``).
+
+    Zipf mix: requests draw one of ``users`` distinct feature rows
+    with rank-``zipf_a`` popularity — the heavy-skew traffic shape the
+    BigDL lineage served in production.  Three passes: (1) steady
+    un-hedged fleet (p50/p99, shed rate, goodput-per-chip), (2) the
+    same load with tail-latency hedging enabled (hedged p99 + hedge
+    counters), (3) a replica kill mid-load (recovery wall-clock =
+    kill → ejected from the live set → first post-eject OK).  Pure
+    control-plane numbers, meaningful on any backend."""
+    import contextlib
+    import threading
+
+    import numpy as np
+
+    from bigdl_tpu import nn
+    from bigdl_tpu.resilience import faults
+    from bigdl_tpu.serving import ServingFleet, Status
+    from bigdl_tpu.telemetry import Histogram
+
+    rng = np.random.RandomState(0)
+    features = rng.rand(users, feature_dim).astype(np.float32)
+    ranks = np.arange(1, users + 1, dtype=np.float64)
+    probs = ranks ** -float(zipf_a)
+    probs /= probs.sum()
+
+    model = nn.Sequential(nn.Linear(feature_dim, 128), nn.Tanh(),
+                          nn.Linear(128, 10), nn.LogSoftMax())
+
+    def build(hedge):
+        fleet = ServingFleet.build(
+            model, n_replicas=n_replicas,
+            server_kw=dict(max_batch=max_batch, max_queue=max_queue),
+            heartbeat_timeout=0.4,
+            router_kw=dict(default_deadline_s=deadline_s,
+                           hedge=hedge))
+        fleet.start()
+        # warm every replica's bucket ladder so steady numbers
+        # exclude compiles
+        warm = [fleet.servers[rid].submit(features[i % users])
+                for rid in fleet.servers for i in range(max_batch)]
+        for f in warm:
+            f.result(timeout=120)
+        return fleet
+
+    def open_loop(fleet, duration):
+        mix = rng.choice(users, size=int(rate_rps * duration) + 64,
+                         p=probs)
+        futs = []
+        t0 = time.perf_counter()
+        n = 0
+        while True:
+            elapsed = time.perf_counter() - t0
+            if elapsed >= duration:
+                break
+            while n < int(elapsed * rate_rps):
+                futs.append(fleet.submit(features[mix[n % len(mix)]]))
+                n += 1
+            time.sleep(0.0005)
+        return [f.result(timeout=120) for f in futs]
+
+    def stats(results):
+        ok_lat = [r.latency_s for r in results if r.ok]
+        hist = Histogram(window=max(1, len(ok_lat)))
+        for v in ok_lat:
+            hist.observe(v)
+
+        def pct(q):
+            p = hist.quantile(q)
+            return round(p * 1e3, 3) if p is not None else None
+
+        shed = sum(r.status is Status.OVERLOADED for r in results)
+        return {
+            "offered": len(results),
+            "ok": sum(r.ok for r in results),
+            "shed": shed,
+            "shed_rate": round(shed / len(results), 4) if results
+            else 0.0,
+            "latency_p50_ms": pct(0.50),
+            "latency_p99_ms": pct(0.99),
+        }
+
+    out = {"n_replicas": n_replicas, "users": users,
+           "zipf_a": zipf_a, "rate_rps": rate_rps,
+           "deadline_s": deadline_s}
+
+    # -- pass 1: steady un-hedged + replica kill mid-load ------------
+    fleet = build(hedge=False)
+    try:
+        steady = open_loop(fleet, duration_s)
+        out["steady"] = stats(steady)
+        gpc = fleet.goodput_per_chip()
+        out["goodput_per_chip_flops"] = round(
+            gpc["model_flops_per_sec_per_chip"], 1)
+        out["fleet_mfu"] = gpc["mfu"]
+
+        # replica kill mid-load: keep offering traffic while r1 dies;
+        # recovery = kill -> ejected from the live set -> first
+        # post-eject OK probe
+        kill = {"recovery_s": None, "ejected": False}
+
+        def killer():
+            t_kill = time.monotonic()
+            deadline = t_kill + 30
+            while "r1" in fleet.router.members \
+                    and time.monotonic() < deadline:
+                time.sleep(0.005)
+            kill["ejected"] = "r1" not in fleet.router.members
+            while time.monotonic() < deadline:
+                probe = fleet.submit(features[0]).result(timeout=30)
+                if probe.ok:
+                    kill["recovery_s"] = round(
+                        time.monotonic() - t_kill, 3)
+                    return
+                time.sleep(0.01)
+
+        with contextlib.ExitStack() as stack:
+            stack.enter_context(faults.kill_replica("r1"))
+            kt = threading.Thread(target=killer)
+            kt.start()
+            during = open_loop(fleet, duration_s / 2)
+            kt.join(timeout=60)
+        out["kill"] = dict(kill, **stats(during))
+        out["recovery_s"] = kill["recovery_s"]
+        # every request resolved with a typed Status (zero lost
+        # beyond the shed budget)
+        out["all_resolved_typed"] = all(
+            r.status is not None for r in steady + during)
+    finally:
+        fleet.stop(timeout=30)
+
+    # -- pass 2: the same steady load, hedged ------------------------
+    fleet = build(hedge=True)
+    try:
+        hedged = open_loop(fleet, duration_s)
+        h = stats(hedged)
+        h["hedges_fired"] = fleet.router.metrics.hedges_fired
+        h["hedges_won"] = fleet.router.metrics.hedges_won
+        out["hedged"] = h
+    finally:
+        fleet.stop(timeout=30)
+
+    out["p99_ms"] = out["steady"]["latency_p99_ms"]
+    out["hedged_p99_ms"] = out["hedged"]["latency_p99_ms"]
+    out["shed_rate"] = out["steady"]["shed_rate"]
+    return out
+
+
+def run_fleet_bench() -> None:
+    """--fleet mode: open-loop Zipf load over the 4-replica fleet on
+    CPU (control-plane numbers), write SERVING_r02.json, print the one
+    JSON line."""
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    out = {"bench": "fleet", "backend": "cpu",
+           "measured_at": _utc_now()}
+    try:
+        out.update(_fleet_measurements())
+        p99 = out["p99_ms"]
+        out.update({
+            "metric": "fleet open-loop p99 latency",
+            "value": p99 if p99 is not None else 0.0,
+            "unit": "ms",
+        })
+    except Exception as e:
+        out["error"] = f"{type(e).__name__}: {e}"[:500]
+        out.update({"metric": "fleet open-loop p99 latency",
+                    "value": 0.0, "unit": "ms"})
+    try:
+        with open(os.path.join(_here(), FLEET_RESULT), "w") as f:
+            json.dump(out, f, indent=1)
+    except OSError:
+        pass
+    print(json.dumps(out), flush=True)
+
+
+# --------------------------------------------------------------------------
 # Elastic leg: chaos run through the shrink-to-survivors coordinator
 # --------------------------------------------------------------------------
 
@@ -1683,7 +1874,10 @@ LEDGER_FIELDS = (
     "transformerlm_cpu_tokens_per_sec",
     "simplernn_records_per_sec", "lenet5_images_per_sec",
     "decode_tokens_per_sec", "prefill_tokens_per_sec",
-    "serving_p99_ms", "serving_p50_ms", "elastic_recovery_s",
+    "serving_p99_ms", "serving_p50_ms",
+    "fleet_p99_ms", "fleet_hedged_p99_ms", "fleet_shed_rate",
+    "fleet_goodput_per_chip", "fleet_recovery_s",
+    "elastic_recovery_s",
     "sdc_detection_latency_steps", "telemetry_overhead_pct",
     "goodput_productive_fraction", "goodput_accounted_fraction",
     "goodput_checkpoint_fraction", "data_stall_s",
@@ -1700,6 +1894,14 @@ def ledger_record(result: dict) -> dict:
     serving = result.get("serving") or {}
     flat["serving_p99_ms"] = serving.get("p99_ms")
     flat["serving_p50_ms"] = serving.get("p50_ms")
+    # the fleet leg (ISSUE 9): shed rate may only fall, goodput-per-
+    # chip may only rise — tools/perf_sentinel.py guards the direction
+    fleet = result.get("fleet") or {}
+    flat["fleet_p99_ms"] = fleet.get("p99_ms")
+    flat["fleet_hedged_p99_ms"] = fleet.get("hedged_p99_ms")
+    flat["fleet_shed_rate"] = fleet.get("shed_rate")
+    flat["fleet_goodput_per_chip"] = fleet.get("goodput_per_chip_flops")
+    flat["fleet_recovery_s"] = fleet.get("recovery_s")
     elastic = result.get("elastic") or {}
     flat["elastic_recovery_s"] = elastic.get("recovery_wall_clock_s")
     integrity = result.get("integrity") or {}
@@ -1998,6 +2200,30 @@ def main(ledger: bool = True, probe: bool = True) -> None:
                        or "serving leg returned nothing"}
     result["serving"] = serving
 
+    # fleet leg: open-loop Zipf load over the 4-replica serving fleet
+    # (p99 with/without hedging, shed rate, goodput-per-chip, replica-
+    # kill recovery; backend-independent, lands in SERVING_r02.json) —
+    # best-effort like the serving leg; BENCH_FLEET_TIMEOUT=0
+    # disables it.
+    if FLEET_TIMEOUT <= 0:
+        fleet = {"skipped": "BENCH_FLEET_TIMEOUT=0"}
+    else:
+        ok, fres, note = _run_sub(["--fleet"], FLEET_TIMEOUT)
+        if ok and fres and "error" not in fres:
+            fleet = {
+                "p99_ms": fres.get("p99_ms"),
+                "hedged_p99_ms": fres.get("hedged_p99_ms"),
+                "shed_rate": fres.get("shed_rate"),
+                "goodput_per_chip_flops": fres.get(
+                    "goodput_per_chip_flops"),
+                "recovery_s": fres.get("recovery_s"),
+                "source": FLEET_RESULT,
+            }
+        else:
+            fleet = {"error": (fres or {}).get("error") or note
+                     or "fleet leg returned nothing"}
+    result["fleet"] = fleet
+
     # elastic leg: chaos run through the shrink-to-survivors coordinator
     # (recovery wall-clock + pre/post-fault throughput; backend-
     # independent, lands in ELASTIC_r01.json) — best-effort like the
@@ -2124,11 +2350,11 @@ def main(ledger: bool = True, probe: bool = True) -> None:
                           "simplernn_records_per_sec",
                           "lenet5_images_per_sec", "error")
                 if result.get(k) is not None}
-            # the control-plane legs (serving/elastic/integrity/
+            # the control-plane legs (serving/fleet/elastic/integrity/
             # telemetry/sharding) are backend-independent and were
             # measured LIVE this run — they must not be shadowed by
             # whatever the stale chip record carried
-            for leg in ("serving", "elastic", "integrity",
+            for leg in ("serving", "fleet", "elastic", "integrity",
                         "telemetry", "sharding"):
                 if result.get(leg) is not None:
                     merged[leg] = result[leg]
@@ -2150,6 +2376,7 @@ if __name__ == "__main__":
     p = argparse.ArgumentParser()
     p.add_argument("--probe", action="store_true")
     p.add_argument("--serving", action="store_true")
+    p.add_argument("--fleet", action="store_true")
     p.add_argument("--elastic", action="store_true")
     p.add_argument("--integrity", action="store_true")
     p.add_argument("--telemetry", action="store_true")
@@ -2170,6 +2397,8 @@ if __name__ == "__main__":
         run_probe()
     elif a.serving:
         run_serving_bench()
+    elif a.fleet:
+        run_fleet_bench()
     elif a.elastic:
         run_elastic_bench()
     elif a.integrity:

@@ -203,10 +203,13 @@ _SERVING_LOCK = threading.Lock()
 _SERVING_FAULTS: list = []  # [dict(kind, remaining, exc_type|seconds, fired)]
 
 
-def check_serving_fault():
-    """Called by the serving worker before each batch step: applies the
+def check_serving_fault(server: Optional[str] = None):
+    """Called by the serving worker before each batch step (and by the
+    hot-swap canary) with the server's replica name: applies the
     injected latency, then raises the injected failure while its budget
-    lasts.  No-op (and free) when nothing is registered."""
+    lasts.  An entry carrying a ``server`` name only fires for that
+    replica; unscoped entries fire for every server.  No-op (and free)
+    when nothing is registered."""
     if not _SERVING_FAULTS:
         return
     delay = 0.0
@@ -214,6 +217,8 @@ def check_serving_fault():
     with _SERVING_LOCK:
         for f in _SERVING_FAULTS:
             if f["remaining"] <= 0:
+                continue
+            if f.get("server") is not None and f["server"] != server:
                 continue
             if f["kind"] == "latency":
                 f["remaining"] -= 1
@@ -234,12 +239,15 @@ def check_serving_fault():
 
 
 @contextlib.contextmanager
-def serving_step_failures(times: int = 1, exc_type=RuntimeError):
+def serving_step_failures(times: int = 1, exc_type=RuntimeError,
+                          server: Optional[str] = None):
     """Fail the next ``times`` serving batch steps with ``exc_type``
     (classified by the server's RetryPolicy: a retryable type counts
-    toward the breaker threshold, a fatal one trips it immediately)."""
+    toward the breaker threshold, a fatal one trips it immediately).
+    ``server`` scopes the fault to one named replica."""
     entry = {"kind": "fail", "remaining": int(times),
-             "exc_type": exc_type, "fired": 0}
+             "exc_type": exc_type, "fired": 0,
+             "server": None if server is None else str(server)}
     with _SERVING_LOCK:
         _SERVING_FAULTS.append(entry)
     try:
@@ -250,12 +258,15 @@ def serving_step_failures(times: int = 1, exc_type=RuntimeError):
 
 
 @contextlib.contextmanager
-def serving_step_latency(seconds: float, times: int = 1 << 30):
+def serving_step_latency(seconds: float, times: int = 1 << 30,
+                         server: Optional[str] = None):
     """Add ``seconds`` of host-side latency to the next ``times``
     serving batch steps — drives deadline-expiry and queue-depth
-    behaviors without a slow model."""
+    behaviors without a slow model.  ``server`` scopes the fault to one
+    named replica."""
     entry = {"kind": "latency", "remaining": int(times),
-             "seconds": float(seconds), "fired": 0}
+             "seconds": float(seconds), "fired": 0,
+             "server": None if server is None else str(server)}
     with _SERVING_LOCK:
         _SERVING_FAULTS.append(entry)
     try:
@@ -263,6 +274,78 @@ def serving_step_latency(seconds: float, times: int = 1 << 30):
     finally:
         with _SERVING_LOCK:
             _SERVING_FAULTS.remove(entry)
+
+
+# ---------------------------------------------------------------------------
+# fleet (replica-membership) faults
+# ---------------------------------------------------------------------------
+# The serving-fleet layer (serving/fleet.py) gives inference the same
+# cluster fault surface training got: each ReplicaAgent consults
+# check_fleet_fault(replica) once per heartbeat pump, so replica death
+# and KV partitions are scheduled deterministically against the
+# heartbeat timeline.  ``delay_replica`` rides the per-server scoping
+# of the serving injectors above (the slow path is the compiled step,
+# not the heartbeat).
+
+_FLEET_LOCK = threading.Lock()
+_FLEET_FAULTS: list = []  # [dict(kind, replica, remaining, fired)]
+
+
+def check_fleet_fault(replica: str) -> Optional[str]:
+    """Called once per heartbeat pump by each ReplicaAgent.  Returns
+    the armed fault kind for this replica (``"kill"`` consumes one
+    budget unit; ``"partition"`` reports while armed without consuming
+    — a partition lasts as long as its context), or None."""
+    if not _FLEET_FAULTS:
+        return None
+    with _FLEET_LOCK:
+        for f in _FLEET_FAULTS:
+            if f["replica"] != replica or f["remaining"] <= 0:
+                continue
+            if f["kind"] == "kill":
+                f["remaining"] -= 1
+            f["fired"] += 1
+            return f["kind"]
+    return None
+
+
+@contextlib.contextmanager
+def _fleet_fault(entry):
+    with _FLEET_LOCK:
+        _FLEET_FAULTS.append(entry)
+    try:
+        yield entry
+    finally:
+        with _FLEET_LOCK:
+            _FLEET_FAULTS.remove(entry)
+
+
+def kill_replica(replica: str):
+    """Kill serving replica ``replica`` at its next heartbeat pump: its
+    server hard-stops (in-flight requests resolve typed, queued ones
+    CANCELLED) and its heartbeats cease — the router's missed-heartbeat
+    ejection and failover-retry paths are exercised end to end."""
+    return _fleet_fault({"kind": "kill", "replica": str(replica),
+                         "remaining": 1, "fired": 0})
+
+
+def delay_replica(replica: str, seconds: float, times: int = 1 << 30):
+    """Slow ``replica``'s serving steps by ``seconds`` each — its
+    queue grows and its published p99 inflates, driving the router's
+    least-loaded routing away from it (the serving analogue of
+    :func:`delay_host`)."""
+    return serving_step_latency(seconds, times=times, server=replica)
+
+
+def partition_kv(replica: str):
+    """Partition ``replica`` from the fleet KV transport while the
+    context is active: its heartbeats and health snapshots stop
+    landing, so the router presumes it dead and ejects it; on heal
+    (context exit) its beats resume and it is re-admitted — the
+    asymmetric-partition case where the replica itself is healthy but
+    invisible."""
+    return _fleet_fault({"kind": "partition", "replica": str(replica),
+                         "remaining": 1 << 30, "fired": 0})
 
 
 # ---------------------------------------------------------------------------

@@ -34,19 +34,31 @@ verified checkpoints) applied to an in-process request path:
   (p50/p99) backed by the unified telemetry registry
   (:mod:`bigdl_tpu.telemetry` — Prometheus text export, mergeable
   histograms), exported through ``visualization.summary``.
+* :mod:`.fleet` / :mod:`.router` — the replica fleet layer:
+  :class:`ServingFleet` runs N replicas whose membership rides the
+  elastic KV transport (heartbeats + health snapshots + incarnation
+  numbers, exactly like training gangs) and rolls verified deploys
+  one replica at a time with fleet-wide rollback;
+  :class:`FleetRouter` dispatches least-loaded with deadline-budget
+  failover retries, optional p99-derived hedging, and per-replica
+  circuit breakers.
 
 Deterministic serving fault injectors (fail-next-N steps, injected
-step latency, poisoned params) live with the training injectors in
-:mod:`bigdl_tpu.resilience.faults`.
+step latency, poisoned params, replica kill/partition) live with the
+training injectors in :mod:`bigdl_tpu.resilience.faults`.
 """
 from .batcher import MicroBatcher
 from .breaker import CircuitBreaker
+from .fleet import FleetQuorumError, ReplicaAgent, ServingFleet
 from .metrics import ServingMetrics
+from .router import FleetRouter
 from .server import InferenceServer
 from .status import ServeFuture, ServeResult, Status
 from .swap import load_verified_params
 
 __all__ = [
-    "CircuitBreaker", "InferenceServer", "MicroBatcher", "ServeFuture",
-    "ServeResult", "ServingMetrics", "Status", "load_verified_params",
+    "CircuitBreaker", "FleetQuorumError", "FleetRouter",
+    "InferenceServer", "MicroBatcher", "ReplicaAgent", "ServeFuture",
+    "ServeResult", "ServingFleet", "ServingMetrics", "Status",
+    "load_verified_params",
 ]

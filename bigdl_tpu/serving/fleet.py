@@ -1,0 +1,432 @@
+"""Replica fleet: membership, health publishing, rolling verified deploys.
+
+This is the integration layer the ROADMAP's planet-scale-serving item
+asks for: N hardened :class:`~.server.InferenceServer` replicas become
+ONE serving surface with the same fault story training got in the
+robustness arc.
+
+* :class:`ReplicaAgent` — one per replica: heartbeats + a health
+  snapshot (``ready``, queue depth, breaker state, p99) published
+  through the **elastic KV transport**
+  (:class:`~bigdl_tpu.resilience.elastic.ElasticCoordinator` — the
+  identical membership protocol training gangs run, incarnation
+  numbers included).  The agent is also the fleet chaos surface:
+  :func:`~bigdl_tpu.resilience.faults.kill_replica` hard-stops its
+  server at the next pump, :func:`~bigdl_tpu.resilience.faults
+  .partition_kv` silences its publishing.
+* :class:`~.router.FleetRouter` — maintained by the fleet's pump
+  loop: health-aware least-loaded dispatch, deadline-budget failover
+  retries, optional p99-derived hedging, per-replica breakers, and
+  membership ejection/re-admission.
+* **Rolling verified deploys** — :meth:`ServingFleet.rolling_swap`
+  rolls new params through the fleet ONE replica at a time, each
+  through the existing crc32c-verified load + canary
+  (:meth:`~.server.InferenceServer.swap_params`).  The first
+  :class:`~.swap.SwapRejected` halts the deploy and rolls every
+  already-swapped replica back to its prior params, and the deploy
+  never proceeds while the rest of the fleet is below the configured
+  **ready quorum** — a poisoned artifact can never serve a user
+  request, fleet-wide.
+
+The fleet's merged telemetry rides the existing cross-host fold
+(:func:`~bigdl_tpu.telemetry.aggregate.merge_metrics`): per-replica
+registries sum into one cluster view, ``write_snapshots`` drops the
+per-replica payloads ``tools/run_report.py`` renders, and
+:meth:`goodput_per_chip` reports served model-FLOP/s per chip — the
+serving analogue of cluster MFU.
+"""
+from __future__ import annotations
+
+import json
+import logging
+import threading
+import time
+from typing import Callable, Dict, Optional
+
+from ..resilience import faults as _faults
+from ..resilience.elastic import ElasticCoordinator, InMemoryKV
+from .metrics import ServingMetrics
+from .router import FleetRouter, HEALTH_PREFIX
+from .server import InferenceServer
+from .swap import SwapRejected, load_verified_params
+
+log = logging.getLogger("bigdl_tpu")
+
+
+class FleetQuorumError(RuntimeError):
+    """A rolling deploy (or other fleet-wide operation) would drop the
+    ready replica count below the configured quorum — refused."""
+
+
+class ReplicaAgent:
+    """The publisher side of fleet membership for ONE replica.
+
+    ``pump()`` — called by the fleet's heartbeat loop (or directly by
+    tests) — consults the fleet fault injectors, acks any new
+    incarnation, heartbeats through the coordinator, and publishes the
+    health snapshot the router routes on.  A killed agent stays
+    silent; a partitioned one stays alive but invisible.
+    """
+
+    def __init__(self, replica_id: str, server: InferenceServer,
+                 transport, heartbeat_timeout: float = 2.0,
+                 clock: Callable[[], float] = time.monotonic):
+        self.replica_id = str(replica_id)
+        self.server = server
+        self.coordinator = ElasticCoordinator(
+            self.replica_id, transport,
+            heartbeat_timeout=heartbeat_timeout, clock=clock)
+        self._clock = clock
+        self._beats = 0
+        self._acked: Optional[int] = None
+        self.killed = False
+
+    def health_snapshot(self) -> dict:
+        h = self.server.health()
+        m = self.server.metrics
+        return {
+            "replica": self.replica_id,
+            "ready": h["ready"],
+            "healthy": h["healthy"],
+            "draining": h["draining"],
+            "queue_depth": h["queue_depth"],
+            "breaker_state": h["breaker"]["state"],
+            "p99_s": m._lat.quantile(0.99),
+            "served_ok": int(m.counts["ok"]),
+            "ts": self._clock(),
+        }
+
+    def pump(self):
+        """One heartbeat round.  No-op once killed; silent while
+        partitioned (beats age out and the router presumes us dead —
+        exactly a dead training host's signature)."""
+        if self.killed:
+            return
+        fault = _faults.check_fleet_fault(self.replica_id)
+        if fault == "kill":
+            self.kill()
+            return
+        if fault == "partition":
+            return
+        c = self.coordinator
+        n, members = c.membership()
+        if n != self._acked:
+            c.ack(n)
+            self._acked = n
+        self._beats += 1
+        # a healed partition (or an ejected replica coming back) beats
+        # with rejoin=True until the membership includes it again
+        c.heartbeat(step=self._beats,
+                    rejoin=self.replica_id not in members)
+        snap = self.health_snapshot()
+        snap["incarnation"] = n
+        c.transport.put(HEALTH_PREFIX + self.replica_id,
+                        json.dumps(snap))
+
+    def kill(self):
+        """Injected replica death: hard-stop the server (queued
+        requests resolve CANCELLED — typed, never silent) and stop
+        heartbeating."""
+        self.killed = True
+        log.warning("fleet: replica %s killed", self.replica_id)
+        self.server.stop(timeout=0.5)
+
+
+class ServingFleet:
+    """N replicas + agents + router behind one lifecycle.
+
+    Build one either from pre-constructed servers
+    (``ServingFleet(servers={...})``) or with :meth:`build`, which
+    stamps out ``n_replicas`` named servers over one model.  ``start``
+    launches every server, runs one synchronous pump round (so the
+    router has a live view before the first request), then starts the
+    background pump thread.
+    """
+
+    def __init__(self, servers: Dict[str, InferenceServer],
+                 transport=None, *, heartbeat_timeout: float = 2.0,
+                 pump_interval_s: Optional[float] = None,
+                 ready_quorum: Optional[int] = None,
+                 router_kw: Optional[dict] = None,
+                 clock: Callable[[], float] = time.monotonic):
+        if not servers:
+            raise ValueError("a fleet needs at least one replica")
+        self.transport = transport if transport is not None \
+            else InMemoryKV()
+        self.servers = dict(servers)
+        self.heartbeat_timeout = float(heartbeat_timeout)
+        self.pump_interval_s = (heartbeat_timeout / 4.0
+                                if pump_interval_s is None
+                                else float(pump_interval_s))
+        # quorum default: strict majority of the configured fleet
+        self.ready_quorum = (len(self.servers) // 2 + 1
+                             if ready_quorum is None
+                             else int(ready_quorum))
+        self._clock = clock
+        self.agents = {
+            rid: ReplicaAgent(rid, srv, self.transport,
+                              heartbeat_timeout=heartbeat_timeout,
+                              clock=clock)
+            for rid, srv in self.servers.items()}
+        coordinator = ElasticCoordinator(
+            "fleet-router", self.transport,
+            heartbeat_timeout=heartbeat_timeout, clock=clock)
+        coordinator.bootstrap(sorted(self.servers))
+        router_kw = dict(router_kw or {})
+        router_kw.setdefault("clock", clock)
+        self.router = FleetRouter(self.servers, coordinator,
+                                  **router_kw)
+        self.deploys = 0
+        self.deploy_rollbacks = 0
+        self._pump_thread: Optional[threading.Thread] = None
+        self._stop_pump = threading.Event()
+
+    @classmethod
+    def build(cls, model, n_replicas: int = 4, transport=None,
+              server_kw: Optional[dict] = None, **fleet_kw
+              ) -> "ServingFleet":
+        """Stamp out ``n_replicas`` named servers (``r0``…) over one
+        model.  Each replica pins its own param copy at start, so a
+        per-replica swap/rollback never bleeds across replicas."""
+        servers = {
+            f"r{i}": InferenceServer(model, name=f"r{i}",
+                                     **(server_kw or {}))
+            for i in range(int(n_replicas))}
+        return cls(servers, transport, **fleet_kw)
+
+    # ------------------------------------------------------------ lifecycle
+    def start(self) -> "ServingFleet":
+        for srv in self.servers.values():
+            if not srv.healthy():
+                srv.start()
+        self.pump_once()
+        if self.pump_interval_s > 0:
+            self._stop_pump.clear()
+            self._pump_thread = threading.Thread(
+                target=self._pump_loop, daemon=True,
+                name="bigdl-fleet-pump")
+            self._pump_thread.start()
+        return self
+
+    def pump_once(self):
+        """One synchronous membership round: every agent beats, then
+        the router refreshes its view.  Tests drive this directly for
+        deterministic membership transitions."""
+        for agent in self.agents.values():
+            agent.pump()
+        self.router.refresh()
+
+    def _pump_loop(self):
+        while not self._stop_pump.wait(self.pump_interval_s):
+            try:
+                self.pump_once()
+            except Exception:
+                log.exception("fleet: pump round failed")
+
+    def stop(self, timeout: Optional[float] = 10.0) -> bool:
+        """Stop the pump, close the router (in-flight requests still
+        resolve), and hard-stop every replica."""
+        self._stop_pump.set()
+        if self._pump_thread is not None:
+            self._pump_thread.join(timeout)
+            self._pump_thread = None
+        self.router.close()
+        ok = True
+        for srv in self.servers.values():
+            ok = srv.stop(timeout=timeout) and ok
+        return ok
+
+    # ------------------------------------------------------------ routing
+    def submit(self, feature, deadline_s=None):
+        return self.router.submit(feature, deadline_s=deadline_s)
+
+    def submit_generate(self, prompt_ids, max_new, **kw):
+        return self.router.submit_generate(prompt_ids, max_new, **kw)
+
+    def ready_count(self, exclude=()) -> int:
+        return sum(1 for rid, srv in self.servers.items()
+                   if rid not in exclude and srv.ready())
+
+    # ------------------------------------------------------------ deploys
+    def rolling_swap(self, params=None, path: Optional[str] = None,
+                     order=None) -> int:
+        """Fleet-wide verified deploy, one replica at a time.
+
+        ``path`` loads ONCE through the crc32c-verified checkpoint
+        path (corrupt bytes refuse the whole deploy before any replica
+        is touched).  Each replica then runs its own canary via
+        :meth:`~.server.InferenceServer.swap_params`; the first
+        :class:`SwapRejected` halts the roll and **rolls back every
+        already-swapped replica** to its captured prior params.
+        Before each replica swaps, the fleet must hold
+        ``ready_quorum`` ready replicas (the install is atomic between
+        batches and a failed canary leaves the old params serving, so
+        the target itself stays in rotation — the guard is against
+        rolling a deploy through an already-degraded fleet) —
+        otherwise :class:`FleetQuorumError` (and rollback of anything
+        already swapped).  Returns the number of replicas deployed.
+
+        Replicas that are not healthy (killed, draining) are skipped —
+        they pick up current params through the normal swap path when
+        they come back.
+        """
+        if (params is None) == (path is None):
+            raise ValueError("pass exactly one of params/path")
+        if path is not None:
+            params = load_verified_params(path)
+        order = list(order) if order is not None \
+            else sorted(self.servers)
+        done = []  # [(rid, (prior_params, prior_buffers))]
+        for rid in order:
+            srv = self.servers.get(rid)
+            if srv is None or not srv.healthy():
+                log.warning("fleet: deploy skipping unhealthy "
+                            "replica %s", rid)
+                continue
+            ready = self.ready_count()
+            if ready < self.ready_quorum:
+                self._rollback(done)
+                self.deploy_rollbacks += 1
+                raise FleetQuorumError(
+                    f"deploy halted before {rid}: only {ready} "
+                    f"replica(s) ready, quorum is "
+                    f"{self.ready_quorum} — fleet rolled back")
+            prior = srv.current_params()
+            try:
+                srv.swap_params(params=params)
+            except SwapRejected as e:
+                self._rollback(done)
+                self.deploy_rollbacks += 1
+                raise SwapRejected(
+                    f"rolling deploy halted at {rid}: {e} — "
+                    f"{len(done)} already-swapped replica(s) rolled "
+                    f"back")
+            done.append((rid, prior))
+            log.info("fleet: deployed to %s (%d/%d)", rid, len(done),
+                     len(order))
+        self.deploys += 1
+        return len(done)
+
+    def _rollback(self, done):
+        for rid, (prior_params, prior_buffers) in reversed(done):
+            try:
+                self.servers[rid].swap_params(params=prior_params,
+                                              buffers=prior_buffers)
+            except SwapRejected:
+                # the prior params were serving seconds ago; a canary
+                # refusing them now means something else is injecting
+                # failures — keep rolling back the rest, loudly
+                log.exception("fleet: rollback canary failed on %s",
+                              rid)
+
+    # ------------------------------------------------------------ telemetry
+    def goodput_per_chip(self) -> dict:
+        """Served model-FLOP/s per chip over the fleet's first→last
+        batch window, and that rate as a fraction of one chip's peak —
+        one replica is assumed to own one chip (the in-process fleet's
+        mesh story; a sharded replica would scale ``chips``)."""
+        total = 0.0
+        t0 = t1 = None
+        for srv in self.servers.values():
+            g = srv.metrics.goodput_per_chip()
+            total += g["flops_total"]
+            w0, w1 = srv.metrics.batch_window()
+            if w0 is not None:
+                t0 = w0 if t0 is None else min(t0, w0)
+                t1 = w1 if t1 is None else max(t1, w1)
+        chips = max(1, len(self.servers))
+        wall = (t1 - t0) if (t0 is not None and t1 is not None) else 0.0
+        rate = total / wall / chips if wall > 0 else 0.0
+        out = {"flops_total": total, "wall_s": wall, "chips": chips,
+               "model_flops_per_sec_per_chip": rate, "mfu": None}
+        if rate > 0:
+            try:
+                from ..telemetry.device_info import current_device_spec
+
+                spec = current_device_spec()
+                if spec.peak_flops_per_sec:
+                    out["mfu"] = rate / spec.peak_flops_per_sec
+                    out["nominal_device"] = spec.nominal
+            except Exception:
+                pass
+        return out
+
+    #: router registry families folded into the fleet view — the ones
+    #: only the router populates.  Its *request* families share names
+    #: with the replicas' (it records fleet-level outcomes, they
+    #: record per-attempt outcomes); folding both would double-count,
+    #: so the router's copies of shared names stay in its own
+    #: ``router`` section.
+    _ROUTER_FOLD_FAMILIES = (
+        "bigdl_serving_hedges_total", "bigdl_serving_retries_total",
+        "bigdl_fleet_dispatch_total",
+    )
+
+    def _router_fold_metrics(self) -> dict:
+        snap = self.router.metrics.registry.snapshot()["metrics"]
+        return {name: fam for name, fam in snap.items()
+                if name in self._ROUTER_FOLD_FAMILIES}
+
+    def snapshot(self) -> dict:
+        """The fleet view: per-replica snapshots, the router's, the
+        membership state, fleet goodput-per-chip, and the per-replica
+        metric registries folded into one cluster view by the existing
+        cross-host merge (:func:`telemetry.aggregate.merge_metrics` —
+        counters sum, histogram buckets add)."""
+        from ..telemetry.aggregate import merge_metrics
+
+        per_replica = {rid: srv.metrics.snapshot()
+                       for rid, srv in sorted(self.servers.items())}
+        registries = [srv.metrics.registry.snapshot()["metrics"]
+                      for _, srv in sorted(self.servers.items())]
+        registries.append(self._router_fold_metrics())
+        n, members = self.router.coordinator.membership()
+        return {
+            "replicas": per_replica,
+            "router": self.router.snapshot(),
+            "membership": {
+                "incarnation": n,
+                "members": list(members),
+                "ejections": self.router.ejections,
+                "readmissions": self.router.readmissions,
+            },
+            "deploys": self.deploys,
+            "deploy_rollbacks": self.deploy_rollbacks,
+            "goodput_per_chip": self.goodput_per_chip(),
+            "metrics": merge_metrics(registries),
+        }
+
+    def to_prometheus(self) -> str:
+        """Prometheus text of every replica registry plus the
+        router's, each series labeled — scrape-ready fleet view."""
+        parts = [srv.metrics.to_prometheus()
+                 for _, srv in sorted(self.servers.items())]
+        parts.append(self.router.metrics.to_prometheus())
+        return "\n".join(parts)
+
+    def write_snapshots(self, directory: str) -> list:
+        """Drop one ``<replica>.json`` payload per replica (plus the
+        router's) into ``directory`` — the snapshot-dir format
+        ``tools/run_report.py`` merges and renders."""
+        from ..telemetry.aggregate import write_snapshot
+
+        n, _ = self.router.coordinator.membership()
+        paths = []
+        for rid, srv in sorted(self.servers.items()):
+            payload = {
+                "host": rid,
+                "incarnation": n,
+                "metrics": srv.metrics.registry.snapshot()["metrics"],
+                "serving": srv.metrics.snapshot(),
+            }
+            paths.append(write_snapshot(directory, rid, payload))
+        paths.append(write_snapshot(directory, "fleet-router", {
+            "host": "fleet-router",
+            "incarnation": n,
+            # only the router-specific families (hedges/retries/
+            # dispatch): its copies of the shared request families
+            # would double-count against the replicas' in the merge
+            "metrics": self._router_fold_metrics(),
+            "serving": self.router.metrics.snapshot(),
+        }))
+        return paths

@@ -65,14 +65,27 @@ class ServingMetrics:
             "bigdl_serving_flops_total",
             "XLA cost-model FLOPs dispatched (per-bucket static "
             "cost x batches)", labels=("bucket",))
+        # hot-swap outcomes and tail-latency hedging land in the same
+        # registry so the fleet fold (telemetry.aggregate.merge_metrics)
+        # and to_prometheus() carry them — a rejected deploy or a hedge
+        # storm must be visible in the scraped view, not just in
+        # python attributes
+        self._swaps = self.registry.counter(
+            "bigdl_serving_swaps_total",
+            "hot param swap outcomes", labels=("outcome",))
+        self._hedges = self.registry.counter(
+            "bigdl_serving_hedges_total",
+            "tail-latency hedges (fired = duplicate sent, won = the "
+            "hedge's response was used)", labels=("event",))
+        self._retries = self.registry.counter(
+            "bigdl_serving_retries_total",
+            "failover retries dispatched to another replica")
         # per-bucket static cost (XLA cost model) + the wall window the
         # flops were spent in — what goodput-per-chip divides by
         self._bucket_flops: Dict[int, float] = {}
         self._t_first_batch: Optional[float] = None
         self._t_last_batch: Optional[float] = None
         self.counts: Dict[str, int] = {s.value: 0 for s in Status}
-        self.swaps = 0
-        self.swap_rollbacks = 0
 
     # ------------------------------------------------------------------
     def record(self, status: Status, latency_s: float = 0.0,
@@ -86,6 +99,54 @@ class ServingMetrics:
 
     def record_depth(self, depth: int):
         self._depth.observe(depth)
+
+    def record_swap(self, installed: bool):
+        """One hot-swap outcome: ``installed`` or ``rejected`` (the
+        canary/verify refused it and the prior params keep serving)."""
+        self._swaps.labels(
+            outcome="installed" if installed else "rejected").inc()
+
+    def record_hedge(self, won: bool = False):
+        """One hedging event: ``record_hedge()`` when the duplicate is
+        sent (fired), ``record_hedge(won=True)`` when the hedge's
+        response beat the primary and was used."""
+        self._hedges.labels(event="won" if won else "fired").inc()
+
+    def record_retry(self):
+        self._retries.inc()
+
+    def _counter_value(self, name: str, **labels) -> int:
+        fam = self.registry.get(name)
+        if fam is None:
+            return 0
+        for got, child in fam.series():
+            if all(got.get(k) == v for k, v in labels.items()):
+                return int(child.value)
+        return 0
+
+    @property
+    def swaps(self) -> int:
+        return self._counter_value("bigdl_serving_swaps_total",
+                                   outcome="installed")
+
+    @property
+    def swap_rollbacks(self) -> int:
+        return self._counter_value("bigdl_serving_swaps_total",
+                                   outcome="rejected")
+
+    @property
+    def hedges_fired(self) -> int:
+        return self._counter_value("bigdl_serving_hedges_total",
+                                   event="fired")
+
+    @property
+    def hedges_won(self) -> int:
+        return self._counter_value("bigdl_serving_hedges_total",
+                                   event="won")
+
+    @property
+    def retries(self) -> int:
+        return int(self._retries.value)
 
     def record_bucket_cost(self, bucket: int, flops: float):
         """Install the static cost of one bucket's compiled forward
@@ -119,6 +180,13 @@ class ServingMetrics:
         fam = self.registry.get("bigdl_serving_flops_total")
         return float(sum(child.value for _, child in fam.series())) \
             if fam is not None else 0.0
+
+    def batch_window(self):
+        """(first, last) batch wall-clock marks — what a fleet fold
+        uses to compute one shared serving window; (None, None) before
+        any batch."""
+        with self._lock:
+            return self._t_first_batch, self._t_last_batch
 
     def goodput_per_chip(self) -> dict:
         """Model-FLOP/s actually served over the first→last batch wall
@@ -173,6 +241,9 @@ class ServingMetrics:
             "padded_rows": self.padded_rows,
             "swaps": self.swaps,
             "swap_rollbacks": self.swap_rollbacks,
+            "hedges_fired": self.hedges_fired,
+            "hedges_won": self.hedges_won,
+            "retries": self.retries,
             "flops_total": gpc["flops_total"],
             "model_flops_per_sec": gpc["model_flops_per_sec"],
             "serving_mfu": gpc["mfu"],

@@ -1,0 +1,479 @@
+"""Failover router over a fleet of serving replicas.
+
+One :class:`FleetRouter` fronts N :class:`~.server.InferenceServer`
+replicas and owns the three cluster behaviors a single server cannot
+have:
+
+* **Live-set maintenance** — replica membership rides the same
+  machinery as training gangs (:class:`~bigdl_tpu.resilience.elastic
+  .ElasticCoordinator`): every replica heartbeats and publishes a
+  health snapshot (ready, queue depth, breaker state, p99) through the
+  elastic KV transport, membership is versioned by incarnation
+  numbers, and every reconfiguration is an incarnation bump.  The
+  router ejects a replica that misses heartbeats or reports its
+  breaker open (eviction marker + membership proposal, exactly the
+  shrink path training takes on a dead host) and re-admits it when its
+  beats resume and it reports ready again.
+* **Failover dispatch** — requests go to the *least-loaded* ready
+  replica (router-tracked in-flight count + the replica's published
+  queue depth).  A request that comes back with a retryable status
+  (INTERNAL_ERROR / UNAVAILABLE / OVERLOADED / CANCELLED) retries on a
+  *different* replica with the **remaining** deadline budget — the
+  deadline is propagated, never reset — until the budget or the
+  attempt bound runs out.  Per-replica circuit breakers
+  (:class:`~.breaker.CircuitBreaker`, the same state machine the
+  server wraps its compiled step in) stop the router from hammering a
+  replica that keeps failing, independent of membership.
+* **Tail-latency hedging** — optionally, when the primary has not
+  answered within a p99-derived delay, the request is *duplicated* to
+  a second replica; the first usable response wins and the loser is
+  abandoned (its result is discarded on arrival — a dispatched device
+  batch is not interruptible).  ``hedges_fired`` / ``hedges_won``
+  count it in the router's :class:`~.metrics.ServingMetrics`.
+
+Every request resolves to exactly one typed
+:class:`~.status.ServeResult`, same contract as the single server —
+the fleet adds failure *routing*, never failure *hiding*.
+"""
+from __future__ import annotations
+
+import json
+import logging
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+from typing import Callable, Dict, Optional, Tuple
+
+from .breaker import CircuitBreaker, REJECT
+from .metrics import ServingMetrics
+from .status import ServeFuture, ServeResult, Status
+
+log = logging.getLogger("bigdl_tpu")
+
+#: KV key prefix for replica health snapshots (next to the
+#: coordinator's ``hb/`` beats; the payload carries the incarnation it
+#: was published under)
+HEALTH_PREFIX = "srvhealth/"
+
+#: statuses worth retrying on a different replica — the *replica*
+#: failed or refused, the request itself is fine
+RETRYABLE_STATUSES = frozenset((
+    Status.INTERNAL_ERROR, Status.UNAVAILABLE, Status.OVERLOADED,
+    Status.CANCELLED,
+))
+
+
+def read_health(transport, replica: str) -> Optional[dict]:
+    """The newest health snapshot ``replica`` published, or None."""
+    raw = transport.get(HEALTH_PREFIX + str(replica))
+    if raw is None:
+        return None
+    try:
+        return json.loads(raw)
+    except ValueError:
+        return None
+
+
+class FleetRouter:
+    """Health-aware failover router — see the module docstring.
+
+    Parameters
+    ----------
+    replicas : id → the local server handle to dispatch to (in a
+        multi-process fleet these are RPC stubs; the contract is just
+        ``submit`` / ``submit_generate`` returning a ServeFuture).
+    coordinator : the router's own ElasticCoordinator over the fleet
+        transport (membership reads + eject/readmit proposals).
+    max_attempts : dispatch attempts per request (primary + retries).
+    default_deadline_s : per-request deadline when ``submit`` gives
+        none (None = no deadline; retries then bound only by attempts).
+    hedge : enable tail-latency hedging.
+    hedge_delay_s : fixed hedge delay; None derives it from the
+        router's own observed p99 (clamped to ``hedge_min_delay_s``,
+        with ``hedge_default_delay_s`` before any sample exists).
+    breaker_factory : per-replica router-side breaker constructor.
+    max_workers : router dispatch pool size (each in-flight request
+        occupies one worker while it waits).
+    """
+
+    def __init__(self, replicas: Dict[str, object], coordinator, *,
+                 metrics: Optional[ServingMetrics] = None,
+                 max_attempts: int = 3,
+                 default_deadline_s: Optional[float] = None,
+                 hedge: bool = False,
+                 hedge_delay_s: Optional[float] = None,
+                 hedge_min_delay_s: float = 0.005,
+                 hedge_default_delay_s: float = 0.050,
+                 breaker_factory: Optional[Callable[[], CircuitBreaker]]
+                 = None,
+                 max_workers: int = 16,
+                 clock: Callable[[], float] = time.monotonic):
+        self.replicas = dict(replicas)
+        self.coordinator = coordinator
+        self.metrics = metrics or ServingMetrics()
+        self.max_attempts = max(1, int(max_attempts))
+        self.default_deadline_s = default_deadline_s
+        self.hedge = bool(hedge)
+        self.hedge_delay_s = hedge_delay_s
+        self.hedge_min_delay_s = float(hedge_min_delay_s)
+        self.hedge_default_delay_s = float(hedge_default_delay_s)
+        self._breaker_factory = breaker_factory or CircuitBreaker
+        self._clock = clock
+        self._lock = threading.Lock()
+        # optimistic until the first refresh: every configured replica
+        # is a member (matches the fleet's bootstrap membership)
+        self._members: Tuple[str, ...] = tuple(sorted(self.replicas))
+        self._health: Dict[str, dict] = {}
+        self._inflight: Dict[str, int] = {r: 0 for r in self.replicas}
+        self._breakers: Dict[str, CircuitBreaker] = {}
+        self._dispatch_total = self.metrics.registry.counter(
+            "bigdl_fleet_dispatch_total",
+            "router dispatches per replica and terminal status",
+            labels=("replica", "status"))
+        self.ejections = 0
+        self.readmissions = 0
+        self._pool = ThreadPoolExecutor(
+            max_workers=int(max_workers),
+            thread_name_prefix="bigdl-fleet-router")
+        self._closed = False
+
+    # ------------------------------------------------------------ membership
+    @property
+    def members(self) -> Tuple[str, ...]:
+        with self._lock:
+            return self._members
+
+    def live(self) -> Tuple[str, ...]:
+        """Members currently routable: health known-ready (or not yet
+        reported) and router-side breaker not rejecting."""
+        with self._lock:
+            members, health = self._members, dict(self._health)
+        out = []
+        for r in members:
+            h = health.get(r)
+            if h is not None and not h.get("ready", True):
+                continue
+            if self._breaker(r).state == "open":
+                continue
+            out.append(r)
+        return tuple(out)
+
+    def health_of(self, replica: str) -> Optional[dict]:
+        with self._lock:
+            return self._health.get(replica)
+
+    def refresh(self):
+        """One membership-maintenance round: re-read beats + health,
+        eject members that missed heartbeats or report breaker-open,
+        re-admit returners that beat again and report ready.  Called by
+        the fleet's pump loop; idempotent and safe to call anytime."""
+        c = self.coordinator
+        n, members = c.membership()
+        beats = c.beats()
+        alive = c.alive(beats)
+        health: Dict[str, dict] = {}
+        for r in self.replicas:
+            h = read_health(c.transport, r)
+            if h is not None:
+                health[r] = h
+        dead = [m for m in members if m not in alive]
+        breaker_open = [
+            m for m in members if m in alive
+            and (health.get(m) or {}).get("breaker_state") == "open"]
+        out = dead + breaker_open
+        if out:
+            survivors = [m for m in members if m not in out]
+            if survivors:
+                n2 = c.propose(
+                    survivors,
+                    f"fleet eject: dead={dead} "
+                    f"breaker_open={breaker_open}", expect=n)
+                if n2 is not None:
+                    for m in out:
+                        c.evict(m, "missed heartbeats" if m in dead
+                                else "breaker open")
+                    self.ejections += len(out)
+                    log.warning(
+                        "fleet: ejected %s (dead=%s breaker_open=%s), "
+                        "incarnation %d members=%s", out, dead,
+                        breaker_open, n2, survivors)
+                n, members = c.membership()
+        rejoiners = [
+            r for r in sorted(alive)
+            if r not in members and r in self.replicas
+            and (health.get(r) or {}).get("ready")]
+        if rejoiners:
+            grown = sorted(set(members) | set(rejoiners))
+            n2 = c.propose(grown, f"fleet readmit: {rejoiners}",
+                           expect=n)
+            if n2 is not None:
+                for r in rejoiners:
+                    c.readmit(r)
+                self.readmissions += len(rejoiners)
+                log.warning("fleet: re-admitted %s, incarnation %d "
+                            "members=%s", rejoiners, n2, grown)
+                n, members = c.membership()
+        with self._lock:
+            self._members = tuple(sorted(members))
+            self._health = health
+
+    # ------------------------------------------------------------ dispatch
+    def _breaker(self, replica: str) -> CircuitBreaker:
+        with self._lock:
+            br = self._breakers.get(replica)
+            if br is None:
+                br = self._breakers[replica] = self._breaker_factory()
+            return br
+
+    def _pick(self, exclude=()) -> Optional[str]:
+        """Least-loaded ready member outside ``exclude`` whose router-
+        side breaker admits traffic.  The breaker is only ``acquire``d
+        on the replica actually chosen, so a half-open probe slot is
+        never burned on a replica we don't dispatch to."""
+        with self._lock:
+            members = self._members
+            health = dict(self._health)
+            inflight = dict(self._inflight)
+        ranked = []
+        for r in members:
+            if r in exclude or r not in self.replicas:
+                continue
+            h = health.get(r)
+            if h is not None and not h.get("ready", True):
+                continue
+            load = inflight.get(r, 0) + int(
+                (h or {}).get("queue_depth", 0))
+            ranked.append((load, r))
+        for _, r in sorted(ranked):
+            if self._breaker(r).acquire() != REJECT:
+                return r
+        return None
+
+    def _resolve(self, fut: ServeFuture, result: ServeResult,
+                 t0: float):
+        result.latency_s = self._clock() - t0
+        self.metrics.record(result.status, result.latency_s,
+                            result.queued_s)
+        fut._resolve(result)
+
+    def submit(self, feature,
+               deadline_s: Optional[float] = None) -> ServeFuture:
+        """Route one classification request across the fleet.  Returns
+        a future that resolves to the winning replica's ServeResult
+        (or a typed router-level failure)."""
+        return self._enqueue("classify", feature, None, deadline_s)
+
+    def submit_generate(self, prompt_ids, max_new: int,
+                        eos_id: Optional[int] = None,
+                        pad_id: Optional[int] = None,
+                        deadline_s: Optional[float] = None
+                        ) -> ServeFuture:
+        """Route one generation request across the fleet."""
+        return self._enqueue("generate", prompt_ids,
+                             (int(max_new), eos_id, pad_id), deadline_s)
+
+    def _enqueue(self, kind, payload, opts, deadline_s) -> ServeFuture:
+        fut = ServeFuture()
+        now = self._clock()
+        if deadline_s is None:
+            deadline_s = self.default_deadline_s
+        deadline = None if deadline_s is None \
+            else now + float(deadline_s)
+        if self._closed:
+            self._resolve(fut, ServeResult(
+                Status.UNAVAILABLE, error="router closed"), now)
+            return fut
+        try:
+            self._pool.submit(self._drive, kind, payload, opts,
+                              deadline, fut, now)
+        except RuntimeError:  # closed between the check and the submit
+            self._resolve(fut, ServeResult(
+                Status.UNAVAILABLE, error="router closed"), now)
+        return fut
+
+    def _dispatch(self, replica: str, kind, payload, opts,
+                  remaining: Optional[float]) -> ServeFuture:
+        client = self.replicas[replica]
+        with self._lock:
+            self._inflight[replica] = self._inflight.get(replica, 0) + 1
+
+        def on_done(f, _replica=replica):
+            with self._lock:
+                self._inflight[_replica] -= 1
+            res = f._result
+            br = self._breaker(_replica)
+            if res is not None and res.status is Status.OK:
+                br.record_success()
+            else:
+                # anything else — failure, shed, cancel, blown deadline
+                # — reads as "stop preferring this replica"; the
+                # breaker's half-open probe re-tests it later
+                br.record_failure()
+            if res is not None:
+                self._dispatch_total.labels(
+                    replica=_replica, status=res.status.value).inc()
+
+        try:
+            if kind == "classify":
+                inner = client.submit(payload, deadline_s=remaining)
+            else:
+                max_new, eos_id, pad_id = opts
+                inner = client.submit_generate(
+                    payload, max_new, eos_id=eos_id, pad_id=pad_id,
+                    deadline_s=remaining)
+        except Exception as e:
+            # a submit() that raises (malformed request, stopped
+            # handle) resolves typed instead of leaking out of the
+            # router pool
+            inner = ServeFuture()
+            with self._lock:
+                self._inflight[replica] -= 1
+            self._breaker(replica).record_failure()
+            inner._resolve(ServeResult(
+                Status.INTERNAL_ERROR,
+                error=f"submit to {replica} raised "
+                      f"{type(e).__name__}: {e}"))
+            return inner
+        inner.add_done_callback(on_done)
+        return inner
+
+    def _hedge_delay(self) -> float:
+        if self.hedge_delay_s is not None:
+            return float(self.hedge_delay_s)
+        p99 = self.metrics._lat.quantile(0.99)
+        if p99 is None or p99 <= 0:
+            return self.hedge_default_delay_s
+        return max(self.hedge_min_delay_s, float(p99))
+
+    def _await_first_usable(self, pending: Dict[str, ServeFuture],
+                            deadline: Optional[float],
+                            hedge_replica: Optional[str]
+                            ) -> Tuple[Optional[ServeResult],
+                                       Optional[str]]:
+        """Wait until one pending future resolves OK (first usable
+        response wins; a failed one keeps the wait going while others
+        are still out), all of them fail (return the last failure), or
+        the deadline passes (return ``(None, None)``)."""
+        event = threading.Event()
+        for f in pending.values():
+            f.add_done_callback(lambda _f: event.set())
+        last: Optional[ServeResult] = None
+        last_replica: Optional[str] = None
+        while pending:
+            for r in [r for r, f in pending.items() if f.done()]:
+                res = pending.pop(r)._result
+                if res.status is Status.OK:
+                    if hedge_replica is not None \
+                            and r == hedge_replica:
+                        self.metrics.record_hedge(won=True)
+                    return res, r
+                last, last_replica = res, r
+            if not pending:
+                break
+            now = self._clock()
+            if deadline is not None and now >= deadline:
+                return None, None
+            timeout = 0.05 if deadline is None \
+                else min(0.05, deadline - now)
+            event.wait(timeout)
+            event.clear()
+        return last, last_replica
+
+    def _drive(self, kind, payload, opts, deadline: Optional[float],
+               fut: ServeFuture, t0: float):
+        tried = set()
+        attempts = 0
+        last: Optional[ServeResult] = None
+        while True:
+            now = self._clock()
+            if deadline is not None and now >= deadline:
+                self._resolve(fut, ServeResult(
+                    Status.DEADLINE_EXCEEDED,
+                    error=f"deadline budget exhausted after "
+                          f"{attempts} attempt(s)"), t0)
+                return
+            if attempts >= self.max_attempts:
+                self._resolve(fut, last or ServeResult(
+                    Status.UNAVAILABLE,
+                    error=f"no attempt succeeded in "
+                          f"{self.max_attempts}"), t0)
+                return
+            primary = self._pick(exclude=tried)
+            if primary is None:
+                # nothing routable outside the tried set: degrade
+                # typed (the single-server OVERLOADED/UNAVAILABLE
+                # discipline, fleet-wide)
+                self._resolve(fut, last or ServeResult(
+                    Status.UNAVAILABLE, error="no ready replica"), t0)
+                return
+            if attempts > 0:
+                self.metrics.record_retry()
+            attempts += 1
+            remaining = None if deadline is None else deadline - now
+            pending = {primary: self._dispatch(
+                primary, kind, payload, opts, remaining)}
+            hedge_replica = None
+            if self.hedge and not pending[primary].done():
+                delay = self._hedge_delay()
+                if remaining is None or delay < remaining:
+                    done_early = threading.Event()
+                    pending[primary].add_done_callback(
+                        lambda _f: done_early.set())
+                    if not done_early.wait(delay):
+                        rem2 = None if deadline is None \
+                            else deadline - self._clock()
+                        if rem2 is None or rem2 > 0:
+                            hedge_replica = self._pick(
+                                exclude=tried | {primary})
+                        if hedge_replica is not None:
+                            self.metrics.record_hedge(won=False)
+                            pending[hedge_replica] = self._dispatch(
+                                hedge_replica, kind, payload, opts,
+                                rem2)
+            result, via = self._await_first_usable(
+                pending, deadline, hedge_replica)
+            if result is None:
+                self._resolve(fut, ServeResult(
+                    Status.DEADLINE_EXCEEDED,
+                    error=f"deadline passed waiting on "
+                          f"{sorted(pending)}"), t0)
+                return
+            if result.status is Status.OK:
+                self._resolve(fut, result, t0)
+                return
+            if result.status is Status.DEADLINE_EXCEEDED:
+                # the budget died at the replica — propagate, don't
+                # burn another attempt on a dead budget
+                self._resolve(fut, result, t0)
+                return
+            if result.status in RETRYABLE_STATUSES:
+                tried.add(via)
+                if hedge_replica is not None:
+                    tried.add(hedge_replica)
+                last = result
+                continue
+            self._resolve(fut, result, t0)
+            return
+
+    # ------------------------------------------------------------ lifecycle
+    def close(self, wait: bool = True):
+        """Stop accepting new requests and wind down the dispatch
+        pool (in-flight drives finish — every accepted request still
+        resolves)."""
+        self._closed = True
+        self._pool.shutdown(wait=wait)
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            members = list(self._members)
+            inflight = dict(self._inflight)
+        return {
+            "members": members,
+            "live": list(self.live()),
+            "inflight": inflight,
+            "ejections": self.ejections,
+            "readmissions": self.readmissions,
+            "breakers": {r: b.snapshot()
+                         for r, b in sorted(self._breakers.items())},
+            "metrics": self.metrics.snapshot(),
+        }

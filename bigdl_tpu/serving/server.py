@@ -134,9 +134,14 @@ class InferenceServer:
                  breaker: Optional[CircuitBreaker] = None,
                  policy: Optional[RetryPolicy] = None,
                  metrics: Optional[ServingMetrics] = None,
-                 generate_dtype=None):
+                 generate_dtype=None, name: Optional[str] = None):
         from ..optim._sharding_utils import data_mesh
 
+        #: replica identity — the fleet layer names its servers so the
+        #: per-replica fault injectors (``delay_replica`` et al.) can
+        #: target one member; anonymous servers match only unscoped
+        #: faults
+        self.name = name
         self.model = model
         self.mesh = data_mesh(mesh)
         self._n_dev = self.mesh.shape["data"] if self.mesh is not None \
@@ -284,6 +289,25 @@ class InferenceServer:
             deadline_s = self._default_deadline_s
         return None if deadline_s is None else now + float(deadline_s)
 
+    def _fast_fail_expired(self, deadline: Optional[float],
+                           now: float) -> Optional[ServeFuture]:
+        """A request whose remaining budget is already <= 0 resolves
+        DEADLINE_EXCEEDED right here — before admission, before the
+        queue, before metrics see a depth sample.  The fleet router
+        retries with the *remaining* deadline budget, so a dead budget
+        arriving here is the common case under failover, and queueing
+        it would waste a batch slot on an answer nobody is waiting
+        for."""
+        if deadline is None or deadline > now:
+            return None
+        fut = ServeFuture()
+        result = ServeResult(Status.DEADLINE_EXCEEDED,
+                             error="deadline budget exhausted before "
+                                   "admission")
+        self.metrics.record(result.status, 0.0, 0.0)
+        fut._resolve(result)
+        return fut
+
     def submit(self, feature,
                deadline_s: Optional[float] = None) -> ServeFuture:
         """One classification/regression request: ``feature`` is a
@@ -300,10 +324,13 @@ class InferenceServer:
                 f"feature shape {feature.shape} does not match this "
                 f"server's pinned shape {self._feature_shape}")
         now = time.monotonic()
+        deadline = self._deadline(deadline_s, now)
+        fast = self._fast_fail_expired(deadline, now)
+        if fast is not None:
+            return fast
         return self._admit(Request(
             kind="classify", payload=feature,
-            future=ServeFuture(), submitted_at=now,
-            deadline=self._deadline(deadline_s, now)))
+            future=ServeFuture(), submitted_at=now, deadline=deadline))
 
     def submit_generate(self, prompt_ids, max_new: int,
                         eos_id: Optional[int] = None,
@@ -321,9 +348,13 @@ class InferenceServer:
         if max_new < 1:
             raise ValueError(f"max_new must be >= 1, got {max_new}")
         now = time.monotonic()
+        deadline = self._deadline(deadline_s, now)
+        fast = self._fast_fail_expired(deadline, now)
+        if fast is not None:
+            return fast
         return self._admit(Request(
             kind="generate", payload=prompt, future=ServeFuture(),
-            submitted_at=now, deadline=self._deadline(deadline_s, now),
+            submitted_at=now, deadline=deadline,
             opts=(int(max_new), eos_id, pad_id)))
 
     # ------------------------------------------------------------ hot swap
@@ -347,6 +378,10 @@ class InferenceServer:
             with self._model_lock:
                 canary = self._canary_x
                 bufs = buffers if buffers is not None else self._buffers
+            # the canary rides the same injection point as live batches
+            # (scoped by replica name), so a fleet test can fail ONE
+            # replica's canary deterministically mid-rolling-deploy
+            _faults.check_serving_fault(self.name)
             if canary is not None and self._fwd is not None:
                 out = self._fwd(params, bufs, canary)
                 if not bool(tree_finite(out)):
@@ -355,22 +390,29 @@ class InferenceServer:
             elif not bool(tree_finite(params)):
                 raise SwapRejected("candidate params are non-finite")
         except SwapRejected:
-            self.metrics.swap_rollbacks += 1
+            self.metrics.record_swap(installed=False)
             raise
         except (KeyboardInterrupt, SystemExit):
             raise
         except Exception as e:
-            self.metrics.swap_rollbacks += 1
+            self.metrics.record_swap(installed=False)
             raise SwapRejected(f"canary batch failed "
                                f"({type(e).__name__}: {e})")
         with self._model_lock:
             self._params = params
             if buffers is not None:
                 self._buffers = buffers
-        self.metrics.swaps += 1
+        self.metrics.record_swap(installed=True)
         log.info("serving params hot-swapped%s",
                  f" from {path}" if path else "")
         return True
+
+    def current_params(self):
+        """The (params, buffers) pair currently serving — what a fleet
+        rollback re-installs on the already-swapped replicas when a
+        later replica rejects the deploy."""
+        with self._model_lock:
+            return self._params, self._buffers
 
     # ------------------------------------------------------------ worker
     def _note_drain(self):
@@ -472,7 +514,7 @@ class InferenceServer:
         with self._model_lock:
             params, buffers = self._params, self._buffers
         try:
-            _faults.check_serving_fault()
+            _faults.check_serving_fault(self.name)
             if kind == "classify":
                 x, bucket = self.batcher.coalesce(
                     [r.payload for r in reqs])

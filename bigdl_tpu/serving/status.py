@@ -57,22 +57,45 @@ class ServeFuture:
     a ``timeout`` raises ``TimeoutError`` rather than returning a
     placeholder, so a hung server is loud — but under the server's
     contract every admitted request is resolved even on drain, stop,
-    or breaker trip."""
+    or breaker trip.  ``add_done_callback`` lets the fleet router wait
+    on several replicas' futures at once (hedging) without polling."""
 
-    __slots__ = ("_event", "_result")
+    __slots__ = ("_event", "_result", "_callbacks", "_cb_lock")
 
     def __init__(self):
         self._event = threading.Event()
         self._result: Optional[ServeResult] = None
+        self._callbacks = []
+        self._cb_lock = threading.Lock()
 
     def done(self) -> bool:
         return self._event.is_set()
 
     def _resolve(self, result: ServeResult):
-        if self._event.is_set():  # first resolution wins
-            return
-        self._result = result
-        self._event.set()
+        with self._cb_lock:
+            if self._event.is_set():  # first resolution wins
+                return
+            self._result = result
+            self._event.set()
+            callbacks, self._callbacks = self._callbacks, []
+        for cb in callbacks:
+            try:
+                cb(self)
+            except Exception:  # a broken observer must not break resolve
+                pass
+
+    def add_done_callback(self, fn):
+        """Call ``fn(self)`` when the future resolves (immediately if it
+        already has).  Callback exceptions are swallowed — resolution
+        must never fail because an observer raised."""
+        with self._cb_lock:
+            if not self._event.is_set():
+                self._callbacks.append(fn)
+                return
+        try:
+            fn(self)
+        except Exception:
+            pass
 
     def result(self, timeout: Optional[float] = None) -> ServeResult:
         if not self._event.wait(timeout):

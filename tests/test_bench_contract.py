@@ -76,6 +76,7 @@ def test_fallback_merges_persisted_tpu_numbers(tmp_path):
                 # unit-tested in-process (test_*_measurements_contract);
                 # skip their slow subprocesses here
                 "BENCH_SERVING_TIMEOUT": "0",
+                "BENCH_FLEET_TIMEOUT": "0",
                 "BENCH_ELASTIC_TIMEOUT": "0",
                 "BENCH_INTEGRITY_TIMEOUT": "0",
                 "BENCH_TELEMETRY_TIMEOUT": "0",
@@ -170,6 +171,49 @@ def test_serving_measurements_contract():
     t = out["totals"]
     assert t["total"] == t["served_ok"] + t["shed"] \
         + t["deadline_exceeded"] + t["internal_error"]
+
+
+def test_fleet_measurements_contract():
+    """The fleet leg's measurement dict carries the judged fields
+    (p99 with/without hedging, shed rate, goodput-per-chip, replica-
+    kill recovery seconds, every request typed) — run tiny in-process
+    so tier-1 stays fast; the full leg is `--fleet` and its one JSON
+    line lands in SERVING_r02.json."""
+    bench = _bench()
+    out = bench._fleet_measurements(rate_rps=150.0, duration_s=0.6,
+                                    users=32, max_batch=8,
+                                    max_queue=32)
+    assert out["n_replicas"] == 4
+    assert out["steady"]["offered"] > 0
+    assert out["steady"]["ok"] > 0
+    assert out["p99_ms"] is not None
+    assert out["p99_ms"] >= out["steady"]["latency_p50_ms"]
+    assert out["hedged"]["offered"] > 0
+    assert out["hedged_p99_ms"] is not None
+    assert out["hedged"]["hedges_fired"] >= 0
+    assert out["hedged"]["hedges_won"] <= out["hedged"]["hedges_fired"]
+    assert 0.0 <= out["shed_rate"] <= 1.0
+    # the killed replica was ejected and the fleet recovered, bounded
+    assert out["kill"]["ejected"] is True
+    assert out["recovery_s"] is not None
+    assert 0 < out["recovery_s"] < 30
+    # zero requests lost beyond the shed budget: everything typed
+    assert out["all_resolved_typed"] is True
+    # goodput-per-chip is measured (XLA cost model works on CPU too)
+    assert out["goodput_per_chip_flops"] > 0
+    # and the record flattens into the schema-stable ledger fields
+    rec = bench.ledger_record({"fleet": {
+        "p99_ms": out["p99_ms"], "hedged_p99_ms": out["hedged_p99_ms"],
+        "shed_rate": out["shed_rate"],
+        "goodput_per_chip_flops": out["goodput_per_chip_flops"],
+        "recovery_s": out["recovery_s"]}})
+    assert rec["fleet_p99_ms"] == out["p99_ms"]
+    assert rec["fleet_shed_rate"] == out["shed_rate"]
+    assert rec["fleet_goodput_per_chip"] == \
+        out["goodput_per_chip_flops"]
+    assert rec["fleet_recovery_s"] == out["recovery_s"]
+    for key in bench.LEDGER_FIELDS:
+        assert key in rec
 
 
 def test_elastic_measurements_contract():

@@ -4,6 +4,11 @@
 thread; the serving worker (PR 2) and now the elastic layer's watchdog
 worker threads (PR 3) hammer them concurrently — state transitions must
 stay consistent and no failure count may be lost under contention.
+The serving `_BoundedQueue` (PR 9) adds the fleet router as a second
+producer tier: many router pool threads `try_put` while the worker
+`get`s, requeues half-open leftovers with `put_front`, and `drain_all`s
+on stop — no request may be lost or duplicated, and admission must
+never push the queue past its bound.
 """
 import threading
 
@@ -12,6 +17,7 @@ import pytest
 from bigdl_tpu.resilience.retry import RetryPolicy
 from bigdl_tpu.serving.breaker import (ADMIT, CLOSED, HALF_OPEN, OPEN,
                                        PROBE, REJECT, CircuitBreaker)
+from bigdl_tpu.serving.server import _BoundedQueue
 
 N_THREADS = 16
 
@@ -112,6 +118,130 @@ def test_breaker_mixed_storm_invariants():
 
     _hammer(storm)
     assert br.acquire() in (ADMIT, PROBE, REJECT)
+
+
+# ---------------------------------------------------------------------------
+# _BoundedQueue (the serving admission queue)
+# ---------------------------------------------------------------------------
+
+def test_bounded_queue_admission_never_exceeds_bound_no_lost_items():
+    """Producers `try_put` while drainers `drain_all` and a watcher
+    samples the length: admission never pushes past the bound, and
+    every admitted item comes out exactly once (accepted == drained +
+    leftover, no duplicates)."""
+    q = _BoundedQueue(maxsize=8)
+    accepted = [[] for _ in range(N_THREADS)]
+    drained = []
+    drain_lock = threading.Lock()
+    over_bound = []
+    stop = threading.Event()
+
+    def watcher():
+        while not stop.is_set():
+            n = len(q)
+            if n > q.maxsize:
+                over_bound.append(n)  # pragma: no cover - failure path
+
+    w = threading.Thread(target=watcher)
+    w.start()
+
+    def work(i):
+        if i % 4 == 0:  # 4 drainers vs 12 producers
+            for _ in range(200):
+                got = q.drain_all()
+                with drain_lock:
+                    drained.extend(got)
+        else:
+            for k in range(100):
+                item = (i, k)
+                if q.try_put(item):
+                    accepted[i].append(item)
+
+    try:
+        _hammer(work)
+    finally:
+        stop.set()
+        w.join(timeout=10)
+    drained.extend(q.drain_all())
+    assert not over_bound, f"bound exceeded: {over_bound[:5]}"
+    all_accepted = [it for lst in accepted for it in lst]
+    assert len(all_accepted) > 0
+    assert sorted(drained) == sorted(all_accepted)   # none lost...
+    assert len(set(drained)) == len(drained)         # ...none duped
+
+
+def test_bounded_queue_put_front_races_get_without_loss():
+    """The half-open-probe requeue path: consumers `get` items and
+    randomly `put_front` some back (as the worker does with probe
+    leftovers) while producers keep admitting — every admitted item is
+    consumed exactly once, nothing is lost to the front/back race."""
+    q = _BoundedQueue(maxsize=64)
+    n_items = 400
+    consumed = []
+    consumed_lock = threading.Lock()
+    produced = []
+    produced_lock = threading.Lock()
+    done_producing = threading.Event()
+
+    def work(i):
+        if i < 4:  # producers
+            for k in range(n_items // 4):
+                item = (i, k)
+                while not q.try_put(item):
+                    pass
+                with produced_lock:
+                    produced.append(item)
+        else:      # consumers, requeueing every 3rd item once
+            seen_again = set()
+            while True:
+                item = q.get(timeout=0.02)
+                if item is None:
+                    if done_producing.is_set() and len(q) == 0:
+                        return
+                    continue
+                h = hash(item) % 3
+                if h == 0 and item not in seen_again:
+                    seen_again.add(item)
+                    q.put_front([item])   # admitted work goes back
+                else:
+                    with consumed_lock:
+                        consumed.append(item)
+
+    barrier = threading.Barrier(N_THREADS)
+    errors = []
+
+    def run(i):
+        barrier.wait()
+        try:
+            work(i)
+        except BaseException as e:  # pragma: no cover - failure path
+            errors.append(e)
+        if i < 4:
+            # last producer out flips the flag
+            with produced_lock:
+                if len(produced) == n_items:
+                    done_producing.set()
+
+    threads = [threading.Thread(target=run, args=(i,))
+               for i in range(N_THREADS)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60)
+        assert not t.is_alive(), "queue hammer thread wedged"
+    assert not errors
+    consumed.extend(q.drain_all())
+    assert sorted(consumed) == sorted(produced)
+
+
+def test_bounded_queue_put_front_preserves_order_ahead_of_new():
+    q = _BoundedQueue(maxsize=4)
+    q.try_put("new1")
+    q.put_front(["a", "b"])     # requeued in original order, ahead
+    q.try_put("new2")           # admission full is fine for put_front
+    assert [q.get_nowait() for _ in range(4)] == \
+        ["a", "b", "new1", "new2"]
+    assert q.get_nowait() is None
 
 
 # ---------------------------------------------------------------------------

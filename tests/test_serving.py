@@ -127,6 +127,42 @@ def test_deadline_expired_on_arrival_and_in_queue(server):
     assert server.metrics.snapshot()["deadline_exceeded"] == 2
 
 
+def test_expired_budget_fast_fails_without_queueing(server):
+    """A request whose remaining budget is <= 0 (the fleet router's
+    failover-retry case) resolves DEADLINE_EXCEEDED synchronously —
+    it never occupies a queue slot or a batch slot."""
+    rng = np.random.RandomState(0)
+    for budget in (0.0, -1.0):
+        fut = server.submit(feat(rng), deadline_s=budget)
+        assert fut.done()                     # resolved before return
+        r = fut.result(timeout=0)
+        assert r.status is Status.DEADLINE_EXCEEDED
+        assert "budget" in r.error
+    snap = server.metrics.snapshot()
+    assert snap["deadline_exceeded"] == 2
+    assert snap["batches"] == 0               # nothing hit the device
+    # queue-depth histogram saw no admission from the dead requests
+    assert snap["queue_depth_max"] == 0
+
+
+def test_expired_budget_fast_fails_generate_path():
+    from bigdl_tpu.models.transformer import TransformerLM
+
+    lm = TransformerLM(61, embed_dim=16, num_heads=2, num_layers=1,
+                       max_len=32, output="logits")
+    srv = InferenceServer(lm, max_batch=4)
+    srv.start()
+    try:
+        rng = np.random.RandomState(0)
+        prompt = rng.randint(1, 61, 6).astype(np.int32)
+        fut = srv.submit_generate(prompt, max_new=4, deadline_s=-0.5)
+        assert fut.done()
+        assert fut.result(0).status is Status.DEADLINE_EXCEEDED
+        assert srv.metrics.snapshot()["batches"] == 0
+    finally:
+        srv.stop(timeout=10)
+
+
 def test_queue_full_sheds_with_typed_overloaded():
     srv = InferenceServer(small_model(), max_batch=4, max_queue=4)
     srv.start()
@@ -358,6 +394,32 @@ def test_metrics_quantiles_and_counts():
     assert 0.45 < snap["latency_p50_s"] < 0.56
     assert snap["latency_p99_s"] > 0.9
     assert snap["shed"] == 1 and snap["deadline_exceeded"] == 1
+
+
+def test_metrics_swap_and_hedge_counters_in_prometheus():
+    """The swap-outcome and hedge counters are registry-backed so the
+    scraped exposition (and the fleet's cross-replica fold) carries
+    them, not just python attributes."""
+    m = ServingMetrics()
+    m.record_swap(installed=True)
+    m.record_swap(installed=False)
+    m.record_swap(installed=False)
+    m.record_hedge()                   # fired
+    m.record_hedge(won=True)
+    m.record_retry()
+    assert m.swaps == 1 and m.swap_rollbacks == 2
+    assert m.hedges_fired == 1 and m.hedges_won == 1
+    assert m.retries == 1
+    snap = m.snapshot()
+    assert snap["swaps"] == 1 and snap["swap_rollbacks"] == 2
+    assert snap["hedges_fired"] == 1 and snap["hedges_won"] == 1
+    assert snap["retries"] == 1
+    text = m.to_prometheus()
+    assert 'bigdl_serving_swaps_total{outcome="installed"} 1.0' in text
+    assert 'bigdl_serving_swaps_total{outcome="rejected"} 2.0' in text
+    assert 'bigdl_serving_hedges_total{event="fired"} 1.0' in text
+    assert 'bigdl_serving_hedges_total{event="won"} 1.0' in text
+    assert "bigdl_serving_retries_total 1.0" in text
 
 
 # ---------------------------------------------------------------------------

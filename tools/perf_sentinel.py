@@ -57,6 +57,14 @@ DEFAULT_TOLERANCES = {
     "decode_tokens_per_sec": ("higher", 0.15),
     "prefill_tokens_per_sec": ("higher", 0.15),
     "serving_p99_ms": ("lower", 0.50),
+    # serving-fleet leg (ISSUE 9): shed rate may only fall and
+    # goodput-per-chip may only rise; latency/recovery on the 1-core
+    # CI box is noisy, so tolerances are wide with absolute floors
+    # absorbing jitter around small values
+    "fleet_shed_rate": ("lower", 0.50, 0.02),
+    "fleet_goodput_per_chip": ("higher", 0.60),
+    "fleet_p99_ms": ("lower", 0.75, 5.0),
+    "fleet_recovery_s": ("lower", 1.00, 0.5),
     "elastic_recovery_s": ("lower", 1.00),
     "telemetry_overhead_pct": ("lower", 2.00),
     # async-everything goodput family (ISSUE 7): the productive
