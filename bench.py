@@ -531,6 +531,18 @@ def run_worker(backend: str) -> None:
                     f"{type(e).__name__}: {e}"[:200]
             if over_budget(0.75):
                 break
+        # graceful Pallas degradation: a Mosaic-dead kernel no longer
+        # surfaces as a leg error while the headline silently rides XLA
+        # convs — the first-dispatch probe falls back to conv_gemm and
+        # the reason lands here as a schema field
+        try:
+            from bigdl_tpu.ops.conv3x3_pallas import pallas_fallback_reason
+
+            reason = pallas_fallback_reason()
+            if reason:
+                out["resnet50_conv_fallback"] = reason
+        except Exception:
+            pass
         flush("resnet50_conv_impls")
     # (bf16/f32 throughput keys were assigned right after each bench ran,
     # so every partial checkpoint carries them; only the CPU-path f32 and
@@ -1857,6 +1869,190 @@ def run_sharding_bench() -> None:
 
 
 # --------------------------------------------------------------------------
+# DLRM leg: sharded-embedding recommendation workload, sparse vs dense
+# gradient transport (ISSUE 10)
+# --------------------------------------------------------------------------
+
+DLRM_TIMEOUT = float(os.environ.get("BENCH_DLRM_TIMEOUT", "420"))
+DLRM_RESULT = "DLRM_r01.json"
+
+
+def _dlrm_measurements(steps: int = 24, batch: int = 256,
+                       table_sizes=(65536, 32768, 8192, 1024, 256),
+                       embed_dim: int = 16, n_records: int = 2048,
+                       zipf_exponent: float = 1.1,
+                       shard_min_bytes: int = 512 * 1024,
+                       lr: float = 0.5):
+    """The sparsity-aware transport leg (ISSUE 10), on 8 forced-host
+    CPU devices over a Zipf rank-``zipf_exponent`` clickstream:
+
+    * **sparse pass** — the derived plan row-shards every table at or
+      above ``shard_min_bytes`` over the data axis (total table bytes
+      exceed the pretend per-device budget of total/2 — the FSDP-style
+      proof) and ships the replicated tables' gradients as
+      ``(row_indices, row_values)``;
+    * **dense pass** — the SAME model under an explicit
+      replicate-everything plan: every table's gradient rides the
+      dense all-reduce (the transport the reference framework
+      hard-wired).
+
+    Judged numbers: measured collective bytes/step (the plan-derived
+    ``bigdl_perf_collective_bytes`` gauge — sparse transport accounted
+    as actual index+value bytes) with its reduction ratio, and
+    steps/sec for both passes with the loss descending."""
+    import jax
+    import numpy as np
+
+    from bigdl_tpu import nn
+    from bigdl_tpu.dataset import ZipfClickstream
+    from bigdl_tpu.models.dlrm import DLRM
+    from bigdl_tpu.optim import SGD, max_iteration
+    from bigdl_tpu.optim.distri_optimizer import DistriOptimizer
+    from bigdl_tpu.parallel.plan import Plan, Rule
+    from bigdl_tpu.telemetry import MetricsRegistry, Telemetry
+    from bigdl_tpu.utils.rng import RNG
+    from jax.sharding import PartitionSpec as P
+
+    import logging
+
+    if jax.device_count() < 8:
+        raise RuntimeError(
+            f"dlrm leg needs 8 forced-host devices, have "
+            f"{jax.device_count()}")
+    bigdl_log = logging.getLogger("bigdl_tpu")
+    prev_level = bigdl_log.level
+    bigdl_log.setLevel(logging.WARNING)
+    # the trace-profiled iteration parses an xplane dump whose size
+    # scales with the program's op count — on the sparse program that
+    # one iteration costs seconds of pure measurement overhead, so the
+    # judged steps/sec comparison runs unprofiled on BOTH passes
+    prev_profile = os.environ.get("BIGDL_METRICS_PROFILEINTERVAL")
+    os.environ["BIGDL_METRICS_PROFILEINTERVAL"] = "0"
+
+    class _Losses:
+        def __init__(self):
+            self.values = []
+
+        def add_scalar(self, name, value, step):
+            if name == "Loss":
+                self.values.append(float(value))
+
+    table_sizes = tuple(int(v) for v in table_sizes)
+    table_bytes = sum(v * embed_dim * 4 for v in table_sizes)
+
+    def run(plan):
+        RNG().set_seed(7)
+        model = DLRM(dense_dim=4, table_sizes=table_sizes,
+                     embed_dim=embed_dim,
+                     shard_min_bytes=shard_min_bytes)
+        data = ZipfClickstream(n_records, table_sizes, dense_dim=4,
+                               exponent=zipf_exponent)
+        tm = Telemetry(registry=MetricsRegistry())
+        rec = _Losses()
+        opt = DistriOptimizer(model, data, nn.BCECriterion(),
+                              batch_size=batch)
+        opt.set_optim_method(SGD(learning_rate=lr))
+        opt.set_end_when(max_iteration(steps))
+        opt.set_telemetry(tm)
+        opt.set_train_summary(rec)
+        if plan is not None:
+            opt.set_sharding_plan(plan)
+        t0 = time.monotonic()
+        opt.optimize()
+        wall = time.monotonic() - t0
+        compile_s = float(tm.compile_seconds.sum)
+        sps = (steps - 1) / max(wall - compile_s, 1e-9)
+        snap = tm.registry.snapshot()["metrics"]
+
+        def gauge(name):
+            series = (snap.get(name) or {}).get("series") or []
+            return float(series[0]["value"]) if series else None
+
+        return {"wall_s": round(wall, 3),
+                "compile_s": round(compile_s, 3),
+                "steps_per_sec": round(sps, 3), "losses": rec.values,
+                "collective_bytes": gauge("bigdl_perf_collective_bytes"),
+                "sparse_saved": gauge("bigdl_perf_sparse_bytes_saved"),
+                "sharded_tables": list(model.sharded_tables)}
+
+    try:
+        sparse = run(None)  # derived plan: row sharding + sparse wire
+        dense = run(Plan([Rule(".*", P())]))  # replicate-all, dense wire
+    finally:
+        bigdl_log.setLevel(prev_level)
+        if prev_profile is None:
+            os.environ.pop("BIGDL_METRICS_PROFILEINTERVAL", None)
+        else:
+            os.environ["BIGDL_METRICS_PROFILEINTERVAL"] = prev_profile
+
+    ratio = None
+    if sparse["collective_bytes"] and dense["collective_bytes"]:
+        ratio = dense["collective_bytes"] / sparse["collective_bytes"]
+    sl, dl = sparse["losses"], dense["losses"]
+    return {
+        "devices": 8,
+        "mesh": "data=8",
+        "zipf_exponent": zipf_exponent,
+        "table_sizes": list(table_sizes),
+        "embed_dim": embed_dim,
+        "table_bytes_total": table_bytes,
+        # the row-sharding forcing function: the full tables exceed a
+        # pretend per-device budget of half their total (PR 8's
+        # FSDP-style proof, applied to stateful tables)
+        "per_device_table_budget_bytes": table_bytes // 2,
+        "sharded_tables": sparse["sharded_tables"],
+        "steps": steps, "batch": batch,
+        "steps_per_sec": sparse["steps_per_sec"],
+        "collective_bytes_per_step": sparse["collective_bytes"],
+        "sparse_bytes_saved_per_step": sparse["sparse_saved"],
+        "loss_first": round(sl[0], 5) if sl else None,
+        "loss_last": round(sl[-1], 5) if sl else None,
+        "loss_descending": bool(sl and sl[-1] < sl[0]),
+        "dense_steps_per_sec": dense["steps_per_sec"],
+        "dense_collective_bytes_per_step": dense["collective_bytes"],
+        "dense_loss_descending": bool(dl and dl[-1] < dl[0]),
+        "collective_bytes_reduction_x": (round(ratio, 2)
+                                         if ratio else None),
+        "sparse_compile_s": sparse["compile_s"],
+        "dense_compile_s": dense["compile_s"],
+    }
+
+
+def run_dlrm_bench() -> None:
+    """--dlrm mode: the sharded-embedding DLRM workload on 8 forced-
+    host CPU devices — sparse vs dense gradient transport — writes
+    DLRM_r01.json, prints the one JSON line."""
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8").strip()
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    out = {"bench": "dlrm", "backend": "cpu",
+           "forced_host_devices": 8, "measured_at": _utc_now()}
+    try:
+        out.update(_dlrm_measurements())
+        out.update({
+            "metric": "DLRM sparse-transport collective-bytes "
+                      "reduction vs dense all-reduce",
+            "value": out.get("collective_bytes_reduction_x") or 0.0,
+            "unit": "x",
+        })
+    except Exception as e:
+        out["error"] = f"{type(e).__name__}: {e}"[:500]
+        out.update({"metric": "DLRM sparse-transport collective-bytes "
+                              "reduction vs dense all-reduce",
+                    "value": 0.0, "unit": "x"})
+    try:
+        with open(os.path.join(_here(), DLRM_RESULT), "w") as f:
+            json.dump(out, f, indent=1)
+    except OSError:
+        pass
+    print(json.dumps(out), flush=True)
+
+
+# --------------------------------------------------------------------------
 # Perf ledger: the append-only trajectory record the sentinel guards
 # --------------------------------------------------------------------------
 
@@ -1883,6 +2079,8 @@ LEDGER_FIELDS = (
     "goodput_checkpoint_fraction", "data_stall_s",
     "checkpoint_blocked_s",
     "sharding_composed_steps_per_sec", "sharding_fsdp_param_bytes_frac",
+    "dlrm_steps_per_sec", "dlrm_collective_bytes_per_step",
+    "resnet50_conv_fallback",
     "vs_baseline",
 )
 
@@ -1924,6 +2122,13 @@ def ledger_record(result: dict) -> dict:
         "composed_steps_per_sec")
     flat["sharding_fsdp_param_bytes_frac"] = sharding.get(
         "fsdp_param_bytes_frac")
+    # the DLRM sparse-transport leg (ISSUE 10): steps/sec may only
+    # rise; measured collective bytes/step may only fall — the wire
+    # win sparse transport exists for must never silently erode
+    dlrm = result.get("dlrm") or {}
+    flat["dlrm_steps_per_sec"] = dlrm.get("steps_per_sec")
+    flat["dlrm_collective_bytes_per_step"] = dlrm.get(
+        "collective_bytes_per_step")
     rec = {"schema": LEDGER_SCHEMA,
            "ts": result.get("measured_at") or _utc_now(),
            "recorded_at": _utc_now()}
@@ -2324,6 +2529,31 @@ def main(ledger: bool = True, probe: bool = True) -> None:
                         or "sharding leg returned nothing"}
     result["sharding"] = sharding
 
+    # dlrm leg: the sharded-embedding recommendation workload, sparse
+    # vs dense gradient transport on a forced-host CPU mesh (backend-
+    # independent, lands in DLRM_r01.json) — best-effort like the
+    # other legs; BENCH_DLRM_TIMEOUT=0 disables it.
+    if DLRM_TIMEOUT <= 0:
+        dlrm = {"skipped": "BENCH_DLRM_TIMEOUT=0"}
+    else:
+        ok, dres, note = _run_sub(["--dlrm"], DLRM_TIMEOUT)
+        if ok and dres and "error" not in dres:
+            dlrm = {
+                "steps_per_sec": dres.get("steps_per_sec"),
+                "collective_bytes_per_step": dres.get(
+                    "collective_bytes_per_step"),
+                "dense_collective_bytes_per_step": dres.get(
+                    "dense_collective_bytes_per_step"),
+                "collective_bytes_reduction_x": dres.get(
+                    "collective_bytes_reduction_x"),
+                "loss_descending": dres.get("loss_descending"),
+                "source": DLRM_RESULT,
+            }
+        else:
+            dlrm = {"error": (dres or {}).get("error") or note
+                    or "dlrm leg returned nothing"}
+    result["dlrm"] = dlrm
+
     if not from_tpu:
         # the tunnel dies for hours at a time: the judged artifact must
         # still CARRY the chip numbers, honestly stamped — merge the
@@ -2355,7 +2585,7 @@ def main(ledger: bool = True, probe: bool = True) -> None:
             # measured LIVE this run — they must not be shadowed by
             # whatever the stale chip record carried
             for leg in ("serving", "fleet", "elastic", "integrity",
-                        "telemetry", "sharding"):
+                        "telemetry", "sharding", "dlrm"):
                 if result.get(leg) is not None:
                     merged[leg] = result[leg]
             result = merged
@@ -2381,6 +2611,7 @@ if __name__ == "__main__":
     p.add_argument("--integrity", action="store_true")
     p.add_argument("--telemetry", action="store_true")
     p.add_argument("--sharding", action="store_true")
+    p.add_argument("--dlrm", action="store_true")
     p.add_argument("--worker", choices=["tpu", "cpu"])
     # every orchestrated run appends to PERF_LEDGER.jsonl by default;
     # --no-ledger keeps scratch runs out of the judged trajectory
@@ -2407,6 +2638,8 @@ if __name__ == "__main__":
         run_telemetry_bench()
     elif a.sharding:
         run_sharding_bench()
+    elif a.dlrm:
+        run_dlrm_bench()
     elif a.worker:
         run_worker(a.worker)
     else:

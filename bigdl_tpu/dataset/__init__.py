@@ -11,6 +11,7 @@ from .ingest import (
     write_seq_files,
 )
 from . import datasets, image, ingest, text
+from .clickstream import ZipfClickstream, zipf_probs
 from .prefetch import DevicePrefetcher, InlineFeed, make_feed
 
 

@@ -1,0 +1,75 @@
+"""Synthetic Zipf clickstream — the DLRM workload's data source.
+
+Real clickstreams are heavily skewed: a handful of hot users/items
+absorb most lookups (rank-frequency follows a Zipf law; the bench uses
+exponent 1.1 — the shape Parallax measures sparse-gradient wins on).
+This generator reproduces that skew deterministically:
+
+* per categorical table, row ids are drawn ``p(rank) proportional to
+  (rank + 1) ** -exponent`` and mapped through a seeded permutation, so
+  the hot rows are scattered across the table (a contiguous hot prefix
+  would make row sharding trivially imbalanced in a way real tables
+  are not);
+* dense features are standard normals;
+* the click label is Bernoulli of a sigmoid-scored hidden linear model
+  over the dense features plus one hidden weight per (table, row) — so
+  the stream is *learnable* and a descending loss means the model
+  found the planted structure.
+
+Built on :class:`~bigdl_tpu.dataset.dataset.LocalArrayDataSet`, so the
+epoch order, shuffle state and record cursor ride the same
+``state_dict`` machinery as every other dataset — checkpoint/resume
+stays bitwise (docs/determinism.md).  Samples are
+``Sample([dense, indices], label)`` with ``indices`` float 1-based
+(``models.dlrm.DLRM``'s input layout).
+"""
+from __future__ import annotations
+
+from typing import Sequence
+
+import numpy as np
+
+from ..utils.rng import np_stream
+from .dataset import LocalArrayDataSet
+from .sample import Sample
+
+
+def zipf_probs(vocab: int, exponent: float = 1.1) -> np.ndarray:
+    """Normalized Zipf rank probabilities over ``vocab`` ranks."""
+    ranks = np.arange(1, vocab + 1, dtype=np.float64)
+    p = ranks ** -float(exponent)
+    return p / p.sum()
+
+
+class ZipfClickstream(LocalArrayDataSet):
+    """Seeded synthetic clickstream for ``table_sizes`` categorical
+    tables plus ``dense_dim`` dense features.
+
+    ``exponent`` is the Zipf rank exponent (1.1 default — the bench's
+    skew).  ``seed`` routes through ``utils.rng.derive_seed`` so
+    ``set_global_seed`` governs it like every other generator."""
+
+    def __init__(self, n_records: int, table_sizes: Sequence[int],
+                 dense_dim: int = 4, exponent: float = 1.1,
+                 seed: int = 20):
+        self.table_sizes = tuple(int(v) for v in table_sizes)
+        self.dense_dim = int(dense_dim)
+        self.exponent = float(exponent)
+        rng = np_stream(seed)
+        n = int(n_records)
+        dense = rng.randn(n, self.dense_dim).astype(np.float32)
+        idx = np.empty((n, len(self.table_sizes)), np.float32)
+        score = dense @ rng.randn(self.dense_dim).astype(np.float32) * 0.5
+        for t, vocab in enumerate(self.table_sizes):
+            perm = rng.permutation(vocab)
+            ranks = rng.choice(vocab, size=n,
+                               p=zipf_probs(vocab, self.exponent))
+            rows = perm[ranks]
+            idx[:, t] = rows.astype(np.float32) + 1.0  # 1-based
+            row_w = rng.randn(vocab).astype(np.float32)
+            score = score + 0.5 * row_w[rows]
+        prob = 1.0 / (1.0 + np.exp(-score))
+        clicks = (rng.rand(n) < prob).astype(np.float32)
+        super().__init__([
+            Sample([dense[i], idx[i]], np.array([clicks[i]], np.float32))
+            for i in range(n)])

@@ -1,4 +1,5 @@
 from .autoencoder import Autoencoder
+from .dlrm import DLRM
 from .inception import Inception_v1, InceptionV1NoAuxClassifier
 from .lenet import LeNet5, lenet_graph
 from .resnet import ResNet50, ResNetCifar

@@ -1,0 +1,115 @@
+"""DLRM-style recommendation model — the embedding-scale workload.
+
+The scenario that actually looks like "millions of users": a click
+predictor over a handful of dense features plus many categorical
+features, each backed by an embedding table, with the large tables row-
+sharded across the mesh (``nn.ShardedEmbedding``) because their total
+bytes exceed one device's budget.  Architecture follows the DLRM
+lineage (Naumov et al.; the BigDL production recommendation stack is
+the same shape):
+
+* **bottom MLP** over the dense features, projecting to ``embed_dim``
+  so it joins the feature-interaction block as one more "embedding";
+* **one embedding lookup per categorical feature** (tables at or above
+  ``shard_min_bytes`` bind their rows to ``shard_axis``; smaller
+  tables replicate and ride the plan's sparse gradient transport);
+* **pairwise dot-product feature interaction** over the stacked
+  feature vectors (the upper triangle, concatenated with the bottom
+  output);
+* **top MLP** ending in a sigmoid click probability, trained with
+  ``nn.BCECriterion``.
+
+Input is ``[dense, indices]``: ``dense`` float ``[B, dense_dim]``,
+``indices`` float ``[B, n_tables]`` carrying the 1-based row id per
+table (the :mod:`bigdl_tpu.dataset.clickstream` layout).
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax.numpy as jnp
+import numpy as np
+
+from .. import nn
+from ..nn.embedding import ShardedEmbedding
+from ..nn.module import Container
+
+
+def _mlp(dims: Sequence[int], sigmoid_out: bool = False):
+    seq = nn.Sequential()
+    for i in range(len(dims) - 1):
+        seq.add(nn.Linear(dims[i], dims[i + 1]))
+        last = i == len(dims) - 2
+        seq.add(nn.Sigmoid() if (last and sigmoid_out) else nn.ReLU())
+    return seq
+
+
+class DLRM(Container):
+    """Dense-bottom x multi-table-sparse x interaction x top click model.
+
+    ``table_sizes`` — rows per categorical table; tables whose full
+    ``rows x embed_dim`` float32 bytes reach ``shard_min_bytes`` shard
+    their rows (and optimizer slots) over ``shard_axis``, the rest
+    replicate with sparse gradient transport.  ``bottom_dims`` /
+    ``top_dims`` are the hidden widths (input/output widths are
+    derived).  Children: ``[bottom, emb_0 .. emb_{T-1}, top]``.
+    """
+
+    def __init__(self, dense_dim: int, table_sizes: Sequence[int],
+                 embed_dim: int = 16,
+                 bottom_dims: Sequence[int] = (64,),
+                 top_dims: Sequence[int] = (64,),
+                 shard_axis: Optional[str] = "data",
+                 shard_min_bytes: int = 1 << 20):
+        super().__init__()
+        self.dense_dim = int(dense_dim)
+        self.table_sizes = tuple(int(v) for v in table_sizes)
+        self.embed_dim = int(embed_dim)
+        self.n_tables = len(self.table_sizes)
+        if self.n_tables < 1:
+            raise ValueError("DLRM needs at least one embedding table")
+        self.add(_mlp([self.dense_dim] + list(bottom_dims)
+                      + [self.embed_dim]))
+        self.sharded_tables = []
+        for t, rows in enumerate(self.table_sizes):
+            nbytes = rows * self.embed_dim * 4
+            bind = (shard_axis if shard_axis is not None
+                    and nbytes >= int(shard_min_bytes) else None)
+            if bind is not None:
+                self.sharded_tables.append(t)
+            self.add(ShardedEmbedding(rows, self.embed_dim,
+                                      axis_name=bind))
+        # interaction: upper triangle of the (T+1) x (T+1) dot-product
+        # matrix over {bottom, embeddings}, concatenated with bottom
+        n_feat = self.n_tables + 1
+        self._triu = np.triu_indices(n_feat, k=1)
+        interact_dim = self.embed_dim + (n_feat * (n_feat - 1)) // 2
+        self.add(_mlp([interact_dim] + list(top_dims) + [1],
+                      sigmoid_out=True))
+
+    def apply_fn(self, params, buffers, inp, training: bool = True,
+                 rng=None):
+        dense, idx = inp[0], inp[1]
+        new_buffers = dict(buffers)
+        bottom, nb = self.modules[0].apply_fn(
+            params["0"], buffers["0"], dense, training, rng)
+        new_buffers["0"] = nb
+        feats = [bottom]
+        for t in range(self.n_tables):
+            k = str(1 + t)
+            e, _ = self.modules[1 + t].apply_fn(
+                params[k], buffers[k], idx[:, t], training, rng)
+            feats.append(e)
+        stack = jnp.stack(feats, axis=1)               # [B, T+1, D]
+        inter = jnp.einsum("bnd,bmd->bnm", stack, stack)
+        iu, ju = self._triu
+        z = inter[:, iu, ju]                           # [B, C(T+1, 2)]
+        top_in = jnp.concatenate([bottom, z], axis=1)
+        k = str(self.n_tables + 1)
+        out, nb = self.modules[-1].apply_fn(
+            params[k], buffers[k], top_in, training, rng)
+        new_buffers[k] = nb
+        return out, new_buffers
+
+    def _apply(self, params, buffers, inp, training, rng):
+        return self.apply_fn(params, buffers, inp, training, rng)

@@ -18,6 +18,7 @@ from .linear import (
     Add, AddConstant, Bilinear, CAdd, CMul, Cosine, Euclidean, Linear,
     LookupTable, MM, MV, Mul, MulConstant,
 )
+from .embedding import ShardedEmbedding
 from .activations import (
     Abs, Clamp, ELU, Exp, HardShrink, HardTanh, LeakyReLU, Log, LogSigmoid,
     LogSoftMax, Max, Mean, Min, Power, PReLU, ReLU, ReLU6, RReLU, Sigmoid,

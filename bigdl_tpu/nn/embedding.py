@@ -1,0 +1,126 @@
+"""Sharded embedding tables — the recommendation-workload layer.
+
+The BigDL lineage served embedding-heavy recommendation models in
+production; their tables are the one parameter class that does not fit
+the replicate-everything default: multi-GB row counts (must shard) and
+Zipf-skewed access (a batch touches a vanishing fraction of rows, so
+dense gradient all-reduce wastes nearly all collective bytes —
+Parallax, arxiv 1808.02621).  :class:`ShardedEmbedding` covers both
+sides:
+
+* **rows partitioned over a mesh axis** (``axis_name``, usually
+  ``"data"`` — the expert-parallel pattern from ``parallel.moe``): the
+  module stores the FULL ``[V, D]`` table host-side, the sharding plan
+  (``parallel.plan.derive_plan`` via ``spmd.param_specs``) shards the
+  leading row dim at trace time, and the lookup becomes an index
+  exchange under ``shard_map`` — every shard ``all_gather``s the gang's
+  flat indices, gathers the rows it owns, and a ``psum_scatter`` routes
+  each requester exactly its rows back.  The wire carries per-lookup
+  index+value bytes both ways (the backward rides the exchange's AD
+  transpose — row gradients return to their owners pre-summed), never
+  the dense table.  Optimizer slots shard with their rows
+  (``spmd.slot_specs`` inherits the param specs).
+
+* **sparse gradient transport when replicated** (``sparse_grads =
+  True``): a table small enough to replicate still has >99%-zero-row
+  gradients under skewed batches; the derived plan stamps its rule
+  ``transport="sparse"`` so the compiled step ships
+  ``(row_indices, row_values)`` over the data axis instead of the dense
+  all-reduce (``parallel.plan`` "Gradient transport").
+
+Unbound (``axis_name=None``) or on a single-device mesh the layer is a
+plain gather — the same function, computed locally.  Index convention
+follows :class:`~bigdl_tpu.nn.linear.LookupTable`: 1-based floats,
+``padding_value`` rows zeroed.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+from jax import lax
+
+from .initialization import ONE_D, RandomNormal
+from .module import TensorModule
+
+
+class ShardedEmbedding(TensorModule):
+    """Embedding whose rows (and their optimizer slots) partition over
+    a mesh axis, with sparse gradient transport when replicated.
+
+    ``n_index`` rows x ``n_output`` columns; ``axis_name`` names the
+    mesh axis sharding the rows (``None`` = replicated table, sparse
+    gradient wire).  When bound, ``n_index`` should divide the axis
+    size — a non-dividing mesh (e.g. after an elastic shrink to an odd
+    survivor count) degrades to a full replica with a warning from the
+    plan, never dropping rows.
+    """
+
+    #: derive_plan stamps this module's rules ``transport="sparse"``
+    sparse_grads = True
+
+    def __init__(self, n_index: int, n_output: int,
+                 axis_name: Optional[str] = "data",
+                 padding_value: float = 0):
+        super().__init__()
+        if n_index < 1 or n_output < 1:
+            raise ValueError(
+                f"ShardedEmbedding needs positive table dims, got "
+                f"({n_index}, {n_output})")
+        self.n_index, self.n_output = int(n_index), int(n_output)
+        self.axis_name = axis_name
+        self.padding_value = padding_value
+        self.reset()
+
+    def reset(self):
+        w_init = self._init_methods.get(
+            "weight", (RandomNormal(0, 1.0 / max(self.n_output, 1) ** 0.5),
+                       None))[0]
+        self._register_param(
+            "weight", w_init.init((self.n_index, self.n_output), ONE_D))
+        return self
+
+    def _n_shards(self) -> int:
+        """Bound-axis size, or 1 when eager/unbound (the MoEFFN /
+        RowParallelLinear detection pattern)."""
+        if self.axis_name is None:
+            return 1
+        try:
+            return lax.psum(1, self.axis_name)
+        except NameError:
+            return 1
+
+    def _apply(self, params, buffers, x, training, rng):
+        w = params["weight"]
+        idx0 = jnp.clip(x.astype(jnp.int32) - 1, 0, self.n_index - 1)
+        n = self._n_shards()
+        if n == 1 or w.shape[0] == self.n_index:
+            # unbound, single shard, or a plan that degraded the table
+            # to a replica (non-dividing mesh): local gather
+            out = jnp.take(w, idx0, axis=0)
+        else:
+            rows = w.shape[0]  # V / n local rows under shard_map
+            shape = idx0.shape
+            flat = idx0.reshape(-1)
+            me = lax.axis_index(self.axis_name)
+            # index exchange: every shard sees the gang's lookups...
+            all_idx = lax.all_gather(flat, self.axis_name, tiled=True)
+            rel = all_idx - me * rows
+            mine = (rel >= 0) & (rel < rows)
+            contrib = jnp.where(
+                mine[:, None],
+                jnp.take(w, jnp.clip(rel, 0, rows - 1), axis=0),
+                jnp.zeros((), w.dtype))
+            # ...and a psum_scatter routes each requester its rows
+            # (exactly one owner contributes per lookup).  The AD
+            # transpose of this pair returns row gradients to their
+            # owners pre-summed — per-lookup index+value wire, never
+            # the dense table.
+            out = lax.psum_scatter(
+                contrib.reshape(n, -1, w.shape[1]),
+                self.axis_name, scatter_dimension=0, tiled=False)
+            out = out.reshape(shape + (w.shape[1],))
+        if self.padding_value != 0:
+            mask = (x.astype(jnp.int32) == int(self.padding_value))
+            out = jnp.where(mask[..., None], 0.0, out)
+        return out, buffers

@@ -93,6 +93,12 @@ class Optimizer:
         self.sharding_plan = None
         _fsdp = get_property("bigdl.fsdp.minBytes")
         self.fsdp_min_bytes = int(_fsdp) if _fsdp else None
+        # sparse gradient transport row budget, as a fraction of a
+        # table's rows (parallel/plan.py "Gradient transport";
+        # bigdl.sparse.density property sets the default, 1/16) —
+        # consumed by the derived plan; explicit plans carry their own
+        _sd = get_property("bigdl.sparse.density")
+        self.sparse_density = float(_sd) if _sd else None
         # how the last profiled iteration's phase split was measured:
         # "trace" (jax.profiler device events) or None (not profiled)
         self.phase_source = None
@@ -254,6 +260,18 @@ class Optimizer:
         disables.  (``bigdl.fsdp.minBytes`` property sets the
         default.)"""
         self.fsdp_min_bytes = int(min_bytes) if min_bytes else None
+        return self
+
+    def set_sparse_density(self, density: Optional[float]):
+        """Size the sparse gradient transport's per-step row budget:
+        a ``transport="sparse"`` table ships ``ceil(rows * density)``
+        ``(index, row)`` pairs per shard instead of its dense gradient,
+        with automatic fallback to the dense all-reduce when the budget
+        would not beat it — or when a batch overflows it (exact,
+        in-program).  ``None`` restores the ``bigdl.sparse.density``
+        property default (1/16).  See docs/distributed.md "Gradient
+        transport"."""
+        self.sparse_density = float(density) if density else None
         return self
 
     def set_drop_module_property(self, drop_percentage, max_drop_percentage,
@@ -535,7 +553,8 @@ class Optimizer:
             self.telemetry.write_snapshot(step=state.get("neval"))
 
     def _tm_analyze(self, fn, *args, label: str = "train_step",
-                    collective_bytes: float = 0.0, **kwargs):
+                    collective_bytes: float = 0.0,
+                    sparse_bytes_saved: float = 0.0, **kwargs):
         """Feed the step program to the telemetry PerfAccountant: XLA
         cost-model FLOPs/bytes from lowering ``fn`` with the driver's
         concrete args (no compile, no execution — lowering only traces
@@ -548,6 +567,7 @@ class Optimizer:
             return
         tm.perf.analyze_jitted(fn, *args, label=label,
                                collective_bytes=collective_bytes,
+                               sparse_bytes_saved=sparse_bytes_saved,
                                **kwargs)
 
     # -- determinism + integrity plumbing (docs/determinism.md) ---------
@@ -1049,7 +1069,8 @@ class Optimizer:
             compute_dtype=self.compute_dtype, donate=True,
             guard=self.gradient_guard, with_gnorm=True,
             n_microbatch=self.pipeline_microbatch,
-            fsdp_min_bytes=self.fsdp_min_bytes)
+            fsdp_min_bytes=self.fsdp_min_bytes,
+            sparse_density=self.sparse_density)
 
     def _publish_plan_metrics(self, engine, params):
         """Addressable-param-bytes gauges: the FSDP acceptance
@@ -1182,7 +1203,8 @@ class Optimizer:
                         engine.jitted_for(x, y, False), params, slots,
                         buffers, jnp.float32(lr), jax.random.PRNGKey(0),
                         x, y,
-                        collective_bytes=engine.collective_bytes)
+                        collective_bytes=engine.collective_bytes,
+                        sparse_bytes_saved=engine.sparse_bytes_saved)
 
                 def dispatch():
                     return engine.step(params, slots, buffers, lr, x, y,

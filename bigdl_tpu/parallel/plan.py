@@ -17,8 +17,10 @@ pieces:
   shard large otherwise-replicated parameters over the ``data`` axis
   with gather-on-use.  Parallax (arxiv 1808.02621) is the reason the
   plan is *per-variable*: the right partitioning/transport differs
-  across one param tree, and the same ``Plan`` indirection is the hook
-  a later sparse-gradient transport chooses per rule.
+  across one param tree, and each rule now also picks its gradient
+  *transport* — ``transport="sparse"`` ships a table's gradient over
+  the data axis as ``(row_indices, row_values)`` instead of the dense
+  all-reduce (docs/distributed.md "Gradient transport").
 
 * :func:`compile_step_with_plan` — the ONE compiled-step builder.  For
   ANY mesh — data-only, data x model [x seq], data x pipe [x model]
@@ -57,7 +59,7 @@ from ..utils.jax_compat import shard_map
 
 log = logging.getLogger("bigdl_tpu")
 
-__all__ = ["Rule", "Plan", "derive_plan", "named_leaves",
+__all__ = ["Rule", "Plan", "TRANSPORTS", "derive_plan", "named_leaves",
            "match_partition_rules", "compile_step_with_plan",
            "CompiledPlanStep", "spec_table"]
 
@@ -112,6 +114,16 @@ def _map_named(fn, tree, sep: str = "/"):
 # rules + plan
 # ---------------------------------------------------------------------------
 
+#: gradient-transport vocabulary a :class:`Rule` may carry.  "dense" =
+#: the classic all-reduce/pmean wire; "sparse" = the leaf's gradient
+#: travels the data axis as ``(unique_row_indices, row_values)``
+#: (Parallax, arxiv 1808.02621 — embedding tables touched by a skewed
+#: batch produce >99%-zero-row gradients, and shipping the dense tensor
+#: wastes nearly all collective bytes).  Anything else is rejected
+#: loudly at plan-construction time.
+TRANSPORTS = ("dense", "sparse")
+
+
 class Rule(NamedTuple):
     """One ordered partition rule: the first ``re.search`` match wins.
 
@@ -119,18 +131,24 @@ class Rule(NamedTuple):
     leaves for data-axis parameter sharding with gather-on-use (the spec
     then carries the data axis on the sharded weight dim); ``reason``
     documents where the rule came from (introspection kind, "fsdp",
-    "user", "default")."""
+    "user", "default").  ``transport`` picks the gradient wire for the
+    rule's leaves (see :data:`TRANSPORTS`): ``"sparse"`` ships
+    ``(row_indices, row_values)`` over the data axis instead of the
+    dense all-reduce — with an automatic density-threshold fallback to
+    dense per leaf (docs/distributed.md "Gradient transport")."""
 
     pattern: str
     spec: P
     fsdp: bool = False
     reason: str = ""
+    transport: str = "dense"
 
 
 class _Entry(NamedTuple):
     spec: P
     fsdp: bool
     rule: Optional[Rule]
+    transport: str = "dense"
 
 
 def _spec_axes(spec) -> Tuple[str, ...]:
@@ -166,17 +184,46 @@ class Plan:
 
     def __init__(self, rules: Sequence[Rule], *, mesh: Optional[Mesh] = None,
                  fsdp_min_bytes: Optional[int] = None,
-                 data_axis: str = "data"):
+                 data_axis: str = "data",
+                 sparse_density: Optional[float] = None):
         self.rules = tuple(Rule(*r) for r in rules)
+        for r in self.rules:
+            if r.transport not in TRANSPORTS:
+                raise ValueError(
+                    f"rule {r.pattern!r} names unknown gradient "
+                    f"transport {r.transport!r} — expected one of "
+                    f"{TRANSPORTS}")
+            if r.transport == "sparse" and r.fsdp:
+                raise ValueError(
+                    f"rule {r.pattern!r} combines transport='sparse' "
+                    "with fsdp=True — FSDP gradients already ride the "
+                    "gather's reduce-scatter transpose; sparse "
+                    "transport applies to data-replicated tables only")
         self.mesh = mesh
         self.fsdp_min_bytes = fsdp_min_bytes
         self.data_axis = data_axis
+        # sparse-transport row budget as a fraction of the table's rows:
+        # the compiled step ships exactly ``ceil(rows * density)``
+        # (index, row) pairs per shard per step, falling back to the
+        # dense wire — at trace time when that budget's bytes would not
+        # beat the dense all-reduce, at run time (in-program, exact)
+        # when a batch touches more rows than the budget
+        if sparse_density is None:
+            from ..utils.engine import get_property
+
+            sparse_density = float(get_property(
+                "bigdl.sparse.density", 1.0 / 16))
+        if not 0.0 < float(sparse_density) <= 1.0:
+            raise ValueError(
+                f"sparse_density must be in (0, 1], got {sparse_density}")
+        self.sparse_density = float(sparse_density)
 
     # -- binding ---------------------------------------------------------
     def bind(self, mesh: Mesh) -> "Plan":
         return Plan(self.rules, mesh=mesh,
                     fsdp_min_bytes=self.fsdp_min_bytes,
-                    data_axis=self.data_axis)
+                    data_axis=self.data_axis,
+                    sparse_density=self.sparse_density)
 
     def _mesh_size(self, axis: Optional[str]) -> int:
         if self.mesh is None or axis is None:
@@ -217,20 +264,50 @@ class Plan:
             if re.search(rule.pattern, name) is None:
                 continue
             spec = self._degrade(rule.spec)
+            if rule.transport == "sparse" and not self._fits(spec, shape):
+                # a sharded table whose rows stop dividing (elastic
+                # shrink re-derives the mesh at survivor counts) falls
+                # back to a full replica — rows re-partition or
+                # replicate, they are never dropped
+                log.warning(
+                    "sharding plan: %s (%s) does not divide over spec "
+                    "%s — the table runs replicated (sparse transport "
+                    "still applies to its gradient)", name, shape,
+                    _spec_str(spec))
+                spec = self._strip_unfit(spec, shape)
             fsdp = rule.fsdp and self.data_axis in _spec_axes(spec)
             if fsdp and not self._fits(spec, shape):
                 spec = P(*(self._strip_data(p) for p in spec))
                 fsdp = False
-            if not fsdp:
+            if not fsdp and rule.transport != "sparse":
+                # sparse-transport leaves keep their replica: the whole
+                # point is that their gradient wire is already cheap,
+                # so the FSDP threshold rule must not claim them
                 spec = self._maybe_auto_fsdp(spec, leaf)
                 fsdp = self.data_axis in _spec_axes(spec) and \
                     spec != self._degrade(rule.spec)
                 if fsdp:
-                    return _Entry(spec, True, rule)
-            return _Entry(spec, fsdp, rule)
+                    return _Entry(spec, True, rule, "dense")
+            return _Entry(spec, fsdp, rule, rule.transport)
         raise ValueError(
             f"no partition rule matched param {name!r} — append a "
             "catch-all Rule('.*', P()) for replicate-by-default plans")
+
+    def _strip_unfit(self, spec: P, shape) -> P:
+        """Drop every spec dim whose combined axis size does not divide
+        the dim extent (the sparse-table shrink degradation)."""
+        parts = []
+        for dim, part in enumerate(spec):
+            if part is None or dim >= len(shape):
+                parts.append(part)
+                continue
+            n = 1
+            for a in (part if isinstance(part, tuple) else (part,)):
+                n *= self._mesh_size(a)
+            parts.append(part if n <= 1 or shape[dim] % n == 0 else None)
+        while parts and parts[-1] is None:  # P(None) == P() (cosmetic)
+            parts.pop()
+        return P(*parts)
 
     def _strip_data(self, part):
         if part == self.data_axis:
@@ -296,14 +373,84 @@ class Plan:
     def has_fsdp(self, tree) -> bool:
         return any(jax.tree_util.tree_leaves(self.fsdp_tree(tree)))
 
+    def transport_tree(self, tree):
+        """Per-leaf gradient-transport pytree (``"dense"``/``"sparse"``)."""
+        return jax.tree_util.tree_map(
+            lambda e: e.transport, self.entries(tree),
+            is_leaf=lambda e: isinstance(e, _Entry))
+
+    def has_sparse(self, tree) -> bool:
+        return any(t == "sparse" for t in
+                   jax.tree_util.tree_leaves(self.transport_tree(tree)))
+
     def named_entries(self, tree):
         return named_leaves(self.entries(tree),
                             is_leaf=lambda x: isinstance(x, _Entry))
 
     def table(self, tree) -> dict:
-        """``{path name: spec string}`` — the golden-test / docs view."""
-        return {name: _spec_str(e.spec) + (" [fsdp]" if e.fsdp else "")
+        """``{path name: "spec | transport [markers]"}`` — the
+        golden-test / docs view; the transport column rides every row
+        (``BIGDL_REGEN_PLAN_GOLDENS=1`` regenerates the fixtures)."""
+        return {name: (_spec_str(e.spec) + " | " + e.transport
+                       + (" [fsdp]" if e.fsdp else ""))
                 for name, e in self.named_entries(tree)}
+
+    # -- sparse-transport sizing ----------------------------------------
+    def sparse_budget(self, leaf) -> int:
+        """Static (index, row) slots one shard ships per step for a
+        sparse-transport leaf: ``ceil(rows * sparse_density)``."""
+        rows = int(tuple(leaf.shape)[0])
+        return max(1, int(np.ceil(rows * self.sparse_density)))
+
+    _INDEX_BYTES = 4  # int32 row ids on the wire
+
+    def _row_bytes(self, leaf) -> float:
+        shape = tuple(leaf.shape)
+        width = int(np.prod(shape[1:])) if len(shape) > 1 else 1
+        return float(width * jnp.dtype(leaf.dtype).itemsize)
+
+    def sparse_wire_bytes(self, leaf) -> float:
+        """Actual bytes the sparse exchange moves for one step: every
+        shard all_gathers its K ``(int32 index, row)`` pairs to the
+        n_d - 1 peers (ring all-gather: each rank receives the other
+        ranks' slots once)."""
+        n_d = self._mesh_size(self.data_axis)
+        k = self.sparse_budget(leaf)
+        return (n_d - 1) * k * (self._row_bytes(leaf) + self._INDEX_BYTES)
+
+    def _dense_data_wire(self, leaf, local_bytes: float) -> float:
+        """The dense comparator: all-reduce of the leaf's local slice
+        over the data axis (reduce-scatter + all-gather ring)."""
+        n_d = self._mesh_size(self.data_axis)
+        if n_d <= 1:
+            return 0.0
+        return 2.0 * (n_d - 1) / n_d * local_bytes
+
+    def sparse_engaged(self, leaf, entry: _Entry) -> bool:
+        """Trace-time density-threshold fallback: the sparse wire is
+        taken only when its budgeted bytes actually beat the dense
+        all-reduce — a table whose batches touch most rows (or a tiny
+        table) keeps the dense wire.  Only data-replicated leaves
+        qualify: rows sharded over the data axis already move
+        per-lookup index+value bytes via their exchange's AD
+        transpose."""
+        if entry.transport != "sparse" or entry.fsdp:
+            return False
+        if self.data_axis in _spec_axes(entry.spec):
+            return False
+        if self._mesh_size(self.data_axis) <= 1:
+            return False
+        shape = tuple(getattr(leaf, "shape", ()) or ())
+        if len(shape) < 1:
+            return False
+        nbytes = float(int(np.prod(shape))
+                       * jnp.dtype(leaf.dtype).itemsize)
+        shard_n = 1
+        for a in _spec_axes(entry.spec):
+            shard_n *= self._mesh_size(a)
+        local = nbytes / max(shard_n, 1)
+        return self.sparse_wire_bytes(leaf) < self._dense_data_wire(
+            leaf, local)
 
     # -- collective accounting -------------------------------------------
     def collective_bytes(self, tree) -> float:
@@ -314,12 +461,18 @@ class Plan:
         * FSDP leaf: ``2(n_d-1)/n_d x full bytes`` — the gather-on-use
           plus its reduce-scatter transpose — plus the grad all-reduce
           of the slice over any OTHER replicated axes;
-        * non-FSDP leaf: ``2(R-1)/R x local slice bytes`` where ``R``
-          is the product of the mesh axes the leaf is replicated over
-          (the gradient pmean's reduce-scatter + all-gather pair);
-          expert-parallel leaves (sharded over ``data``) reduce over
-          no axis — their all_to_all ACTIVATION traffic is a token
-          function, not accounted here.
+        * non-FSDP dense leaf: ``2(R-1)/R x local slice bytes`` where
+          ``R`` is the product of the mesh axes the leaf is replicated
+          over (the gradient pmean's reduce-scatter + all-gather pair);
+          expert-parallel and sharded-embedding leaves (sharded over
+          ``data``) reduce over no axis — their all_to_all/exchange
+          ACTIVATION traffic is a token/lookup function, not accounted
+          here;
+        * sparse-transport leaf (engaged — see :meth:`sparse_engaged`):
+          the data-axis component is the ACTUAL index+value wire,
+          ``(n_d - 1) x K x (row bytes + 4)`` with
+          ``K = ceil(rows x sparse_density)`` — not the dense formula;
+          any other replicated axes still all-reduce the dense rows.
 
         On a pure-data mesh with a replicate-everything plan this is
         exactly the old hard-wired ``2(n-1)/n x param bytes`` ring
@@ -350,6 +503,15 @@ class Plan:
                         r *= self._mesh_size(a)
                 if r > 1:
                     total += 2.0 * (r - 1) / r * local
+            elif self.sparse_engaged(leaf, entry):
+                # index+value wire over data; dense over the rest
+                total += self.sparse_wire_bytes(leaf)
+                r = 1
+                for a in axes:
+                    if a not in sharded and a != self.data_axis:
+                        r *= self._mesh_size(a)
+                if r > 1:
+                    total += 2.0 * (r - 1) / r * local
             else:
                 r = 1
                 for a in axes:
@@ -358,6 +520,31 @@ class Plan:
                 if r > 1:
                     total += 2.0 * (r - 1) / r * local
         return total
+
+    def sparse_bytes_saved(self, tree) -> float:
+        """Wire bytes one step does NOT move because sparse transport
+        replaced the dense all-reduce (the
+        ``bigdl_perf_sparse_bytes_saved`` gauge): per engaged leaf,
+        dense data-axis ring bytes minus the budgeted index+value
+        bytes."""
+        if self.mesh is None:
+            return 0.0
+        saved = 0.0
+        leaves = dict(named_leaves(tree))
+        for name, entry in self.named_entries(tree):
+            leaf = leaves[name]
+            if not self.sparse_engaged(leaf, entry):
+                continue
+            shape = tuple(leaf.shape)
+            nbytes = float(int(np.prod(shape))
+                           * jnp.dtype(leaf.dtype).itemsize)
+            shard_n = 1
+            for a in _spec_axes(entry.spec):
+                shard_n *= self._mesh_size(a)
+            local = nbytes / max(shard_n, 1)
+            saved += self._dense_data_wire(leaf, local) \
+                - self.sparse_wire_bytes(leaf)
+        return saved
 
 
 def _spec_str(spec: P) -> str:
@@ -385,10 +572,29 @@ def spec_table(specs) -> dict:
 # default rule derivation (param_specs-style module introspection)
 # ---------------------------------------------------------------------------
 
+def _sparse_param_names(module, prefix=()):
+    """'/'-joined param-tree names whose owning module opted into
+    sparse gradient transport (``sparse_grads = True`` — e.g.
+    ``nn.ShardedEmbedding``: a Zipf-skewed batch touches a vanishing
+    fraction of its rows, Parallax's motivating case)."""
+    from ..nn.module import Container
+
+    out = set()
+    if getattr(module, "sparse_grads", False):
+        for name, _ in named_leaves(module.param_tree()):
+            out.add("/".join(prefix + (name,)) if name
+                    else "/".join(prefix))
+    elif isinstance(module, Container):
+        for i, child in enumerate(module.modules):
+            out |= _sparse_param_names(child, prefix + (str(i),))
+    return out
+
+
 def derive_plan(model, mesh: Mesh, *, model_axis: Optional[str] = "model",
                 pipe_axis: Optional[str] = None,
                 n_pipe: Optional[int] = None,
                 fsdp_min_bytes: Optional[int] = None,
+                sparse_density: Optional[float] = None,
                 extra_rules: Sequence[Rule] = ()) -> Plan:
     """The default :class:`Plan` for ``model`` on ``mesh``.
 
@@ -399,13 +605,24 @@ def derive_plan(model, mesh: Mesh, *, model_axis: Optional[str] = "model",
     layer dim over ``pipe``, composed with per-block tensor-parallel
     specs).  ``extra_rules`` go FIRST — user regex rules override the
     derived defaults.  ``fsdp_min_bytes`` arms the threshold FSDP rule
-    (see :meth:`Plan._maybe_auto_fsdp`)."""
+    (see :meth:`Plan._maybe_auto_fsdp`).  Modules with
+    ``sparse_grads = True`` get their rules stamped
+    ``transport="sparse"`` (docs/distributed.md "Gradient
+    transport")."""
     from .spmd import param_specs as module_specs
 
     model_axis = (model_axis if model_axis is not None
                   and model_axis in mesh.axis_names else None)
     rules = list(extra_rules)
+    sparse_names = _sparse_param_names(model)
     if pipe_axis is not None:
+        if sparse_names:
+            raise NotImplementedError(
+                "sparse gradient transport does not compose with the "
+                "pipeline layout — the packed block stack has no "
+                "per-table wire to sparsify; train sparse-table models "
+                "on a data [x model] mesh "
+                f"(sparse params: {sorted(sparse_names)})")
         from .pipeline import pack_params, param_specs as packed_specs
 
         packed = pack_params(model, n_pipe, model_axis)
@@ -416,11 +633,16 @@ def derive_plan(model, mesh: Mesh, *, model_axis: Optional[str] = "model",
     else:
         spec_tree = module_specs(model, model_axis)
     for name, spec in named_leaves(spec_tree):
-        if isinstance(spec, P) and tuple(spec):
+        if not isinstance(spec, P):
+            continue
+        transport = "sparse" if name in sparse_names else "dense"
+        if tuple(spec) or transport == "sparse":
             rules.append(Rule("^" + re.escape(name) + "$", spec,
-                              reason="introspection"))
+                              reason="introspection",
+                              transport=transport))
     rules.append(Rule(".*", P(), reason="default"))
-    return Plan(rules, mesh=mesh, fsdp_min_bytes=fsdp_min_bytes)
+    return Plan(rules, mesh=mesh, fsdp_min_bytes=fsdp_min_bytes,
+                sparse_density=sparse_density)
 
 
 def _block_first(model) -> int:
@@ -451,7 +673,8 @@ class CompiledPlanStep:
     # populated by compile_step_with_plan:
     #   kind, mesh, plan, model, optim, param_specs, slot_specs,
     #   buffer_specs, input_spec, io_spec, pad_multiple, step,
-    #   jitted_for, collective_bytes, has_fsdp, n_data, n_seq
+    #   jitted_for, collective_bytes, sparse_bytes_saved,
+    #   transport_table, has_fsdp, n_data, n_seq
 
     def init_state(self):
         """Fresh device-placed (params, slots, buffers) from the live
@@ -521,19 +744,10 @@ class CompiledPlanStep:
 def _warn_dropped_axes(model, mesh, seq_axis, model_axis):
     """The diagnosability satellite: a model BUILT for an axis the mesh
     lacks used to run silently un-parallelized."""
-    bound = set()
     try:
-        from .moe import MoEFFN
-        from .tensor_parallel import ColumnParallelLinear, RowParallelLinear
+        from .spmd import bound_axes
 
-        for m in model.modules_iter():
-            if isinstance(m, (ColumnParallelLinear, RowParallelLinear)) \
-                    and m.axis_name:
-                bound.add(m.axis_name)
-            if isinstance(m, MoEFFN) and m.axis_name:
-                bound.add(m.axis_name)
-        if getattr(model, "seq_strategy", None) in ("ring", "ulysses"):
-            bound.add(getattr(model, "seq_axis", "seq"))
+        bound = bound_axes(model)
     except Exception:
         return
     missing = sorted(a for a in bound if a not in mesh.axis_names)
@@ -553,6 +767,7 @@ def compile_step_with_plan(model, criterion, optim, mesh: Mesh,
                            n_microbatch: Optional[int] = None,
                            remat: Optional[bool] = None,
                            fsdp_min_bytes: Optional[int] = None,
+                           sparse_density: Optional[float] = None,
                            data_axis: str = "data", seq_axis: str = "seq",
                            model_axis: str = "model",
                            pipe_axis: str = "pipe") -> CompiledPlanStep:
@@ -614,7 +829,8 @@ def compile_step_with_plan(model, criterion, optim, mesh: Mesh,
     _check_moe(model, mesh, d_ax, s_ax)
     if plan is None:
         plan = derive_plan(model, mesh, model_axis=m_ax,
-                           fsdp_min_bytes=fsdp_min_bytes)
+                           fsdp_min_bytes=fsdp_min_bytes,
+                           sparse_density=sparse_density)
     else:
         plan = plan.bind(mesh)
     host_params = model.param_tree()
@@ -627,6 +843,40 @@ def compile_step_with_plan(model, criterion, optim, mesh: Mesh,
     buffers = model.buffer_tree()
     sslots = slot_specs(optim.init_state(host_params), pspecs)
     bspecs = jax.tree_util.tree_map(lambda _: P(), buffers)
+
+    # -- per-leaf gradient transport (Parallax; docs/distributed.md) ----
+    # k_tree: static (index, row) budget per leaf — 0 compiles the
+    # dense wire; > 0 compiles the sparse index+value exchange with an
+    # in-program exact fallback when a batch overflows the budget.
+    # transport_table records every decision for diagnosability.
+    n_data = mesh.shape[d_ax] if d_ax else 1
+    transport_table = {}
+    _entries_by_name = dict(plan.named_entries(host_params))
+
+    def _k_of(name, leaf):
+        e = _entries_by_name[name]
+        if e.transport != "sparse":
+            return 0
+        if d_ax is None or n_data <= 1:
+            transport_table[name] = "dense (single data shard)"
+            return 0
+        spec = e.spec
+        if d_ax in _spec_axes(spec):
+            transport_table[name] = (
+                "sparse (rows sharded over the data axis — the lookup "
+                "exchange's AD transpose already carries index+value "
+                "rows)")
+            return 0
+        if not plan.sparse_engaged(leaf, e):
+            transport_table[name] = (
+                "dense (density-threshold fallback: budgeted sparse "
+                "wire would not beat the dense all-reduce)")
+            return 0
+        k = plan.sparse_budget(leaf)
+        transport_table[name] = f"sparse (row budget K={k})"
+        return k
+
+    k_tree = _map_named(_k_of, host_params)
 
     in_spec = _in_spec_fn(d_ax, s_ax, input_seq_dim)
     io_spec = _io_spec_fn(in_spec)
@@ -650,13 +900,55 @@ def compile_step_with_plan(model, criterion, optim, mesh: Mesh,
 
         return jax.tree_util.tree_map(g, p, pspecs, fsdp_flags)
 
+    def _sparse_allreduce(g, k, spec):
+        """Sparse gradient transport over the data axis: ship each
+        shard's K touched ``(int32 row index, row values)`` pairs and
+        segment-sum them back into the dense layout — exactly
+        ``lax.psum(g, data)`` when every shard's touched-row count fits
+        the budget (untouched budget slots carry zero rows, which
+        scatter-add as no-ops).  When ANY shard overflows, an
+        in-program ``lax.cond`` (predicate pmax'd over every axis the
+        leaf is replicated on, so all peers take the same branch) falls
+        back to the dense all-reduce — the exact-numerics guarantee
+        never depends on the batch's density."""
+        flat = g.reshape(g.shape[0], -1)
+        # NaN/Inf rows compare unequal to zero, so anomalous gradients
+        # still travel and the NaN guard sees them
+        touched = jnp.any(flat != 0, axis=1)
+        n_loc = jnp.sum(touched.astype(jnp.int32))
+        repl_axes = tuple(a for a in all_axes if not _spec_has(spec, a))
+        overflow = lax.pmax((n_loc > k).astype(jnp.int32),
+                            repl_axes) > 0
+
+        def sparse_branch(gf):
+            # top_k on the 0/1 touched scores selects every touched
+            # row first; zero rows pad the fixed budget
+            _, idx = lax.top_k(touched.astype(jnp.float32), k)
+            vals = jnp.take(gf, idx, axis=0)
+            all_idx = lax.all_gather(idx, d_ax, tiled=True)
+            all_vals = lax.all_gather(vals, d_ax, axis=0, tiled=True)
+            return jnp.zeros_like(gf).at[all_idx].add(all_vals)
+
+        def dense_branch(gf):
+            return lax.psum(gf, d_ax)
+
+        out = lax.cond(overflow, dense_branch, sparse_branch, flat)
+        return out.reshape(g.shape)
+
     def _make_reduce_grad(masked):
         """The one gradient-reduction rule (module docstring)."""
-        def reduce_grad(g, spec):
+        def reduce_grad(g, spec, k):
             if d_ax:
                 if _spec_has(spec, d_ax):
-                    # FSDP (gather transpose) and expert stacks
-                    # (all_to_all transpose) arrive pre-summed over data
+                    # FSDP (gather transpose), expert stacks and
+                    # sharded embedding rows (all_to_all/exchange
+                    # transposes) arrive pre-summed over data
+                    if not masked:
+                        g = g / n_data
+                elif k:
+                    # sparse transport: indices+values on the wire,
+                    # psum semantics out (pmean = /n below)
+                    g = _sparse_allreduce(g, k, spec)
                     if not masked:
                         g = g / n_data
                 else:
@@ -768,7 +1060,8 @@ def compile_step_with_plan(model, criterion, optim, mesh: Mesh,
 
             (loss, nb), grads = jax.value_and_grad(loss_fn,
                                                    has_aux=True)(params)
-            grads = jax.tree_util.tree_map(reduce_grad, grads, pspecs)
+            grads = jax.tree_util.tree_map(reduce_grad, grads, pspecs,
+                                           k_tree)
             if reg_paths:
                 # per-shard reg grads are exact — added AFTER the
                 # cross-shard reduction, never scaled by it
@@ -861,6 +1154,8 @@ def compile_step_with_plan(model, criterion, optim, mesh: Mesh,
         input_spec=in_spec(2), io_spec=io_spec, step=step,
         jitted_for=_jitted_for, pad_multiple=n_data,
         collective_bytes=plan.collective_bytes(host_params),
+        sparse_bytes_saved=plan.sparse_bytes_saved(host_params),
+        transport_table=transport_table,
         has_fsdp=has_fsdp, n_data=n_data, n_seq=n_seq,
         n_model=n_model, n_pipe=1, model_axis=m_ax, seq_axis=s_ax,
         input_seq_dim=input_seq_dim)
@@ -906,10 +1201,18 @@ def _compile_pipeline(model, criterion, optim, mesh, plan, d_ax, m_ax,
 
     packed0 = pack_params(model, S, m_ax)
     if plan is None:
+        # derive_plan itself rejects sparse-grad modules under a pipe
+        # axis — the packed stack has no per-table wire to sparsify
         plan = derive_plan(model, mesh, model_axis=m_ax, pipe_axis=p_ax,
                            n_pipe=S)
     else:
         plan = plan.bind(mesh)
+        if plan.has_sparse(packed0):
+            raise NotImplementedError(
+                "sparse gradient transport does not compose with the "
+                "pipeline layout — a transport='sparse' rule matched "
+                "the packed block stack; use a data [x model] mesh for "
+                "sparse-table models")
     pspecs = plan.param_specs(packed0)
     sslots = slot_specs(optim.init_state(packed0), pspecs)
     all_axes = tuple(a for a in (d_ax, p_ax, m_ax) if a)
@@ -1022,6 +1325,7 @@ def _compile_pipeline(model, criterion, optim, mesh, plan, d_ax, m_ax,
         input_spec=in_batch, io_spec=io_spec, step=step,
         jitted_for=_jitted_for, pad_multiple=n_data * M,
         collective_bytes=plan.collective_bytes(packed0),
+        sparse_bytes_saved=0.0, transport_table={},
         has_fsdp=False, n_data=n_data, n_seq=1, n_model=n_model,
         n_pipe=S, n_microbatch=M, model_axis=m_ax, seq_axis=None,
         input_seq_dim=None)

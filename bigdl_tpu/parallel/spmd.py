@@ -44,11 +44,16 @@ def param_specs(module, model_axis: str = "model"):
     sharding axis, router weights replicated); every other parameter is
     replicated.
     """
+    from ..nn.embedding import ShardedEmbedding
     from ..nn.module import Container
     from .moe import MoEFFN
     from .tensor_parallel import ColumnParallelLinear, RowParallelLinear
 
     tree = module.param_tree()
+    if isinstance(module, ShardedEmbedding) and module.axis_name:
+        # rows (and their optimizer slots) partition over the bound
+        # axis; the lookup is an index exchange under shard_map
+        return {"weight": P(module.axis_name)}
     if isinstance(module, ColumnParallelLinear) and module.axis_name:
         specs = {"weight": P(model_axis, None)}
         if "bias" in tree:
@@ -118,6 +123,7 @@ def bound_axes(model) -> frozenset:
     layers, expert-parallel MoE, a ring/ulysses sequence strategy) —
     the axes whose silent absence from a mesh is a misconfiguration
     worth warning about, not a default quietly dropped."""
+    from ..nn.embedding import ShardedEmbedding
     from .moe import MoEFFN
     from .tensor_parallel import ColumnParallelLinear, RowParallelLinear
 
@@ -126,7 +132,7 @@ def bound_axes(model) -> frozenset:
         if isinstance(m, (ColumnParallelLinear, RowParallelLinear)) \
                 and m.axis_name:
             bound.add(m.axis_name)
-        if isinstance(m, MoEFFN) and m.axis_name:
+        if isinstance(m, (MoEFFN, ShardedEmbedding)) and m.axis_name:
             bound.add(m.axis_name)
     if getattr(model, "seq_strategy", None) in ("ring", "ulysses"):
         bound.add(getattr(model, "seq_axis", "seq"))

@@ -160,7 +160,13 @@ class PerfAccountant:
             labels=("program",))
         self.collective_bytes = r.gauge(
             "bigdl_perf_collective_bytes",
-            "estimated collective wire bytes per step",
+            "estimated collective wire bytes per step (sparse-transport "
+            "leaves accounted as actual index+value bytes)",
+            labels=("program",))
+        self.sparse_bytes_saved = r.gauge(
+            "bigdl_perf_sparse_bytes_saved",
+            "collective wire bytes per step NOT moved because sparse "
+            "gradient transport replaced the dense all-reduce",
             labels=("program",))
         self.intensity = r.gauge(
             "bigdl_perf_arithmetic_intensity",
@@ -203,6 +209,7 @@ class PerfAccountant:
     # -- program analysis ------------------------------------------------
     def analyze_jitted(self, fn, *args, label: str = "train_step",
                        collective_bytes: float = 0.0,
+                       sparse_bytes_saved: float = 0.0,
                        **kwargs) -> Optional[StepCost]:
         """Lower a jitted callable with the driver's concrete args and
         read XLA's cost model — no compile, no execution, no donation
@@ -218,7 +225,8 @@ class PerfAccountant:
             log.debug("perf: cost analysis failed for %r: %s: %s",
                       label, type(e).__name__, e)
             return None
-        return self.on_program(label, cost)
+        return self.on_program(label, cost,
+                               sparse_bytes_saved=sparse_bytes_saved)
 
     def analyze_compiled(self, compiled, label: str = "train_step",
                          collective_bytes: float = 0.0
@@ -240,7 +248,8 @@ class PerfAccountant:
             return None
         return self.on_program(label, cost)
 
-    def on_program(self, label: str, cost: StepCost) -> StepCost:
+    def on_program(self, label: str, cost: StepCost,
+                   sparse_bytes_saved: float = 0.0) -> StepCost:
         """Install an analyzed program: publish its static gauges and
         make it the one ``on_step`` attributes work to."""
         label = str(label)
@@ -251,6 +260,9 @@ class PerfAccountant:
             cost.bytes_accessed)
         self.collective_bytes.labels(program=label).set(
             cost.collective_bytes)
+        if sparse_bytes_saved:
+            self.sparse_bytes_saved.labels(program=label).set(
+                float(sparse_bytes_saved))
         if cost.arithmetic_intensity is not None:
             self.intensity.labels(program=label).set(
                 cost.arithmetic_intensity)

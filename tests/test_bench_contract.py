@@ -80,7 +80,8 @@ def test_fallback_merges_persisted_tpu_numbers(tmp_path):
                 "BENCH_ELASTIC_TIMEOUT": "0",
                 "BENCH_INTEGRITY_TIMEOUT": "0",
                 "BENCH_TELEMETRY_TIMEOUT": "0",
-                "BENCH_SHARDING_TIMEOUT": "0"})
+                "BENCH_SHARDING_TIMEOUT": "0",
+                "BENCH_DLRM_TIMEOUT": "0"})
     # --no-ledger: a test invocation must not append to the repo's
     # judged PERF_LEDGER.jsonl trajectory
     out = subprocess.run(
@@ -329,6 +330,46 @@ def test_sharding_measurements_contract():
         out["composed_steps_per_sec"]
     assert rec["sharding_fsdp_param_bytes_frac"] == \
         out["fsdp_param_bytes_frac"]
+    for key in bench.LEDGER_FIELDS:
+        assert key in rec
+
+
+def test_dlrm_measurements_contract():
+    """The DLRM sparse-transport leg's measurement dict carries the
+    judged fields (measured collective bytes/step for the sparse and
+    dense passes with the reduction ratio, steps/sec for both, loss
+    trajectories descending-capable) — run small in-process on the
+    suite's 8 forced-host devices; the full leg is `--dlrm` and its
+    one JSON line lands in DLRM_r01.json."""
+    bench = _bench()
+    out = bench._dlrm_measurements(steps=6, batch=128,
+                                   table_sizes=(2048, 512, 128),
+                                   embed_dim=16, n_records=512,
+                                   shard_min_bytes=64 * 1024)
+    assert out["devices"] == 8
+    assert out["mesh"] == "data=8"
+    assert out["zipf_exponent"] == 1.1
+    assert out["sharded_tables"] == [0]   # 2048x16 f32 = 128 KiB
+    # the full tables exceed the pretend per-device budget (total/2):
+    # row sharding is forced, not optional
+    assert out["table_bytes_total"] > out["per_device_table_budget_bytes"]
+    assert out["steps_per_sec"] > 0
+    assert out["dense_steps_per_sec"] > 0
+    # the wire win: measured collective bytes/step shrink well past the
+    # acceptance bar even at this tiny scale (the full leg commits ~190x)
+    assert out["collective_bytes_per_step"] > 0
+    assert out["dense_collective_bytes_per_step"] > \
+        5 * out["collective_bytes_per_step"]
+    assert out["collective_bytes_reduction_x"] > 5
+    assert out["sparse_bytes_saved_per_step"] > 0
+    assert out["loss_first"] is not None and out["loss_last"] is not None
+    # and the record flattens into the schema-stable ledger fields
+    rec = bench.ledger_record({"dlrm": {
+        "steps_per_sec": out["steps_per_sec"],
+        "collective_bytes_per_step": out["collective_bytes_per_step"]}})
+    assert rec["dlrm_steps_per_sec"] == out["steps_per_sec"]
+    assert rec["dlrm_collective_bytes_per_step"] == \
+        out["collective_bytes_per_step"]
     for key in bench.LEDGER_FIELDS:
         assert key in rec
 

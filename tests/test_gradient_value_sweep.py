@@ -264,6 +264,12 @@ MODULE_CASES = {
     "LookupTable": (lambda: nn.LookupTable(10, 4),
                     lambda: np.array([[1., 3.], [2., 9.]], np.float32),
                     {"diff": []}),
+    # unbound (eager) path: the local gather — the bound index-exchange
+    # path is pinned in tests/test_sparse_transport.py
+    "ShardedEmbedding": (lambda: nn.ShardedEmbedding(10, 4),
+                         lambda: np.array([[1., 3.], [2., 9.]],
+                                          np.float32),
+                         {"diff": []}),
     "MM": (lambda: nn.MM(),
            lambda: T(R.randn(2, 3, 4).astype(np.float32),
                      R.randn(2, 4, 5).astype(np.float32)), {}),

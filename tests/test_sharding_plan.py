@@ -198,13 +198,28 @@ def _golden_cases():
         return lm.param_tree(), derive_plan(lm, mesh,
                                             fsdp_min_bytes=4096)
 
+    def dlrm():
+        from bigdl_tpu.models.dlrm import DLRM
+
+        RNG().set_seed(1)
+        # 4 KiB shard threshold: the 512-row table row-shards over
+        # data, the 64-row table replicates — BOTH carry the sparse
+        # transport column (the ISSUE 10 per-rule wire, visible in one
+        # committed table)
+        model = DLRM(dense_dim=4, table_sizes=(512, 64), embed_dim=8,
+                     shard_min_bytes=4096)
+        mesh = Mesh(devs, ("data",))
+        return model.param_tree(), derive_plan(model, mesh)
+
     cases["resnet50"] = resnet50
     cases["transformerlm"] = transformerlm
     cases["llama"] = llama
+    cases["dlrm"] = dlrm
     return cases
 
 
-@pytest.mark.parametrize("name", ["resnet50", "transformerlm", "llama"])
+@pytest.mark.parametrize("name", ["resnet50", "transformerlm", "llama",
+                                  "dlrm"])
 def test_golden_plan_tables(name):
     tree, plan = _golden_cases()[name]()
     table = plan.table(tree)
@@ -347,8 +362,8 @@ def test_fsdp_specs_shard_large_leaves_only():
     table = plan.table(model.param_tree())
     assert "[fsdp]" in table["0/weight"]   # 512x256 f32 = 512 KiB
     assert "data" in table["0/weight"]
-    assert table["0/bias"] == "replicated"
-    assert table["2/weight"] == "replicated"  # 2x512 f32 = 4 KiB
+    assert table["0/bias"] == "replicated | dense"
+    assert table["2/weight"] == "replicated | dense"  # 2x512 f32 = 4 KiB
 
 
 # ---------------------------------------------------------------------------

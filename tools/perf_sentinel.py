@@ -82,6 +82,12 @@ DEFAULT_TOLERANCES = {
     # silently stopped sharding
     "sharding_composed_steps_per_sec": ("higher", 0.50),
     "sharding_fsdp_param_bytes_frac": ("lower", 0.25),
+    # DLRM sparse gradient transport (ISSUE 10): steps/sec on the
+    # forced-host CPU leg is noisy (wide tolerance); the measured
+    # collective bytes/step is a deterministic plan/accounting property
+    # — a rise means the sparse wire silently stopped engaging
+    "dlrm_steps_per_sec": ("higher", 0.50),
+    "dlrm_collective_bytes_per_step": ("lower", 0.25),
 }
 
 
