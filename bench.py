@@ -1157,6 +1157,418 @@ def run_fleet_bench() -> None:
 
 
 # --------------------------------------------------------------------------
+# Disagg leg: paged KV + prefill/decode pools + telemetry autoscaling
+# --------------------------------------------------------------------------
+
+DISAGG_TIMEOUT = float(os.environ.get("BENCH_DISAGG_TIMEOUT", "420"))
+DISAGG_RESULT = "SERVING_r03.json"
+
+
+def _disagg_measurements(phase_s: float = 2.5, low_rps: float = 2.0,
+                         high_rps: float = 60.0, users: int = 24,
+                         zipf_a: float = 1.1, prompt_len: int = 6,
+                         max_new: int = 40, long_prompt: int = 8,
+                         long_new: int = 24, t_max: int = 64,
+                         page_size: int = 4, vocab: int = 31,
+                         max_queue: int = 16,
+                         eval_interval_s: float = 0.35,
+                         cooldown_s: float = 1.2,
+                         deadline_s: float = 10.0,
+                         cold_start: bool = True,
+                         layers: int = 2):
+    """The serving scale-out leg: paged KV-cache vs the static-bucket
+    baseline at EQUAL arena bytes, a Zipf load ramp over a mixed
+    prefill/decode fleet in three passes (static / paged / paged +
+    autoscale), and the compile-cache cold-start probe.
+
+    Proof obligations (the committed SERVING_r03.json):
+
+    * at equal KV arena bytes the paged pool sustains ≥ 2x the
+      concurrent long decodes the static ``T_max`` accounting admits,
+      with every paged token stream EXACTLY the unpaged
+      ``cached_generate`` stream;
+    * under the ramp, each pool scales up on sustained p99/shed/queue
+      breach and back down on idle (replica-count timeline), with
+      cooldown respected and ≤ 1 scale direction flip per ramp phase,
+      at a shed rate no worse than the fixed paged fleet's;
+    * TTFT/TPOT p50/p99 per pass.  Pure control-plane numbers,
+      meaningful on any backend."""
+    import threading
+
+    import numpy as np
+
+    from bigdl_tpu.models.generate import cached_generate
+    from bigdl_tpu.models.transformer import TransformerLM
+    from bigdl_tpu.serving import (AutoscalePolicy, Autoscaler,
+                                   InferenceServer, KVPagePool,
+                                   ServingFleet, Status)
+    from bigdl_tpu.telemetry import Histogram
+    from bigdl_tpu.utils.rng import RNG
+
+    def build_model():
+        RNG().set_seed(11)
+        return TransformerLM(vocab, embed_dim=16, num_heads=2,
+                             mlp_dim=32, num_layers=layers,
+                             max_len=t_max)
+
+    model = build_model()
+    params = model.param_tree()
+    gen = cached_generate(model)
+    rng = np.random.RandomState(0)
+    prompts = rng.randint(1, vocab + 1,
+                          (users, prompt_len)).astype(np.int32)
+    ranks = np.arange(1, users + 1, dtype=np.float64)
+    probs = ranks ** -float(zipf_a)
+    probs /= probs.sum()
+    num_pages = (2 * t_max) // page_size   # arena = TWO static buckets
+
+    def ref_tail(prompt, n):
+        return np.asarray(gen(params, prompt[None], n))[0,
+                                                        len(prompt):]
+
+    out = {"t_max": t_max, "page_size": page_size,
+           "arena_positions": num_pages * page_size}
+
+    # -- part A: paged-vs-static concurrency at equal arena bytes ----
+    pool = KVPagePool.for_model(model, num_pages, page_size=page_size)
+    out["arena_bytes"] = pool.arena_bytes()
+    pages_per_long = pool.pages_for_tokens(long_prompt + long_new)
+    #: the static-bucket accounting: every request pins a whole T_max
+    #: window, so this arena admits exactly this many long decodes
+    static_max = (num_pages * page_size) // t_max
+    #: the paged accounting: requests pin only the pages they fill
+    paged_target = num_pages // pages_per_long
+    srv = InferenceServer(model, kv_pool=pool, max_batch=8,
+                          batch_window_s=0.25).start()
+    try:
+        long_prompts = [rng.randint(1, vocab + 1,
+                                    (long_prompt,)).astype(np.int32)
+                        for _ in range(paged_target)]
+        refs = [ref_tail(p, long_new) for p in long_prompts]
+        futs = [srv.submit_generate(p, long_new)
+                for p in long_prompts]
+        res = [f.result(timeout=300) for f in futs]
+        exact = all(r.ok and np.array_equal(r.output, refs[i])
+                    for i, r in enumerate(res))
+        paged_concurrent = pool.high_water // pages_per_long
+    finally:
+        srv.stop(timeout=30)
+    out["concurrency"] = {
+        "static_max_long_decodes": static_max,
+        "paged_long_decodes_sustained": paged_concurrent,
+        "paged_concurrency_x": round(paged_concurrent
+                                     / max(1, static_max), 2),
+        "paged_outputs_exact": bool(exact),
+        "pages_per_long_decode": pages_per_long,
+        "pool_leak_free": pool.free_pages == pool.num_pages,
+    }
+
+    # -- part B: the Zipf load ramp, three passes --------------------
+    phases = ((("low", low_rps), ("high", high_rps),
+               ("idle", 0.0)))
+
+    def pct_ms(vals, q):
+        if not vals:
+            return None
+        hist = Histogram(window=max(1, len(vals)))
+        for v in vals:
+            hist.observe(v)
+        p = hist.quantile(q)
+        return round(p * 1e3, 3) if p is not None else None
+
+    def run_ramp(fleet, asc=None):
+        per_phase, timeline, t0 = [], [], time.perf_counter()
+        t0_mono = time.monotonic()   # the autoscaler's clock basis
+        stop_ctl = threading.Event()
+
+        def controller():
+            while not stop_ctl.wait(eval_interval_s):
+                if asc is not None:
+                    try:
+                        asc.evaluate_once()
+                    except Exception:   # control must not kill load
+                        pass
+                counts = {"prefill": 0, "decode": 0, "both": 0}
+                for s in list(fleet.servers.values()):
+                    counts[getattr(s, "role", "both")] += 1
+                timeline.append(dict(
+                    t=round(time.perf_counter() - t0, 2), **counts))
+
+        ctl = threading.Thread(target=controller, daemon=True)
+        ctl.start()
+        try:
+            for name, rate in phases:
+                futs, n = [], 0
+                p0 = time.perf_counter()
+                dur = phase_s if rate else 2 * phase_s
+                while True:
+                    elapsed = time.perf_counter() - p0
+                    if elapsed >= dur:
+                        break
+                    while n < int(elapsed * rate):
+                        i = int(rng.choice(users, p=probs))
+                        futs.append(fleet.submit_generate(
+                            prompts[i], max_new,
+                            deadline_s=deadline_s))
+                        n += 1
+                    time.sleep(0.002)
+                per_phase.append((name, futs))
+        finally:
+            done = [(name, [f.result(timeout=300) for f in futs])
+                    for name, futs in per_phase]
+            stop_ctl.set()
+            ctl.join(timeout=10)
+        stats = {}
+        all_res = []
+        for name, res in done:
+            all_res.extend(res)
+            ok_lat = [r.latency_s for r in res if r.ok]
+            shed = sum(r.status is Status.OVERLOADED for r in res)
+            stats[name] = {
+                "offered": len(res), "ok": sum(r.ok for r in res),
+                "shed": shed,
+                "shed_rate": round(shed / len(res), 4) if res else 0.0,
+                "latency_p50_ms": pct_ms(ok_lat, 0.50),
+                "latency_p99_ms": pct_ms(ok_lat, 0.99),
+            }
+        offered = len(all_res)
+        shed = sum(r.status is Status.OVERLOADED for r in all_res)
+        stats["total"] = {
+            "offered": offered,
+            "ok": sum(r.ok for r in all_res),
+            "shed": shed,
+            "shed_rate": round(shed / offered, 4) if offered else 0.0,
+            "all_resolved_typed": all(r.status is not None
+                                      for r in all_res),
+        }
+        return stats, timeline, t0_mono
+
+    def phase_metrics(fleet):
+        """TTFT from the router (disagg records it at first-token),
+        TPOT from the worst decode replica."""
+        r = fleet.router.metrics.snapshot()
+        tpots = [s.metrics.snapshot() for s in fleet.servers.values()
+                 if getattr(s, "role", "both") in ("decode", "both")]
+
+        def ms(v):
+            return round(v * 1e3, 3) if v is not None else None
+
+        def worst(key):
+            vals = [t[key] for t in tpots if t[key] is not None]
+            return ms(max(vals)) if vals else None
+
+        return {"ttft_p50_ms": ms(r["ttft_p50_s"]),
+                "ttft_p99_ms": ms(r["ttft_p99_s"]),
+                "tpot_p50_ms": worst("tpot_p50_s"),
+                "tpot_p99_ms": worst("tpot_p99_s")}
+
+    def make_paged_fleet():
+        # max_workers sized ABOVE the offered concurrency: the load
+        # must reach the replicas (and their published signals), not
+        # queue invisibly in the router's dispatch pool
+        return ServingFleet.build(
+            model, n_replicas=2, roles=("prefill", "decode"),
+            kv_pages=num_pages, kv_page_size=page_size,
+            server_kw=dict(max_batch=8, max_queue=max_queue),
+            heartbeat_timeout=0.4, pump_interval_s=0.1,
+            router_kw=dict(default_deadline_s=deadline_s,
+                           disaggregate=True, max_workers=96))
+
+    # pass 1: static-bucket baseline (unpaged, same replica count)
+    fleet = ServingFleet.build(
+        model, n_replicas=2,
+        server_kw=dict(max_batch=8, max_queue=max_queue),
+        heartbeat_timeout=0.4, pump_interval_s=0.1,
+        router_kw=dict(default_deadline_s=deadline_s,
+                       max_workers=96))
+    fleet.start()
+    try:
+        warm = fleet.submit_generate(prompts[0], max_new)
+        warm.result(timeout=300)
+        stats, _, _ = run_ramp(fleet)
+        lat = fleet.router.metrics.snapshot()
+
+        def ms(v):
+            return round(v * 1e3, 3) if v is not None else None
+
+        # the unpaged path emits every token at once: its whole
+        # latency IS its TTFT, and TPOT is unobservable
+        out["static_pass"] = dict(
+            stats, ttft_p50_ms=ms(lat["latency_p50_s"]),
+            ttft_p99_ms=ms(lat["latency_p99_s"]),
+            tpot_p50_ms=None, tpot_p99_ms=None)
+    finally:
+        fleet.stop(timeout=30)
+
+    # pass 2: paged + disaggregated, fixed fleet
+    fleet = make_paged_fleet()
+    fleet.start()
+    try:
+        fleet.submit_generate(prompts[0], max_new).result(timeout=300)
+        stats, _, _ = run_ramp(fleet)
+        out["paged_pass"] = dict(stats, **phase_metrics(fleet))
+    finally:
+        fleet.stop(timeout=30)
+
+    # pass 3: paged + autoscale
+    fleet = make_paged_fleet()
+    fleet.start()
+
+    def factory(rid, role):
+        return InferenceServer(
+            model, name=rid, role=role, max_batch=8,
+            max_queue=max_queue,
+            kv_pool=KVPagePool.for_model(model, num_pages,
+                                         page_size=page_size))
+
+    asc = Autoscaler(fleet, factory, policy=AutoscalePolicy(
+        min_replicas=1, max_replicas=3, p99_high_s=0.25,
+        shed_high=0.01, queue_high=3, sustain=2,
+        p99_idle_s=0.05, queue_idle=2, idle_sustain=2,
+        cooldown_s=cooldown_s, idle_requests_delta=1,
+        drain_timeout_s=10.0))
+    try:
+        fleet.submit_generate(prompts[0], max_new).result(timeout=300)
+        stats, timeline, t0_mono = run_ramp(fleet, asc=asc)
+        out["autoscale_pass"] = dict(stats, **phase_metrics(fleet))
+        decode_counts = [t["decode"] for t in timeline]
+        # ≤ 1 scale direction flip per ramp phase: map each decision
+        # onto the ramp clock and walk phase boundaries (decisions
+        # landing in the post-ramp drain tail count in the last phase)
+        bounds, acc = [], 0.0
+        for name, rate in phases:
+            dur = phase_s if rate else 2 * phase_s
+            bounds.append((name, acc, acc + dur))
+            acc += dur
+        rel = [(d["at"] - t0_mono, d["direction"])
+               for d in asc.decisions]
+        flips = {}
+        for i, (name, lo, hi) in enumerate(bounds):
+            last = i == len(bounds) - 1
+            dirs = [direction for t, direction in rel
+                    if lo <= t and (last or t < hi)]
+            flips[name] = sum(1 for a, b in zip(dirs, dirs[1:])
+                              if a != b)
+        scaled_up = bool(decode_counts) \
+            and max(decode_counts) > decode_counts[0]
+        out["autoscale"] = {
+            "timeline": timeline,
+            "decisions": [
+                {k: d[k] for k in ("pool", "direction", "replica",
+                                   "reason")}
+                for d in asc.decisions],
+            "decode_replicas_min": min(decode_counts)
+            if decode_counts else None,
+            "decode_replicas_max": max(decode_counts)
+            if decode_counts else None,
+            "scaled_up": scaled_up,
+            "scaled_back_down": scaled_up and decode_counts
+            and decode_counts[-1] < max(decode_counts),
+            "direction_flips_per_phase": flips,
+            "max_flips_in_a_phase": max(flips.values())
+            if flips else 0,
+            "cooldown_s": cooldown_s,
+        }
+        out["autoscale"]["shed_rate_vs_fixed"] = {
+            "fixed": out["paged_pass"]["total"]["shed_rate"],
+            "autoscaled": stats["total"]["shed_rate"],
+            "no_worse": stats["total"]["shed_rate"]
+            <= out["paged_pass"]["total"]["shed_rate"] + 1e-9,
+        }
+    finally:
+        fleet.stop(timeout=30)
+
+    # -- part C: compile-cache cold start ----------------------------
+    if cold_start:
+        import shutil
+        import tempfile
+
+        import jax
+
+        from bigdl_tpu.serving.compile_cache import (_STATE,
+                                                     set_compile_cache_dir)
+
+        def spin_up():
+            fresh = build_model()
+            p = KVPagePool.for_model(fresh, num_pages,
+                                     page_size=page_size)
+            s = InferenceServer(fresh, kv_pool=p, max_batch=4)
+            t0 = time.perf_counter()
+            s.start()
+            r = s.submit_generate(prompts[0], 3).result(timeout=300)
+            dt = time.perf_counter() - t0
+            s.stop(timeout=30)
+            return dt if r.ok else None
+
+        cache_dir = tempfile.mkdtemp(prefix="bigdl-xla-cache-")
+        prior = jax.config.jax_compilation_cache_dir
+        try:
+            no_cache_s = spin_up()
+            set_compile_cache_dir(cache_dir)
+            populate_s = spin_up()   # writes the executables
+            warm_s = spin_up()       # ...this one should load them
+            out["cold_start"] = {
+                "no_cache_s": round(no_cache_s, 3)
+                if no_cache_s else None,
+                "cache_populate_s": round(populate_s, 3)
+                if populate_s else None,
+                "cache_warm_s": round(warm_s, 3) if warm_s else None,
+                "speedup_x": round(no_cache_s / warm_s, 2)
+                if (no_cache_s and warm_s) else None,
+                "cache_entries": len(os.listdir(cache_dir)),
+            }
+        except Exception as e:  # cache support varies per backend
+            out["cold_start"] = {"error": f"{type(e).__name__}: {e}"}
+        finally:
+            jax.config.update("jax_compilation_cache_dir", prior)
+            _STATE["dir"] = None
+            shutil.rmtree(cache_dir, ignore_errors=True)
+
+    out["ttft_p99_ms"] = (out.get("paged_pass") or {}).get(
+        "ttft_p99_ms")
+    out["ttft_p50_ms"] = (out.get("paged_pass") or {}).get(
+        "ttft_p50_ms")
+    out["tpot_p99_ms"] = (out.get("paged_pass") or {}).get(
+        "tpot_p99_ms")
+    out["tpot_p50_ms"] = (out.get("paged_pass") or {}).get(
+        "tpot_p50_ms")
+    out["paged_concurrency_x"] = out["concurrency"][
+        "paged_concurrency_x"]
+    out["shed_rate"] = (out.get("autoscale_pass")
+                        or {}).get("total", {}).get("shed_rate")
+    return out
+
+
+def run_disagg_bench() -> None:
+    """--disagg mode: paged-vs-static + the three-pass Zipf ramp over
+    a mixed prefill/decode fleet on CPU (control-plane numbers), write
+    SERVING_r03.json, print the one JSON line."""
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    out = {"bench": "disagg", "backend": "cpu",
+           "measured_at": _utc_now()}
+    try:
+        out.update(_disagg_measurements())
+        p99 = out.get("ttft_p99_ms")
+        out.update({
+            "metric": "disaggregated serving TTFT p99",
+            "value": p99 if p99 is not None else 0.0,
+            "unit": "ms",
+        })
+    except Exception as e:
+        out["error"] = f"{type(e).__name__}: {e}"[:500]
+        out.update({"metric": "disaggregated serving TTFT p99",
+                    "value": 0.0, "unit": "ms"})
+    try:
+        with open(os.path.join(_here(), DISAGG_RESULT), "w") as f:
+            json.dump(out, f, indent=1)
+    except OSError:
+        pass
+    print(json.dumps(out), flush=True)
+
+
+# --------------------------------------------------------------------------
 # Elastic leg: chaos run through the shrink-to-survivors coordinator
 # --------------------------------------------------------------------------
 
@@ -2073,6 +2485,8 @@ LEDGER_FIELDS = (
     "serving_p99_ms", "serving_p50_ms",
     "fleet_p99_ms", "fleet_hedged_p99_ms", "fleet_shed_rate",
     "fleet_goodput_per_chip", "fleet_recovery_s",
+    "disagg_ttft_p99_ms", "disagg_tpot_p99_ms",
+    "disagg_paged_concurrency_x", "disagg_shed_rate",
     "elastic_recovery_s",
     "sdc_detection_latency_steps", "telemetry_overhead_pct",
     "goodput_productive_fraction", "goodput_accounted_fraction",
@@ -2100,6 +2514,15 @@ def ledger_record(result: dict) -> dict:
     flat["fleet_shed_rate"] = fleet.get("shed_rate")
     flat["fleet_goodput_per_chip"] = fleet.get("goodput_per_chip_flops")
     flat["fleet_recovery_s"] = fleet.get("recovery_s")
+    # the disagg leg (ISSUE 11): TTFT/TPOT may only fall, the paged
+    # concurrency multiple may only rise, shed under the ramp may only
+    # fall — tools/perf_sentinel.py guards the direction
+    disagg = result.get("disagg") or {}
+    flat["disagg_ttft_p99_ms"] = disagg.get("ttft_p99_ms")
+    flat["disagg_tpot_p99_ms"] = disagg.get("tpot_p99_ms")
+    flat["disagg_paged_concurrency_x"] = disagg.get(
+        "paged_concurrency_x")
+    flat["disagg_shed_rate"] = disagg.get("shed_rate")
     elastic = result.get("elastic") or {}
     flat["elastic_recovery_s"] = elastic.get("recovery_wall_clock_s")
     integrity = result.get("integrity") or {}
@@ -2429,6 +2852,36 @@ def main(ledger: bool = True, probe: bool = True) -> None:
                      or "fleet leg returned nothing"}
     result["fleet"] = fleet
 
+    # disagg leg: paged KV + prefill/decode pools + autoscaling under
+    # a Zipf load ramp (TTFT/TPOT, paged-vs-static concurrency, shed
+    # rate, replica-count timeline; backend-independent, lands in
+    # SERVING_r03.json) — best-effort like the serving leg;
+    # BENCH_DISAGG_TIMEOUT=0 disables it.
+    if DISAGG_TIMEOUT <= 0:
+        disagg = {"skipped": "BENCH_DISAGG_TIMEOUT=0"}
+    else:
+        ok, dgres, note = _run_sub(["--disagg"], DISAGG_TIMEOUT)
+        if ok and dgres and "error" not in dgres:
+            disagg = {
+                "ttft_p99_ms": dgres.get("ttft_p99_ms"),
+                "ttft_p50_ms": dgres.get("ttft_p50_ms"),
+                "tpot_p99_ms": dgres.get("tpot_p99_ms"),
+                "tpot_p50_ms": dgres.get("tpot_p50_ms"),
+                "paged_concurrency_x": dgres.get(
+                    "paged_concurrency_x"),
+                "shed_rate": dgres.get("shed_rate"),
+                "autoscale_scaled_up": (dgres.get("autoscale")
+                                        or {}).get("scaled_up"),
+                "autoscale_scaled_back_down":
+                    (dgres.get("autoscale")
+                     or {}).get("scaled_back_down"),
+                "source": DISAGG_RESULT,
+            }
+        else:
+            disagg = {"error": (dgres or {}).get("error") or note
+                      or "disagg leg returned nothing"}
+    result["disagg"] = disagg
+
     # elastic leg: chaos run through the shrink-to-survivors coordinator
     # (recovery wall-clock + pre/post-fault throughput; backend-
     # independent, lands in ELASTIC_r01.json) — best-effort like the
@@ -2584,8 +3037,8 @@ def main(ledger: bool = True, probe: bool = True) -> None:
             # telemetry/sharding) are backend-independent and were
             # measured LIVE this run — they must not be shadowed by
             # whatever the stale chip record carried
-            for leg in ("serving", "fleet", "elastic", "integrity",
-                        "telemetry", "sharding", "dlrm"):
+            for leg in ("serving", "fleet", "disagg", "elastic",
+                        "integrity", "telemetry", "sharding", "dlrm"):
                 if result.get(leg) is not None:
                     merged[leg] = result[leg]
             result = merged
@@ -2607,6 +3060,7 @@ if __name__ == "__main__":
     p.add_argument("--probe", action="store_true")
     p.add_argument("--serving", action="store_true")
     p.add_argument("--fleet", action="store_true")
+    p.add_argument("--disagg", action="store_true")
     p.add_argument("--elastic", action="store_true")
     p.add_argument("--integrity", action="store_true")
     p.add_argument("--telemetry", action="store_true")
@@ -2630,6 +3084,8 @@ if __name__ == "__main__":
         run_serving_bench()
     elif a.fleet:
         run_fleet_bench()
+    elif a.disagg:
+        run_disagg_bench()
     elif a.elastic:
         run_elastic_bench()
     elif a.integrity:

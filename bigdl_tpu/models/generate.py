@@ -154,6 +154,61 @@ def _moe_ffn_nodrop(moe, params, x):
     return y.reshape(B, Tq, D)
 
 
+def _gqa_attend(q, k_cache, v_cache, pos, H, Hkv, Dh):
+    """Causal attention of Tq queries (absolute positions
+    pos..pos+Tq-1) against a dense ``[B, Hkv, Tm, Dh]`` cache view.
+    GQA contracts the query groups against the UN-repeated cache — a
+    repeat here would materialize H/Hkv copies of the whole cache
+    every decode step, exactly the bandwidth GQA exists to save.
+    Shared by the dense-cache machinery and the paged decode path (the
+    paged path passes a page-gathered view), so the two can never
+    drift numerically."""
+    Tq, Tm = q.shape[2], k_cache.shape[2]
+    scale = 1.0 / jnp.sqrt(jnp.float32(Dh)).astype(q.dtype)
+    qpos = pos + jnp.arange(Tq)
+    mask = jnp.arange(Tm)[None, :] <= qpos[:, None]   # [Tq, Tm]
+    if Hkv == H:
+        scores = jnp.einsum("bhqd,bhkd->bhqk", q, k_cache) * scale
+        scores = jnp.where(mask[None, None], scores, -jnp.inf)
+        probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1)
+        return jnp.einsum("bhqk,bhkd->bhqd", probs.astype(q.dtype),
+                          v_cache)
+    B = q.shape[0]
+    qg = q.reshape(B, Hkv, H // Hkv, Tq, Dh)
+    scores = jnp.einsum("bhgqd,bhkd->bhgqk", qg, k_cache) * scale
+    scores = jnp.where(mask[None, None, None], scores, -jnp.inf)
+    probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1)
+    o = jnp.einsum("bhgqk,bhkd->bhgqd", probs.astype(q.dtype),
+                   v_cache)
+    return o.reshape(B, H, Tq, Dh)
+
+
+def _ffn_sublayer(block, bp, h):
+    """ln2 + the block's MLP (gelu / swiglu / capacity-free MoE) with
+    the residual add — the post-attention half of a block, shared by
+    the dense-cache and paged machineries."""
+    ln2, _ = block.modules[2].apply_fn(bp["2"], {}, h, False, None)
+    kind = getattr(block, "mlp_kind",
+                   "moe" if block.is_moe else "gelu")
+    if kind == "moe":
+        ffn = _moe_ffn_nodrop(block.modules[3], bp["3"], ln2)
+    elif kind == "swiglu":
+        g, _ = block.modules[3].apply_fn(bp["3"], {}, ln2, False,
+                                         None)
+        u, _ = block.modules[4].apply_fn(bp["4"], {}, ln2, False,
+                                         None)
+        ffn, _ = block.modules[5].apply_fn(
+            bp["5"], {}, jax.nn.silu(g) * u, False, None)
+    else:
+        mid, _ = block.modules[3].apply_fn(bp["3"], {}, ln2, False,
+                                           None)
+        out, _ = block.modules[4].apply_fn(bp["4"], {},
+                                           jax.nn.gelu(mid), False,
+                                           None)
+        ffn = out
+    return h + ffn
+
+
 def _decode_machinery(model, first, count, T_max, kv_int8=False):
     """The cached-attention forward shared by the sampling decoder and
     beam search — built once per generator from the model structure.
@@ -189,29 +244,7 @@ def _decode_machinery(model, first, count, T_max, kv_int8=False):
         return jnp.repeat(kv, H // Hkv, axis=1)
 
     def _attend(q, k_cache, v_cache, pos):
-        """Causal attention of Tq queries (absolute positions
-        pos..pos+Tq-1) against the cache.  GQA contracts the query
-        groups against the UN-repeated [B, Hkv, T_max, Dh] cache — a
-        repeat here would materialize H/Hkv copies of the whole cache
-        every decode step, exactly the bandwidth GQA exists to save."""
-        Tq, Tm = q.shape[2], k_cache.shape[2]
-        scale = 1.0 / jnp.sqrt(jnp.float32(Dh)).astype(q.dtype)
-        qpos = pos + jnp.arange(Tq)
-        mask = jnp.arange(Tm)[None, :] <= qpos[:, None]   # [Tq, Tm]
-        if Hkv == H:
-            scores = jnp.einsum("bhqd,bhkd->bhqk", q, k_cache) * scale
-            scores = jnp.where(mask[None, None], scores, -jnp.inf)
-            probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1)
-            return jnp.einsum("bhqk,bhkd->bhqd", probs.astype(q.dtype),
-                              v_cache)
-        B = q.shape[0]
-        qg = q.reshape(B, Hkv, H // Hkv, Tq, Dh)
-        scores = jnp.einsum("bhgqd,bhkd->bhgqk", qg, k_cache) * scale
-        scores = jnp.where(mask[None, None, None], scores, -jnp.inf)
-        probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1)
-        o = jnp.einsum("bhgqk,bhkd->bhgqd", probs.astype(q.dtype),
-                       v_cache)
-        return o.reshape(B, H, Tq, Dh)
+        return _gqa_attend(q, k_cache, v_cache, pos, H, Hkv, Dh)
 
     def _quant(x):
         """absmax int8 over the head dim: x ≈ q * s, q int8,
@@ -293,26 +326,7 @@ def _decode_machinery(model, first, count, T_max, kv_int8=False):
             o = _attend(q, *_cache_kv(cache, q.dtype), pos)
         o = o.transpose(0, 2, 1, 3).reshape(B, o.shape[2], H * Dh)
         h = h + _proj(o, ap, "wo", "bo", mha.with_bias)
-        ln2, _ = block.modules[2].apply_fn(bp["2"], {}, h, False, None)
-        kind = getattr(block, "mlp_kind",
-                       "moe" if block.is_moe else "gelu")
-        if kind == "moe":
-            ffn = _moe_ffn_nodrop(block.modules[3], bp["3"], ln2)
-        elif kind == "swiglu":
-            g, _ = block.modules[3].apply_fn(bp["3"], {}, ln2, False,
-                                             None)
-            u, _ = block.modules[4].apply_fn(bp["4"], {}, ln2, False,
-                                             None)
-            ffn, _ = block.modules[5].apply_fn(
-                bp["5"], {}, jax.nn.silu(g) * u, False, None)
-        else:
-            mid, _ = block.modules[3].apply_fn(bp["3"], {}, ln2, False,
-                                               None)
-            out, _ = block.modules[4].apply_fn(bp["4"], {},
-                                               jax.nn.gelu(mid), False,
-                                               None)
-            ffn = out
-        return h + ffn, cache
+        return _ffn_sublayer(block, bp, h), cache
 
     def _embed_at(pc, tok, pos, Tq):
         h, _ = embed.apply_fn(pc["0"], {}, tok, False, None)
@@ -575,6 +589,319 @@ def make_beam_search(model, max_len: Optional[int] = None,
                     int(max_new), int(num_beams), eos, pad)
 
     return beam_search
+
+
+# --------------------------------------------------------------------------
+# Paged decode: page-table KV through a shared KVPagePool arena
+# --------------------------------------------------------------------------
+
+def _paged_machinery(model, first, count, page_size):
+    """The paged twin of :func:`_decode_machinery`: K/V live in a
+    shared ``[num_pages, layers, Hkv, page_size, Dh]`` arena and each
+    request addresses its positions through a page table ``pt`` (page
+    ids, bucket-padded).  Attention gathers the request's pages into a
+    dense view and runs the SAME :func:`_gqa_attend` the unpaged path
+    runs — masked positions contribute exactly zero, so the paged
+    token stream is the unpaged stream (pinned in
+    tests/test_kvpool.py).
+
+    Shapes are static per (prompt_len, page_bucket): ``pos`` and
+    ``pt`` are traced values, so page-table REUSE never recompiles —
+    one decode program per page-count bucket, ever.
+    """
+    blocks = model.modules[first:first + count]
+    ln_f = model.modules[first + count]
+    head = model.modules[first + count + 1]
+    embed = model.modules[0]
+    mha0 = blocks[0].modules[1]
+    H, Dh = mha0.num_heads, mha0.head_dim
+    Hkv = getattr(mha0, "num_kv_heads", H)
+    use_rope = getattr(model, "use_rope", False)
+    rope_theta = getattr(mha0, "rope_theta", 10000.0)
+
+    def _split(x, B, h=H):
+        return x.reshape(B, -1, h, Dh).transpose(0, 2, 1, 3)
+
+    def _rep(kv):
+        if Hkv == H:
+            return kv
+        return jnp.repeat(kv, H // Hkv, axis=1)
+
+    def _embed_at(pc, tok, pos, Tq):
+        h, _ = embed.apply_fn(pc["0"], {}, tok, False, None)
+        if use_rope:
+            return h
+        return h + lax.dynamic_slice_in_dim(pc["pos"], pos, Tq)
+
+    def _qkv(block, ap, ln1, pos_ids):
+        mha = block.modules[1]
+        B = ln1.shape[0]
+        q = _split(_proj(ln1, ap, "wq", "bq", mha.with_bias), B)
+        k = _split(_proj(ln1, ap, "wk", "bk", mha.with_bias), B, Hkv)
+        v = _split(_proj(ln1, ap, "wv", "bv", mha.with_bias), B, Hkv)
+        if use_rope:
+            from ..nn.attention import rope_rotate
+
+            q = rope_rotate(q, pos_ids, rope_theta)
+            k = rope_rotate(k, pos_ids, rope_theta)
+        return q, k, v
+
+    def logits_last(pc, h):
+        h = h[:, -1:, :]
+        h, _ = ln_f.apply_fn(pc[str(first + count)], {}, h, False, None)
+        h, _ = head.apply_fn(pc[str(first + count + 1)], {}, h, False,
+                             None)
+        return h[:, 0, :].astype(jnp.float32)
+
+    def prefill(pc, prompt, pt, arena_k, arena_v):
+        """The whole prompt in one causal pass (the flash path the
+        dense machinery uses — first-token numerics identical), K/V
+        scattered into the request's pages.  ``prompt`` is [1, T0]."""
+        B, T0 = prompt.shape
+        n_pages = -(-T0 // page_size)          # static: T0 is static
+        h = _embed_at(pc, prompt, 0, T0)
+        for bi, block in enumerate(blocks):
+            bp = pc[str(first + bi)]
+            ln1, _ = block.modules[0].apply_fn(bp["0"], {}, h, False,
+                                               None)
+            q, k, v = _qkv(block, bp["1"], ln1, jnp.arange(T0))
+
+            def paged_view(x):  # [1, Hkv, T0, Dh] -> [n, Hkv, ps, Dh]
+                xp = jnp.pad(
+                    x[0], ((0, 0), (0, n_pages * page_size - T0),
+                           (0, 0)))
+                return xp.reshape(Hkv, n_pages, page_size,
+                                  Dh).transpose(1, 0, 2, 3)
+
+            arena_k = arena_k.at[pt[:n_pages], bi].set(
+                paged_view(k).astype(arena_k.dtype))
+            arena_v = arena_v.at[pt[:n_pages], bi].set(
+                paged_view(v).astype(arena_v.dtype))
+            from ..ops.flash_attention import flash_attention
+
+            o = flash_attention(q, _rep(k), _rep(v), causal=True)
+            o = o.transpose(0, 2, 1, 3).reshape(B, T0, H * Dh)
+            h = h + _proj(o, bp["1"], "wo", "bo",
+                          block.modules[1].with_bias)
+            h = _ffn_sublayer(block, bp, h)
+        return logits_last(pc, h), arena_k, arena_v
+
+    def decode(pc, tok, pos, pt, arena_k, arena_v):
+        """One token [1, 1] at traced absolute position ``pos``: write
+        its K/V into page ``pt[pos // page_size]`` slot ``pos %
+        page_size``, attend over the gathered page view."""
+        P = pt.shape[0]
+        h = _embed_at(pc, tok, pos, 1)
+        for bi, block in enumerate(blocks):
+            bp = pc[str(first + bi)]
+            ln1, _ = block.modules[0].apply_fn(bp["0"], {}, h, False,
+                                               None)
+            q, k, v = _qkv(block, bp["1"], ln1, pos + jnp.arange(1))
+            page = pt[pos // page_size]
+            slot = pos % page_size
+            arena_k = arena_k.at[page, bi, :, slot, :].set(
+                k[0, :, 0, :].astype(arena_k.dtype))
+            arena_v = arena_v.at[page, bi, :, slot, :].set(
+                v[0, :, 0, :].astype(arena_v.dtype))
+            # gather THIS request's pages into a dense [1, Hkv, T, Dh]
+            # view (T = bucket * page_size); positions past ``pos``
+            # (padding pages, other requests' bytes) are causally
+            # masked to exactly zero weight inside _gqa_attend
+            kc = arena_k[pt, bi].transpose(1, 0, 2, 3).reshape(
+                Hkv, P * page_size, Dh)[None].astype(q.dtype)
+            vc = arena_v[pt, bi].transpose(1, 0, 2, 3).reshape(
+                Hkv, P * page_size, Dh)[None].astype(q.dtype)
+            o = _gqa_attend(q, kc, vc, pos, H, Hkv, Dh)
+            o = o.transpose(0, 2, 1, 3).reshape(1, 1, H * Dh)
+            h = h + _proj(o, bp["1"], "wo", "bo",
+                          block.modules[1].with_bias)
+            h = _ffn_sublayer(block, bp, h)
+        return logits_last(pc, h), arena_k, arena_v
+
+    return prefill, decode
+
+
+# jitted paged programs per model instance, keyed by (page_size,
+# compute_dtype): shared across every pool with that geometry so a
+# second pool (a scaled-up replica) never recompiles
+_PAGED_FN_CACHE = weakref.WeakKeyDictionary()
+
+
+def _paged_fns(model, first, count, page_size, compute_dtype):
+    from ..optim.optimizer import _cast_floats
+
+    slot = _PAGED_FN_CACHE.setdefault(model, {})
+    key = (int(page_size), compute_dtype)
+    if key not in slot:
+        prefill, decode = _paged_machinery(model, first, count,
+                                           page_size)
+        cast = (lambda p: _cast_floats(p, compute_dtype)) \
+            if compute_dtype else (lambda p: p)
+
+        @jax.jit
+        def _prefill(p, prompt, pt, ak, av):
+            logits, ak, av = prefill(cast(p), prompt, pt, ak, av)
+            return jnp.argmax(logits, axis=-1)[0] + 1, ak, av
+
+        @jax.jit
+        def _decode(p, tok, pos, pt, ak, av):
+            logits, ak, av = decode(cast(p), tok, pos, pt, ak, av)
+            return jnp.argmax(logits, axis=-1)[0] + 1, ak, av
+
+        slot[key] = (_prefill, _decode)
+    return slot[key]
+
+
+class PagedSequence:
+    """Host-side state of one in-flight paged decode: the page lease,
+    the next write position, and the last emitted (1-based) token."""
+
+    __slots__ = ("lease", "pos", "last", "prompt_len")
+
+    def __init__(self, lease, pos: int, last: int, prompt_len: int):
+        self.lease = lease
+        self.pos = int(pos)
+        self.last = int(last)
+        self.prompt_len = int(prompt_len)
+
+    def release(self):
+        self.lease.release()
+
+
+class PagedDecoder:
+    """Per-request paged greedy decode against a shared
+    :class:`~bigdl_tpu.serving.kvpool.KVPagePool`.
+
+    ``start`` leases pages for the prompt, prefills them, and returns
+    the first generated token inside a :class:`PagedSequence`;
+    ``step`` advances one token, extending the lease (one page at a
+    time) as the decode crosses page boundaries — a failed extension
+    raises :class:`~bigdl_tpu.serving.kvpool.PoolExhausted` and the
+    caller sheds typed.  Greedy only (the serving path's contract; a
+    per-request sampling RNG would defeat page-table compile reuse).
+
+    Compile accounting: ONE jitted prefill per (prompt_len,
+    page_bucket) and ONE jitted decode per page bucket — ``pos`` and
+    the page table are traced, so steps and page-table reuse never
+    recompile.  ``compile_stats()`` exposes both jit cache sizes for
+    the tests that pin this.
+    """
+
+    def __init__(self, model, pool, compute_dtype=None,
+                 max_len: Optional[int] = None):
+        from ..optim.optimizer import _cast_floats
+
+        first, count = _check_model(model)
+        mha0 = model.modules[first].modules[1]
+        Hkv = getattr(mha0, "num_kv_heads", mha0.num_heads)
+        if (pool.layers, pool.num_kv_heads, pool.head_dim) != \
+                (count, Hkv, mha0.head_dim):
+            raise ValueError(
+                f"pool geometry (layers={pool.layers}, "
+                f"Hkv={pool.num_kv_heads}, Dh={pool.head_dim}) does "
+                f"not match the model (layers={count}, Hkv={Hkv}, "
+                f"Dh={mha0.head_dim})")
+        self.model = model
+        self.pool = pool
+        #: decode window cap: the positional table AND the arena both
+        #: bound how long any one request may grow
+        self.T_max = min(_check_len(model, max_len),
+                         pool.max_positions)
+        self.max_pages = pool.pages_for_tokens(self.T_max)
+        # the jitted programs depend only on (model, page_size,
+        # compute_dtype) — NOT on which pool's arena they run against
+        # — so every same-geometry pool (each autoscaled replica gets
+        # its own) shares one compile, and a cold scale-up pays zero
+        # paged compiles on an already-warm host
+        self._prefill_fn, self._decode_fn = _paged_fns(
+            model, first, count, pool.page_size, compute_dtype)
+
+    # ------------------------------------------------------------------
+    def _padded_table(self, lease):
+        from ..serving.kvpool import page_bucket_for
+
+        bucket = page_bucket_for(len(lease.pages), self.max_pages)
+        pt = lease.pages + [0] * (bucket - len(lease.pages))
+        return jnp.asarray(pt, jnp.int32)
+
+    def start(self, params, prompt_ids) -> PagedSequence:
+        """Prefill one 1-D prompt into freshly leased pages; the
+        returned sequence's ``last`` is the first generated token.
+        Raises ``PoolExhausted`` (shed typed upstream) when the pool
+        cannot back the prompt."""
+        prompt = jnp.asarray(prompt_ids, jnp.int32)
+        if prompt.ndim != 1:
+            raise ValueError(f"prompt_ids must be 1-D, got shape "
+                             f"{prompt.shape}")
+        T0 = int(prompt.shape[0])
+        if T0 + 1 > self.T_max:
+            raise ValueError(
+                f"prompt {T0} leaves no decode room in max_len "
+                f"{self.T_max}")
+        lease = self.pool.alloc(self.pool.pages_for_tokens(T0))
+        try:
+            pt = self._padded_table(lease)
+            with self.pool.arena_lock:
+                ak, av = self.pool.arena
+                tok, ak, av = self._prefill_fn(params, prompt[None],
+                                               pt, ak, av)
+                self.pool.set_arena(ak, av)
+            return PagedSequence(lease, pos=T0, last=int(tok),
+                                 prompt_len=T0)
+        except BaseException:
+            lease.release()
+            raise
+
+    def step(self, params, seq: PagedSequence) -> int:
+        """Advance one greedy token (writes the previous token's K/V
+        at ``seq.pos``).  May raise ``PoolExhausted`` on a failed page
+        extension — the sequence's pages stay held so the caller can
+        resolve it typed before releasing."""
+        if seq.lease.released:
+            raise RuntimeError("sequence already released")
+        if seq.pos + 1 > self.T_max:
+            raise ValueError(f"decode window exhausted at pos "
+                             f"{seq.pos} (max_len {self.T_max})")
+        need = seq.pos // self.pool.page_size + 1
+        if need > len(seq.lease.pages):
+            seq.lease.extend(need - len(seq.lease.pages))
+        pt = self._padded_table(seq.lease)
+        tok = jnp.asarray([[seq.last]], jnp.int32)
+        with self.pool.arena_lock:
+            ak, av = self.pool.arena
+            nxt, ak, av = self._decode_fn(params, tok,
+                                          jnp.int32(seq.pos), pt, ak,
+                                          av)
+            self.pool.set_arena(ak, av)
+        seq.pos += 1
+        seq.last = int(nxt)
+        return seq.last
+
+    def compile_stats(self) -> dict:
+        """Jit cache sizes — the static-shape contract: decode entries
+        ≤ page buckets used, prefill entries ≤ distinct (prompt_len,
+        bucket) pairs."""
+        return {
+            "prefill_cache_size": int(self._prefill_fn._cache_size()),
+            "decode_cache_size": int(self._decode_fn._cache_size()),
+        }
+
+
+# compiled paged decoders per model instance (the _GEN_CACHE pattern);
+# the inner key carries the pool's identity — a pool swap (new arena
+# geometry) must rebuild the decoder
+_PAGED_CACHE = weakref.WeakKeyDictionary()
+
+
+def cached_paged_decoder(model, pool, compute_dtype=None,
+                         max_len: Optional[int] = None) -> PagedDecoder:
+    cfg = (id(pool), compute_dtype, max_len or model.max_len)
+    slot = _PAGED_CACHE.setdefault(model, {})
+    if cfg not in slot:
+        slot[cfg] = PagedDecoder(model, pool,
+                                 compute_dtype=compute_dtype,
+                                 max_len=max_len)
+    return slot[cfg]
 
 
 # compiled capacity replays per model instance (the _GEN_CACHE

@@ -43,22 +43,41 @@ verified checkpoints) applied to an in-process request path:
   failover retries, optional p99-derived hedging, and per-replica
   circuit breakers.
 
+* :mod:`.kvpool` / :mod:`.pools` / :mod:`.autoscale` — the serving
+  scale-out control plane: :class:`KVPagePool` pages the decode
+  KV-cache (requests hold pages for the positions they actually fill,
+  pool exhaustion sheds typed OVERLOADED), replicas advertise a
+  prefill/decode/both **role** so the router can disaggregate the two
+  phases into separately-sized pools (KV pages travel between them as
+  crc-verified handoff blobs), and :class:`Autoscaler` scales each
+  pool independently on the router's aggregated telemetry (p99, shed
+  rate, queue depth, KV occupancy) with hysteresis, cooldowns, and
+  drain-before-retire.  :mod:`.compile_cache` persists XLA
+  executables (``bigdl.serving.compileCache``) so cold autoscaled
+  replicas skip per-bucket compiles.
+
 Deterministic serving fault injectors (fail-next-N steps, injected
 step latency, poisoned params, replica kill/partition) live with the
 training injectors in :mod:`bigdl_tpu.resilience.faults`.
 """
+from .autoscale import AutoscalePolicy, Autoscaler
 from .batcher import MicroBatcher
 from .breaker import CircuitBreaker
+from .compile_cache import set_compile_cache_dir
 from .fleet import FleetQuorumError, ReplicaAgent, ServingFleet
+from .kvpool import KVPagePool, PageLease, PoolExhausted
 from .metrics import ServingMetrics
+from .pools import HandoffCorrupt
 from .router import FleetRouter
 from .server import InferenceServer
 from .status import ServeFuture, ServeResult, Status
 from .swap import load_verified_params
 
 __all__ = [
-    "CircuitBreaker", "FleetQuorumError", "FleetRouter",
-    "InferenceServer", "MicroBatcher", "ReplicaAgent", "ServeFuture",
-    "ServeResult", "ServingFleet", "ServingMetrics", "Status",
-    "load_verified_params",
+    "AutoscalePolicy", "Autoscaler", "CircuitBreaker",
+    "FleetQuorumError", "FleetRouter", "HandoffCorrupt",
+    "InferenceServer", "KVPagePool", "MicroBatcher", "PageLease",
+    "PoolExhausted", "ReplicaAgent", "ServeFuture", "ServeResult",
+    "ServingFleet", "ServingMetrics", "Status",
+    "load_verified_params", "set_compile_cache_dir",
 ]

@@ -1,0 +1,283 @@
+"""Telemetry-driven autoscaling: replica counts that track load.
+
+A fixed fleet sized for the peak wastes chips off-peak and sheds at
+the peak it was mis-sized for.  The :class:`Autoscaler` is a control
+loop over the signals the router already aggregates — per-pool p99,
+shed rate, published queue depth, and KV-pool occupancy, all read from
+the health snapshots replicas publish every heartbeat — scaling each
+role pool (``prefill`` / ``decode`` / ``both``) **independently**:
+prefill is compute-bound and decode HBM-bound (the PR 6 roofline
+split), so their load signals, and therefore their replica counts,
+move separately.
+
+Control discipline (what keeps it from flapping):
+
+* **Hysteresis** — a breach (or idle) signal must sustain for
+  ``sustain`` (``idle_sustain``) consecutive evaluations before any
+  action; one noisy sample scales nothing.
+* **Cooldown** — after any action the pool holds for ``cooldown_s``;
+  a new replica needs time to warm (the persisted compile cache —
+  ``bigdl.serving.compileCache`` — shrinks exactly this window) before
+  its effect is measurable.
+* **Bounds** — ``min_replicas``/``max_replicas`` clamp every pool.
+* **Drain-before-retire** — scale-down rides the graceful-preemption
+  path (:meth:`~.fleet.ServingFleet.remove_replica` with
+  ``drain=True``): admission stops, everything admitted finishes
+  (paged decodes resolve and release their pages), then the replica
+  leaves membership.
+
+Every decision is a structured event (kept in ``decisions``, logged)
+plus a ``bigdl_autoscale_decisions_total{pool,direction}`` counter in
+the router registry, so the scaling history is scrape-visible next to
+the request metrics it acted on.
+"""
+from __future__ import annotations
+
+import logging
+import math
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Sequence
+
+from .pools import serves_phase
+
+log = logging.getLogger("bigdl_tpu")
+
+__all__ = ["AutoscalePolicy", "Autoscaler"]
+
+
+@dataclass
+class AutoscalePolicy:
+    """Per-pool scaling policy — thresholds, hysteresis, bounds."""
+    min_replicas: int = 1
+    max_replicas: int = 8
+    #: scale-up watermarks: breach ANY of these...
+    p99_high_s: float = 0.5
+    shed_high: float = 0.02        # shed fraction of the eval window
+    queue_high: int = 32           # summed published queue depth
+    kv_occupancy_high: float = 0.90
+    #: ...for this many consecutive evaluations
+    sustain: int = 2
+    #: scale-down watermarks: ALL of these, sustained idle_sustain
+    p99_idle_s: float = 0.050
+    queue_idle: int = 1
+    kv_occupancy_idle: float = 0.50
+    idle_sustain: int = 3
+    #: traffic-activity gate: when set, p99/queue breaches only count
+    #: while the pool saw MORE than this many requests since the last
+    #: evaluation (the published p99 is a windowed quantile — over no
+    #: fresh traffic it is stale history, not an actionable signal),
+    #: and a quiet pool (≤ this delta) reads as idle regardless of
+    #: that stale p99.  None disables the gate (breaches always
+    #: actionable; idleness judged by p99_idle_s alone).
+    idle_requests_delta: Optional[int] = None
+    #: no second action within the cooldown
+    cooldown_s: float = 10.0
+    #: drain budget for scale-down
+    drain_timeout_s: float = 10.0
+
+
+@dataclass
+class _PoolState:
+    breach_streak: int = 0
+    idle_streak: int = 0
+    last_action_t: float = -math.inf
+    last_direction: Optional[str] = None
+    spawned: int = 0
+    last_shed: Dict[str, int] = field(default_factory=dict)
+    last_total: Dict[str, int] = field(default_factory=dict)
+
+
+class Autoscaler:
+    """Scales a :class:`~.fleet.ServingFleet`'s role pools from the
+    registry signals the router aggregates.
+
+    Parameters
+    ----------
+    fleet : the running ServingFleet (its pump loop keeps the health
+        snapshots the signals are read from fresh).
+    replica_factory : ``(replica_id, role) -> InferenceServer`` —
+        builds an UNSTARTED server for a scale-up;
+        :meth:`~.fleet.ServingFleet.add_replica` starts it.
+    pools : role pools to manage; defaults to the distinct roles the
+        fleet's replicas advertise (a homogeneous fleet scales its one
+        ``both`` pool).
+    policy / policies : one shared :class:`AutoscalePolicy` or a
+        per-pool dict.
+    """
+
+    def __init__(self, fleet, replica_factory: Callable[[str, str],
+                                                        object],
+                 policy: Optional[AutoscalePolicy] = None,
+                 policies: Optional[Dict[str, AutoscalePolicy]] = None,
+                 pools: Optional[Sequence[str]] = None,
+                 clock: Callable[[], float] = time.monotonic):
+        self.fleet = fleet
+        self.replica_factory = replica_factory
+        if pools is None:
+            pools = tuple(sorted({getattr(s, "role", "both")
+                                  for s in fleet.servers.values()}))
+        self.pools = tuple(pools)
+        base = policy or AutoscalePolicy()
+        self.policies = {p: (policies or {}).get(p, base)
+                         for p in self.pools}
+        self._clock = clock
+        self._state = {p: _PoolState() for p in self.pools}
+        #: structured decision log (every entry also hits the counter
+        #: + the process log)
+        self.decisions: List[dict] = []
+        self._decisions_total = \
+            fleet.router.metrics.registry.counter(
+                "bigdl_autoscale_decisions_total",
+                "autoscaler actions per pool and direction",
+                labels=("pool", "direction"))
+
+    # ------------------------------------------------------------ signals
+    def _pool_health(self, pool: str) -> Dict[str, dict]:
+        """Health snapshots of the replicas serving ``pool`` — the
+        SAME view the router routes on.  A replica with no snapshot
+        yet contributes nothing (it is not routable either)."""
+        out = {}
+        for rid in self.fleet.servers:
+            h = self.fleet.router.health_of(rid)
+            if h is not None and serves_phase(h.get("role"), pool):
+                out[rid] = h
+        return out
+
+    def pool_signals(self, pool: str) -> dict:
+        """Aggregate one pool's control signals from published health:
+        worst p99, shed count/rate over the window since the last
+        evaluation, summed queue depth, worst KV occupancy."""
+        st = self._state[pool]
+        health = self._pool_health(pool)
+        p99 = max((h.get("p99_s") or 0.0 for h in health.values()),
+                  default=0.0)
+        queue = sum(int(h.get("queue_depth", 0))
+                    for h in health.values())
+        kv_occ = max((h.get("kv_occupancy") or 0.0
+                      for h in health.values()), default=0.0)
+        shed_d = total_d = 0
+        for rid, h in health.items():
+            shed_d += max(0, int(h.get("shed_total", 0))
+                          - st.last_shed.get(rid, 0))
+            total_d += max(0, int(h.get("requests_total", 0))
+                           - st.last_total.get(rid, 0))
+            st.last_shed[rid] = int(h.get("shed_total", 0))
+            st.last_total[rid] = int(h.get("requests_total", 0))
+        return {
+            "pool": pool,
+            "replicas": self.pool_size(pool),
+            "p99_s": p99,
+            "queue_depth": queue,
+            "kv_occupancy": kv_occ,
+            "shed_delta": shed_d,
+            "requests_delta": total_d,
+            "shed_rate": (shed_d / total_d) if total_d else 0.0,
+        }
+
+    def pool_size(self, pool: str) -> int:
+        """Replicas whose EXACT role is ``pool`` — what scaling
+        actuates (a ``both`` member is never retired by a phase
+        pool's scale-down)."""
+        return sum(1 for s in self.fleet.servers.values()
+                   if getattr(s, "role", "both") == pool)
+
+    def replica_counts(self) -> Dict[str, int]:
+        """{pool: replica count} — one timeline sample for the bench."""
+        return {p: self.pool_size(p) for p in self.pools}
+
+    # ------------------------------------------------------------ control
+    def _record(self, pool: str, direction: str, replica: str,
+                reason: str, signals: dict):
+        event = {"at": self._clock(), "pool": pool,
+                 "direction": direction, "replica": replica,
+                 "reason": reason, "signals": signals}
+        self.decisions.append(event)
+        self._decisions_total.labels(pool=pool,
+                                     direction=direction).inc()
+        log.info("autoscale: %s %s (%s) — %s", direction, replica,
+                 pool, reason)
+
+    def _scale_up(self, pool: str, reason: str, signals: dict):
+        st = self._state[pool]
+        st.spawned += 1
+        rid = f"{pool}-as{st.spawned}"
+        server = self.replica_factory(rid, pool)
+        self.fleet.add_replica(rid, server)
+        st.last_action_t = self._clock()
+        st.last_direction = "up"
+        st.breach_streak = st.idle_streak = 0
+        self._record(pool, "up", rid, reason, signals)
+
+    def _retire_candidate(self, pool: str) -> Optional[str]:
+        """Last-in-first-out: prefer autoscaler-spawned replicas (the
+        capacity this loop added), newest name first."""
+        exact = sorted(rid for rid, s in self.fleet.servers.items()
+                       if getattr(s, "role", "both") == pool)
+        if not exact:
+            return None
+        spawned = [r for r in exact if f"{pool}-as" in r]
+        return (spawned or exact)[-1]
+
+    def _scale_down(self, pool: str, reason: str, signals: dict):
+        rid = self._retire_candidate(pool)
+        if rid is None:
+            return
+        st = self._state[pool]
+        policy = self.policies[pool]
+        self.fleet.remove_replica(
+            rid, timeout=policy.drain_timeout_s, drain=True)
+        st.last_action_t = self._clock()
+        st.last_direction = "down"
+        st.breach_streak = st.idle_streak = 0
+        self._record(pool, "down", rid, reason, signals)
+
+    def evaluate_once(self) -> List[dict]:
+        """One control round over every managed pool.  Returns the
+        decisions taken this round (possibly empty — sustained-breach
+        hysteresis and cooldowns mean MOST rounds act on nothing)."""
+        taken = []
+        for pool in self.pools:
+            policy = self.policies[pool]
+            st = self._state[pool]
+            sig = self.pool_signals(pool)
+            gate = policy.idle_requests_delta
+            active = gate is None or sig["requests_delta"] > gate
+            breaches = []
+            if active and sig["p99_s"] >= policy.p99_high_s:
+                breaches.append(f"p99 {sig['p99_s']:.3f}s >= "
+                                f"{policy.p99_high_s}s")
+            if sig["shed_rate"] >= policy.shed_high \
+                    and sig["shed_delta"] > 0:
+                breaches.append(f"shed rate {sig['shed_rate']:.3f} >= "
+                                f"{policy.shed_high}")
+            if active and sig["queue_depth"] >= policy.queue_high:
+                breaches.append(f"queue {sig['queue_depth']} >= "
+                                f"{policy.queue_high}")
+            if sig["kv_occupancy"] >= policy.kv_occupancy_high:
+                breaches.append(
+                    f"kv occupancy {sig['kv_occupancy']:.2f} >= "
+                    f"{policy.kv_occupancy_high}")
+            idle = (sig["shed_delta"] == 0
+                    and sig["queue_depth"] <= policy.queue_idle
+                    and sig["kv_occupancy"]
+                    <= policy.kv_occupancy_idle
+                    and (not active
+                         or sig["p99_s"] <= policy.p99_idle_s))
+            st.breach_streak = st.breach_streak + 1 if breaches else 0
+            st.idle_streak = st.idle_streak + 1 if idle else 0
+            now = self._clock()
+            if now - st.last_action_t < policy.cooldown_s:
+                continue  # hold: the last action is still settling
+            before = len(self.decisions)
+            if breaches and st.breach_streak >= policy.sustain \
+                    and sig["replicas"] < policy.max_replicas:
+                self._scale_up(pool, "; ".join(breaches), sig)
+            elif idle and st.idle_streak >= policy.idle_sustain \
+                    and sig["replicas"] > policy.min_replicas:
+                self._scale_down(
+                    pool,
+                    f"idle: p99 {sig['p99_s']:.3f}s, no shed, "
+                    f"queue {sig['queue_depth']}", sig)
+            taken.extend(self.decisions[before:])
+        return taken

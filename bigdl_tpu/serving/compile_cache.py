@@ -1,0 +1,82 @@
+"""Persisted compile cache for fast replica spin-up.
+
+A cold autoscaled replica pays one XLA compile per (bucket,
+feature-shape) — worst-case the whole bucket ladder — before it can
+take traffic at full readiness.  jax's persistent compilation cache
+(``jax.config.jax_compilation_cache_dir``) amortizes that across
+process lifetimes: the first replica ever to compile a bucket writes
+the executable to disk, and every later spin-up (autoscale scale-up,
+crash replacement, rolling restart) loads it instead of recompiling.
+
+``bigdl.serving.compileCache`` (env ``BIGDL_SERVING_COMPILECACHE``)
+names the directory; :meth:`~.server.InferenceServer.start` calls
+:func:`maybe_set_compile_cache_dir` so every replica start wires it in
+without the caller doing anything.  Explicit
+:func:`set_compile_cache_dir` wins over the property.  Best-effort by
+design: a backend without persistent-cache support (CPU jax versions
+vary) must never fail a replica start — the worst case is the old
+behavior, a cold compile.
+"""
+from __future__ import annotations
+
+import logging
+import os
+import threading
+from typing import Optional
+
+log = logging.getLogger("bigdl_tpu")
+
+__all__ = ["set_compile_cache_dir", "maybe_set_compile_cache_dir",
+           "compile_cache_dir"]
+
+_LOCK = threading.Lock()
+_STATE = {"dir": None}
+
+
+def compile_cache_dir() -> Optional[str]:
+    """The directory currently wired into jax, or None."""
+    with _LOCK:
+        return _STATE["dir"]
+
+
+def set_compile_cache_dir(path: str) -> str:
+    """Point jax's persistent compilation cache at ``path`` (created
+    if missing) and drop the min-compile-time/min-entry-size floors so
+    serving-scale programs (small, many) are cached too.  Idempotent;
+    returns the installed path."""
+    import jax
+
+    path = os.path.abspath(str(path))
+    with _LOCK:
+        if _STATE["dir"] == path:
+            return path
+        os.makedirs(path, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", path)
+        for knob, val in (
+                ("jax_persistent_cache_min_compile_time_secs", 0.0),
+                ("jax_persistent_cache_min_entry_size_bytes", -1)):
+            try:
+                jax.config.update(knob, val)
+            except (AttributeError, ValueError):  # older jax: keep
+                pass                              # that knob's default
+        _STATE["dir"] = path
+        log.info("serving: persistent compile cache at %s", path)
+        return path
+
+
+def maybe_set_compile_cache_dir() -> Optional[str]:
+    """Wire the ``bigdl.serving.compileCache`` property in when set;
+    best-effort (a replica start must never fail on cache plumbing)."""
+    from ..utils.engine import get_property
+
+    path = get_property("bigdl.serving.compileCache")
+    if not path:
+        return compile_cache_dir()
+    try:
+        return set_compile_cache_dir(path)
+    except (KeyboardInterrupt, SystemExit):
+        raise
+    except Exception as e:
+        log.warning("serving: compile cache at %r not enabled: %s",
+                    path, e)
+        return None

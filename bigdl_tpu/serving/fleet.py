@@ -84,17 +84,30 @@ class ReplicaAgent:
     def health_snapshot(self) -> dict:
         h = self.server.health()
         m = self.server.metrics
-        return {
+        snap = {
             "replica": self.replica_id,
             "ready": h["ready"],
             "healthy": h["healthy"],
             "draining": h["draining"],
             "queue_depth": h["queue_depth"],
             "breaker_state": h["breaker"]["state"],
+            "role": h.get("role", "both"),
             "p99_s": m._lat.quantile(0.99),
             "served_ok": int(m.counts["ok"]),
+            # shed/total ride along so the autoscaler can derive a
+            # per-pool shed RATE from published signals alone
+            "shed_total": int(m.counts["overloaded"]),
+            "requests_total": int(sum(m.counts.values())),
             "ts": self._clock(),
         }
+        kv = h.get("kv")
+        if kv:
+            snap["kv_occupancy"] = kv["occupancy"]
+            snap["kv_free_pages"] = kv["free_pages"]
+            snap["kv_pages"] = kv["num_pages"]
+            # keep the replica's pool gauges fresh at heartbeat cadence
+            m.set_kv_pool(kv)
+        return snap
 
     def pump(self):
         """One heartbeat round.  No-op once killed; silent while
@@ -183,15 +196,32 @@ class ServingFleet:
 
     @classmethod
     def build(cls, model, n_replicas: int = 4, transport=None,
-              server_kw: Optional[dict] = None, **fleet_kw
-              ) -> "ServingFleet":
+              server_kw: Optional[dict] = None, roles=None,
+              kv_pages: Optional[int] = None, kv_page_size: int = 16,
+              **fleet_kw) -> "ServingFleet":
         """Stamp out ``n_replicas`` named servers (``r0``…) over one
         model.  Each replica pins its own param copy at start, so a
-        per-replica swap/rollback never bleeds across replicas."""
-        servers = {
-            f"r{i}": InferenceServer(model, name=f"r{i}",
-                                     **(server_kw or {}))
-            for i in range(int(n_replicas))}
+        per-replica swap/rollback never bleeds across replicas.
+
+        ``roles`` (a sequence per index or dict per replica id) builds
+        a disaggregated fleet — e.g. ``roles=("prefill", "decode",
+        "decode")``; ``kv_pages`` gives every replica its OWN
+        ``kv_page_size``-paged KV pool (required for non-``both``
+        roles; with role ``both`` it switches generation to the paged
+        path)."""
+        servers = {}
+        for i in range(int(n_replicas)):
+            rid = f"r{i}"
+            kw = dict(server_kw or {})
+            if roles is not None:
+                kw["role"] = roles[rid] if isinstance(roles, dict) \
+                    else roles[i]
+            if kv_pages:
+                from .kvpool import KVPagePool
+
+                kw["kv_pool"] = KVPagePool.for_model(
+                    model, kv_pages, page_size=kv_page_size)
+            servers[rid] = InferenceServer(model, name=rid, **kw)
         return cls(servers, transport, **fleet_kw)
 
     # ------------------------------------------------------------ lifecycle
@@ -212,7 +242,7 @@ class ServingFleet:
         """One synchronous membership round: every agent beats, then
         the router refreshes its view.  Tests drive this directly for
         deterministic membership transitions."""
-        for agent in self.agents.values():
+        for agent in list(self.agents.values()):
             agent.pump()
         self.router.refresh()
 
@@ -232,7 +262,7 @@ class ServingFleet:
             self._pump_thread = None
         self.router.close()
         ok = True
-        for srv in self.servers.values():
+        for srv in list(self.servers.values()):
             ok = srv.stop(timeout=timeout) and ok
         return ok
 
@@ -246,6 +276,58 @@ class ServingFleet:
     def ready_count(self, exclude=()) -> int:
         return sum(1 for rid, srv in self.servers.items()
                    if rid not in exclude and srv.ready())
+
+    def pool_replicas(self, role: str) -> Dict[str, InferenceServer]:
+        """Servers whose advertised role serves ``role`` (``both``
+        members serve every pool)."""
+        from .pools import serves_phase
+
+        return {rid: srv for rid, srv in self.servers.items()
+                if serves_phase(getattr(srv, "role", "both"), role)}
+
+    # ------------------------------------------------------- elasticity
+    def add_replica(self, rid: str,
+                    server: InferenceServer) -> InferenceServer:
+        """Join one new replica to the running fleet (the autoscaler's
+        scale-up actuator): start it, give it an agent, register it
+        with the router, and run one pump round so it is routable
+        before this returns."""
+        if rid in self.servers:
+            raise ValueError(f"replica {rid!r} already in the fleet")
+        self.servers[rid] = server
+        if not server.healthy():
+            server.start()
+        agent = ReplicaAgent(rid, server, self.transport,
+                             heartbeat_timeout=self.heartbeat_timeout,
+                             clock=self._clock)
+        self.agents[rid] = agent
+        self.router.add_replica(rid, server)
+        agent.pump()            # beats with rejoin=True
+        self.router.refresh()   # ... and is re-admitted here
+        log.info("fleet: added replica %s (role=%s)", rid,
+                 getattr(server, "role", "both"))
+        return server
+
+    def remove_replica(self, rid: str, timeout: float = 10.0,
+                       drain: bool = True) -> bool:
+        """Retire one replica (the autoscaler's scale-down actuator):
+        **drain before retire** — admission stops via the graceful-
+        preemption path and everything already admitted finishes
+        (in-flight paged decodes resolve and release their pages) —
+        then hard-stop, deregister from the router, and retire from
+        membership immediately.  Returns True when the worker exited
+        within ``timeout``."""
+        srv = self.servers.pop(rid, None)
+        if srv is None:
+            return False
+        self.agents.pop(rid, None)     # stops heartbeating this rid
+        ok = True
+        if drain and srv.healthy():
+            ok = srv.drain(timeout)
+        ok = srv.stop(timeout) and ok
+        self.router.remove_replica(rid)
+        log.info("fleet: removed replica %s (drained=%s)", rid, drain)
+        return ok
 
     # ------------------------------------------------------------ deploys
     def rolling_swap(self, params=None, path: Optional[str] = None,
@@ -360,6 +442,7 @@ class ServingFleet:
     _ROUTER_FOLD_FAMILIES = (
         "bigdl_serving_hedges_total", "bigdl_serving_retries_total",
         "bigdl_fleet_dispatch_total",
+        "bigdl_autoscale_decisions_total",
     )
 
     def _router_fold_metrics(self) -> dict:

@@ -76,10 +76,38 @@ class ServingMetrics:
         self._hedges = self.registry.counter(
             "bigdl_serving_hedges_total",
             "tail-latency hedges (fired = duplicate sent, won = the "
-            "hedge's response was used)", labels=("event",))
+            "hedge's response was used, suppressed = a decode-phase "
+            "hedge the router refused — duplicating a long decode "
+            "doubles HBM + KV-pool pressure)", labels=("event",))
         self._retries = self.registry.counter(
             "bigdl_serving_retries_total",
             "failover retries dispatched to another replica")
+        # the generation-phase family (paged/disaggregated serving):
+        # prefill = prompt pass + first token, decode = the rest.
+        # TTFT/TPOT are the two numbers a serving SLO is written in —
+        # p50/p99 land in snapshot() next to the request latencies
+        self._phase = self.registry.histogram(
+            "bigdl_serving_phase_seconds",
+            "wall seconds per generation phase",
+            labels=("phase",), bounds=_LATENCY_BUCKETS, window=window)
+        self._ttft = self.registry.histogram(
+            "bigdl_serving_ttft_seconds",
+            "submit -> first generated token (time-to-first-token)",
+            bounds=_LATENCY_BUCKETS, window=window)
+        self._tpot = self.registry.histogram(
+            "bigdl_serving_tpot_seconds",
+            "decode seconds per generated token "
+            "(time-per-output-token)",
+            bounds=_LATENCY_BUCKETS, window=window)
+        # KV page-pool occupancy gauges (zero-valued when the server
+        # has no pool — the fleet fold may sum them safely)
+        self._kv_total = self.registry.gauge(
+            "bigdl_serving_kv_pages_total", "KV page-pool capacity")
+        self._kv_free = self.registry.gauge(
+            "bigdl_serving_kv_pages_free", "KV page-pool free pages")
+        self._kv_occupancy = self.registry.gauge(
+            "bigdl_serving_kv_occupancy",
+            "KV page-pool occupancy fraction (in-use / capacity)")
         # per-bucket static cost (XLA cost model) + the wall window the
         # flops were spent in — what goodput-per-chip divides by
         self._bucket_flops: Dict[int, float] = {}
@@ -112,8 +140,33 @@ class ServingMetrics:
         response beat the primary and was used."""
         self._hedges.labels(event="won" if won else "fired").inc()
 
+    def record_hedge_suppressed(self):
+        """A decode-phase hedge the router refused to fire (the
+        ``hedge_decode`` knob) — counted so hedge duty stays auditable
+        even when the answer is 'no'."""
+        self._hedges.labels(event="suppressed").inc()
+
     def record_retry(self):
         self._retries.inc()
+
+    def record_phase(self, phase: str, seconds: float):
+        """One generation phase's wall time (``prefill`` | ``decode``)."""
+        self._phase.labels(phase=phase).observe(seconds)
+
+    def record_ttft(self, seconds: float):
+        self._ttft.observe(seconds)
+
+    def record_tpot(self, seconds: float):
+        self._tpot.observe(seconds)
+
+    def set_kv_pool(self, stats: Optional[dict]):
+        """Refresh the KV page-pool gauges from
+        ``KVPagePool.stats()`` (no-op on None)."""
+        if not stats:
+            return
+        self._kv_total.set(float(stats.get("num_pages", 0)))
+        self._kv_free.set(float(stats.get("free_pages", 0)))
+        self._kv_occupancy.set(float(stats.get("occupancy", 0.0)))
 
     def _counter_value(self, name: str, **labels) -> int:
         fam = self.registry.get(name)
@@ -143,6 +196,11 @@ class ServingMetrics:
     def hedges_won(self) -> int:
         return self._counter_value("bigdl_serving_hedges_total",
                                    event="won")
+
+    @property
+    def hedges_suppressed(self) -> int:
+        return self._counter_value("bigdl_serving_hedges_total",
+                                   event="suppressed")
 
     @property
     def retries(self) -> int:
@@ -243,7 +301,21 @@ class ServingMetrics:
             "swap_rollbacks": self.swap_rollbacks,
             "hedges_fired": self.hedges_fired,
             "hedges_won": self.hedges_won,
+            "hedges_suppressed": self.hedges_suppressed,
             "retries": self.retries,
+            # per-phase generation view (None until the paged /
+            # disaggregated path has served a request)
+            "ttft_p50_s": self._ttft.quantile(0.50),
+            "ttft_p99_s": self._ttft.quantile(0.99),
+            "tpot_p50_s": self._tpot.quantile(0.50),
+            "tpot_p99_s": self._tpot.quantile(0.99),
+            "prefill_p99_s":
+                self._phase.labels(phase="prefill").quantile(0.99),
+            "decode_p99_s":
+                self._phase.labels(phase="decode").quantile(0.99),
+            "kv_pages_total": int(self._kv_total.value),
+            "kv_pages_free": int(self._kv_free.value),
+            "kv_occupancy": float(self._kv_occupancy.value),
             "flops_total": gpc["flops_total"],
             "model_flops_per_sec": gpc["model_flops_per_sec"],
             "serving_mfu": gpc["mfu"],

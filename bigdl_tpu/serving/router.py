@@ -104,6 +104,8 @@ class FleetRouter:
                  hedge_delay_s: Optional[float] = None,
                  hedge_min_delay_s: float = 0.005,
                  hedge_default_delay_s: float = 0.050,
+                 hedge_decode: bool = False,
+                 disaggregate: bool = False,
                  breaker_factory: Optional[Callable[[], CircuitBreaker]]
                  = None,
                  max_workers: int = 16,
@@ -117,6 +119,17 @@ class FleetRouter:
         self.hedge_delay_s = hedge_delay_s
         self.hedge_min_delay_s = float(hedge_min_delay_s)
         self.hedge_default_delay_s = float(hedge_default_delay_s)
+        #: hedging a DECODE-phase request duplicates a long
+        #: HBM-bandwidth-bound stream and doubles its KV-pool hold for
+        #: a tail win that belongs to prefill — suppressed by default;
+        #: suppressions are counted
+        #: (``bigdl_serving_hedges_total{event="suppressed"}``)
+        self.hedge_decode = bool(hedge_decode)
+        #: split ``submit_generate`` into a prefill dispatch (returns
+        #: the KV handoff + first token) and a decode dispatch
+        #: (streams the rest), each least-loaded within its own role
+        #: pool under the same deadline-budget/retry/breaker machinery
+        self.disaggregate = bool(disaggregate)
         self._breaker_factory = breaker_factory or CircuitBreaker
         self._clock = clock
         self._lock = threading.Lock()
@@ -217,6 +230,37 @@ class FleetRouter:
             self._members = tuple(sorted(members))
             self._health = health
 
+    def add_replica(self, replica: str, handle) -> None:
+        """Register a new dispatch target (autoscale scale-up): the
+        replica joins the routable set once its agent beats and its
+        health reports ready (the normal re-admission path)."""
+        with self._lock:
+            self.replicas[replica] = handle
+            self._inflight.setdefault(replica, 0)
+
+    def remove_replica(self, replica: str) -> None:
+        """Deregister a retired replica and retire it from membership
+        NOW (a planned retire must not wait out the heartbeat timeout
+        like a death would)."""
+        with self._lock:
+            self.replicas.pop(replica, None)
+            self._health.pop(replica, None)
+            self._breakers.pop(replica, None)
+        c = self.coordinator
+        n, members = c.membership()
+        if replica in members:
+            survivors = [m for m in members if m != replica]
+            if survivors:
+                n2 = c.propose(survivors, f"fleet retire: {replica}",
+                               expect=n)
+                if n2 is not None:
+                    c.evict(replica, "retired (scale-down)")
+                    log.info("fleet: retired %s, incarnation %d "
+                             "members=%s", replica, n2, survivors)
+        with self._lock:
+            self._members = tuple(m for m in self._members
+                                  if m != replica)
+
     # ------------------------------------------------------------ dispatch
     def _breaker(self, replica: str) -> CircuitBreaker:
         with self._lock:
@@ -225,11 +269,17 @@ class FleetRouter:
                 br = self._breakers[replica] = self._breaker_factory()
             return br
 
-    def _pick(self, exclude=()) -> Optional[str]:
+    def _pick(self, exclude=(), phase: Optional[str] = None
+              ) -> Optional[str]:
         """Least-loaded ready member outside ``exclude`` whose router-
-        side breaker admits traffic.  The breaker is only ``acquire``d
-        on the replica actually chosen, so a half-open probe slot is
-        never burned on a replica we don't dispatch to."""
+        side breaker admits traffic, optionally restricted to the
+        replicas serving ``phase`` (``prefill`` | ``decode`` — role
+        advertised in the health snapshot, unreported roles count as
+        ``both``).  The breaker is only ``acquire``d on the replica
+        actually chosen, so a half-open probe slot is never burned on
+        a replica we don't dispatch to."""
+        from .pools import serves_phase
+
         with self._lock:
             members = self._members
             health = dict(self._health)
@@ -241,6 +291,9 @@ class FleetRouter:
             h = health.get(r)
             if h is not None and not h.get("ready", True):
                 continue
+            if phase is not None and not serves_phase(
+                    (h or {}).get("role"), phase):
+                continue
             load = inflight.get(r, 0) + int(
                 (h or {}).get("queue_depth", 0))
             ranked.append((load, r))
@@ -248,6 +301,18 @@ class FleetRouter:
             if self._breaker(r).acquire() != REJECT:
                 return r
         return None
+
+    def pool_members(self, phase: str) -> Tuple[str, ...]:
+        """Current members of one role pool (from the health view) —
+        what the autoscaler sizes."""
+        from .pools import serves_phase
+
+        with self._lock:
+            members = self._members
+            health = dict(self._health)
+        return tuple(sorted(
+            r for r in members
+            if serves_phase((health.get(r) or {}).get("role"), phase)))
 
     def _resolve(self, fut: ServeFuture, result: ServeResult,
                  t0: float):
@@ -283,8 +348,11 @@ class FleetRouter:
             self._resolve(fut, ServeResult(
                 Status.UNAVAILABLE, error="router closed"), now)
             return fut
+        drive = self._drive
+        if kind == "generate" and self.disaggregate:
+            drive = self._drive_disagg
         try:
-            self._pool.submit(self._drive, kind, payload, opts,
+            self._pool.submit(drive, kind, payload, opts,
                               deadline, fut, now)
         except RuntimeError:  # closed between the check and the submit
             self._resolve(fut, ServeResult(
@@ -293,8 +361,17 @@ class FleetRouter:
 
     def _dispatch(self, replica: str, kind, payload, opts,
                   remaining: Optional[float]) -> ServeFuture:
-        client = self.replicas[replica]
         with self._lock:
+            client = self.replicas.get(replica)
+            if client is None:
+                # retired (autoscale scale-down) between _pick and
+                # here: resolve typed-retryable, never KeyError in the
+                # drive thread (which would leave the future hanging)
+                inner = ServeFuture()
+                inner._resolve(ServeResult(
+                    Status.UNAVAILABLE,
+                    error=f"replica {replica} retired"))
+                return inner
             self._inflight[replica] = self._inflight.get(replica, 0) + 1
 
         def on_done(f, _replica=replica):
@@ -316,6 +393,14 @@ class FleetRouter:
         try:
             if kind == "classify":
                 inner = client.submit(payload, deadline_s=remaining)
+            elif kind == "prefill":
+                inner = client.submit_prefill(payload,
+                                              deadline_s=remaining)
+            elif kind == "decode":
+                max_new, eos_id, pad_id = opts
+                inner = client.submit_decode(
+                    payload, max_new, eos_id=eos_id, pad_id=pad_id,
+                    deadline_s=remaining)
             else:
                 max_new, eos_id, pad_id = opts
                 inner = client.submit_generate(
@@ -379,33 +464,44 @@ class FleetRouter:
             event.clear()
         return last, last_replica
 
-    def _drive(self, kind, payload, opts, deadline: Optional[float],
-               fut: ServeFuture, t0: float):
+    #: which role pool each dispatch kind routes within (classify and
+    #: whole generates go anywhere)
+    _KIND_PHASE = {"prefill": "prefill", "decode": "decode"}
+
+    def _attempt_loop(self, kind, payload, opts,
+                      deadline: Optional[float]) -> ServeResult:
+        """The failover core: least-loaded dispatch within the kind's
+        role pool, retryable outcomes retried on a different replica
+        with the REMAINING deadline budget, optional hedging.  Always
+        returns a typed ServeResult — the disaggregated drive chains
+        two of these (prefill, then decode) under one budget."""
+        phase = self._KIND_PHASE.get(kind)
+        hedge_ok = self.hedge and (kind != "decode"
+                                   or self.hedge_decode)
         tried = set()
         attempts = 0
         last: Optional[ServeResult] = None
         while True:
             now = self._clock()
             if deadline is not None and now >= deadline:
-                self._resolve(fut, ServeResult(
+                return ServeResult(
                     Status.DEADLINE_EXCEEDED,
                     error=f"deadline budget exhausted after "
-                          f"{attempts} attempt(s)"), t0)
-                return
+                          f"{attempts} attempt(s)")
             if attempts >= self.max_attempts:
-                self._resolve(fut, last or ServeResult(
+                return last or ServeResult(
                     Status.UNAVAILABLE,
                     error=f"no attempt succeeded in "
-                          f"{self.max_attempts}"), t0)
-                return
-            primary = self._pick(exclude=tried)
+                          f"{self.max_attempts}")
+            primary = self._pick(exclude=tried, phase=phase)
             if primary is None:
                 # nothing routable outside the tried set: degrade
                 # typed (the single-server OVERLOADED/UNAVAILABLE
                 # discipline, fleet-wide)
-                self._resolve(fut, last or ServeResult(
-                    Status.UNAVAILABLE, error="no ready replica"), t0)
-                return
+                return last or ServeResult(
+                    Status.UNAVAILABLE,
+                    error="no ready replica"
+                          + (f" in the {phase} pool" if phase else ""))
             if attempts > 0:
                 self.metrics.record_retry()
             attempts += 1
@@ -420,40 +516,91 @@ class FleetRouter:
                     pending[primary].add_done_callback(
                         lambda _f: done_early.set())
                     if not done_early.wait(delay):
-                        rem2 = None if deadline is None \
-                            else deadline - self._clock()
-                        if rem2 is None or rem2 > 0:
-                            hedge_replica = self._pick(
-                                exclude=tried | {primary})
-                        if hedge_replica is not None:
-                            self.metrics.record_hedge(won=False)
-                            pending[hedge_replica] = self._dispatch(
-                                hedge_replica, kind, payload, opts,
-                                rem2)
+                        if not hedge_ok:
+                            # the hedge WOULD have fired — a decode
+                            # duplicate doubles HBM + KV-pool hold, so
+                            # count the suppression and carry on
+                            self.metrics.record_hedge_suppressed()
+                        else:
+                            rem2 = None if deadline is None \
+                                else deadline - self._clock()
+                            if rem2 is None or rem2 > 0:
+                                hedge_replica = self._pick(
+                                    exclude=tried | {primary},
+                                    phase=phase)
+                            if hedge_replica is not None:
+                                self.metrics.record_hedge(won=False)
+                                pending[hedge_replica] = \
+                                    self._dispatch(
+                                        hedge_replica, kind, payload,
+                                        opts, rem2)
             result, via = self._await_first_usable(
                 pending, deadline, hedge_replica)
             if result is None:
-                self._resolve(fut, ServeResult(
+                return ServeResult(
                     Status.DEADLINE_EXCEEDED,
                     error=f"deadline passed waiting on "
-                          f"{sorted(pending)}"), t0)
-                return
+                          f"{sorted(pending)}")
             if result.status is Status.OK:
-                self._resolve(fut, result, t0)
-                return
+                return result
             if result.status is Status.DEADLINE_EXCEEDED:
                 # the budget died at the replica — propagate, don't
                 # burn another attempt on a dead budget
-                self._resolve(fut, result, t0)
-                return
+                return result
             if result.status in RETRYABLE_STATUSES:
                 tried.add(via)
                 if hedge_replica is not None:
                     tried.add(hedge_replica)
                 last = result
                 continue
-            self._resolve(fut, result, t0)
+            return result
+
+    def _drive(self, kind, payload, opts, deadline: Optional[float],
+               fut: ServeFuture, t0: float):
+        self._resolve(fut, self._attempt_loop(kind, payload, opts,
+                                              deadline), t0)
+
+    def _drive_disagg(self, kind, payload, opts,
+                      deadline: Optional[float], fut: ServeFuture,
+                      t0: float):
+        """Disaggregated generate: a prefill dispatch (routed within
+        the prefill pool; returns the crc-sealed KV handoff + first
+        token) then a decode dispatch (routed within the decode pool)
+        under the SAME deadline budget.  The handoff blob is retained
+        router-side across decode retries, so a decode replica killed
+        mid-stream replays on a survivor within the remaining budget.
+        """
+        import numpy as np
+
+        from .pools import deserialize_handoff
+
+        pre = self._attempt_loop("prefill", payload, (), deadline)
+        if pre.status is not Status.OK:
+            self._resolve(fut, pre, t0)
             return
+        try:
+            first = int(deserialize_handoff(pre.output)["first_token"])
+        except Exception as e:
+            self._resolve(fut, ServeResult(
+                Status.INTERNAL_ERROR,
+                error=f"prefill handoff unusable: "
+                      f"{type(e).__name__}: {e}"), t0)
+            return
+        self.metrics.record_ttft(self._clock() - t0)
+        max_new = opts[0]
+        if max_new <= 1:
+            self._resolve(fut, ServeResult(
+                Status.OK, output=np.asarray([first], np.int32),
+                queued_s=pre.queued_s), t0)
+            return
+        dec = self._attempt_loop("decode", pre.output, opts, deadline)
+        if dec.status is not Status.OK:
+            self._resolve(fut, dec, t0)
+            return
+        dec.output = np.concatenate(
+            [np.asarray([first], np.int32),
+             np.asarray(dec.output, np.int32)])
+        self._resolve(fut, dec, t0)
 
     # ------------------------------------------------------------ lifecycle
     def close(self, wait: bool = True):
@@ -471,6 +618,9 @@ class FleetRouter:
             "members": members,
             "live": list(self.live()),
             "inflight": inflight,
+            "pools": {"prefill": list(self.pool_members("prefill")),
+                      "decode": list(self.pool_members("decode"))},
+            "disaggregate": self.disaggregate,
             "ejections": self.ejections,
             "readmissions": self.readmissions,
             "breakers": {r: b.snapshot()

@@ -134,8 +134,10 @@ class InferenceServer:
                  breaker: Optional[CircuitBreaker] = None,
                  policy: Optional[RetryPolicy] = None,
                  metrics: Optional[ServingMetrics] = None,
-                 generate_dtype=None, name: Optional[str] = None):
+                 generate_dtype=None, name: Optional[str] = None,
+                 kv_pool=None, role: str = "both"):
         from ..optim._sharding_utils import data_mesh
+        from .pools import ROLES
 
         #: replica identity — the fleet layer names its servers so the
         #: per-replica fault injectors (``delay_replica`` et al.) can
@@ -143,6 +145,22 @@ class InferenceServer:
         #: faults
         self.name = name
         self.model = model
+        #: paged KV arena (``serving.kvpool.KVPagePool``): when set,
+        #: generation serves through the paged decode path — each
+        #: request holds pages for the positions it actually fills
+        #: instead of a whole static T_max bucket, and pool exhaustion
+        #: sheds typed OVERLOADED
+        self.kv_pool = kv_pool
+        if role not in ROLES:
+            raise ValueError(f"role {role!r} not in {ROLES}")
+        #: which generation phase(s) this replica serves — advertised
+        #: in the health snapshot so the FleetRouter can route prefill
+        #: and decode to separately-sized pools
+        self.role = role
+        if role != "both" and kv_pool is None:
+            raise ValueError(
+                f"role {role!r} requires a kv_pool (the prefill/"
+                f"decode split moves KV pages between pools)")
         self.mesh = data_mesh(mesh)
         self._n_dev = self.mesh.shape["data"] if self.mesh is not None \
             else 1
@@ -185,7 +203,12 @@ class InferenceServer:
         if self._started:
             raise RuntimeError("server already started")
         from ..optim.evaluator import _cached_eval_fwd
+        from .compile_cache import maybe_set_compile_cache_dir
 
+        # persisted compile cache (bigdl.serving.compileCache): a cold
+        # autoscaled replica loads per-bucket executables instead of
+        # recompiling them — best-effort, never fails a start
+        maybe_set_compile_cache_dir()
         self.model.evaluate()
         self._fwd = _cached_eval_fwd(self.model, self.mesh)
         # on_request flips readiness the instant the signal lands (the
@@ -241,13 +264,17 @@ class InferenceServer:
                 and len(self._queue) < self._queue.maxsize)
 
     def health(self) -> dict:
-        return {
+        out = {
             "healthy": self.healthy(),
             "ready": self.ready(),
             "draining": bool(self._draining or self._should_drain()),
             "queue_depth": len(self._queue),
             "breaker": self.breaker.snapshot(),
+            "role": self.role,
         }
+        if self.kv_pool is not None:
+            out["kv"] = self.kv_pool.stats()
+        return out
 
     def compile_stats(self) -> dict:
         """Compile accounting for the static-shape contract: the jit
@@ -354,6 +381,60 @@ class InferenceServer:
             return fast
         return self._admit(Request(
             kind="generate", payload=prompt, future=ServeFuture(),
+            submitted_at=now, deadline=deadline,
+            opts=(int(max_new), eos_id, pad_id)))
+
+    def _require_pool(self, what: str):
+        if self.kv_pool is None:
+            raise RuntimeError(
+                f"{what} requires a kv_pool (paged serving); this "
+                f"server has none")
+
+    def submit_prefill(self, prompt_ids,
+                       deadline_s: Optional[float] = None
+                       ) -> ServeFuture:
+        """Prefill-only dispatch for the disaggregated path: run the
+        prompt pass, produce the first token, and return a crc-sealed
+        KV handoff blob (``result.output``) a decode-pool replica can
+        continue from.  The prefill replica's pages are released as
+        soon as the blob is exported — prefill holds pages only for
+        the duration of the prompt pass."""
+        self._require_pool("submit_prefill")
+        prompt = np.asarray(prompt_ids, np.int32)
+        if prompt.ndim != 1:
+            raise ValueError(f"prompt_ids must be 1-D, got shape "
+                             f"{prompt.shape}")
+        now = time.monotonic()
+        deadline = self._deadline(deadline_s, now)
+        fast = self._fast_fail_expired(deadline, now)
+        if fast is not None:
+            return fast
+        return self._admit(Request(
+            kind="prefill", payload=prompt, future=ServeFuture(),
+            submitted_at=now, deadline=deadline))
+
+    def submit_decode(self, handoff: bytes, max_new: int,
+                      eos_id: Optional[int] = None,
+                      pad_id: Optional[int] = None,
+                      deadline_s: Optional[float] = None
+                      ) -> ServeFuture:
+        """Decode-only dispatch for the disaggregated path: verify
+        ``handoff`` (crc32c + geometry), import its pages into this
+        replica's pool, and stream the remaining ``max_new - 1``
+        tokens (the first one was produced by prefill and rides the
+        handoff).  The result's ``output`` holds those remaining
+        tokens; a corrupt blob resolves INTERNAL_ERROR, a full pool
+        sheds OVERLOADED."""
+        self._require_pool("submit_decode")
+        if max_new < 1:
+            raise ValueError(f"max_new must be >= 1, got {max_new}")
+        now = time.monotonic()
+        deadline = self._deadline(deadline_s, now)
+        fast = self._fast_fail_expired(deadline, now)
+        if fast is not None:
+            return fast
+        return self._admit(Request(
+            kind="decode", payload=handoff, future=ServeFuture(),
             submitted_at=now, deadline=deadline,
             opts=(int(max_new), eos_id, pad_id)))
 
@@ -499,16 +580,26 @@ class InferenceServer:
     def _group(reqs):
         """Split a gathered batch into runnable groups: classify
         requests coalesce together; generate requests group by their
-        compiled signature (prompt_len, opts)."""
+        compiled signature (prompt_len, opts); the paged kinds
+        (prefill / decode) each form one group — they are driven
+        per-request by the continuous paged loop, which interleaves
+        them regardless of shape."""
         groups: dict = {}
         for r in reqs:
-            key = ("classify",) if r.kind == "classify" else \
-                ("generate", r.payload.shape[0], r.opts)
+            if r.kind == "classify":
+                key = ("classify",)
+            elif r.kind in ("prefill", "decode"):
+                key = (r.kind,)
+            else:
+                key = ("generate", r.payload.shape[0], r.opts)
             groups.setdefault(key, []).append(r)
         for key, group in groups.items():
             yield key[0], group
 
     def _run_group(self, kind: str, reqs: list):
+        if kind in ("prefill", "decode") or (
+                kind == "generate" and self.kv_pool is not None):
+            return self._run_paged_group(kind, reqs)
         t_batch = time.monotonic()
         queued = [t_batch - r.submitted_at for r in reqs]
         with self._model_lock:
@@ -569,6 +660,206 @@ class InferenceServer:
         except Exception as e:  # non-lowerable fwd, analysis quirks
             log.debug("serving: bucket %d cost analysis skipped: %s",
                       bucket, e)
+
+    # ------------------------------------------------------- paged decode
+    def _import_handoff(self, decoder, blob):
+        """Verify a KV handoff blob and materialize it as a live
+        PagedSequence in THIS replica's pool (crc + geometry checked;
+        pages leased here, scattered from the blob)."""
+        from ..models.generate import PagedSequence
+        from .pools import HandoffCorrupt, deserialize_handoff
+
+        h = deserialize_handoff(blob)
+        pool = self.kv_pool
+        geometry = (h["layers"], h["num_kv_heads"], h["page_size"],
+                    h["head_dim"])
+        expect = (pool.layers, pool.num_kv_heads, pool.page_size,
+                  pool.head_dim)
+        if geometry != expect:
+            raise HandoffCorrupt(
+                f"handoff geometry {geometry} does not match this "
+                f"pool {expect}")
+        lease = pool.alloc(int(h["k_pages"].shape[0]))
+        try:
+            pool.write_pages(lease.pages, h["k_pages"], h["v_pages"])
+        except BaseException:
+            lease.release()
+            raise
+        return PagedSequence(lease, pos=int(h["pos"]),
+                             last=int(h["first_token"]),
+                             prompt_len=int(h["pos"]))
+
+    def _run_paged_group(self, kind: str, reqs: list):
+        """Continuous paged generation: one host loop interleaves
+        every in-flight sequence a token at a time, so a long decode
+        never blocks a short one and a kill/drain/deadline mid-stream
+        resolves typed WITH its pages released.  Outcomes:
+
+        * pool exhaustion (at start or on a mid-decode page
+          extension) → typed OVERLOADED shed;
+        * a corrupt handoff → INTERNAL_ERROR (refused before any K/V
+          byte is trusted);
+        * deadline mid-decode → DEADLINE_EXCEEDED;
+        * hard stop mid-decode → CANCELLED;
+        * everything else finishes OK with the unpaged path's exact
+          eos-then-pad emission convention.
+        """
+        from ..models.generate import (_eos_pad, cached_paged_decoder)
+        from .kvpool import PoolExhausted
+        from .pools import HandoffCorrupt, serialize_handoff
+
+        pool = self.kv_pool
+        decoder = cached_paged_decoder(
+            self.model, pool, compute_dtype=self.generate_dtype)
+        with self._model_lock:
+            params = self._params
+
+        def fail(req, queued_s, exc, status=Status.INTERNAL_ERROR):
+            fatal = self.policy.classify(exc) == "fatal"
+            self.breaker.record_failure(fatal=fatal)
+            err = f"{type(exc).__name__}: {exc}"
+            log.warning("paged serving %s failed (%s, %s): %s",
+                        req.kind, "fatal" if fatal else "retryable",
+                        self.breaker.state, err)
+            self._resolve(req, ServeResult(status, error=err,
+                                           queued_s=queued_s))
+
+        live = []
+        for req in reqs:
+            now = time.monotonic()
+            queued_s = now - req.submitted_at
+            try:
+                _faults.check_serving_fault(self.name)
+                if req.kind == "decode":
+                    max_new, eos_id, pad_id = req.opts
+                    eos, pad = map(int, _eos_pad(self.model, eos_id,
+                                                 pad_id))
+                    seq = self._import_handoff(decoder, req.payload)
+                    # the first token rode the handoff: this dispatch
+                    # owes the remaining max_new - 1
+                    entry = {
+                        "req": req, "seq": seq, "toks": [],
+                        "target": max_new - 1, "eos": eos, "pad": pad,
+                        "queued_s": queued_s,
+                        "done": eos > 0 and seq.last == eos,
+                        "t_decode": time.monotonic(), "steps": 0,
+                    }
+                    live.append(entry)
+                else:
+                    t0 = time.monotonic()
+                    seq = decoder.start(params, req.payload)
+                    prefill_s = time.monotonic() - t0
+                    self.metrics.record_phase("prefill", prefill_s)
+                    self.metrics.record_ttft(
+                        time.monotonic() - req.submitted_at)
+                    if req.kind == "prefill":
+                        k_pages, v_pages = pool.read_pages(
+                            seq.lease.pages)
+                        blob = serialize_handoff(
+                            k_pages, v_pages, seq.last, seq.pos,
+                            pool.page_size)
+                        seq.release()
+                        self.breaker.record_success()
+                        self.metrics.record_batch(1, 1)
+                        self._resolve(req, ServeResult(
+                            Status.OK, output=blob,
+                            queued_s=queued_s, bucket=1))
+                    else:  # paged full generate
+                        max_new, eos_id, pad_id = req.opts
+                        eos, pad = map(int, _eos_pad(
+                            self.model, eos_id, pad_id))
+                        live.append({
+                            "req": req, "seq": seq,
+                            "toks": [seq.last], "target": max_new,
+                            "eos": eos, "pad": pad,
+                            "queued_s": queued_s,
+                            "done": eos > 0 and seq.last == eos,
+                            "t_decode": time.monotonic(), "steps": 0,
+                        })
+            except PoolExhausted as e:
+                # admission control, not failure: shed typed (the
+                # breaker must not trip on a full pool)
+                self._resolve(req, ServeResult(
+                    Status.OVERLOADED, error=f"KV pool exhausted: {e}",
+                    queued_s=queued_s))
+            except (KeyboardInterrupt, SystemExit):
+                raise
+            except HandoffCorrupt as e:
+                fail(req, queued_s, e)
+            except Exception as e:
+                fail(req, queued_s, e)
+        self.metrics.set_kv_pool(pool.stats())
+
+        def finish(entry):
+            seq, req = entry["seq"], entry["req"]
+            seq.release()
+            decode_s = time.monotonic() - entry["t_decode"]
+            self.metrics.record_phase("decode", decode_s)
+            if entry["steps"]:
+                self.metrics.record_tpot(decode_s / entry["steps"])
+            self.breaker.record_success()
+            self.metrics.record_batch(1, 1)
+            self._resolve(req, ServeResult(
+                Status.OK,
+                output=np.asarray(entry["toks"], np.int32),
+                queued_s=entry["queued_s"], bucket=1))
+
+        def abort(entry, result: ServeResult):
+            entry["seq"].release()
+            result.queued_s = entry["queued_s"]
+            self._resolve(entry["req"], result)
+
+        # round-robin continuous decode: every live sequence advances
+        # one token per round, so a long decode never starves a short
+        # one and page pressure tracks actual lengths
+        while live:
+            if self._hard_stop:
+                for entry in live:
+                    abort(entry, ServeResult(
+                        Status.CANCELLED,
+                        error="server stopped mid-decode"))
+                break
+            nxt = []
+            for entry in live:
+                req, seq = entry["req"], entry["seq"]
+                if len(entry["toks"]) >= entry["target"]:
+                    finish(entry)
+                    continue
+                if entry["done"]:
+                    # eos already emitted: pad-fill (the unpaged
+                    # path's static-shape convention) without burning
+                    # device steps
+                    entry["toks"].extend(
+                        [entry["pad"]]
+                        * (entry["target"] - len(entry["toks"])))
+                    nxt.append(entry)
+                    continue
+                if req.expired(time.monotonic()):
+                    abort(entry, ServeResult(
+                        Status.DEADLINE_EXCEEDED,
+                        error="deadline expired mid-decode"))
+                    continue
+                try:
+                    _faults.check_serving_fault(self.name)
+                    tok = decoder.step(params, seq)
+                except PoolExhausted as e:
+                    abort(entry, ServeResult(
+                        Status.OVERLOADED,
+                        error=f"KV pool exhausted mid-decode: {e}"))
+                    continue
+                except (KeyboardInterrupt, SystemExit):
+                    raise
+                except Exception as e:
+                    entry["seq"].release()
+                    fail(req, entry["queued_s"], e)
+                    continue
+                entry["steps"] += 1
+                entry["toks"].append(tok)
+                if entry["eos"] > 0 and tok == entry["eos"]:
+                    entry["done"] = True
+                nxt.append(entry)
+            live = nxt
+        self.metrics.set_kv_pool(pool.stats())
 
     def _run_generate(self, params, reqs):
         """One compiled decode program per (bucket, prompt_len,

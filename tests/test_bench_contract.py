@@ -77,6 +77,7 @@ def test_fallback_merges_persisted_tpu_numbers(tmp_path):
                 # skip their slow subprocesses here
                 "BENCH_SERVING_TIMEOUT": "0",
                 "BENCH_FLEET_TIMEOUT": "0",
+                "BENCH_DISAGG_TIMEOUT": "0",
                 "BENCH_ELASTIC_TIMEOUT": "0",
                 "BENCH_INTEGRITY_TIMEOUT": "0",
                 "BENCH_TELEMETRY_TIMEOUT": "0",
@@ -213,6 +214,54 @@ def test_fleet_measurements_contract():
     assert rec["fleet_goodput_per_chip"] == \
         out["goodput_per_chip_flops"]
     assert rec["fleet_recovery_s"] == out["recovery_s"]
+    for key in bench.LEDGER_FIELDS:
+        assert key in rec
+
+
+def test_disagg_measurements_contract():
+    """The disagg leg's measurement dict carries the judged fields
+    (paged-vs-static concurrency multiple with exact outputs, TTFT/
+    TPOT percentiles, the autoscaler timeline/decisions with flip
+    accounting, shed no worse than the fixed fleet) — run tiny
+    in-process so tier-1 stays fast; the full leg is `--disagg` and
+    its one JSON line lands in SERVING_r03.json."""
+    bench = _bench()
+    out = bench._disagg_measurements(
+        phase_s=0.5, low_rps=2.0, high_rps=8.0, users=8,
+        max_new=4, long_prompt=4, long_new=12, t_max=32,
+        page_size=4, eval_interval_s=0.2, cooldown_s=0.4,
+        deadline_s=20.0, cold_start=False, layers=1)
+    # paged-vs-static at equal arena bytes: >= 2x concurrent long
+    # decodes, every stream exactly the unpaged reference, no leaks
+    c = out["concurrency"]
+    assert c["static_max_long_decodes"] >= 1
+    assert c["paged_concurrency_x"] >= 2.0
+    assert c["paged_outputs_exact"] is True
+    assert c["pool_leak_free"] is True
+    # every pass resolves everything typed
+    for key in ("static_pass", "paged_pass", "autoscale_pass"):
+        assert out[key]["total"]["all_resolved_typed"] is True
+        assert out[key]["total"]["offered"] > 0
+    # per-phase serving metrics measured on the paged passes
+    assert out["paged_pass"]["ttft_p99_ms"] is not None
+    assert out["paged_pass"]["tpot_p99_ms"] is not None
+    assert out["static_pass"]["tpot_p99_ms"] is None  # unobservable
+    # the autoscaler proof fields exist and respect the no-flap bar
+    a = out["autoscale"]
+    assert a["timeline"], "no replica-count timeline"
+    assert a["max_flips_in_a_phase"] <= 1
+    assert a["shed_rate_vs_fixed"]["no_worse"] is True
+    assert isinstance(a["decisions"], list)
+    # and the record flattens into the schema-stable ledger fields
+    rec = bench.ledger_record({"disagg": {
+        "ttft_p99_ms": out["ttft_p99_ms"],
+        "tpot_p99_ms": out["tpot_p99_ms"],
+        "paged_concurrency_x": out["paged_concurrency_x"],
+        "shed_rate": out["shed_rate"]}})
+    assert rec["disagg_ttft_p99_ms"] == out["ttft_p99_ms"]
+    assert rec["disagg_paged_concurrency_x"] == \
+        out["paged_concurrency_x"]
+    assert rec["disagg_shed_rate"] == out["shed_rate"]
     for key in bench.LEDGER_FIELDS:
         assert key in rec
 

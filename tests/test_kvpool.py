@@ -1,0 +1,330 @@
+"""Paged KV-cache specs (serving/kvpool.py + the paged decode path in
+models/generate.py): the page allocator never leaks across ANY request
+lifecycle (eos, deadline expiry, cancel, kill mid-decode), page-table
+reuse keeps the compile count at one program per page-count bucket,
+pool exhaustion sheds typed OVERLOADED with full recovery after drain,
+and the paged greedy token stream is EXACTLY the unpaged
+``cached_generate`` stream — pages change where K/V live, never what
+gets decoded."""
+import time
+
+import numpy as np
+import pytest
+
+from bigdl_tpu import nn  # noqa: F401 — registry
+from bigdl_tpu.models.generate import cached_generate, cached_paged_decoder
+from bigdl_tpu.models.transformer import TransformerLM
+from bigdl_tpu.serving import InferenceServer, KVPagePool, Status
+from bigdl_tpu.serving.kvpool import (PoolExhausted, page_bucket_for,
+                                      page_bucket_ladder)
+from bigdl_tpu.serving.pools import (HandoffCorrupt, deserialize_handoff,
+                                     serialize_handoff)
+from bigdl_tpu.utils.rng import RNG
+
+VOCAB, TMAX = 23, 32
+
+#: one model per architecture for the whole module (1 layer — compile
+#: wall, not model scale, dominates these specs): params are
+#: seed-deterministic, and the paged decode programs (shared per
+#: (model, page_size) across pools) then compile once per file, not
+#: once per test
+_MODELS = {}
+
+
+def _model(**kw):
+    key = tuple(sorted(kw.items()))
+    if key not in _MODELS:
+        RNG().set_seed(4)
+        _MODELS[key] = TransformerLM(VOCAB, embed_dim=16, num_heads=2,
+                                     mlp_dim=32, num_layers=1,
+                                     max_len=TMAX, **kw)
+    return _MODELS[key]
+
+
+def _pool(model, num_pages=32, page_size=4):
+    return KVPagePool.for_model(model, num_pages, page_size=page_size)
+
+
+# ---------------------------------------------------------------------------
+# allocator
+# ---------------------------------------------------------------------------
+
+def test_bucket_ladder_doubles_to_max():
+    assert page_bucket_ladder(12) == [1, 2, 4, 8, 12]
+    assert page_bucket_for(3, 12) == 4
+    assert page_bucket_for(12, 12) == 12
+    with pytest.raises(PoolExhausted):
+        page_bucket_for(13, 12)
+
+
+def test_alloc_free_and_exhaustion_accounting():
+    pool = KVPagePool(num_pages=4, layers=1, num_kv_heads=1,
+                      page_size=2, head_dim=4)
+    a = pool.alloc(3)
+    assert pool.free_pages == 1 and pool.high_water == 3
+    with pytest.raises(PoolExhausted):
+        pool.alloc(2)
+    assert pool.exhaustions == 1
+    a.extend(1)
+    assert pool.free_pages == 0 and pool.high_water == 4
+    a.release()
+    a.release()                      # idempotent
+    assert pool.free_pages == 4
+    assert pool.frees == 4 and pool.allocs == 4
+    with pytest.raises(RuntimeError, match="released"):
+        a.extend(1)
+    stats = pool.stats()
+    assert stats["occupancy"] == 0.0 and stats["arena_bytes"] > 0
+
+
+# ---------------------------------------------------------------------------
+# paged decode == unpaged decode, exactly
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("kw", [
+    {},
+    # the rope/GQA and MoE architectures compile their own program
+    # sets — correctness-identical machinery, so they ride the slow
+    # tier to keep tier-1 inside its budget
+    pytest.param({"rope": True, "num_kv_heads": 1},
+                 marks=pytest.mark.slow),
+    pytest.param({"moe_experts": 4, "moe_capacity_factor": 8.0},
+                 marks=pytest.mark.slow)])
+def test_paged_stream_matches_unpaged_reference(kw):
+    model = _model(**kw)
+    params = model.param_tree()
+    pool = _pool(model)
+    dec = cached_paged_decoder(model, pool)
+    gen = cached_generate(model)
+    rng = np.random.RandomState(0)
+    for T0, max_new in ((5, 12), (3, 16)):
+        prompt = rng.randint(1, VOCAB + 1, (T0,)).astype(np.int32)
+        ref = np.asarray(gen(params, prompt[None], max_new))[0, T0:]
+        seq = dec.start(params, prompt)
+        toks = [seq.last]
+        for _ in range(max_new - 1):
+            toks.append(dec.step(params, seq))
+        seq.release()
+        np.testing.assert_array_equal(np.asarray(toks), ref)
+    assert pool.free_pages == pool.num_pages
+
+
+def test_interleaved_sequences_do_not_interfere():
+    """Two decodes sharing one arena, advanced alternately: each
+    stream must equal its isolated reference — page tables isolate
+    requests even though every K/V byte lives in the same arrays."""
+    model = _model()
+    params = model.param_tree()
+    pool = _pool(model)
+    dec = cached_paged_decoder(model, pool)
+    gen = cached_generate(model)
+    rng = np.random.RandomState(1)
+    pa = rng.randint(1, VOCAB + 1, (4,)).astype(np.int32)
+    pb = rng.randint(1, VOCAB + 1, (6,)).astype(np.int32)
+    ref_a = np.asarray(gen(params, pa[None], 10))[0, 4:]
+    ref_b = np.asarray(gen(params, pb[None], 10))[0, 6:]
+    sa, sb = dec.start(params, pa), dec.start(params, pb)
+    ta, tb = [sa.last], [sb.last]
+    for _ in range(9):
+        ta.append(dec.step(params, sa))
+        tb.append(dec.step(params, sb))
+    sa.release(), sb.release()
+    np.testing.assert_array_equal(np.asarray(ta), ref_a)
+    np.testing.assert_array_equal(np.asarray(tb), ref_b)
+    assert pool.free_pages == pool.num_pages
+
+
+def test_page_table_reuse_compiles_once_per_bucket():
+    """Long decode crossing several page buckets: the decode jit cache
+    holds at most one entry per page-count bucket ever used, and a
+    SECOND sequence replaying the same growth adds zero compiles."""
+    model = _model()
+    params = model.param_tree()
+    pool = _pool(model, num_pages=32, page_size=2)
+    dec = cached_paged_decoder(model, pool)
+    rng = np.random.RandomState(2)
+    prompt = rng.randint(1, VOCAB + 1, (3,)).astype(np.int32)
+
+    def run():
+        seq = dec.start(params, prompt)
+        for _ in range(24):          # grows through buckets 2,4,8,16
+            dec.step(params, seq)
+        seq.release()
+
+    run()
+    stats = dec.compile_stats()
+    buckets_used = {page_bucket_for(n, dec.max_pages)
+                    for n in range(2, pool.pages_for_tokens(3 + 25) + 1)}
+    assert stats["decode_cache_size"] <= len(buckets_used)
+    run()                            # pure reuse
+    assert dec.compile_stats() == stats
+    assert pool.free_pages == pool.num_pages
+
+
+# ---------------------------------------------------------------------------
+# lifecycle: no page leaks, typed outcomes
+# ---------------------------------------------------------------------------
+
+def _lm_server(model, pool, **kw):
+    kw.setdefault("max_batch", 8)
+    return InferenceServer(model, kv_pool=pool, **kw)
+
+
+def test_eos_stop_pads_and_releases_pages():
+    model = _model()
+    pool = _pool(model)
+    srv = _lm_server(model, pool).start()
+    try:
+        rng = np.random.RandomState(3)
+        prompt = rng.randint(1, VOCAB + 1, (5,)).astype(np.int32)
+        # pick the guaranteed-eos token: the FIRST generated token,
+        # then ask for more — everything after must be pad
+        probe = srv.submit_generate(prompt, max_new=1).result(60)
+        assert probe.ok
+        eos = int(probe.output[0])
+        res = srv.submit_generate(prompt, max_new=6, eos_id=eos,
+                                  pad_id=1).result(60)
+        assert res.ok
+        np.testing.assert_array_equal(
+            res.output, [eos, 1, 1, 1, 1, 1])
+    finally:
+        srv.stop(10)
+    assert pool.free_pages == pool.num_pages
+
+
+def test_deadline_expiry_mid_decode_resolves_typed_and_frees():
+    model = _model()
+    pool = _pool(model)
+    srv = _lm_server(model, pool).start()
+    try:
+        rng = np.random.RandomState(4)
+        prompt = rng.randint(1, VOCAB + 1, (5,)).astype(np.int32)
+        # warm the decode path so the deadline death is mid-decode,
+        # not mid-compile
+        assert srv.submit_generate(prompt, max_new=4).result(60).ok
+        from bigdl_tpu.resilience import faults
+
+        with faults.serving_step_latency(0.05, times=1 << 10):
+            res = srv.submit_generate(prompt, max_new=20,
+                                      deadline_s=0.12).result(60)
+        assert res.status is Status.DEADLINE_EXCEEDED
+        assert "mid-decode" in res.error
+    finally:
+        srv.stop(10)
+    assert pool.free_pages == pool.num_pages
+
+
+def test_hard_stop_mid_decode_cancels_typed_and_frees():
+    model = _model()
+    pool = _pool(model)
+    srv = _lm_server(model, pool).start()
+    rng = np.random.RandomState(5)
+    prompt = rng.randint(1, VOCAB + 1, (5,)).astype(np.int32)
+    assert srv.submit_generate(prompt, max_new=2).result(60).ok
+    from bigdl_tpu.resilience import faults
+
+    with faults.serving_step_latency(0.05, times=1 << 10):
+        fut = srv.submit_generate(prompt, max_new=200)
+        time.sleep(0.15)             # decode underway
+        srv.stop(timeout=30)
+    res = fut.result(60)
+    assert res.status is Status.CANCELLED
+    assert pool.free_pages == pool.num_pages
+
+
+def test_pool_exhaustion_sheds_typed_and_recovers():
+    """A pool too small for the offered concurrency sheds OVERLOADED
+    (never a hang, never an admission of an un-servable decode) and
+    returns to full free count after the survivors drain."""
+    model = _model()
+    pool = _pool(model, num_pages=3, page_size=4)  # one request's worth
+    srv = _lm_server(model, pool, batch_window_s=0.05).start()
+    try:
+        rng = np.random.RandomState(6)
+        prompts = [rng.randint(1, VOCAB + 1, (8,)).astype(np.int32)
+                   for _ in range(4)]
+        futs = [srv.submit_generate(p, max_new=4) for p in prompts]
+        res = [f.result(120) for f in futs]
+        by = {r.status for r in res}
+        assert Status.OK in by
+        shed = [r for r in res if r.status is Status.OVERLOADED]
+        assert shed, [r.status for r in res]
+        assert all("pool exhausted" in r.error.lower()
+                   or "KV pool" in r.error for r in shed)
+        assert pool.exhaustions >= 1
+    finally:
+        srv.stop(10)
+    assert pool.free_pages == pool.num_pages
+
+
+def test_kill_replica_mid_decode_frees_pages():
+    """The fleet chaos bar's pool half: a killed replica's in-flight
+    decode resolves typed (CANCELLED) and its pages come back."""
+    from bigdl_tpu.resilience import faults
+    from bigdl_tpu.serving import ServingFleet
+
+    model = _model()
+    fl = ServingFleet.build(
+        model, n_replicas=1, kv_pages=32, kv_page_size=4,
+        server_kw=dict(max_batch=8), pump_interval_s=0.02,
+        heartbeat_timeout=0.3)
+    fl.start()
+    try:
+        rng = np.random.RandomState(7)
+        prompt = rng.randint(1, VOCAB + 1, (5,)).astype(np.int32)
+        assert fl.servers["r0"].submit_generate(
+            prompt, max_new=2).result(60).ok
+        pool = fl.servers["r0"].kv_pool
+        with faults.serving_step_latency(0.05, times=1 << 10):
+            fut = fl.servers["r0"].submit_generate(prompt, max_new=200)
+            time.sleep(0.15)
+            with faults.kill_replica("r0"):
+                deadline = time.monotonic() + 15
+                while fl.servers["r0"].healthy() \
+                        and time.monotonic() < deadline:
+                    time.sleep(0.02)
+        res = fut.result(60)
+        assert res.status is Status.CANCELLED
+        assert pool.free_pages == pool.num_pages
+    finally:
+        fl.stop(10)
+
+
+# ---------------------------------------------------------------------------
+# handoff integrity
+# ---------------------------------------------------------------------------
+
+def test_handoff_roundtrip_and_corruption_refused():
+    k = np.arange(2 * 2 * 1 * 4 * 4, dtype=np.float32).reshape(
+        2, 2, 1, 4, 4)
+    blob = serialize_handoff(k, k + 1, first_token=7, pos=6,
+                             page_size=4)
+    h = deserialize_handoff(blob)
+    assert h["first_token"] == 7 and h["pos"] == 6
+    np.testing.assert_array_equal(h["k_pages"], k)
+    # flip one payload byte: crc must refuse
+    bad = bytearray(blob)
+    bad[len(bad) // 2] ^= 0x40
+    with pytest.raises(HandoffCorrupt, match="crc32c"):
+        deserialize_handoff(bytes(bad))
+    with pytest.raises(HandoffCorrupt, match="magic"):
+        deserialize_handoff(b"XXXX" + blob[4:])
+    with pytest.raises(HandoffCorrupt):
+        deserialize_handoff(b"short")
+
+
+def test_decode_geometry_mismatch_refused_typed():
+    model = _model()
+    pool = _pool(model, page_size=4)
+    srv = _lm_server(model, pool, role="decode").start()
+    try:
+        # a blob with the wrong page_size for this pool
+        k = np.zeros((1, 2, 2, 8, 8), np.float32)
+        blob = serialize_handoff(k, k, first_token=1, pos=3,
+                                 page_size=8)
+        res = srv.submit_decode(blob, max_new=4).result(60)
+        assert res.status is Status.INTERNAL_ERROR
+        assert "geometry" in res.error
+    finally:
+        srv.stop(10)
+    assert pool.free_pages == pool.num_pages

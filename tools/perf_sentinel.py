@@ -65,6 +65,15 @@ DEFAULT_TOLERANCES = {
     "fleet_goodput_per_chip": ("higher", 0.60),
     "fleet_p99_ms": ("lower", 0.75, 5.0),
     "fleet_recovery_s": ("lower", 1.00, 0.5),
+    # disaggregated serving leg (ISSUE 11): TTFT/TPOT on the 1-core CI
+    # box are scheduler-noisy (wide tolerances, absolute floors); the
+    # paged concurrency multiple is a deterministic arena-accounting
+    # property — a fall means paging silently stopped paying — and
+    # shed under the ramp may only fall
+    "disagg_ttft_p99_ms": ("lower", 2.00, 250.0),
+    "disagg_tpot_p99_ms": ("lower", 2.00, 100.0),
+    "disagg_paged_concurrency_x": ("higher", 0.0),
+    "disagg_shed_rate": ("lower", 0.50, 0.02),
     "elastic_recovery_s": ("lower", 1.00),
     "telemetry_overhead_pct": ("lower", 2.00),
     # async-everything goodput family (ISSUE 7): the productive
