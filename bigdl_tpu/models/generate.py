@@ -154,7 +154,7 @@ def _moe_ffn_nodrop(moe, params, x):
     return y.reshape(B, Tq, D)
 
 
-def _gqa_attend(q, k_cache, v_cache, pos, H, Hkv, Dh):
+def _gqa_attend(q, k_cache, v_cache, pos, H, Hkv, Dh, k_pos=None):
     """Causal attention of Tq queries (absolute positions
     pos..pos+Tq-1) against a dense ``[B, Hkv, Tm, Dh]`` cache view.
     GQA contracts the query groups against the UN-repeated cache — a
@@ -162,11 +162,16 @@ def _gqa_attend(q, k_cache, v_cache, pos, H, Hkv, Dh):
     every decode step, exactly the bandwidth GQA exists to save.
     Shared by the dense-cache machinery and the paged decode path (the
     paged path passes a page-gathered view), so the two can never
-    drift numerically."""
+    drift numerically.  ``k_pos`` [Tm] gives each cache slot's
+    ABSOLUTE position when the view is not contiguous from 0 — the
+    page-window path gathers only the live pages, so slot index and
+    position diverge."""
     Tq, Tm = q.shape[2], k_cache.shape[2]
     scale = 1.0 / jnp.sqrt(jnp.float32(Dh)).astype(q.dtype)
     qpos = pos + jnp.arange(Tq)
-    mask = jnp.arange(Tm)[None, :] <= qpos[:, None]   # [Tq, Tm]
+    if k_pos is None:
+        k_pos = jnp.arange(Tm)
+    mask = k_pos[None, :] <= qpos[:, None]            # [Tq, Tm]
     if Hkv == H:
         scores = jnp.einsum("bhqd,bhkd->bhqk", q, k_cache) * scale
         scores = jnp.where(mask[None, None], scores, -jnp.inf)
@@ -595,7 +600,8 @@ def make_beam_search(model, max_len: Optional[int] = None,
 # Paged decode: page-table KV through a shared KVPagePool arena
 # --------------------------------------------------------------------------
 
-def _paged_machinery(model, first, count, page_size):
+def _paged_machinery(model, first, count, page_size, page_window=None,
+                     page_globals: int = 1):
     """The paged twin of :func:`_decode_machinery`: K/V live in a
     shared ``[num_pages, layers, Hkv, page_size, Dh]`` arena and each
     request addresses its positions through a page table ``pt`` (page
@@ -604,6 +610,16 @@ def _paged_machinery(model, first, count, page_size):
     runs — masked positions contribute exactly zero, so the paged
     token stream is the unpaged stream (pinned in
     tests/test_kvpool.py).
+
+    ``page_window`` turns on the page-granular block mask (the BLaST
+    sparsity story on the serving path): each decode step gathers and
+    attends ONLY the first ``page_globals`` anchor pages plus the last
+    ``page_window`` pages — dead pages are never gathered, so a long
+    decode's per-token attention cost stops growing with total length.
+    Prefill applies the same page-window rule through the block-sparse
+    kernel (``ops/block_sparse``; masked dense off-TPU — identical
+    math).  A window wide enough to cover the whole bucket is EXACTLY
+    the dense paged path (parity pinned in tests/test_kvpool.py).
 
     Shapes are static per (prompt_len, page_bucket): ``pos`` and
     ``pt`` are traced values, so page-table REUSE never recompiles —
@@ -653,6 +669,28 @@ def _paged_machinery(model, first, count, page_size):
                              None)
         return h[:, 0, :].astype(jnp.float32)
 
+    def _prefill_attend(q, k, v, T0):
+        """Prompt self-attention: full causal flash, or the page-window
+        block mask through the block-sparse kernel when the window is
+        configured and actually binds (fewer pages than the prompt
+        holds)."""
+        from ..ops.flash_attention import flash_attention
+
+        n_pages = -(-T0 // page_size)
+        if page_window is None or n_pages <= page_window + page_globals \
+                or T0 % page_size:
+            # non-page-multiple prompts keep the dense causal pass: the
+            # ragged tail page cannot be expressed at block granularity
+            return flash_attention(q, _rep(k), _rep(v), causal=True)
+        from ..ops.block_sparse import (block_sparse_attention,
+                                        sliding_window_mask)
+
+        mask = sliding_window_mask(n_pages, n_pages, page_window,
+                                   n_global=page_globals, causal=True,
+                                   block_q=page_size, block_k=page_size)
+        return block_sparse_attention(q, _rep(k), _rep(v), mask,
+                                      causal=True)
+
     def prefill(pc, prompt, pt, arena_k, arena_v):
         """The whole prompt in one causal pass (the flash path the
         dense machinery uses — first-token numerics identical), K/V
@@ -677,20 +715,30 @@ def _paged_machinery(model, first, count, page_size):
                 paged_view(k).astype(arena_k.dtype))
             arena_v = arena_v.at[pt[:n_pages], bi].set(
                 paged_view(v).astype(arena_v.dtype))
-            from ..ops.flash_attention import flash_attention
-
-            o = flash_attention(q, _rep(k), _rep(v), causal=True)
+            o = _prefill_attend(q, k, v, T0)
             o = o.transpose(0, 2, 1, 3).reshape(B, T0, H * Dh)
             h = h + _proj(o, bp["1"], "wo", "bo",
                           block.modules[1].with_bias)
             h = _ffn_sublayer(block, bp, h)
         return logits_last(pc, h), arena_k, arena_v
 
+    def _page_view(arena, pages, bi, dt):
+        """Gather ``pages`` (page-id vector) of layer ``bi`` into a
+        dense [1, Hkv, len*page_size, Dh] cache view."""
+        n = pages.shape[0]
+        return arena[pages, bi].transpose(1, 0, 2, 3).reshape(
+            Hkv, n * page_size, Dh)[None].astype(dt)
+
     def decode(pc, tok, pos, pt, arena_k, arena_v):
         """One token [1, 1] at traced absolute position ``pos``: write
         its K/V into page ``pt[pos // page_size]`` slot ``pos %
-        page_size``, attend over the gathered page view."""
+        page_size``, attend over the gathered page view.  With a
+        ``page_window``, only the anchor + window pages are gathered —
+        the page-granular block mask: dead pages cost no gather, no
+        bytes, no score columns."""
         P = pt.shape[0]
+        windowed = page_window is not None \
+            and P > page_window + page_globals
         h = _embed_at(pc, tok, pos, 1)
         for bi, block in enumerate(blocks):
             bp = pc[str(first + bi)]
@@ -703,15 +751,33 @@ def _paged_machinery(model, first, count, page_size):
                 k[0, :, 0, :].astype(arena_k.dtype))
             arena_v = arena_v.at[page, bi, :, slot, :].set(
                 v[0, :, 0, :].astype(arena_v.dtype))
-            # gather THIS request's pages into a dense [1, Hkv, T, Dh]
-            # view (T = bucket * page_size); positions past ``pos``
-            # (padding pages, other requests' bytes) are causally
-            # masked to exactly zero weight inside _gqa_attend
-            kc = arena_k[pt, bi].transpose(1, 0, 2, 3).reshape(
-                Hkv, P * page_size, Dh)[None].astype(q.dtype)
-            vc = arena_v[pt, bi].transpose(1, 0, 2, 3).reshape(
-                Hkv, P * page_size, Dh)[None].astype(q.dtype)
-            o = _gqa_attend(q, kc, vc, pos, H, Hkv, Dh)
+            if windowed:
+                # sparse page mask: gather the G anchor pages + the W
+                # pages ending at the current one.  ``start`` clamps to
+                # G so anchors never duplicate; not-yet-written window
+                # slots carry k_pos > pos and mask to exactly zero.
+                G, W = page_globals, page_window
+                cur = pos // page_size
+                start = jnp.maximum(cur - (W - 1), G)
+                live = jnp.concatenate(
+                    [pt[:G], lax.dynamic_slice(pt, (start,), (W,))])
+                page_ids = jnp.concatenate(
+                    [jnp.arange(G), start + jnp.arange(W)])
+                k_pos = (page_ids[:, None] * page_size
+                         + jnp.arange(page_size)[None, :]).reshape(-1)
+                kc = _page_view(arena_k, live, bi, q.dtype)
+                vc = _page_view(arena_v, live, bi, q.dtype)
+                o = _gqa_attend(q, kc, vc, pos, H, Hkv, Dh,
+                                k_pos=k_pos)
+            else:
+                # gather THIS request's pages into a dense
+                # [1, Hkv, T, Dh] view (T = bucket * page_size);
+                # positions past ``pos`` (padding pages, other
+                # requests' bytes) are causally masked to exactly zero
+                # weight inside _gqa_attend
+                kc = _page_view(arena_k, pt, bi, q.dtype)
+                vc = _page_view(arena_v, pt, bi, q.dtype)
+                o = _gqa_attend(q, kc, vc, pos, H, Hkv, Dh)
             o = o.transpose(0, 2, 1, 3).reshape(1, 1, H * Dh)
             h = h + _proj(o, bp["1"], "wo", "bo",
                           block.modules[1].with_bias)
@@ -727,14 +793,19 @@ def _paged_machinery(model, first, count, page_size):
 _PAGED_FN_CACHE = weakref.WeakKeyDictionary()
 
 
-def _paged_fns(model, first, count, page_size, compute_dtype):
+def _paged_fns(model, first, count, page_size, compute_dtype,
+               page_window=None, page_globals=1):
     from ..optim.optimizer import _cast_floats
 
     slot = _PAGED_FN_CACHE.setdefault(model, {})
-    key = (int(page_size), compute_dtype)
+    key = (int(page_size), compute_dtype,
+           None if page_window is None else int(page_window),
+           int(page_globals))
     if key not in slot:
         prefill, decode = _paged_machinery(model, first, count,
-                                           page_size)
+                                           page_size,
+                                           page_window=page_window,
+                                           page_globals=page_globals)
         cast = (lambda p: _cast_floats(p, compute_dtype)) \
             if compute_dtype else (lambda p: p)
 
@@ -788,9 +859,14 @@ class PagedDecoder:
     """
 
     def __init__(self, model, pool, compute_dtype=None,
-                 max_len: Optional[int] = None):
+                 max_len: Optional[int] = None,
+                 page_window: Optional[int] = None,
+                 page_globals: int = 1):
         from ..optim.optimizer import _cast_floats
 
+        if page_window is not None and page_window < 1:
+            raise ValueError(f"page_window must be >= 1 pages, got "
+                             f"{page_window}")
         first, count = _check_model(model)
         mha0 = model.modules[first].modules[1]
         Hkv = getattr(mha0, "num_kv_heads", mha0.num_heads)
@@ -809,12 +885,15 @@ class PagedDecoder:
                          pool.max_positions)
         self.max_pages = pool.pages_for_tokens(self.T_max)
         # the jitted programs depend only on (model, page_size,
-        # compute_dtype) — NOT on which pool's arena they run against
-        # — so every same-geometry pool (each autoscaled replica gets
-        # its own) shares one compile, and a cold scale-up pays zero
-        # paged compiles on an already-warm host
+        # compute_dtype, page window) — NOT on which pool's arena they
+        # run against — so every same-geometry pool (each autoscaled
+        # replica gets its own) shares one compile, and a cold
+        # scale-up pays zero paged compiles on an already-warm host
+        self.page_window = page_window
+        self.page_globals = int(page_globals)
         self._prefill_fn, self._decode_fn = _paged_fns(
-            model, first, count, pool.page_size, compute_dtype)
+            model, first, count, pool.page_size, compute_dtype,
+            page_window=page_window, page_globals=page_globals)
 
     # ------------------------------------------------------------------
     def _padded_table(self, lease):
@@ -894,13 +973,18 @@ _PAGED_CACHE = weakref.WeakKeyDictionary()
 
 
 def cached_paged_decoder(model, pool, compute_dtype=None,
-                         max_len: Optional[int] = None) -> PagedDecoder:
-    cfg = (id(pool), compute_dtype, max_len or model.max_len)
+                         max_len: Optional[int] = None,
+                         page_window: Optional[int] = None,
+                         page_globals: int = 1) -> PagedDecoder:
+    cfg = (id(pool), compute_dtype, max_len or model.max_len,
+           page_window, int(page_globals))
     slot = _PAGED_CACHE.setdefault(model, {})
     if cfg not in slot:
         slot[cfg] = PagedDecoder(model, pool,
                                  compute_dtype=compute_dtype,
-                                 max_len=max_len)
+                                 max_len=max_len,
+                                 page_window=page_window,
+                                 page_globals=page_globals)
     return slot[cfg]
 
 

@@ -56,7 +56,8 @@ class TransformerBlock(Container):
                  rope: bool = False, rope_theta: float = 10000.0,
                  attn_bias: Optional[bool] = None,
                  mlp_bias: Optional[bool] = None,
-                 norm_eps: Optional[float] = None):
+                 norm_eps: Optional[float] = None,
+                 blocksparse: Optional[dict] = None):
         if norm not in ("ln", "rms"):
             raise ValueError(f"norm {norm!r} not in ('ln', 'rms')")
         if mlp not in ("gelu", "swiglu"):
@@ -68,6 +69,10 @@ class TransformerBlock(Container):
         # llama convention: bias-free attention (and swiglu) projections
         with_bias = (attn_bias if attn_bias is not None
                      else not (rope or norm == "rms"))
+        # block-sparse attention config (seq_strategy="blocksparse"):
+        # pattern/window/globals/stride/block forwarded to the MHA's
+        # mask builder (ops/block_sparse.py)
+        bs = dict(blocksparse or {})
         mods = [
             Norm(embed_dim),
             nn.MultiHeadAttention(embed_dim, num_heads, causal=causal,
@@ -75,7 +80,13 @@ class TransformerBlock(Container):
                                   seq_axis=seq_axis,
                                   num_kv_heads=num_kv_heads,
                                   rope=rope, rope_theta=rope_theta,
-                                  with_bias=with_bias),
+                                  with_bias=with_bias,
+                                  sparse_pattern=bs.get("pattern",
+                                                        "sliding"),
+                                  sparse_window=bs.get("window", 2),
+                                  sparse_globals=bs.get("globals", 1),
+                                  sparse_stride=bs.get("stride", 4),
+                                  sparse_block=bs.get("block")),
             Norm(embed_dim),
         ]
         if moe_experts:
@@ -189,7 +200,8 @@ class TransformerLM(Container):
                  attn_bias: Optional[bool] = None,
                  mlp_bias: Optional[bool] = None,
                  head_bias: bool = True,
-                 norm_eps: Optional[float] = None):
+                 norm_eps: Optional[float] = None,
+                 blocksparse: Optional[dict] = None):
         if output not in ("log_probs", "logits"):
             raise ValueError(f"output {output!r} not in (log_probs, logits)")
         mlp_dim = mlp_dim or 4 * embed_dim
@@ -220,7 +232,8 @@ class TransformerLM(Container):
                                    rope_theta=rope_theta,
                                    attn_bias=attn_bias,
                                    mlp_bias=mlp_bias,
-                                   norm_eps=norm_eps)
+                                   norm_eps=norm_eps,
+                                   blocksparse=blocksparse)
                   for _ in range(num_layers)]
         Norm = _norm_factory(norm, norm_eps)
         super().__init__(

@@ -15,7 +15,11 @@ from ..parallel.ring_attention import (attention, blockwise_attention,
 from .initialization import IN_OUT, ONE_D, Xavier, Zeros
 from .module import TensorModule
 
-SEQ_STRATEGIES = ("dense", "flash", "block", "ring", "ulysses")
+SEQ_STRATEGIES = ("dense", "flash", "block", "ring", "ulysses",
+                  "blocksparse")
+
+#: block-sparse mask patterns the layer can build (ops/block_sparse.py)
+SPARSE_PATTERNS = ("sliding", "strided")
 
 
 def rope_rotate(x, pos, theta: float = 10000.0):
@@ -46,6 +50,15 @@ class MultiHeadAttention(TensorModule):
       * ``"ring"``   — ring context parallelism; REQUIRES running inside
         shard_map with the sequence sharded over ``seq_axis``
       * ``"ulysses"`` — all-to-all sequence parallelism (same requirement)
+      * ``"blocksparse"`` — BLaST block-sparse Pallas kernel
+        (ops/block_sparse.py): only the block pairs a static mask
+        allows are ever read or multiplied.  The mask is built from
+        ``sparse_pattern`` at ``sparse_block`` granularity (default
+        ``block_size``): ``"sliding"`` = ``sparse_window`` blocks back
+        plus ``sparse_globals`` anchor blocks (Longformer-style);
+        ``"strided"`` = own block + every ``sparse_stride``-th block.
+        Masks are cached per (T, S); off-TPU the identical math runs
+        densely with the mask applied elementwise.
     """
 
     def __init__(self, embed_dim: int, num_heads: int,
@@ -53,7 +66,11 @@ class MultiHeadAttention(TensorModule):
                  seq_strategy: str = "dense", seq_axis: str = "seq",
                  block_size: int = 512,
                  num_kv_heads: "int | None" = None,
-                 rope: bool = False, rope_theta: float = 10000.0):
+                 rope: bool = False, rope_theta: float = 10000.0,
+                 sparse_pattern: str = "sliding",
+                 sparse_window: int = 2, sparse_globals: int = 1,
+                 sparse_stride: int = 4,
+                 sparse_block: "int | None" = None):
         super().__init__()
         assert embed_dim % num_heads == 0, "embed_dim % num_heads != 0"
         if seq_strategy not in SEQ_STRATEGIES:
@@ -74,6 +91,15 @@ class MultiHeadAttention(TensorModule):
             raise ValueError(
                 f"num_heads {num_heads} not divisible by num_kv_heads "
                 f"{self.num_kv_heads}")
+        if sparse_pattern not in SPARSE_PATTERNS:
+            raise ValueError(f"sparse_pattern {sparse_pattern!r} not in "
+                             f"{SPARSE_PATTERNS}")
+        self.sparse_pattern = sparse_pattern
+        self.sparse_window = int(sparse_window)
+        self.sparse_globals = int(sparse_globals)
+        self.sparse_stride = int(sparse_stride)
+        self.sparse_block = sparse_block
+        self._sparse_masks = {}   # (T, S) -> BlockMask (static, hashable)
         self.rope = bool(rope)
         self.rope_theta = float(rope_theta)
         if self.rope and seq_strategy in ("ring", "ulysses"):
@@ -102,7 +128,40 @@ class MultiHeadAttention(TensorModule):
         h = heads or self.num_heads
         return x.reshape(B, T, h, self.head_dim).transpose(0, 2, 1, 3)
 
+    def block_mask(self, T, S):
+        """The layer's static :class:`~bigdl_tpu.ops.BlockMask` for a
+        (T, S) attention — built once per shape and cached (hashable,
+        so jit never retraces on reuse).  Public so benches and the
+        perf accountant can derive the executed-work correction from
+        the EXACT mask the layer runs."""
+        key = (int(T), int(S))
+        if key not in self._sparse_masks:
+            from ..ops.block_sparse import (pick_block_divisor,
+                                            sliding_window_mask,
+                                            strided_mask)
+
+            target = self.sparse_block or self.block_size
+            b = pick_block_divisor(T, S, target)
+            nq, nk = T // b, S // b
+            if self.sparse_pattern == "strided":
+                m = strided_mask(nq, nk, self.sparse_stride,
+                                 causal=self.causal, block_q=b,
+                                 block_k=b)
+            else:
+                m = sliding_window_mask(nq, nk, self.sparse_window,
+                                        n_global=self.sparse_globals,
+                                        causal=self.causal, block_q=b,
+                                        block_k=b)
+            self._sparse_masks[key] = m
+        return self._sparse_masks[key]
+
     def _attend(self, q, k, v):
+        if self.seq_strategy == "blocksparse":
+            from ..ops.block_sparse import block_sparse_attention
+
+            return block_sparse_attention(
+                q, k, v, self.block_mask(q.shape[2], k.shape[2]),
+                causal=self.causal)
         if self.seq_strategy == "ring":
             return ring_attention(q, k, v, axis_name=self.seq_axis,
                                   causal=self.causal)

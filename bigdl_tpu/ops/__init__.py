@@ -7,5 +7,8 @@ VMEM) and single-pass LayerNorm.  Everything degrades gracefully: on
 non-TPU backends the public wrappers fall back to reference jnp
 implementations, so tests and CPU development need no TPU.
 """
+from .block_sparse import (BlockMask, block_sparse_attention,
+                           block_sparse_matmul, magnitude_block_mask,
+                           sliding_window_mask, strided_mask)
 from .flash_attention import flash_attention
 from .layer_norm import fused_layer_norm

@@ -41,7 +41,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from ._support import pl, pltpu, use_kernel
+from ._support import KernelProbe, pl, pltpu, use_kernel
 
 _LANES = 128  # VMEM scratch lane width (TPU-friendly minor dim)
 _BIG_LSE = 1e30  # lse sentinel for fully-masked rows: exp(s - BIG) == 0
@@ -62,6 +62,91 @@ def _dot(a, b, dims):
                            preferred_element_type=jnp.float32)
 
 
+# --------------------------------------------------------------------------
+# Shared tile machinery — ONE implementation of the online-softmax
+# (m, l, acc) accumulate and the FlashAttention-2 backward tile, used by
+# both the dense-grid flash kernels below and the block-sparse kernels
+# (ops/block_sparse.py), so the two can never drift numerically.
+# --------------------------------------------------------------------------
+
+def _tile_causal_mask(q_start, k_start, block_q: int, block_k: int,
+                      transposed: bool = False):
+    """Boolean causal mask for one score tile at absolute offsets —
+    (bq, bk) for the forward layout, (bk, bq) for the backward's
+    transposed layout.  Offsets may be traced scalars (block indices
+    read from a scalar-prefetch table)."""
+    if transposed:
+        k_pos = k_start + lax.broadcasted_iota(jnp.int32, (block_k, 1), 0)
+        q_pos = q_start + lax.broadcasted_iota(jnp.int32, (1, block_q), 1)
+    else:
+        q_pos = q_start + lax.broadcasted_iota(jnp.int32, (block_q, 1), 0)
+        k_pos = k_start + lax.broadcasted_iota(jnp.int32, (1, block_k), 1)
+    return q_pos >= k_pos
+
+
+def _init_softmax_scratch(m_scr, l_scr, acc_scr):
+    m_scr[...] = jnp.full_like(m_scr, -jnp.inf)
+    l_scr[...] = jnp.zeros_like(l_scr)
+    acc_scr[...] = jnp.zeros_like(acc_scr)
+
+
+def _online_softmax_tile(s, v, m_scr, l_scr, acc_scr):
+    """Fold one (bq, bk) f32 score tile into the running (max, denom,
+    unnormalized output) statistics — the online-softmax accumulate."""
+    m = m_scr[...][:, :1]                             # (bq, 1)
+    l = l_scr[...][:, :1]
+    acc = acc_scr[...]
+    m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+    # guard fully-masked rows: exp(-inf - -inf) would be nan
+    m_safe = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
+    p = jnp.exp(s - m_safe)
+    scale = jnp.where(jnp.isfinite(m), jnp.exp(m - m_safe), 0.0)
+    l_new = l * scale + jnp.sum(p, axis=-1, keepdims=True)
+    acc_new = acc * scale + _dot(p.astype(v.dtype), v, ((1,), (0,)))
+    m_scr[...] = jnp.broadcast_to(m_new, m_scr.shape)
+    l_scr[...] = jnp.broadcast_to(l_new, l_scr.shape)
+    acc_scr[...] = acc_new
+
+
+def _finish_softmax_tile(o_ref, lse_ref, m_scr, l_scr, acc_scr):
+    """Normalize the accumulated output and emit the row log-sum-exp
+    (the backward's softmax statistic); fully-masked rows (l == 0)
+    produce exactly zero output and the ``_BIG_LSE`` sentinel."""
+    m = m_scr[...][:, :1]
+    l = l_scr[...][:, :1]
+    o_ref[0] = (acc_scr[...] / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+    # lse as a ROW (1, bq): broadcast along sublanes in the backward
+    lse = jnp.where(l > 0.0, m + jnp.log(jnp.maximum(l, 1e-30)),
+                    _BIG_LSE)
+    lse_ref[0] = lse[:, 0][None, :]
+
+
+def _bwd_tile_terms(q, do, k, v, lse, delta, sm_scale, st_mask):
+    """The FlashAttention-2 backward tile, transposed layout: recompute
+    the (bk, bq) probability tile from (q, k, lse) and form dSᵀ from
+    the saved delta rows.  Returns (pᵀ, dSᵀ)."""
+    st = _dot(k, q, ((1,), (1,))) * sm_scale          # (bk, bq) f32
+    if st_mask is not None:
+        st = jnp.where(st_mask, st, -jnp.inf)
+    pt = jnp.exp(st - lse)                            # (bk, bq)
+    dpt = _dot(v, do, ((1,), (1,)))                   # (bk, bq)
+    dst = pt * (dpt - delta)
+    return pt, dst
+
+
+def _accum_dkv_tile(q, do, k, v, lse, delta, sm_scale, st_mask,
+                    dk_scr, dv_scr):
+    pt, dst = _bwd_tile_terms(q, do, k, v, lse, delta, sm_scale, st_mask)
+    dv_scr[...] += _dot(pt.astype(v.dtype), do, ((1,), (0,)))  # (bk, d)
+    dk_scr[...] += _dot(dst.astype(q.dtype), q, ((1,), (0,))) * sm_scale
+
+
+def _accum_dq_tile(q, do, k, v, lse, delta, sm_scale, st_mask, dq_scr):
+    pt, dst = _bwd_tile_terms(q, do, k, v, lse, delta, sm_scale, st_mask)
+    # dq += ds @ k — contract the bk (sublane) dim: no transpose
+    dq_scr[...] += _dot(dst.astype(k.dtype), k, ((0,), (0,))) * sm_scale
+
+
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
                 *, sm_scale: float, causal: bool, block_q: int, block_k: int,
                 num_k_blocks: int):
@@ -70,9 +155,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
 
     @pl.when(ki == 0)
     def _init():
-        m_scr[...] = jnp.full_like(m_scr, -jnp.inf)
-        l_scr[...] = jnp.zeros_like(l_scr)
-        acc_scr[...] = jnp.zeros_like(acc_scr)
+        _init_softmax_scratch(m_scr, l_scr, acc_scr)
 
     def compute():
         q = q_ref[0]                                      # (block_q, d)
@@ -80,25 +163,10 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
         v = v_ref[0]
         s = _dot(q, k, (((1,), (1,)))) * sm_scale         # (bq, bk) f32
         if causal:
-            q_pos = (qi * block_q
-                     + lax.broadcasted_iota(jnp.int32, (block_q, 1), 0))
-            k_pos = (ki * block_k
-                     + lax.broadcasted_iota(jnp.int32, (1, block_k), 1))
-            s = jnp.where(q_pos >= k_pos, s, -jnp.inf)
-
-        m = m_scr[...][:, :1]                             # (bq, 1)
-        l = l_scr[...][:, :1]
-        acc = acc_scr[...]
-        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
-        # guard fully-masked rows: exp(-inf - -inf) would be nan
-        m_safe = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
-        p = jnp.exp(s - m_safe)
-        scale = jnp.where(jnp.isfinite(m), jnp.exp(m - m_safe), 0.0)
-        l_new = l * scale + jnp.sum(p, axis=-1, keepdims=True)
-        acc_new = acc * scale + _dot(p.astype(v.dtype), v, ((1,), (0,)))
-        m_scr[...] = jnp.broadcast_to(m_new, m_scr.shape)
-        l_scr[...] = jnp.broadcast_to(l_new, l_scr.shape)
-        acc_scr[...] = acc_new
+            s = jnp.where(_tile_causal_mask(qi * block_q, ki * block_k,
+                                            block_q, block_k),
+                          s, -jnp.inf)
+        _online_softmax_tile(s, v, m_scr, l_scr, acc_scr)
 
     if causal:
         # key blocks strictly above the diagonal contribute nothing
@@ -110,13 +178,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
 
     @pl.when(ki == num_k_blocks - 1)
     def _finish():
-        m = m_scr[...][:, :1]
-        l = l_scr[...][:, :1]
-        o_ref[0] = (acc_scr[...] / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
-        # lse as a ROW (1, bq): broadcast along sublanes in the backward
-        lse = jnp.where(l > 0.0, m + jnp.log(jnp.maximum(l, 1e-30)),
-                        _BIG_LSE)
-        lse_ref[0] = lse[:, 0][None, :]
+        _finish_softmax_tile(o_ref, lse_ref, m_scr, l_scr, acc_scr)
 
 
 def _flash_fwd(q, k, v, causal: bool, sm_scale: float, block_q: int,
@@ -184,27 +246,14 @@ def _dkv_kernel(q_ref, do_ref, k_ref, v_ref, lse_ref, delta_ref,
         dv_scr[...] = jnp.zeros_like(dv_scr)
 
     def compute():
-        q = q_ref[0]                                  # (bq, d)
-        do = do_ref[0]                                # (bq, d)
-        k = k_ref[0]                                  # (bk, d)
-        v = v_ref[0]
-        lse = lse_ref[0]                              # (1, bq) — row bcast
-        delta = delta_ref[0]
         # transposed scores: (bk rows, bq lanes) — lse/delta broadcast
         # along sublanes with no in-kernel transpose
-        st = _dot(k, q, ((1,), (1,))) * sm_scale      # (bk, bq) f32
-        if causal:
-            k_pos = (ki * block_k
-                     + lax.broadcasted_iota(jnp.int32, (block_k, 1), 0))
-            q_pos = (qi * block_q
-                     + lax.broadcasted_iota(jnp.int32, (1, block_q), 1))
-            st = jnp.where(q_pos >= k_pos, st, -jnp.inf)
-        pt = jnp.exp(st - lse)                        # (bk, bq)
-        pt_c = pt.astype(v.dtype)
-        dv_scr[...] += _dot(pt_c, do, ((1,), (0,)))   # (bk, d)
-        dpt = _dot(v, do, ((1,), (1,)))               # (bk, bq)
-        dst = pt * (dpt - delta)
-        dk_scr[...] += _dot(dst.astype(q.dtype), q, ((1,), (0,))) * sm_scale
+        st_mask = _tile_causal_mask(qi * block_q, ki * block_k,
+                                    block_q, block_k,
+                                    transposed=True) if causal else None
+        _accum_dkv_tile(q_ref[0], do_ref[0], k_ref[0], v_ref[0],
+                        lse_ref[0], delta_ref[0], sm_scale, st_mask,
+                        dk_scr, dv_scr)
 
     if causal:
         @pl.when(qi * block_q + block_q - 1 >= ki * block_k)
@@ -231,24 +280,12 @@ def _dq_kernel(q_ref, do_ref, k_ref, v_ref, lse_ref, delta_ref,
         dq_scr[...] = jnp.zeros_like(dq_scr)
 
     def compute():
-        q = q_ref[0]
-        do = do_ref[0]
-        k = k_ref[0]
-        v = v_ref[0]
-        lse = lse_ref[0]
-        delta = delta_ref[0]
-        st = _dot(k, q, ((1,), (1,))) * sm_scale      # (bk, bq)
-        if causal:
-            k_pos = (ki * block_k
-                     + lax.broadcasted_iota(jnp.int32, (block_k, 1), 0))
-            q_pos = (qi * block_q
-                     + lax.broadcasted_iota(jnp.int32, (1, block_q), 1))
-            st = jnp.where(q_pos >= k_pos, st, -jnp.inf)
-        pt = jnp.exp(st - lse)
-        dpt = _dot(v, do, ((1,), (1,)))               # (bk, bq)
-        dst = pt * (dpt - delta)                      # (bk, bq)
-        # dq += ds @ k — contract the bk (sublane) dim: no transpose
-        dq_scr[...] += _dot(dst.astype(k.dtype), k, ((0,), (0,))) * sm_scale
+        st_mask = _tile_causal_mask(qi * block_q, ki * block_k,
+                                    block_q, block_k,
+                                    transposed=True) if causal else None
+        _accum_dq_tile(q_ref[0], do_ref[0], k_ref[0], v_ref[0],
+                       lse_ref[0], delta_ref[0], sm_scale, st_mask,
+                       dq_scr)
 
     if causal:
         @pl.when(ki * block_k <= qi * block_q + block_q - 1)
@@ -405,6 +442,36 @@ def _flash_bwd_rule(causal, sm_scale, interpret, block_q, block_k, res,
 _flash.defvjp(_flash_fwd_rule, _flash_bwd_rule)
 
 
+# --------------------------------------------------------------------------
+# graceful degradation (satellite of the conv3x3 probe): compile the
+# kernel ONCE at first dispatch; a Mosaic failure disables it with one
+# structured warning and the bench records ``attn_kernel_fallback``
+# instead of silently riding the dense reference path
+# --------------------------------------------------------------------------
+
+def _probe_compile():
+    """Compile (not run) fwd+bwd on a tiny representative shape —
+    Mosaic/compile errors surface here, before any real dispatch."""
+    x = jnp.zeros((1, 1, 128, 32), jnp.float32)
+
+    def f(q, k, v):
+        out = _flash(q, k, v, True, 0.25, False, None, None)
+        return jnp.sum(out ** 2)
+
+    jax.jit(jax.grad(f, argnums=(0, 1, 2))).lower(x, x, x).compile()
+
+
+_PROBE = KernelProbe("flash_attention", _probe_compile,
+                     "the dense XLA reference")
+
+
+def attention_fallback_reason():
+    """The error that disabled the flash kernels this process, or None
+    — bench.py folds it into the ``attn_kernel_fallback`` schema
+    field."""
+    return _PROBE.error
+
+
 def flash_attention(q, k, v, causal: bool = False,
                     sm_scale: Optional[float] = None,
                     interpret: bool = False,
@@ -427,7 +494,8 @@ def flash_attention(q, k, v, causal: bool = False,
     def blockable(n):  # one whole block (8-aligned) or a 128-multiple
         return (n % 128 == 0) or (n < 128 and n % 8 == 0)
 
-    if use_kernel(interpret) and blockable(T) and blockable(S):
+    if use_kernel(interpret) and blockable(T) and blockable(S) \
+            and _PROBE.healthy(interpret):
         return _flash(q, k, v, causal, sm_scale, interpret,
                       block_q, block_k)
     return _attention_reference(q, k, v, causal, sm_scale)

@@ -135,7 +135,9 @@ class InferenceServer:
                  policy: Optional[RetryPolicy] = None,
                  metrics: Optional[ServingMetrics] = None,
                  generate_dtype=None, name: Optional[str] = None,
-                 kv_pool=None, role: str = "both"):
+                 kv_pool=None, role: str = "both",
+                 kv_page_window: Optional[int] = None,
+                 kv_page_globals: int = 1):
         from ..optim._sharding_utils import data_mesh
         from .pools import ROLES
 
@@ -151,6 +153,12 @@ class InferenceServer:
         #: instead of a whole static T_max bucket, and pool exhaustion
         #: sheds typed OVERLOADED
         self.kv_pool = kv_pool
+        #: page-granular block mask for long paged decodes (the BLaST
+        #: sparsity story on the serving path): attend only the first
+        #: ``kv_page_globals`` anchor pages + the last
+        #: ``kv_page_window`` pages; None = dense over the page table
+        self.kv_page_window = kv_page_window
+        self.kv_page_globals = int(kv_page_globals)
         if role not in ROLES:
             raise ValueError(f"role {role!r} not in {ROLES}")
         #: which generation phase(s) this replica serves — advertised
@@ -710,7 +718,9 @@ class InferenceServer:
 
         pool = self.kv_pool
         decoder = cached_paged_decoder(
-            self.model, pool, compute_dtype=self.generate_dtype)
+            self.model, pool, compute_dtype=self.generate_dtype,
+            page_window=self.kv_page_window,
+            page_globals=self.kv_page_globals)
         with self._model_lock:
             params = self._params
 

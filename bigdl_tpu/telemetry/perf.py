@@ -168,6 +168,18 @@ class PerfAccountant:
             "collective wire bytes per step NOT moved because sparse "
             "gradient transport replaced the dense all-reduce",
             labels=("program",))
+        self.sparse_flops_skipped_gauge = r.gauge(
+            "bigdl_perf_sparse_flops_skipped",
+            "dense-equivalent MXU FLOPs per step NOT executed because "
+            "block-sparse kernels skipped masked blocks (kernel-"
+            "reported: XLA's cost model cannot see inside Pallas "
+            "custom calls)",
+            labels=("program",))
+        #: kernel-reported sparse corrections per program — the
+        #: uncorrected cost is retained so repeated reports replace,
+        #: never compound
+        self._sparse_flops: Dict[str, dict] = {}
+        self._uncorrected: Dict[str, StepCost] = {}
         self.intensity = r.gauge(
             "bigdl_perf_arithmetic_intensity",
             "flops / bytes accessed of one compiled step",
@@ -254,6 +266,10 @@ class PerfAccountant:
         make it the one ``on_step`` attributes work to."""
         label = str(label)
         self._programs[label] = cost
+        # a fresh analysis supersedes any kernel-reported sparse
+        # correction (the caller re-reports after re-analyzing)
+        self._uncorrected.pop(label, None)
+        self._sparse_flops.pop(label, None)
         self._current = label
         self.flops_per_step.labels(program=label).set(cost.flops)
         self.bytes_per_step.labels(program=label).set(
@@ -268,6 +284,45 @@ class PerfAccountant:
                 cost.arithmetic_intensity)
         self.poll_memory_stats()
         return cost
+
+    def report_sparse_flops(self, label: str, executed_flops: float,
+                            dense_equiv_flops: float) -> Optional[StepCost]:
+        """Kernel-reported effective-FLOPs correction for a program
+        whose Pallas kernels SKIP work the cost model cannot see.
+
+        XLA counts a Pallas call as a zero-FLOP custom call, so a
+        block-sparse kernel's skipped blocks are invisible: without
+        this correction a 2x wall-clock win at 50% density reads as an
+        MFU regression.  The caller (driver/bench — it knows the mask)
+        reports the kernel's ``executed`` FLOPs and the ``dense
+        equivalent``; the program's accounted FLOPs become
+        ``cost-model + executed`` (MFU/model_flops_per_sec rate on
+        EXECUTED work), the dense equivalent is recorded alongside in
+        the payload, and the difference lands in the
+        ``bigdl_perf_sparse_flops_skipped`` gauge.  Repeated reports
+        for one program replace (never compound) the correction."""
+        label = str(label)
+        executed = max(0.0, float(executed_flops))
+        dense_eq = max(executed, float(dense_equiv_flops))
+        base = self._uncorrected.get(label)
+        if base is None:
+            base = self._programs.get(label, StepCost(0.0, 0.0))
+            self._uncorrected[label] = base
+        skipped = dense_eq - executed
+        corrected = base._replace(flops=base.flops + executed)
+        self._programs[label] = corrected
+        self._sparse_flops[label] = {
+            "executed_flops": base.flops + executed,
+            "dense_equivalent_flops": base.flops + dense_eq,
+            "sparse_flops_skipped": skipped,
+        }
+        self.sparse_flops_skipped_gauge.labels(program=label).set(
+            skipped)
+        self.flops_per_step.labels(program=label).set(corrected.flops)
+        if corrected.arithmetic_intensity is not None:
+            self.intensity.labels(program=label).set(
+                corrected.arithmetic_intensity)
+        return corrected
 
     @property
     def current_cost(self) -> Optional[StepCost]:
@@ -364,6 +419,10 @@ class PerfAccountant:
             entry["arithmetic_intensity"] = cost.arithmetic_intensity
             rf = classify_roofline(cost, self.spec)
             entry["bound"] = rf["bound"]
+            # kernel-reported sparse correction: executed-basis flops
+            # with the dense equivalent recorded alongside
+            if label in self._sparse_flops:
+                entry.update(self._sparse_flops[label])
             rate = self._ema_flops_per_sec.get(label)
             if rate is not None:
                 entry["model_flops_per_sec"] = rate

@@ -134,6 +134,60 @@ def test_interleaved_sequences_do_not_interfere():
     assert pool.free_pages == pool.num_pages
 
 
+def test_page_window_covering_arena_matches_dense_exactly():
+    """The page-granular block mask (ISSUE 12): a window wide enough
+    to cover every page a decode can hold is EXACTLY the dense paged
+    path — and the dense paged path is exactly the unpaged stream, so
+    sparse page mask == dense over the same arena, token for token."""
+    model = _model()
+    params = model.param_tree()
+    pool = _pool(model)
+    dec = cached_paged_decoder(model, pool, page_window=8,
+                               page_globals=1)
+    gen = cached_generate(model)
+    rng = np.random.RandomState(8)
+    for T0, max_new in ((5, 12), (3, 16)):
+        prompt = rng.randint(1, VOCAB + 1, (T0,)).astype(np.int32)
+        ref = np.asarray(gen(params, prompt[None], max_new))[0, T0:]
+        seq = dec.start(params, prompt)
+        toks = [seq.last]
+        for _ in range(max_new - 1):
+            toks.append(dec.step(params, seq))
+        seq.release()
+        np.testing.assert_array_equal(np.asarray(toks), ref)
+    assert pool.free_pages == pool.num_pages
+
+
+def test_page_window_binding_skips_dead_pages_and_frees():
+    """A window that actually binds: the decode keeps attending only
+    the anchor + last-W pages (per-token gather is W+G pages, not the
+    whole bucket), every emitted token stays a valid id, and the
+    lease drains clean.  The windowed stream must still agree with
+    the dense stream while the decode fits inside window+globals —
+    divergence is only legal after the mask starts dropping pages."""
+    model = _model()
+    params = model.param_tree()
+    pool = _pool(model)
+    dense = cached_paged_decoder(model, pool)
+    win = cached_paged_decoder(model, pool, page_window=2,
+                               page_globals=1)
+    rng = np.random.RandomState(9)
+    prompt = rng.randint(1, VOCAB + 1, (4,)).astype(np.int32)
+    sd, sw = dense.start(params, prompt), win.start(params, prompt)
+    td, tw = [sd.last], [sw.last]
+    for _ in range(20):
+        td.append(dense.step(params, sd))
+        tw.append(win.step(params, sw))
+    sd.release(), sw.release()
+    # identical while the sequence fits in (window+globals) pages =
+    # 12 positions (prompt 4 + first 8 decodes)
+    agree = 12 - len(prompt)
+    np.testing.assert_array_equal(np.asarray(tw[:agree]),
+                                  np.asarray(td[:agree]))
+    assert all(1 <= t <= VOCAB for t in tw)
+    assert pool.free_pages == pool.num_pages
+
+
 def test_page_table_reuse_compiles_once_per_bucket():
     """Long decode crossing several page buckets: the decode jit cache
     holds at most one entry per page-count bucket ever used, and a

@@ -580,6 +580,31 @@ def test_sentinel_skips_backend_mismatch(tmp_path):
     assert result["status"] == "skipped"
 
 
+def test_sentinel_null_direction_attn_fallback(tmp_path):
+    """The must-be-null invariant (ISSUE 12): a record whose flash/
+    block-sparse kernels fell back to the dense path carries the
+    probe's error in ``attn_kernel_fallback`` — the sentinel must FAIL
+    it (the dead-conv failure mode: numbers silently riding the
+    fallback), and pass records where the field stays null."""
+    bench, sentinel = _bench(), _sentinel()
+    bad = _fake_result(
+        attn_kernel_fallback="MosaicError: lowering failed")
+    ledger, baseline = _write_fixtures(tmp_path, bench, sentinel,
+                                       _fake_result(), bad)
+    assert sentinel.main(["--check", "--ledger", ledger,
+                          "--baseline", baseline]) == 1
+    result = sentinel.compare(bench.ledger_record(bad),
+                              sentinel.read_baseline(baseline))
+    failed = [c for c in result["checks"] if c["status"] == "fail"]
+    assert any(c["metric"] == "attn_kernel_fallback" for c in failed)
+    # healthy kernels (field null) pass
+    ok_ledger, ok_baseline = _write_fixtures(tmp_path, bench, sentinel,
+                                             _fake_result(),
+                                             _fake_result())
+    assert sentinel.main(["--check", "--ledger", ok_ledger,
+                          "--baseline", ok_baseline]) == 0
+
+
 def test_sentinel_cli_exit_codes(tmp_path):
     """The committed-fixture CI contract, via the real CLI."""
     bench, sentinel = _bench(), _sentinel()

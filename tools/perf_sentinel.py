@@ -97,6 +97,17 @@ DEFAULT_TOLERANCES = {
     # — a rise means the sparse wire silently stopped engaging
     "dlrm_steps_per_sec": ("higher", 0.50),
     "dlrm_collective_bytes_per_step": ("lower", 0.25),
+    # block-sparse kernels (ISSUE 12): the T4096 executed-basis MFU
+    # may only rise (null until the next TPU window measures it); the
+    # speedup multiple is the measured wall ratio on TPU and the
+    # deterministic executed-work reduction on the CPU leg — either
+    # way a fall means the kernels silently stopped skipping; and a
+    # TPU record whose flash/block-sparse kernels fell back to the
+    # dense path must FAIL, not quietly ride the fallback (the exact
+    # failure mode that hid the dead conv kernel for 4 releases)
+    "blocksparse_t4096_mfu": ("higher", 0.10),
+    "blocksparse_speedup_x": ("higher", 0.25, 0.2),
+    "attn_kernel_fallback": ("null", 0.0),
 }
 
 
@@ -150,6 +161,22 @@ def compare(record: dict, baseline: dict) -> dict:
         abs_tol = spec[2] if len(spec) > 2 else 0.0
         base = base_rec.get(name)
         cur = record.get(name)
+        if direction == "null":
+            # invariant field: must be null/absent on every record —
+            # a value IS the regression (e.g. attn_kernel_fallback: a
+            # populated fallback reason means the Pallas kernels died
+            # and the numbers silently ride the dense path)
+            check = {"metric": name, "baseline": None, "current": cur,
+                     "direction": direction, "rel_tol": 0.0}
+            if cur in (None, "", False):
+                check["status"] = "pass"
+            else:
+                check.update(status="fail",
+                             reason="%s must be null, got %r"
+                                    % (name, cur))
+                failures += 1
+            checks.append(check)
+            continue
         if base is None or not isinstance(base, (int, float)):
             continue  # baseline never measured it: nothing to guard
         check = {"metric": name, "baseline": base, "current": cur,
@@ -259,10 +286,15 @@ def main(argv=None) -> int:
         else:
             for c in result["checks"]:
                 mark = "FAIL" if c["status"] == "fail" else " ok "
-                print("[%s] %-34s base=%-12g cur=%-12s %s" % (
-                    mark, c["metric"], c["baseline"],
+                base = c["baseline"]
+                print("[%s] %-34s base=%-12s cur=%-12s %s" % (
+                    mark, c["metric"],
+                    ("%g" % base) if isinstance(base, (int, float))
+                    else "null",
                     ("%g" % c["current"]) if isinstance(
-                        c.get("current"), (int, float)) else "missing",
+                        c.get("current"), (int, float))
+                    else ("null" if c["direction"] == "null"
+                          and c.get("current") is None else "missing"),
                     c.get("reason", "")))
             print("perf-sentinel: %s (%d checked, %d failed)"
                   % (result["status"].upper(), len(result["checks"]),
