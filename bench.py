@@ -1128,6 +1128,15 @@ def _fleet_measurements(n_replicas: int = 4, rate_rps: float = 500.0,
            "zipf_a": zipf_a, "rate_rps": rate_rps,
            "deadline_s": deadline_s}
 
+    # -- pass 0: distributed request tracing — overhead + coverage.
+    # Runs FIRST: the overhead A/B needs the fresh process heap (the
+    # open-loop passes below leave fleets' worth of garbage that
+    # inflates gen2 GC scans exactly on the allocation-heavier traced
+    # legs).
+    out["trace"] = _fleet_trace_pass(features=features, users=users)
+    out["trace_overhead_pct"] = out["trace"]["overhead_pct"]
+    out["trace_p99_coverage"] = out["trace"]["p99_coverage"]
+
     # -- pass 1: steady un-hedged + replica kill mid-load ------------
     fleet = build(hedge=False)
     try:
@@ -1190,6 +1199,116 @@ def _fleet_measurements(n_replicas: int = 4, rate_rps: float = 500.0,
     return out
 
 
+def _fleet_trace_pass(features, users,
+                      serial_n: int = 200, repeats: int = 5):
+    """The traced fleet pass: (1) tracing overhead — ONE fleet,
+    alternating A/B legs with the RequestTracer detached/attached
+    (between-process fleet noise on the 1-core box dwarfs the
+    per-request cost; within one process back-to-back legs agree to
+    ~µs), min-of-repeats closed-loop serial latency; (2) per-request
+    trace coverage — an open-loop burst on the same fleet with the
+    sampler budget opened wide, every kept request stitched
+    cross-replica and its span-union coverage of the observed wall
+    clock computed (the p99 cohort's mean is the ledger metric)."""
+    from bigdl_tpu import nn
+    from bigdl_tpu.serving import (ServingFleet, trace_attribution,
+                                   trace_coverage)
+
+    feature_dim = features.shape[1]
+    model = nn.Sequential(nn.Linear(feature_dim, 128), nn.Tanh(),
+                          nn.Linear(128, 10), nn.LogSoftMax())
+
+    def serial_wall(fleet):
+        t0 = time.perf_counter()
+        for i in range(serial_n):
+            fleet.submit(features[i % users]).result(timeout=120)
+        return time.perf_counter() - t0
+
+    out = {}
+    # the overhead legs run the REALISTIC sampler (tail keeps trouble
+    # + a bounded OK budget; dropped traces cost zero span records
+    # router-side and never touch the transport under publish-on-keep)
+    fleet = ServingFleet.build(
+        model, n_replicas=2,
+        server_kw=dict(max_batch=8, max_queue=128),
+        heartbeat_timeout=0.4, tracing=True,
+        trace_kw=dict(keep_per_s=20.0, burst=20.0),
+        router_kw=dict(default_deadline_s=10.0))
+    fleet.start()
+    try:
+        fleet.submit(features[0]).result(timeout=120)  # warm compiles
+        tracer = fleet.router.tracing
+        # pin the pre-existing heap (jax caches, compiled programs)
+        # out of the collector: gen2 scans over it would tax the
+        # allocation-heavier traced legs for garbage that is not theirs
+        import gc
+        import statistics
+
+        gc.collect()
+        gc.freeze()
+        deltas, plains = [], []
+        for rep in range(repeats):
+            # alternate leg order per repeat: any monotonic drift of
+            # the box (thermal / cgroup throttle) biases whichever
+            # side always runs second — median of paired deltas over
+            # both orders cancels it
+            order = (False, True) if rep % 2 == 0 else (True, False)
+            pair = {}
+            for traced in order:
+                fleet.router.tracing = tracer if traced else None
+                pair[traced] = serial_wall(fleet)
+            fleet.router.tracing = tracer
+            deltas.append(pair[True] - pair[False])
+            plains.append(pair[False])
+        gc.unfreeze()
+        # clamp at 0: a negative median is the noise floor, and a
+        # negative frozen baseline would arm the "lower" sentinel
+        # against pure jitter
+        out["overhead_pct"] = round(max(
+            0.0, statistics.median(deltas)
+            / statistics.median(plains) * 100.0), 2)
+        out["serial_n"] = serial_n
+        # coverage burst: keep EVERYTHING from here on so every
+        # request of the slab stitches
+        from bigdl_tpu.telemetry.trace_context import TailSampler
+
+        fleet.tracing.sampler = TailSampler(keep_per_s=1e6, burst=1e6)
+        # coverage burst: a concurrent slab so batches coalesce like
+        # live traffic, every request kept (budget opened wide above)
+        futs = [fleet.submit(features[i % users])
+                for i in range(200)]
+        res = [f.result(timeout=120) for f in futs]
+        kept = fleet.kept_traces()
+        covers = []
+        for k in kept:
+            t = fleet.stitch_trace(k["trace_id"])
+            if t is None:
+                continue
+            c = trace_coverage(t)
+            if c is not None:
+                covers.append((k["latency_s"], c, t))
+        covers.sort()
+        out["sampled"] = len(kept)
+        out["stitched"] = len(covers)
+        out["all_resolved_typed"] = all(
+            r.status is not None for r in res)
+        if covers:
+            p99_idx = int(0.99 * (len(covers) - 1))
+            cohort = covers[p99_idx:]
+            out["p99_coverage"] = round(
+                sum(c for _, c, _ in cohort) / len(cohort), 4)
+            out["coverage_min"] = round(min(c for _, c, _ in covers),
+                                        4)
+            attr = trace_attribution(cohort[-1][2])
+            out["p99_critical_phase"] = attr["critical_phase"]
+        else:
+            out["p99_coverage"] = None
+        out["sampler"] = fleet.tracing.sampler.snapshot()
+    finally:
+        fleet.stop(timeout=30)
+    return out
+
+
 def run_fleet_bench() -> None:
     """--fleet mode: open-loop Zipf load over the 4-replica fleet on
     CPU (control-plane numbers), write SERVING_r02.json, print the one
@@ -1217,6 +1336,205 @@ def run_fleet_bench() -> None:
     except OSError:
         pass
     print(json.dumps(out), flush=True)
+
+
+# --------------------------------------------------------------------------
+# Trace chaos leg: hedged + retried + kill-mid-decode, every sampled
+# request stitched cross-replica (the ISSUE 13 acceptance artifact)
+# --------------------------------------------------------------------------
+
+TRACE_TIMEOUT = float(os.environ.get("BENCH_TRACE_TIMEOUT", "420"))
+TRACE_RESULT = "TRACE_r01.json"
+
+
+def _trace_chaos_measurements(vocab: int = 23, t_max: int = 32,
+                              prompt_len: int = 5):
+    """The distributed-tracing chaos bar: a 4-replica disaggregated
+    fleet (2 prefill + 2 decode, tracing on, keep-everything sampler)
+    absorbs hedged prefills, a retried prefill, and a decode replica
+    killed mid-stream — then every sampled request's stitched
+    cross-replica trace is checked for wall-clock coverage, the hedge
+    winner/loser and the replayed decode attempt are located as
+    labeled spans, and the p99 cohort's critical-path phase is named.
+    """
+    import numpy as np
+
+    from bigdl_tpu.models.transformer import TransformerLM
+    from bigdl_tpu.resilience import faults
+    from bigdl_tpu.serving import ServingFleet, trace_coverage
+    from bigdl_tpu.utils.rng import RNG
+
+    RNG().set_seed(4)
+    model = TransformerLM(vocab, embed_dim=16, num_heads=2,
+                          mlp_dim=32, num_layers=1, max_len=t_max)
+    fleet = ServingFleet.build(
+        model, n_replicas=4,
+        roles=("prefill", "prefill", "decode", "decode"),
+        kv_pages=32, kv_page_size=4, server_kw=dict(max_batch=8),
+        heartbeat_timeout=0.4, pump_interval_s=0.05,
+        tracing=True, trace_kw=dict(keep_per_s=1e6, burst=1e6),
+        router_kw=dict(default_deadline_s=60.0, disaggregate=True,
+                       hedge=True, hedge_delay_s=0.05))
+    fleet.start()
+    out = {"n_replicas": 4,
+           "roles": ["prefill", "prefill", "decode", "decode"]}
+    rng = np.random.RandomState(0)
+    prompts = [rng.randint(1, vocab + 1,
+                           (prompt_len,)).astype(np.int32)
+               for _ in range(4)]
+    try:
+        # warm every pool's compiled programs (hedge/kill must land on
+        # decode work, not compile walls)
+        for p in prompts[:2]:
+            r = fleet.submit_generate(p, max_new=4).result(300)
+            assert r.ok, (r.status, r.error)
+
+        results = []
+        # -- hedged: the primary prefill goes slow, the duplicate on
+        # the other prefill replica wins; the loser's span must close
+        # hedge_outcome=lost at discard
+        with faults.delay_replica("r0", 0.4, times=2):
+            results.append(
+                fleet.submit_generate(prompts[0],
+                                      max_new=6).result(300))
+        # -- retried: one prefill step failure → retry on the other
+        # prefill replica with the remaining budget
+        with faults.serving_step_failures(times=1, server="r0"):
+            results.append(
+                fleet.submit_generate(prompts[1],
+                                      max_new=6).result(300))
+        # -- kill mid-decode: slow the decode pool, find the replica
+        # actually streaming, kill it — the retained handoff replays
+        # on the survivor inside the same trace
+        killed = None
+        with faults.serving_step_latency(0.05, times=1 << 10):
+            fut = fleet.submit_generate(prompts[2], max_new=20)
+            deadline = time.monotonic() + 10
+            while killed is None and time.monotonic() < deadline:
+                snap = fleet.router.snapshot()
+                for rid in ("r2", "r3"):
+                    if snap["inflight"].get(rid, 0) > 0:
+                        killed = rid
+                        break
+                time.sleep(0.02)
+            if killed is not None:
+                with faults.kill_replica(killed):
+                    k_deadline = time.monotonic() + 15
+                    while fleet.servers[killed].healthy() \
+                            and time.monotonic() < k_deadline:
+                        time.sleep(0.02)
+            results.append(fut.result(300))
+        out["killed_replica"] = killed
+        # -- background OK traffic for the p99 cohort
+        for i in range(6):
+            results.append(
+                fleet.submit_generate(prompts[i % 4],
+                                      max_new=4).result(300))
+
+        out["offered"] = len(results)
+        out["ok"] = sum(1 for r in results if r.ok)
+        out["all_resolved_typed"] = all(
+            r.status is not None for r in results)
+
+        kept = fleet.kept_traces()
+        stitched = {}
+        covers = []
+        for k in kept:
+            t = fleet.stitch_trace(k["trace_id"])
+            if t is None:
+                continue
+            stitched[k["trace_id"]] = t
+            c = trace_coverage(t)
+            if c is not None:
+                covers.append(c)
+        out["sampled"] = len(kept)
+        out["stitched"] = len(stitched)
+        out["coverage_min"] = round(min(covers), 4) if covers else None
+        out["coverage_mean"] = round(sum(covers) / len(covers), 4) \
+            if covers else None
+
+        def spans(t, cat=None):
+            return [e for e in t["traceEvents"]
+                    if e.get("ph") == "X"
+                    and (cat is None or e.get("cat") == cat)]
+
+        # hedge winner + loser are distinct labeled spans in ONE trace
+        hedge_ok = False
+        for t in stitched.values():
+            outcomes = {(e["args"].get("hedge_outcome"))
+                        for e in spans(t, "attempt")}
+            if {"won", "lost"} <= outcomes:
+                hedge_ok = True
+                break
+        out["hedge_winner_loser_labeled"] = hedge_ok
+        # the killed decode shows up as a failed attempt + the
+        # replayed survivor attempt in the same stitched trace
+        replay_ok = False
+        for t in stitched.values():
+            dec = [e for e in spans(t, "attempt")
+                   if e["args"].get("kind") == "decode"]
+            statuses = {e["args"].get("status") for e in dec}
+            replicas = {e["args"].get("replica") for e in dec}
+            if len(dec) >= 2 and len(replicas) >= 2 \
+                    and "ok" in statuses \
+                    and any(s not in ("ok", None) for s in statuses):
+                replay_ok = True
+                break
+        out["replayed_decode_labeled"] = replay_ok
+
+        from tools.trace_report import analyze
+
+        report = analyze(stitched)
+        out["p99_cohort"] = report["p99_cohort"]
+        out["sampler"] = fleet.tracing.sampler.snapshot()
+        # the artifact carries a few exemplar stitched traces: the
+        # hedged one, the replayed one, and the slowest
+        keep_ids = []
+        for pred in (lambda t: {"won", "lost"} <= {
+                         e["args"].get("hedge_outcome")
+                         for e in spans(t, "attempt")},
+                     lambda t: any(
+                         e["args"].get("kind") == "decode"
+                         and e["args"].get("status")
+                         not in ("ok", None)
+                         for e in spans(t, "attempt"))):
+            for tid, t in stitched.items():
+                if pred(t) and tid not in keep_ids:
+                    keep_ids.append(tid)
+                    break
+        out["traces"] = {tid: stitched[tid] for tid in keep_ids[:4]}
+    finally:
+        fleet.stop(timeout=30)
+    return out
+
+
+def run_trace_bench() -> None:
+    """--trace mode: the distributed-tracing chaos run on CPU, write
+    TRACE_r01.json, print the one JSON line (traces themselves stay in
+    the artifact, not on stdout)."""
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    out = {"bench": "trace", "backend": "cpu",
+           "measured_at": _utc_now()}
+    try:
+        out.update(_trace_chaos_measurements())
+        out.update({
+            "metric": "stitched trace coverage (min)",
+            "value": out.get("coverage_min") or 0.0,
+            "unit": "fraction",
+        })
+    except Exception as e:
+        out["error"] = f"{type(e).__name__}: {e}"[:500]
+        out.update({"metric": "stitched trace coverage (min)",
+                    "value": 0.0, "unit": "fraction"})
+    try:
+        with open(os.path.join(_here(), TRACE_RESULT), "w") as f:
+            json.dump(out, f, indent=1)
+    except OSError:
+        pass
+    print(json.dumps({k: v for k, v in out.items()
+                      if k != "traces"}), flush=True)
 
 
 # --------------------------------------------------------------------------
@@ -2742,6 +3060,7 @@ LEDGER_FIELDS = (
     "serving_p99_ms", "serving_p50_ms",
     "fleet_p99_ms", "fleet_hedged_p99_ms", "fleet_shed_rate",
     "fleet_goodput_per_chip", "fleet_recovery_s",
+    "trace_overhead_pct", "trace_p99_coverage",
     "disagg_ttft_p99_ms", "disagg_tpot_p99_ms",
     "disagg_paged_concurrency_x", "disagg_shed_rate",
     "elastic_recovery_s",
@@ -2773,6 +3092,12 @@ def ledger_record(result: dict) -> dict:
     flat["fleet_shed_rate"] = fleet.get("shed_rate")
     flat["fleet_goodput_per_chip"] = fleet.get("goodput_per_chip_flops")
     flat["fleet_recovery_s"] = fleet.get("recovery_s")
+    # the distributed-tracing pass (ISSUE 13): traced-vs-untraced
+    # overhead may only fall (abs floor absorbs scheduler jitter) and
+    # the p99 cohort's stitched coverage may only rise — a fall means
+    # replicas silently stopped publishing their fragments
+    flat["trace_overhead_pct"] = fleet.get("trace_overhead_pct")
+    flat["trace_p99_coverage"] = fleet.get("trace_p99_coverage")
     # the disagg leg (ISSUE 11): TTFT/TPOT may only fall, the paged
     # concurrency multiple may only rise, shed under the ramp may only
     # fall — tools/perf_sentinel.py guards the direction
@@ -3115,6 +3440,8 @@ def main(ledger: bool = True, probe: bool = True) -> None:
                 "goodput_per_chip_flops": fres.get(
                     "goodput_per_chip_flops"),
                 "recovery_s": fres.get("recovery_s"),
+                "trace_overhead_pct": fres.get("trace_overhead_pct"),
+                "trace_p99_coverage": fres.get("trace_p99_coverage"),
                 "source": FLEET_RESULT,
             }
         else:
@@ -3359,6 +3686,7 @@ if __name__ == "__main__":
     p.add_argument("--probe", action="store_true")
     p.add_argument("--serving", action="store_true")
     p.add_argument("--fleet", action="store_true")
+    p.add_argument("--trace", action="store_true")
     p.add_argument("--disagg", action="store_true")
     p.add_argument("--elastic", action="store_true")
     p.add_argument("--integrity", action="store_true")
@@ -3384,6 +3712,8 @@ if __name__ == "__main__":
         run_serving_bench()
     elif a.fleet:
         run_fleet_bench()
+    elif a.trace:
+        run_trace_bench()
     elif a.disagg:
         run_disagg_bench()
     elif a.elastic:

@@ -68,6 +68,8 @@ from .fleet import FleetQuorumError, ReplicaAgent, ServingFleet
 from .kvpool import KVPagePool, PageLease, PoolExhausted
 from .metrics import ServingMetrics
 from .pools import HandoffCorrupt
+from .request_trace import (ReplicaTraceSink, RequestTracer,
+                            trace_attribution, trace_coverage)
 from .router import FleetRouter
 from .server import InferenceServer
 from .status import ServeFuture, ServeResult, Status
@@ -77,7 +79,9 @@ __all__ = [
     "AutoscalePolicy", "Autoscaler", "CircuitBreaker",
     "FleetQuorumError", "FleetRouter", "HandoffCorrupt",
     "InferenceServer", "KVPagePool", "MicroBatcher", "PageLease",
-    "PoolExhausted", "ReplicaAgent", "ServeFuture", "ServeResult",
+    "PoolExhausted", "ReplicaAgent", "ReplicaTraceSink",
+    "RequestTracer", "ServeFuture", "ServeResult",
     "ServingFleet", "ServingMetrics", "Status",
     "load_verified_params", "set_compile_cache_dir",
+    "trace_attribution", "trace_coverage",
 ]

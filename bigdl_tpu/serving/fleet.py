@@ -161,6 +161,8 @@ class ServingFleet:
                  pump_interval_s: Optional[float] = None,
                  ready_quorum: Optional[int] = None,
                  router_kw: Optional[dict] = None,
+                 tracing: bool = False,
+                 trace_kw: Optional[dict] = None,
                  clock: Callable[[], float] = time.monotonic):
         if not servers:
             raise ValueError("a fleet needs at least one replica")
@@ -187,12 +189,79 @@ class ServingFleet:
         coordinator.bootstrap(sorted(self.servers))
         router_kw = dict(router_kw or {})
         router_kw.setdefault("clock", clock)
+        # distributed request tracing: one router-side RequestTracer
+        # (context minting, tail sampling, stitching) + one
+        # ReplicaTraceSink bound into every replica, publishing
+        # fragments under trc/<incarnation>/<trace_id>/<host> on the
+        # SAME KV transport membership rides
+        if tracing and "tracing" not in router_kw:
+            from ..telemetry.trace_context import TailSampler
+            from .request_trace import RequestTracer
+
+            trace_kw = dict(trace_kw or {})
+            sampler = trace_kw.pop("sampler", None) or TailSampler(
+                **{k: trace_kw.pop(k) for k in
+                   ("keep_per_s", "burst", "ok_prob")
+                   if k in trace_kw})
+            tracer = RequestTracer(
+                transport=self.transport,
+                incarnation_of=lambda c=coordinator: c.membership()[0],
+                sampler=sampler, clock=clock, **trace_kw)
+            # publish-on-keep: replica fragments stay buffered until
+            # the router's TAIL decision keeps the trace — dropped
+            # traces never touch the transport (the <=3% overhead
+            # budget), errors/hedges/retries always publish
+            tracer.on_keep = self._publish_kept_trace
+            router_kw["tracing"] = tracer
+            for rid, srv in self.servers.items():
+                if srv.trace_sink is None:
+                    srv.trace_sink = self._make_sink(rid)
         self.router = FleetRouter(self.servers, coordinator,
                                   **router_kw)
         self.deploys = 0
         self.deploy_rollbacks = 0
         self._pump_thread: Optional[threading.Thread] = None
         self._stop_pump = threading.Event()
+
+    def _make_sink(self, rid: str):
+        """One replica's trace sink, incarnation-stamped by its agent
+        (fragments published under a dead membership still stitch —
+        the reader scans across incarnations).  Lazy publishing: the
+        router's keep decision pulls the fragment."""
+        from .request_trace import ReplicaTraceSink
+
+        agent = self.agents.get(rid)
+        return ReplicaTraceSink(
+            rid, transport=self.transport,
+            incarnation_of=(lambda a=agent: (a._acked or 0))
+            if agent is not None else None,
+            eager_publish=False, clock=self._clock)
+
+    def _publish_kept_trace(self, trace_id: str):
+        for srv in list(self.servers.values()):
+            sink = getattr(srv, "trace_sink", None)
+            if sink is not None:
+                sink.publish_trace(trace_id)
+
+    @property
+    def tracing(self):
+        """The router-side RequestTracer (None when tracing is off)."""
+        return self.router.tracing
+
+    def kept_traces(self):
+        return self.router.tracing.kept_traces() \
+            if self.router.tracing is not None else []
+
+    def stitch_trace(self, trace_id: str, skew=None):
+        """One kept request's cross-replica Perfetto timeline (replica
+        sinks flushed first so freshly resolved fragments are
+        visible)."""
+        if self.router.tracing is None:
+            return None
+        sinks = [srv.trace_sink for srv in self.servers.values()
+                 if getattr(srv, "trace_sink", None) is not None]
+        return self.router.tracing.stitch(trace_id, skew=skew,
+                                          flush_sinks=sinks)
 
     @classmethod
     def build(cls, model, n_replicas: int = 4, transport=None,
@@ -264,6 +333,9 @@ class ServingFleet:
         ok = True
         for srv in list(self.servers.values()):
             ok = srv.stop(timeout=timeout) and ok
+            sink = getattr(srv, "trace_sink", None)
+            if sink is not None:
+                sink.close()
         return ok
 
     # ------------------------------------------------------------ routing
@@ -301,6 +373,9 @@ class ServingFleet:
                              heartbeat_timeout=self.heartbeat_timeout,
                              clock=self._clock)
         self.agents[rid] = agent
+        if self.router.tracing is not None \
+                and server.trace_sink is None:
+            server.trace_sink = self._make_sink(rid)
         self.router.add_replica(rid, server)
         agent.pump()            # beats with rejoin=True
         self.router.refresh()   # ... and is re-admitted here

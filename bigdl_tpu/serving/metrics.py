@@ -114,15 +114,44 @@ class ServingMetrics:
         self._t_first_batch: Optional[float] = None
         self._t_last_batch: Optional[float] = None
         self.counts: Dict[str, int] = {s.value: 0 for s in Status}
+        # amortized p99 for per-request consumers (tail sampler, hedge
+        # delay): the exact-window quantile sorts up to `window`
+        # samples — at request rate that is an O(n log n) tax per
+        # request, so hot-path readers get a value recomputed every
+        # `_P99_REFRESH` observations instead
+        self._p99_cache: Optional[float] = None
+        self._p99_cache_count = -1
+
+    _P99_REFRESH = 64
+
+    def latency_p99(self) -> Optional[float]:
+        """The OK-latency p99, recomputed at most every
+        ``_P99_REFRESH`` observations — the hot-path spelling of
+        ``snapshot()["latency_p99_s"]`` (which stays exact)."""
+        count = self._lat.count
+        with self._lock:
+            if count - self._p99_cache_count < self._P99_REFRESH \
+                    and self._p99_cache_count >= 0:
+                return self._p99_cache
+        p99 = self._lat.quantile(0.99)
+        with self._lock:
+            self._p99_cache = p99
+            self._p99_cache_count = count
+        return p99
 
     # ------------------------------------------------------------------
     def record(self, status: Status, latency_s: float = 0.0,
-               queued_s: float = 0.0):
+               queued_s: float = 0.0,
+               trace_id: Optional[str] = None):
+        """One terminal request outcome.  ``trace_id`` (a KEPT
+        distributed trace) attaches as a Prometheus-style exemplar to
+        the latency bucket the request landed in — the scraped
+        histogram links straight to a stitched timeline."""
         with self._lock:
             self.counts[status.value] += 1
         self._requests.labels(status=status.value).inc()
         if status is Status.OK:
-            self._lat.observe(latency_s)
+            self._lat.observe(latency_s, exemplar=trace_id)
             self._queued.observe(queued_s)
 
     def record_depth(self, depth: int):

@@ -109,6 +109,7 @@ class FleetRouter:
                  breaker_factory: Optional[Callable[[], CircuitBreaker]]
                  = None,
                  max_workers: int = 16,
+                 tracing=None,
                  clock: Callable[[], float] = time.monotonic):
         self.replicas = dict(replicas)
         self.coordinator = coordinator
@@ -131,6 +132,12 @@ class FleetRouter:
         #: pool under the same deadline-budget/retry/breaker machinery
         self.disaggregate = bool(disaggregate)
         self._breaker_factory = breaker_factory or CircuitBreaker
+        #: distributed request tracing (serving.request_trace
+        #: .RequestTracer): mints a TraceContext per request, records
+        #: the root/attempt spans, tail-samples at completion and
+        #: stitches kept traces from the replicas' KV fragments.
+        #: None = tracing off, zero per-request cost.
+        self.tracing = tracing
         self._clock = clock
         self._lock = threading.Lock()
         # optimistic until the first refresh: every configured replica
@@ -315,10 +322,22 @@ class FleetRouter:
             if serves_phase((health.get(r) or {}).get("role"), phase)))
 
     def _resolve(self, fut: ServeFuture, result: ServeResult,
-                 t0: float):
+                 t0: float, trace=None):
         result.latency_s = self._clock() - t0
-        self.metrics.record(result.status, result.latency_s,
-                            result.queued_s)
+        kept = None
+        if trace is not None:
+            # tail sampling runs HERE, when the outcome is known: the
+            # p99 reference excludes this sample (it is about to land;
+            # amortized-cached — an exact sort per request would tax
+            # the hot path O(window log window))
+            p99 = self.metrics.latency_p99()
+            kept = self.tracing.finish(
+                trace, result.status.value,
+                result.status is Status.OK, result.latency_s, p99)
+            result.trace_id = trace.ctx.trace_id
+        self.metrics.record(
+            result.status, result.latency_s, result.queued_s,
+            trace_id=(result.trace_id if kept else None))
         fut._resolve(result)
 
     def submit(self, feature,
@@ -348,19 +367,25 @@ class FleetRouter:
             self._resolve(fut, ServeResult(
                 Status.UNAVAILABLE, error="router closed"), now)
             return fut
+        # the TraceContext is minted HERE — at submit, before any
+        # dispatch — so router-pool wait is part of the trace too
+        trace = self.tracing.begin(kind, deadline_s) \
+            if self.tracing is not None else None
         drive = self._drive
         if kind == "generate" and self.disaggregate:
             drive = self._drive_disagg
         try:
             self._pool.submit(drive, kind, payload, opts,
-                              deadline, fut, now)
+                              deadline, fut, now, trace)
         except RuntimeError:  # closed between the check and the submit
             self._resolve(fut, ServeResult(
-                Status.UNAVAILABLE, error="router closed"), now)
+                Status.UNAVAILABLE, error="router closed"), now,
+                trace)
         return fut
 
     def _dispatch(self, replica: str, kind, payload, opts,
-                  remaining: Optional[float]) -> ServeFuture:
+                  remaining: Optional[float],
+                  trace=None) -> ServeFuture:
         with self._lock:
             client = self.replicas.get(replica)
             if client is None:
@@ -390,22 +415,28 @@ class FleetRouter:
                 self._dispatch_total.labels(
                     replica=_replica, status=res.status.value).inc()
 
+        # the forked context rides the dispatch only when tracing is
+        # on — untraced dispatch keeps the pre-trace call signature
+        # (third-party replica stubs need not know the kwarg)
+        tkw = {} if trace is None else {"trace": trace.to_wire()}
         try:
             if kind == "classify":
-                inner = client.submit(payload, deadline_s=remaining)
+                inner = client.submit(payload, deadline_s=remaining,
+                                      **tkw)
             elif kind == "prefill":
                 inner = client.submit_prefill(payload,
-                                              deadline_s=remaining)
+                                              deadline_s=remaining,
+                                              **tkw)
             elif kind == "decode":
                 max_new, eos_id, pad_id = opts
                 inner = client.submit_decode(
                     payload, max_new, eos_id=eos_id, pad_id=pad_id,
-                    deadline_s=remaining)
+                    deadline_s=remaining, **tkw)
             else:
                 max_new, eos_id, pad_id = opts
                 inner = client.submit_generate(
                     payload, max_new, eos_id=eos_id, pad_id=pad_id,
-                    deadline_s=remaining)
+                    deadline_s=remaining, **tkw)
         except Exception as e:
             # a submit() that raises (malformed request, stopped
             # handle) resolves typed instead of leaking out of the
@@ -425,20 +456,26 @@ class FleetRouter:
     def _hedge_delay(self) -> float:
         if self.hedge_delay_s is not None:
             return float(self.hedge_delay_s)
-        p99 = self.metrics._lat.quantile(0.99)
+        # amortized-cached p99 (metrics.latency_p99): the exact-window
+        # quantile sorts up to 8192 samples — per-dispatch that tax
+        # compounds exactly on the latency path hedging exists to cut
+        p99 = self.metrics.latency_p99()
         if p99 is None or p99 <= 0:
             return self.hedge_default_delay_s
         return max(self.hedge_min_delay_s, float(p99))
 
     def _await_first_usable(self, pending: Dict[str, ServeFuture],
                             deadline: Optional[float],
-                            hedge_replica: Optional[str]
+                            hedge_replica: Optional[str],
+                            on_result=None
                             ) -> Tuple[Optional[ServeResult],
                                        Optional[str]]:
         """Wait until one pending future resolves OK (first usable
         response wins; a failed one keeps the wait going while others
         are still out), all of them fail (return the last failure), or
-        the deadline passes (return ``(None, None)``)."""
+        the deadline passes (return ``(None, None)``).  ``on_result``
+        observes every resolved (replica, result) as it lands — the
+        tracer closes attempt spans through it."""
         event = threading.Event()
         for f in pending.values():
             f.add_done_callback(lambda _f: event.set())
@@ -447,6 +484,8 @@ class FleetRouter:
         while pending:
             for r in [r for r, f in pending.items() if f.done()]:
                 res = pending.pop(r)._result
+                if on_result is not None:
+                    on_result(r, res)
                 if res.status is Status.OK:
                     if hedge_replica is not None \
                             and r == hedge_replica:
@@ -469,12 +508,21 @@ class FleetRouter:
     _KIND_PHASE = {"prefill": "prefill", "decode": "decode"}
 
     def _attempt_loop(self, kind, payload, opts,
-                      deadline: Optional[float]) -> ServeResult:
+                      deadline: Optional[float],
+                      trace=None) -> ServeResult:
         """The failover core: least-loaded dispatch within the kind's
         role pool, retryable outcomes retried on a different replica
         with the REMAINING deadline budget, optional hedging.  Always
         returns a typed ServeResult — the disaggregated drive chains
-        two of these (prefill, then decode) under one budget."""
+        two of these (prefill, then decode) under one budget.
+
+        With ``trace``, every dispatch (primary, retry, hedge) forks
+        the request's TraceContext with the budget that remains at
+        fork time; attempt spans close with their terminal status, a
+        hedge's discarded duplicate closes ``hedge_outcome=lost`` AT
+        DISCARD (never an orphan), and the winner is labeled ``won``.
+        """
+        tr = self.tracing if trace is not None else None
         phase = self._KIND_PHASE.get(kind)
         hedge_ok = self.hedge and (kind != "decode"
                                    or self.hedge_decode)
@@ -506,8 +554,13 @@ class FleetRouter:
                 self.metrics.record_retry()
             attempts += 1
             remaining = None if deadline is None else deadline - now
+            ctxs: Dict[str, object] = {}
+            if tr is not None:
+                ctxs[primary] = tr.attempt_begin(
+                    trace, primary, kind, remaining)
             pending = {primary: self._dispatch(
-                primary, kind, payload, opts, remaining)}
+                primary, kind, payload, opts, remaining,
+                trace=ctxs.get(primary))}
             hedge_replica = None
             if self.hedge and not pending[primary].done():
                 delay = self._hedge_delay()
@@ -530,12 +583,52 @@ class FleetRouter:
                                     phase=phase)
                             if hedge_replica is not None:
                                 self.metrics.record_hedge(won=False)
+                                if tr is not None:
+                                    ctxs[hedge_replica] = \
+                                        tr.attempt_begin(
+                                            trace, hedge_replica,
+                                            kind, rem2, hedge=True)
                                 pending[hedge_replica] = \
                                     self._dispatch(
                                         hedge_replica, kind, payload,
-                                        opts, rem2)
+                                        opts, rem2,
+                                        trace=ctxs.get(hedge_replica))
+            statuses: Dict[str, str] = {}
+            on_result = None
+            if tr is not None:
+                def on_result(r, res, _st=statuses):
+                    _st[r] = res.status.value if res is not None \
+                        else "abandoned"
             result, via = self._await_first_usable(
-                pending, deadline, hedge_replica)
+                pending, deadline, hedge_replica, on_result=on_result)
+            if tr is not None:
+                hedged_race = len(ctxs) > 1
+                for r, ctx in ctxs.items():
+                    if result is not None \
+                            and result.status is Status.OK:
+                        if r == via:
+                            tr.attempt_end(
+                                trace, ctx, statuses.get(r),
+                                hedge_outcome=("won" if hedged_race
+                                               else None))
+                        elif r in statuses:
+                            # resolved before the winner: a real
+                            # outcome, not a discard
+                            tr.attempt_end(trace, ctx, statuses[r])
+                        else:
+                            # still in flight: its response will be
+                            # discarded on arrival — mark now, close
+                            # the span AT the discard
+                            tr.mark_lost(trace, ctx)
+                            pending[r].add_done_callback(
+                                lambda f, c=ctx: tr.attempt_end(
+                                    trace, c,
+                                    (f._result.status.value
+                                     if f._result else "abandoned"),
+                                    hedge_outcome="lost"))
+                    else:
+                        tr.attempt_end(trace, ctx,
+                                       statuses.get(r, "abandoned"))
             if result is None:
                 return ServeResult(
                     Status.DEADLINE_EXCEEDED,
@@ -556,51 +649,66 @@ class FleetRouter:
             return result
 
     def _drive(self, kind, payload, opts, deadline: Optional[float],
-               fut: ServeFuture, t0: float):
+               fut: ServeFuture, t0: float, trace=None):
+        if trace is not None:
+            self.tracing.router_queue(trace, t0, self._clock())
         self._resolve(fut, self._attempt_loop(kind, payload, opts,
-                                              deadline), t0)
+                                              deadline, trace=trace),
+                      t0, trace)
 
     def _drive_disagg(self, kind, payload, opts,
                       deadline: Optional[float], fut: ServeFuture,
-                      t0: float):
+                      t0: float, trace=None):
         """Disaggregated generate: a prefill dispatch (routed within
         the prefill pool; returns the crc-sealed KV handoff + first
         token) then a decode dispatch (routed within the decode pool)
         under the SAME deadline budget.  The handoff blob is retained
         router-side across decode retries, so a decode replica killed
         mid-stream replays on a survivor within the remaining budget.
+        The TraceContext crosses the pool boundary INSIDE the sealed
+        blob (handoff extras) as well as on the dispatch itself.
         """
         import numpy as np
 
         from .pools import deserialize_handoff
 
-        pre = self._attempt_loop("prefill", payload, (), deadline)
+        if trace is not None:
+            self.tracing.router_queue(trace, t0, self._clock())
+        pre = self._attempt_loop("prefill", payload, (), deadline,
+                                 trace=trace)
         if pre.status is not Status.OK:
-            self._resolve(fut, pre, t0)
+            self._resolve(fut, pre, t0, trace)
             return
+        t_hand = self._clock()
         try:
             first = int(deserialize_handoff(pre.output)["first_token"])
         except Exception as e:
             self._resolve(fut, ServeResult(
                 Status.INTERNAL_ERROR,
                 error=f"prefill handoff unusable: "
-                      f"{type(e).__name__}: {e}"), t0)
+                      f"{type(e).__name__}: {e}"), t0, trace)
             return
         self.metrics.record_ttft(self._clock() - t0)
         max_new = opts[0]
         if max_new <= 1:
             self._resolve(fut, ServeResult(
                 Status.OK, output=np.asarray([first], np.int32),
-                queued_s=pre.queued_s), t0)
+                queued_s=pre.queued_s), t0, trace)
             return
-        dec = self._attempt_loop("decode", pre.output, opts, deadline)
+        if trace is not None:
+            # the router-side handoff hop: blob verify + re-dispatch
+            self.tracing.handoff(trace, t_hand,
+                                 self._clock() - t_hand,
+                                 blob_bytes=len(pre.output))
+        dec = self._attempt_loop("decode", pre.output, opts, deadline,
+                                 trace=trace)
         if dec.status is not Status.OK:
-            self._resolve(fut, dec, t0)
+            self._resolve(fut, dec, t0, trace)
             return
         dec.output = np.concatenate(
             [np.asarray([first], np.int32),
              np.asarray(dec.output, np.int32)])
-        self._resolve(fut, dec, t0)
+        self._resolve(fut, dec, t0, trace)
 
     # ------------------------------------------------------------ lifecycle
     def close(self, wait: bool = True):
@@ -609,6 +717,8 @@ class FleetRouter:
         resolves)."""
         self._closed = True
         self._pool.shutdown(wait=wait)
+        if self.tracing is not None:
+            self.tracing.close()
 
     def snapshot(self) -> dict:
         with self._lock:
@@ -626,4 +736,6 @@ class FleetRouter:
             "breakers": {r: b.snapshot()
                          for r, b in sorted(self._breakers.items())},
             "metrics": self.metrics.snapshot(),
+            "tracing": (self.tracing.snapshot()
+                        if self.tracing is not None else None),
         }

@@ -137,7 +137,7 @@ class InferenceServer:
                  generate_dtype=None, name: Optional[str] = None,
                  kv_pool=None, role: str = "both",
                  kv_page_window: Optional[int] = None,
-                 kv_page_globals: int = 1):
+                 kv_page_globals: int = 1, trace_sink=None):
         from ..optim._sharding_utils import data_mesh
         from .pools import ROLES
 
@@ -165,6 +165,13 @@ class InferenceServer:
         #: in the health snapshot so the FleetRouter can route prefill
         #: and decode to separately-sized pools
         self.role = role
+        #: distributed request tracing (serving.request_trace
+        #: .ReplicaTraceSink): when set, traced requests' queue wait,
+        #: batch formation, compiled-step execution, KV-page gathers
+        #: and swap/canary windows record as children of the request's
+        #: remote span and publish as trace fragments over the fleet
+        #: KV transport.  None = zero tracing overhead.
+        self.trace_sink = trace_sink
         if role != "both" and kv_pool is None:
             raise ValueError(
                 f"role {role!r} requires a kv_pool (the prefill/"
@@ -343,8 +350,28 @@ class InferenceServer:
         fut._resolve(result)
         return fut
 
+    @staticmethod
+    def _parse_trace(trace):
+        """Wire dict (or TraceContext) → TraceContext; malformed
+        contexts degrade to untraced, never fail the request."""
+        if trace is None:
+            return None
+        from ..telemetry.trace_context import TraceContext
+
+        return TraceContext.from_wire(trace)
+
+    def _trace(self, req: Request, name: str, category: str,
+               start: float, duration: float, **args):
+        """Record one request-phase span for a traced request (no-op
+        without a sink or context — the untraced hot path pays one
+        None check)."""
+        if self.trace_sink is not None and req.trace is not None:
+            self.trace_sink.record(req.trace, name, category, start,
+                                   duration, **args)
+
     def submit(self, feature,
-               deadline_s: Optional[float] = None) -> ServeFuture:
+               deadline_s: Optional[float] = None,
+               trace=None) -> ServeFuture:
         """One classification/regression request: ``feature`` is a
         single record (no batch dim); the result's ``output`` is the
         model's output row for it."""
@@ -365,12 +392,14 @@ class InferenceServer:
             return fast
         return self._admit(Request(
             kind="classify", payload=feature,
-            future=ServeFuture(), submitted_at=now, deadline=deadline))
+            future=ServeFuture(), submitted_at=now, deadline=deadline,
+            trace=self._parse_trace(trace)))
 
     def submit_generate(self, prompt_ids, max_new: int,
                         eos_id: Optional[int] = None,
                         pad_id: Optional[int] = None,
-                        deadline_s: Optional[float] = None) -> ServeFuture:
+                        deadline_s: Optional[float] = None,
+                        trace=None) -> ServeFuture:
         """One greedy-decode generation request; the result's
         ``output`` is the generated id row (``max_new`` tokens,
         eos-then-pad per ``models.generate``).  Requests are micro-
@@ -390,7 +419,8 @@ class InferenceServer:
         return self._admit(Request(
             kind="generate", payload=prompt, future=ServeFuture(),
             submitted_at=now, deadline=deadline,
-            opts=(int(max_new), eos_id, pad_id)))
+            opts=(int(max_new), eos_id, pad_id),
+            trace=self._parse_trace(trace)))
 
     def _require_pool(self, what: str):
         if self.kv_pool is None:
@@ -399,8 +429,8 @@ class InferenceServer:
                 f"server has none")
 
     def submit_prefill(self, prompt_ids,
-                       deadline_s: Optional[float] = None
-                       ) -> ServeFuture:
+                       deadline_s: Optional[float] = None,
+                       trace=None) -> ServeFuture:
         """Prefill-only dispatch for the disaggregated path: run the
         prompt pass, produce the first token, and return a crc-sealed
         KV handoff blob (``result.output``) a decode-pool replica can
@@ -419,13 +449,14 @@ class InferenceServer:
             return fast
         return self._admit(Request(
             kind="prefill", payload=prompt, future=ServeFuture(),
-            submitted_at=now, deadline=deadline))
+            submitted_at=now, deadline=deadline,
+            trace=self._parse_trace(trace)))
 
     def submit_decode(self, handoff: bytes, max_new: int,
                       eos_id: Optional[int] = None,
                       pad_id: Optional[int] = None,
-                      deadline_s: Optional[float] = None
-                      ) -> ServeFuture:
+                      deadline_s: Optional[float] = None,
+                      trace=None) -> ServeFuture:
         """Decode-only dispatch for the disaggregated path: verify
         ``handoff`` (crc32c + geometry), import its pages into this
         replica's pool, and stream the remaining ``max_new - 1``
@@ -441,10 +472,18 @@ class InferenceServer:
         fast = self._fast_fail_expired(deadline, now)
         if fast is not None:
             return fast
+        ctx = self._parse_trace(trace)
+        if ctx is None:
+            # belt-and-braces: the context also rides the sealed blob
+            # itself (handoff extras), so a decode dispatched outside
+            # the router still joins its trace
+            from .pools import peek_handoff_trace
+
+            ctx = self._parse_trace(peek_handoff_trace(handoff))
         return self._admit(Request(
             kind="decode", payload=handoff, future=ServeFuture(),
             submitted_at=now, deadline=deadline,
-            opts=(int(max_new), eos_id, pad_id)))
+            opts=(int(max_new), eos_id, pad_id), trace=ctx))
 
     # ------------------------------------------------------------ hot swap
     def swap_params(self, params: Any = None, path: Optional[str] = None,
@@ -461,6 +500,15 @@ class InferenceServer:
         params.  Returns True on install."""
         if (params is None) == (path is None):
             raise ValueError("pass exactly one of params/path")
+        t_swap = time.monotonic()
+
+        def note_swap(outcome: str):
+            # traced requests overlapping this window see it as a
+            # swap_window span in their stitched timeline
+            if self.trace_sink is not None:
+                self.trace_sink.record_swap_window(
+                    t_swap, time.monotonic() - t_swap, outcome)
+
         try:
             if path is not None:
                 params = load_verified_params(path)
@@ -480,11 +528,13 @@ class InferenceServer:
                 raise SwapRejected("candidate params are non-finite")
         except SwapRejected:
             self.metrics.record_swap(installed=False)
+            note_swap("rejected")
             raise
         except (KeyboardInterrupt, SystemExit):
             raise
         except Exception as e:
             self.metrics.record_swap(installed=False)
+            note_swap("rejected")
             raise SwapRejected(f"canary batch failed "
                                f"({type(e).__name__}: {e})")
         with self._model_lock:
@@ -492,6 +542,7 @@ class InferenceServer:
             if buffers is not None:
                 self._buffers = buffers
         self.metrics.record_swap(installed=True)
+        note_swap("installed")
         log.info("serving params hot-swapped%s",
                  f" from {path}" if path else "")
         return True
@@ -516,6 +567,17 @@ class InferenceServer:
         result.latency_s = now - req.submitted_at
         self.metrics.record(result.status, result.latency_s,
                             result.queued_s)
+        if req.trace is not None:
+            result.trace_id = req.trace.trace_id
+            if result.status is not Status.OK:
+                # typed failure span: the stitched trace shows WHAT
+                # failed on WHICH replica, not just a missing interval
+                self._trace(req, f"fail:{req.kind}", "error",
+                            req.submitted_at, result.latency_s,
+                            status=result.status.value,
+                            error=(result.error or "")[:200])
+            if self.trace_sink is not None:
+                self.trace_sink.finish(req.trace)
         req.future._resolve(result)
 
     def _gather(self, limit: int) -> list:
@@ -610,6 +672,9 @@ class InferenceServer:
             return self._run_paged_group(kind, reqs)
         t_batch = time.monotonic()
         queued = [t_batch - r.submitted_at for r in reqs]
+        for r, q in zip(reqs, queued):
+            self._trace(r, "admission_queue", "queue", r.submitted_at,
+                        q)
         with self._model_lock:
             params, buffers = self._params, self._buffers
         try:
@@ -618,6 +683,7 @@ class InferenceServer:
                 x, bucket = self.batcher.coalesce(
                     [r.payload for r in reqs])
                 xj = jnp.asarray(x)
+                t_exec = time.monotonic()
                 self._account_bucket_cost(bucket, params, buffers, xj)
                 out = self._fwd(params, buffers, xj)
                 # host transfer doubles as the execution barrier —
@@ -626,7 +692,16 @@ class InferenceServer:
                 with self._model_lock:
                     self._canary_x = xj  # freshest known-good canary
             else:
+                t_exec = time.monotonic()
                 out_np, bucket = self._run_generate(params, reqs)
+            t_done = time.monotonic()
+            for r in reqs:
+                self._trace(r, "batch_form", "batch", t_batch,
+                            t_exec - t_batch, batch=len(reqs),
+                            bucket=bucket)
+                self._trace(r, f"execute:{kind}", "execute", t_exec,
+                            t_done - t_exec, bucket=bucket,
+                            batch=len(reqs))
         except (KeyboardInterrupt, SystemExit):
             raise
         except Exception as e:
@@ -738,13 +813,19 @@ class InferenceServer:
         for req in reqs:
             now = time.monotonic()
             queued_s = now - req.submitted_at
+            self._trace(req, "admission_queue", "queue",
+                        req.submitted_at, queued_s)
             try:
                 _faults.check_serving_fault(self.name)
                 if req.kind == "decode":
                     max_new, eos_id, pad_id = req.opts
                     eos, pad = map(int, _eos_pad(self.model, eos_id,
                                                  pad_id))
+                    t_g = time.monotonic()
                     seq = self._import_handoff(decoder, req.payload)
+                    self._trace(req, "kv_import", "kv_gather", t_g,
+                                time.monotonic() - t_g,
+                                pages=len(seq.lease.pages))
                     # the first token rode the handoff: this dispatch
                     # owes the remaining max_new - 1
                     entry = {
@@ -762,12 +843,31 @@ class InferenceServer:
                     self.metrics.record_phase("prefill", prefill_s)
                     self.metrics.record_ttft(
                         time.monotonic() - req.submitted_at)
+                    self._trace(req, "prefill", "prefill", t0,
+                                prefill_s,
+                                prompt_len=int(req.payload.shape[0]),
+                                pages=len(seq.lease.pages))
                     if req.kind == "prefill":
+                        t_g = time.monotonic()
                         k_pages, v_pages = pool.read_pages(
                             seq.lease.pages)
+                        extras = None
+                        if req.trace is not None:
+                            from ..telemetry.trace_context import \
+                                TRACE_WIRE_KEY
+
+                            # the context rides the sealed blob: the
+                            # decode replica joins the trace even when
+                            # the dispatch path loses the kwarg
+                            extras = {TRACE_WIRE_KEY:
+                                      req.trace.to_wire()}
                         blob = serialize_handoff(
                             k_pages, v_pages, seq.last, seq.pos,
-                            pool.page_size)
+                            pool.page_size, extras=extras)
+                        self._trace(req, "kv_export", "kv_gather", t_g,
+                                    time.monotonic() - t_g,
+                                    pages=len(seq.lease.pages),
+                                    blob_bytes=len(blob))
                         seq.release()
                         self.breaker.record_success()
                         self.metrics.record_batch(1, 1)
@@ -807,6 +907,9 @@ class InferenceServer:
             self.metrics.record_phase("decode", decode_s)
             if entry["steps"]:
                 self.metrics.record_tpot(decode_s / entry["steps"])
+            self._trace(req, "decode", "decode", entry["t_decode"],
+                        decode_s, steps=entry["steps"],
+                        tokens=len(entry["toks"]))
             self.breaker.record_success()
             self.metrics.record_batch(1, 1)
             self._resolve(req, ServeResult(
@@ -816,6 +919,10 @@ class InferenceServer:
 
         def abort(entry, result: ServeResult):
             entry["seq"].release()
+            decode_s = time.monotonic() - entry["t_decode"]
+            self._trace(entry["req"], "decode", "decode",
+                        entry["t_decode"], decode_s,
+                        steps=entry["steps"], aborted=True)
             result.queued_s = entry["queued_s"]
             self._resolve(entry["req"], result)
 

@@ -44,6 +44,9 @@ class ServeResult:
     queued_s: float = 0.0
     #: padded bucket the request ran in (OK/INTERNAL_ERROR only)
     bucket: Optional[int] = None
+    #: distributed-trace id when request tracing is enabled (look the
+    #: stitched timeline up via the router's RequestTracer / exemplars)
+    trace_id: Optional[str] = None
 
     @property
     def ok(self) -> bool:
@@ -115,6 +118,9 @@ class Request:
     deadline: Optional[float] = None
     #: generate-path options (max_new, eos_id, pad_id)
     opts: tuple = field(default_factory=tuple)
+    #: distributed-trace context (telemetry.trace_context.TraceContext)
+    #: propagated from the router, or None when untraced
+    trace: Optional[object] = None
 
     def expired(self, now: float) -> bool:
         return self.deadline is not None and now >= self.deadline

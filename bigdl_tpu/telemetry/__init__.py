@@ -30,8 +30,8 @@ import time
 from typing import Optional
 
 from .aggregate import (
-    collect_snapshots, merge_cluster, merge_metrics, publish_snapshot,
-    read_snapshot_dir, write_snapshot,
+    collect_snapshots, merge_cluster, merge_metrics, merge_timeline,
+    publish_snapshot, read_snapshot_dir, write_snapshot,
 )
 from .device_info import DeviceSpec, device_spec, peak_flops_per_sec
 from .goodput import GOODPUT_CATEGORIES, GoodputLedger
@@ -42,16 +42,21 @@ from .registry import (
     default_registry, reset_default_registry,
 )
 from .slog import configure_logging, get_logger
-from .tracer import CATEGORIES, Span, Tracer
+from .trace_context import (REQUEST_CATEGORIES, TRACE_KV_PREFIX,
+                            TailSampler, TraceContext)
+from .tracer import CATEGORIES, STEP_CATEGORIES, Span, Tracer
 
 __all__ = [
     "BackgroundPublisher", "CATEGORIES", "GOODPUT_CATEGORIES",
     "Counter", "DeviceSpec",
     "Gauge", "Histogram", "MetricsRegistry", "GoodputLedger",
-    "PerfAccountant", "Span", "StepCost", "Telemetry", "Tracer",
+    "PerfAccountant", "REQUEST_CATEGORIES", "STEP_CATEGORIES",
+    "Span", "StepCost", "TRACE_KV_PREFIX", "TailSampler",
+    "Telemetry", "TraceContext", "Tracer",
     "classify_roofline", "collect_snapshots", "configure_logging",
     "default_buckets", "default_registry", "device_spec", "get_logger",
-    "merge_cluster", "merge_metrics", "peak_flops_per_sec",
+    "merge_cluster", "merge_metrics", "merge_timeline",
+    "peak_flops_per_sec",
     "publish_snapshot", "read_snapshot_dir", "reset_default_registry",
     "write_snapshot",
 ]
@@ -247,9 +252,16 @@ class Telemetry:
         self.ledger.recovery_begin()
 
     # -- export ----------------------------------------------------------
+    #: newest spans carried per published payload — enough for the
+    #: cluster timeline's recent window without bloating KV puts
+    SPAN_EXPORT_LIMIT = 512
+
     def payload(self, step: Optional[int] = None) -> dict:
         """The publishable telemetry payload (what lands on the KV
-        transport and in snapshot directories)."""
+        transport and in snapshot directories).  ``spans`` (the newest
+        :data:`SPAN_EXPORT_LIMIT`, with a mono/wall clock anchor) is
+        what ``merge_timeline`` folds into the cluster-wide Perfetto
+        view."""
         return {
             "host": self.host,
             "step": step,
@@ -258,6 +270,9 @@ class Telemetry:
             "goodput": self.ledger.snapshot(),
             "metrics": self.registry.snapshot()["metrics"],
             "span_totals": self.tracer.category_totals(),
+            "spans": self.tracer.export_spans(self.SPAN_EXPORT_LIMIT),
+            "clock_anchor": {"mono": self.tracer.clock(),
+                             "wall": time.time()},
             "perf": self.perf.payload(),
         }
 

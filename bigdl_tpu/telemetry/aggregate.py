@@ -29,8 +29,8 @@ from .goodput import GoodputLedger
 
 __all__ = [
     "TM_PREFIX", "collect_snapshots", "merge_cluster", "merge_metrics",
-    "merge_perf", "publish_snapshot", "read_snapshot_dir",
-    "write_snapshot",
+    "merge_perf", "merge_timeline", "publish_snapshot",
+    "read_snapshot_dir", "write_snapshot",
 ]
 
 TM_PREFIX = "tm/"
@@ -166,9 +166,12 @@ def _fold_series(cur: dict, series: dict, kind: str):
         else:  # geometry drift: keep count/sum, drop the buckets
             cur.pop("buckets", None)
         # per-series quantiles do not merge; the cluster view keeps
-        # count/sum/min/max (+ merged buckets when geometries match)
+        # count/sum/min/max (+ merged buckets when geometries match).
+        # Exemplars are per-host pointers into per-host trace stores —
+        # a merged bucket cannot keep one honestly, so they drop too.
         cur.pop("p50", None)
         cur.pop("p99", None)
+        cur.pop("exemplars", None)
 
 
 def host_skew(payloads: Dict[str, dict]) -> Dict[str, dict]:
@@ -250,6 +253,49 @@ def merge_perf(payloads: Dict[str, dict]) -> Optional[dict]:
     return out
 
 
+def merge_timeline(payloads: Dict[str, dict],
+                   skew: Optional[Dict[str, dict]] = None
+                   ) -> Optional[dict]:
+    """Fold per-host published step spans (``payload["spans"]``, see
+    ``Telemetry.payload``) into ONE cluster-wide Perfetto/Chrome-trace
+    timeline: one pid per host, host monotonic clocks aligned onto the
+    first publishing host's via each payload's (mono, wall)
+    ``clock_anchor`` pair, and the per-host step-time skew (vs the
+    cluster median) stamped on each host's process metadata.  None
+    when no host published spans."""
+    skew = skew if skew is not None else host_skew(payloads)
+    hosts = [h for h in sorted(payloads)
+             if (payloads[h] or {}).get("spans")]
+    if not hosts:
+        return None
+    ref_anchor = (payloads[hosts[0]].get("clock_anchor") or {})
+    ref_delta = (ref_anchor.get("wall", 0.0)
+                 - ref_anchor.get("mono", 0.0))
+    events = []
+    for pid, host in enumerate(hosts, start=1):
+        payload = payloads[host]
+        anchor = payload.get("clock_anchor") or {}
+        offset = ((anchor.get("wall", 0.0) - anchor.get("mono", 0.0))
+                  - ref_delta) if anchor and ref_anchor else 0.0
+        meta = {"name": host, "host": host}
+        if host in (skew or {}):
+            meta["step_time_skew"] = skew[host].get("skew")
+            meta["mean_step_s"] = skew[host].get("mean_step_s")
+        events.append({"ph": "M", "name": "process_name", "pid": pid,
+                       "tid": 0, "args": meta})
+        for sp in payload.get("spans", ()):
+            args = dict(sp.get("args") or {})
+            args["host"] = host
+            events.append({
+                "name": sp["name"], "cat": sp["cat"], "ph": "X",
+                "ts": (sp["start"] + offset) * 1e6,
+                "dur": sp["dur"] * 1e6,
+                "pid": pid, "tid": sp.get("tid", 0), "args": args,
+            })
+    return {"traceEvents": events, "displayTimeUnit": "ms",
+            "hosts": hosts}
+
+
 def merge_cluster(payloads: Dict[str, dict]) -> dict:
     """Fold per-host telemetry payloads (host → the dict
     ``Telemetry.payload()`` publishes) into the one cluster view the
@@ -261,6 +307,7 @@ def merge_cluster(payloads: Dict[str, dict]) -> dict:
     for p in payloads.values():
         for cat, secs in (p.get("span_totals") or {}).items():
             spans[cat] = spans.get(cat, 0.0) + float(secs)
+    skew = host_skew(payloads)
     return {
         "hosts": hosts,
         "incarnation": max(
@@ -270,6 +317,9 @@ def merge_cluster(payloads: Dict[str, dict]) -> dict:
         "metrics": merge_metrics(
             [p.get("metrics") or {} for p in payloads.values()]),
         "span_totals": dict(sorted(spans.items())),
-        "per_host_skew": host_skew(payloads),
+        "per_host_skew": skew,
         "perf": merge_perf(payloads),
+        # the cluster-wide Perfetto timeline (None when no host
+        # published spans — the payloads' span export is bounded)
+        "timeline": merge_timeline(payloads, skew=skew),
     }

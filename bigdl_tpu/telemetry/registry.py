@@ -131,19 +131,33 @@ class Histogram:
         self.max: Optional[float] = None
         self._window = int(window)
         self._samples: List[float] = []
+        # bucket index -> {"value", "trace_id"}: the newest exemplar
+        # per bucket (the Prometheus/OpenMetrics exemplar model) —
+        # what links a latency bucket to a kept distributed trace
+        self._exemplars: Dict[int, dict] = {}
 
-    def observe(self, v: float):
+    def observe(self, v: float, exemplar: Optional[str] = None):
+        """Record one observation; ``exemplar`` optionally attaches a
+        trace id to the covering bucket (newest wins per bucket)."""
         v = float(v)
         with self._lock:
-            self.buckets[bisect.bisect_left(self.bounds, v)] += 1
+            idx = bisect.bisect_left(self.bounds, v)
+            self.buckets[idx] += 1
             self.count += 1
             self.sum += v
             self.min = v if self.min is None else min(self.min, v)
             self.max = v if self.max is None else max(self.max, v)
+            if exemplar is not None:
+                self._exemplars[idx] = {"value": v,
+                                        "trace_id": str(exemplar)}
             if self._window > 0:
                 self._samples.append(v)
                 if len(self._samples) > self._window:
                     del self._samples[:len(self._samples) - self._window]
+
+    def exemplars(self) -> Dict[int, dict]:
+        with self._lock:
+            return {i: dict(e) for i, e in self._exemplars.items()}
 
     # -- quantiles ------------------------------------------------------
     def quantile(self, q: float) -> Optional[float]:
@@ -206,7 +220,7 @@ class Histogram:
 
     def _data(self) -> dict:
         with self._lock:
-            return {
+            out = {
                 "count": self.count, "sum": self.sum,
                 "min": self.min, "max": self.max,
                 "bounds": list(self.bounds),
@@ -214,6 +228,12 @@ class Histogram:
                 "p50": self.quantile(0.5) if self.count else None,
                 "p99": self.quantile(0.99) if self.count else None,
             }
+            if self._exemplars:
+                # JSON object keys are strings; keep the snapshot
+                # round-trippable
+                out["exemplars"] = {str(i): dict(e)
+                                    for i, e in self._exemplars.items()}
+            return out
 
 
 def _exact_quantile(samples: Sequence[float], q: float) -> float:
@@ -276,8 +296,8 @@ class _Family:
     def dec(self, n: float = 1.0):
         self._default().dec(n)
 
-    def observe(self, v: float):
-        self._default().observe(v)
+    def observe(self, v: float, exemplar: Optional[str] = None):
+        self._default().observe(v, exemplar=exemplar)
 
     def quantile(self, q: float):
         return self._default().quantile(q)
@@ -388,13 +408,22 @@ class MetricsRegistry:
             for labels, child in fam.series():
                 if fam.kind == "histogram":
                     cum = 0
-                    for bound, c in zip(
+                    exemplars = child.exemplars()
+                    for i, (bound, c) in enumerate(zip(
                             list(child.bounds) + [float("inf")],
-                            child.buckets):
+                            child.buckets)):
                         cum += c
                         le = dict(labels, le=_fmt_float(bound))
-                        lines.append(f"{fam.name}_bucket"
-                                     f"{_label_str(le)} {cum}")
+                        line = (f"{fam.name}_bucket"
+                                f"{_label_str(le)} {cum}")
+                        ex = exemplars.get(i)
+                        if ex is not None:
+                            # OpenMetrics exemplar syntax: the bucket
+                            # links to a kept distributed trace
+                            line += (' # {trace_id="%s"} %s'
+                                     % (ex["trace_id"],
+                                        _fmt_float(ex["value"])))
+                        lines.append(line)
                     lines.append(f"{fam.name}_sum{_label_str(labels)} "
                                  f"{_fmt_float(child.sum)}")
                     lines.append(f"{fam.name}_count"

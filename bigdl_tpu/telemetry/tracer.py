@@ -33,15 +33,23 @@ import time
 from collections import deque
 from typing import Callable, Dict, List, Optional
 
-__all__ = ["CATEGORIES", "Span", "Tracer"]
+from .trace_context import REQUEST_CATEGORIES
 
-#: the closed vocabulary of span categories — everything the goodput
-#: ledger can attribute a second of wall clock to, plus the profiled
-#: split of on-device time
-CATEGORIES = (
+__all__ = ["CATEGORIES", "STEP_CATEGORIES", "Span", "Tracer"]
+
+#: the training-side vocabulary — everything the goodput ledger can
+#: attribute a second of wall clock to, plus the profiled split of
+#: on-device time
+STEP_CATEGORIES = (
     "step", "data_wait", "host_to_device", "compile", "compute",
     "collective", "checkpoint", "recovery", "idle", "other",
 )
+
+#: the closed vocabulary of span categories: the training table above
+#: plus the request-path table (ONE shared constant source —
+#: ``telemetry.trace_context.REQUEST_CATEGORIES`` — so router, server
+#: and tracer can never drift; a vocabulary lint enforces it)
+CATEGORIES = STEP_CATEGORIES + REQUEST_CATEGORIES
 
 
 class Span:
@@ -67,6 +75,18 @@ class Span:
     def __repr__(self):
         return (f"Span({self.name!r}, cat={self.category!r}, "
                 f"dur={self.duration:.6f}s)")
+
+    def to_dict(self) -> dict:
+        """JSON-serializable form — what trace fragments and telemetry
+        payloads publish over the KV transport."""
+        out = {"id": self.id, "name": self.name, "cat": self.category,
+               "start": self.start, "dur": self.duration,
+               "tid": self.tid}
+        if self.parent_id is not None:
+            out["parent_id"] = self.parent_id
+        if self.args:
+            out["args"] = dict(self.args)
+        return out
 
 
 class _SpanCtx:
@@ -165,11 +185,17 @@ class Tracer:
         if parent is not None and parent.end is not None:
             start = min(max(start, parent.start), parent.end)
             end = min(max(end, start), parent.end)
-        s = Span(self._alloc_id(), str(name), category, start,
-                 threading.get_ident(),
-                 parent.id if parent else None, args or None)
-        s.end = end
-        self._finish(s)
+        tid = threading.get_ident()
+        # one lock round trip (id alloc + ring append) — retroactive
+        # records run on serving hot paths
+        with self._lock:
+            self._next_id += 1
+            s = Span(self._next_id, str(name), category, start, tid,
+                     parent.id if parent else None, args or None)
+            s.end = end
+            if len(self._done) == self._done.maxlen:
+                self.dropped += 1
+            self._done.append(s)
         return s
 
     @property
@@ -184,6 +210,15 @@ class Tracer:
     def clear(self):
         with self._lock:
             self._done.clear()
+
+    def export_spans(self, limit: Optional[int] = None) -> List[dict]:
+        """The newest ``limit`` completed spans as JSON-serializable
+        dicts (all of them when ``limit`` is None) — what
+        ``Telemetry.payload`` publishes for the cluster timeline."""
+        spans = self.spans()
+        if limit is not None and len(spans) > int(limit):
+            spans = spans[-int(limit):]
+        return [s.to_dict() for s in spans]
 
     def category_totals(self) -> Dict[str, float]:
         """Seconds per category, summed over completed spans.  ``step``
@@ -229,7 +264,10 @@ class Tracer:
             json.dump(self.to_chrome_trace(), f)
 
 
+_CATEGORY_SET = frozenset(CATEGORIES)
+
+
 def _check_category(category: str):
-    if category not in CATEGORIES:
+    if category not in _CATEGORY_SET:
         raise ValueError(f"unknown span category {category!r}; one of "
                          f"{CATEGORIES}")

@@ -659,3 +659,68 @@ def test_no_print_or_import_time_logging_config_in_library():
         "bare print( / import-time logging.basicConfig in library code "
         "(use telemetry.slog.get_logger / configure_logging — see "
         "docs/observability.md):\n" + "\n".join(offenders))
+
+
+# ---------------------------------------------------------------------------
+# lint: span categories + trace KV keys come from ONE constant table
+# (telemetry.trace_context) — no stringly-typed drift between router,
+# server, and tracer
+# ---------------------------------------------------------------------------
+
+#: literal category in Tracer.record(name, cat) / Tracer.span(name,
+#: cat) / ReplicaTraceSink.record(ctx, name, cat) /
+#: InferenceServer._trace(req, name, cat) call sites
+_SPAN_CATEGORY_CALLS = (
+    re.compile(r"\.(?:record|span)\(\s*f?\"[^\"]+\",\s*\"(\w+)\""),
+    re.compile(r"\.(?:record|span)\(\s*[\w.\[\]\"']+,\s*f?\"[^\"]+\","
+               r"\s*\"(\w+)\""),
+    re.compile(r"_trace\(\s*[\w.\[\]\"']+,\s*f?\"[^\"]+\",\s*"
+               r"\"(\w+)\""),
+)
+
+
+def test_span_categories_and_trace_keys_come_from_shared_table():
+    """Every literal span category recorded anywhere in bigdl_tpu/
+    must be a member of the one shared vocabulary
+    (``telemetry.tracer.CATEGORIES``, which appends
+    ``telemetry.trace_context.REQUEST_CATEGORIES``), and the trace KV
+    key prefix literal ``"trc/"`` may exist ONLY in trace_context.py —
+    router, server, and tracer can never drift on either."""
+    from bigdl_tpu.telemetry.trace_context import (REQUEST_CATEGORIES,
+                                                   TRACE_KV_PREFIX)
+    from bigdl_tpu.telemetry.tracer import CATEGORIES, STEP_CATEGORIES
+
+    # the table itself is coherent: one source, no duplicates
+    assert set(REQUEST_CATEGORIES) <= set(CATEGORIES)
+    assert set(STEP_CATEGORIES).isdisjoint(REQUEST_CATEGORIES)
+    assert len(CATEGORIES) == len(set(CATEGORIES))
+    assert TRACE_KV_PREFIX == "trc/"
+
+    pkg = os.path.join(os.path.dirname(__file__), "..", "bigdl_tpu")
+    offenders = []
+    for dirpath, _dirs, files in os.walk(pkg):
+        for fname in sorted(files):
+            if not fname.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fname)
+            rel = os.path.relpath(path, pkg)
+            with open(path) as f:
+                for lineno, line in enumerate(f, 1):
+                    code = line.split("#", 1)[0]
+                    for pat in _SPAN_CATEGORY_CALLS:
+                        for cat in pat.findall(code):
+                            if cat not in CATEGORIES:
+                                offenders.append(
+                                    f"bigdl_tpu/{rel}:{lineno}: "
+                                    f"category {cat!r} not in the "
+                                    f"shared table: {line.strip()}")
+                    if '"trc/' in code and rel != os.path.join(
+                            "telemetry", "trace_context.py"):
+                        offenders.append(
+                            f"bigdl_tpu/{rel}:{lineno}: literal trace "
+                            f"KV prefix (use telemetry.trace_context"
+                            f".TRACE_KV_PREFIX): {line.strip()}")
+    assert not offenders, (
+        "stringly-typed span categories / trace keys (the shared "
+        "table lives in telemetry/trace_context.py):\n"
+        + "\n".join(offenders))

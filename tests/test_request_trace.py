@@ -1,0 +1,382 @@
+"""Distributed request-tracing specs (telemetry/trace_context.py +
+serving/request_trace.py): context minting/propagation across retries
+and the sealed prefill→decode handoff, hedge winner/loser labeling at
+discard, kill-mid-decode replay visible in one stitched trace,
+tail-based sampling (errors/hedges always kept, OK under budget),
+latency-histogram exemplars, and cross-replica stitch coverage."""
+import time
+
+import numpy as np
+import pytest
+
+from bigdl_tpu import nn
+from bigdl_tpu.resilience import faults
+from bigdl_tpu.serving import (InferenceServer, ServingFleet, Status,
+                               trace_attribution, trace_coverage)
+from bigdl_tpu.serving.request_trace import ReplicaTraceSink
+from bigdl_tpu.telemetry.trace_context import (REQUEST_CATEGORIES,
+                                               TailSampler,
+                                               TraceContext,
+                                               TRACE_WIRE_KEY)
+
+VOCAB, TMAX = 23, 32
+_MODELS = {}
+
+
+def _lm():
+    """One tiny TransformerLM for the whole module (paged decode
+    programs are shared per (model, page_size) — one compile set)."""
+    from bigdl_tpu.models.transformer import TransformerLM
+    from bigdl_tpu.utils.rng import RNG
+
+    if "lm" not in _MODELS:
+        RNG().set_seed(4)
+        _MODELS["lm"] = TransformerLM(VOCAB, embed_dim=16,
+                                      num_heads=2, mlp_dim=32,
+                                      num_layers=1, max_len=TMAX)
+    return _MODELS["lm"]
+
+
+def small_model():
+    return nn.Sequential(nn.Linear(4, 8), nn.Tanh(), nn.Linear(8, 3),
+                         nn.LogSoftMax())
+
+
+def make_fleet(n=2, hedge=False, hedge_delay_s=0.05,
+               keep_per_s=1e6, deadline_s=10.0, **router_kw):
+    fl = ServingFleet.build(
+        small_model(), n_replicas=n,
+        server_kw=dict(max_batch=8, max_queue=64),
+        heartbeat_timeout=0.4, pump_interval_s=0.05,
+        tracing=True, trace_kw=dict(keep_per_s=keep_per_s,
+                                    burst=keep_per_s),
+        router_kw=dict(default_deadline_s=deadline_s, hedge=hedge,
+                       hedge_delay_s=hedge_delay_s, **router_kw))
+    return fl.start()
+
+
+def feat(rng):
+    return rng.rand(4).astype(np.float32)
+
+
+def attempt_spans(trace, kind=None):
+    out = [e for e in trace["traceEvents"]
+           if e.get("ph") == "X" and e.get("cat") == "attempt"]
+    if kind is not None:
+        out = [e for e in out if e["args"].get("kind") == kind]
+    return out
+
+
+# ---------------------------------------------------------------------------
+# context + sampler units
+# ---------------------------------------------------------------------------
+
+def test_trace_context_wire_roundtrip_and_malformed_degrades():
+    ctx = TraceContext.mint(deadline_s=2.5)
+    child = ctx.child(7, remaining_s=1.25, attempt=2, phase="decode")
+    wire = child.to_wire()
+    back = TraceContext.from_wire(wire)
+    assert back == child
+    # a second mint is a different trace
+    assert TraceContext.mint().trace_id != ctx.trace_id
+    # malformed wire degrades to untraced, never raises
+    assert TraceContext.from_wire({"nope": 1}) is None
+    assert TraceContext.from_wire("garbage") is None
+    assert TraceContext.from_wire(None) is None
+
+
+def test_tail_sampler_always_keeps_trouble_budgets_ok():
+    t = [0.0]
+    s = TailSampler(keep_per_s=1.0, burst=2.0, clock=lambda: t[0])
+    # errors / retries / hedges / p99 always keep, regardless of budget
+    for _ in range(50):
+        assert s.keep(ok=False) == "error"
+        assert s.keep(ok=True, retried=True) == "retry"
+        assert s.keep(ok=True, hedged=True) == "hedge"
+        assert s.keep(ok=True, latency_s=0.9, p99_s=0.5) == "p99"
+    # OK traffic under the tail: the burst drains, then drops until
+    # the bucket refills with time
+    kept = sum(s.keep(ok=True, latency_s=0.01, p99_s=1.0) is not None
+               for _ in range(50))
+    assert kept == 2                      # the burst, nothing more
+    t[0] = 3.0                            # 3s x 1/s refill
+    kept2 = sum(s.keep(ok=True, latency_s=0.01, p99_s=1.0) is not None
+                for _ in range(50))
+    assert kept2 == 2
+    snap = s.snapshot()
+    assert snap["kept"]["error"] == 50 and snap["dropped"] > 0
+
+
+# ---------------------------------------------------------------------------
+# classify path: stitching, coverage, exemplars
+# ---------------------------------------------------------------------------
+
+def test_traced_classify_stitches_with_coverage_and_exemplars():
+    fl = make_fleet(n=2)
+    rng = np.random.RandomState(0)
+    try:
+        res = [fl.submit(feat(rng)).result(60) for _ in range(6)]
+        assert all(r.ok for r in res)
+        assert all(r.trace_id for r in res)
+        kept = fl.kept_traces()
+        assert len(kept) == 6             # budget wide open
+        t = fl.stitch_trace(res[-1].trace_id)
+        cats = {e["cat"] for e in t["traceEvents"]
+                if e.get("ph") == "X"}
+        # replica-side queue/batch/execute are children of the remote
+        # request span, in the shared vocabulary
+        assert {"request", "attempt", "queue", "batch",
+                "execute"} <= cats
+        assert cats <= set(REQUEST_CATEGORIES)
+        assert len(t["hosts"]) >= 2       # router + the replica
+        cov = trace_coverage(t)
+        assert cov is not None and cov >= 0.95
+        attr = trace_attribution(t)
+        assert attr["critical_phase"] in ("compute", "queue", "batch",
+                                          "kv", "transport")
+        # kept trace ids ride the latency histogram as exemplars
+        text = fl.router.metrics.to_prometheus()
+        assert 'trace_id="' in text
+    finally:
+        fl.stop(timeout=15)
+
+
+def test_retry_forks_context_with_remaining_budget_per_attempt():
+    fl = make_fleet(n=2)
+    rng = np.random.RandomState(1)
+    try:
+        [fl.submit(feat(rng)).result(60) for _ in range(4)]  # warm
+        with faults.serving_step_failures(times=1, server="r0") as b:
+            res = [fl.submit(feat(rng), deadline_s=10.0).result(60)
+                   for _ in range(6)]
+            assert b["fired"] == 1
+        assert all(r.ok for r in res)
+        retried = [k for k in fl.kept_traces() if k["retried"]]
+        assert retried, "the failed+retried request must be kept"
+        t = fl.stitch_trace(retried[0]["trace_id"])
+        atts = sorted(attempt_spans(t),
+                      key=lambda e: e["args"]["attempt"])
+        assert len(atts) == 2
+        # retried on a DIFFERENT replica, with the budget that
+        # actually remained at each fork
+        assert atts[0]["args"]["replica"] != atts[1]["args"]["replica"]
+        b0 = atts[0]["args"]["remaining_budget_s"]
+        b1 = atts[1]["args"]["remaining_budget_s"]
+        assert b0 is not None and b1 is not None and b1 < b0 <= 10.0
+        assert atts[0]["args"]["status"] == "internal_error"
+        assert atts[1]["args"]["status"] == "ok"
+    finally:
+        fl.stop(timeout=15)
+
+
+def test_hedge_loser_closes_as_lost_at_discard_no_double_count():
+    fl = make_fleet(n=2, hedge=True, hedge_delay_s=0.05)
+    rng = np.random.RandomState(2)
+    try:
+        [fl.submit(feat(rng)).result(60) for _ in range(4)]  # warm
+        time.sleep(0.1)
+        with faults.delay_replica("r0", 0.4, times=4):
+            r = fl.submit(feat(rng), deadline_s=10.0).result(30)
+        assert r.ok
+        hedged = [k for k in fl.kept_traces() if k["hedged"]]
+        assert hedged, "the hedged request must be kept"
+        time.sleep(0.6)   # the loser's late response arrives: discard
+        t = fl.stitch_trace(hedged[0]["trace_id"])
+        atts = attempt_spans(t)
+        outcomes = sorted(
+            e["args"].get("hedge_outcome") for e in atts
+            if e["args"].get("hedge_outcome") is not None)
+        # winner AND loser are distinct labeled spans — the loser
+        # closed at discard, not leaked as an orphan
+        assert outcomes == ["lost", "won"]
+        # the union coverage stays honest (a union cannot double
+        # count) and the pre-hedge wait is covered by the lost primary
+        cov = trace_coverage(t)
+        assert cov is not None and 0.95 <= cov <= 1.0
+        # ...while duplicate DUTY is excluded from the phase sums: the
+        # loser's replica compute never inflates the attribution
+        attr = trace_attribution(t)
+        assert attr["phases"].get("compute", 0.0) \
+            <= attr["wall_s"] + 1e-6
+        # the loser's replica-side spans are labeled too
+        lost_exec = [
+            e for e in t["traceEvents"] if e.get("ph") == "X"
+            and e.get("cat") in ("queue", "execute")
+            and (e["args"] or {}).get("hedge_outcome") == "lost"]
+        assert lost_exec, "replica spans of the lost attempt carry " \
+                          "the label"
+    finally:
+        fl.stop(timeout=15)
+
+
+# ---------------------------------------------------------------------------
+# handoff propagation + typed error span
+# ---------------------------------------------------------------------------
+
+def test_context_survives_handoff_blob_bit_for_bit():
+    from bigdl_tpu.serving.pools import (deserialize_handoff,
+                                         peek_handoff_trace,
+                                         serialize_handoff)
+
+    ctx = TraceContext.mint(deadline_s=3.0).child(
+        9, remaining_s=1.5, attempt=1, phase="decode")
+    k = np.zeros((2, 1, 2, 4, 8), np.float32)
+    blob = serialize_handoff(k, k, first_token=5, pos=3, page_size=4,
+                             extras={TRACE_WIRE_KEY: ctx.to_wire()})
+    wire = deserialize_handoff(blob)[TRACE_WIRE_KEY]
+    assert wire == ctx.to_wire()
+    assert TraceContext.from_wire(wire) == ctx
+    assert peek_handoff_trace(blob) == ctx.to_wire()
+    # a corrupt blob peeks as None (the crc verdict belongs to decode)
+    bad = bytearray(blob)
+    bad[-1] ^= 0xFF
+    assert peek_handoff_trace(bytes(bad)) is None
+
+
+def test_corrupt_handoff_yields_typed_error_span():
+    from bigdl_tpu.resilience.elastic import InMemoryKV
+    from bigdl_tpu.serving import KVPagePool
+
+    model = _lm()
+    kv = InMemoryKV()
+    sink = ReplicaTraceSink("rX", transport=kv)
+    srv = InferenceServer(model, name="rX", max_batch=4,
+                          kv_pool=KVPagePool.for_model(
+                              model, 32, page_size=4),
+                          trace_sink=sink).start()
+    try:
+        ctx = TraceContext.mint(deadline_s=10.0)
+        res = srv.submit_decode(b"BKVHgarbage", max_new=4,
+                                trace=ctx.to_wire()).result(60)
+        assert res.status is Status.INTERNAL_ERROR
+        assert "Handoff" in res.error or "handoff" in res.error
+        assert res.trace_id == ctx.trace_id
+        frag = sink.fragment(ctx.trace_id)
+        errs = [s for s in frag["spans"] if s["cat"] == "error"]
+        assert errs and errs[0]["args"]["status"] == "internal_error"
+        # and the fragment published to the KV under trc/
+        sink.flush()
+        assert any(k.startswith("trc/") and ctx.trace_id in k
+                   for k in kv.keys("trc/"))
+    finally:
+        srv.stop(timeout=15)
+
+
+# ---------------------------------------------------------------------------
+# kill mid-decode: the failed attempt AND the replay stitch into one
+# ---------------------------------------------------------------------------
+
+def test_kill_mid_decode_stitches_failed_and_replayed_attempts():
+    model = _lm()
+    fl = ServingFleet.build(
+        model, n_replicas=3, roles=("prefill", "decode", "decode"),
+        kv_pages=32, kv_page_size=4, server_kw=dict(max_batch=8),
+        heartbeat_timeout=0.4, pump_interval_s=0.05,
+        tracing=True, trace_kw=dict(keep_per_s=1e6, burst=1e6),
+        router_kw=dict(default_deadline_s=60.0, disaggregate=True))
+    fl.start()
+    rng = np.random.RandomState(3)
+    prompt = rng.randint(1, VOCAB + 1, (5,)).astype(np.int32)
+    try:
+        assert fl.submit_generate(prompt, max_new=3).result(300).ok
+        killed = None
+        with faults.serving_step_latency(0.05, times=1 << 10):
+            fut = fl.submit_generate(prompt, max_new=16)
+            deadline = time.monotonic() + 10
+            while killed is None and time.monotonic() < deadline:
+                snap = fl.router.snapshot()
+                for rid in ("r1", "r2"):
+                    if snap["inflight"].get(rid, 0) > 0:
+                        killed = rid
+                        break
+                time.sleep(0.02)
+            assert killed is not None
+            with faults.kill_replica(killed):
+                k_deadline = time.monotonic() + 15
+                while fl.servers[killed].healthy() \
+                        and time.monotonic() < k_deadline:
+                    time.sleep(0.02)
+            res = fut.result(300)
+        assert res.ok, (res.status, res.error)
+        t = fl.stitch_trace(res.trace_id)
+        assert t is not None
+        dec = attempt_spans(t, kind="decode")
+        statuses = {e["args"].get("status") for e in dec}
+        replicas = {e["args"].get("replica") for e in dec}
+        # the killed attempt and the replayed survivor attempt are
+        # BOTH in the stitched trace, distinctly labeled
+        assert len(dec) >= 2
+        assert len(replicas) >= 2 and killed in replicas
+        assert "ok" in statuses
+        assert any(s not in ("ok", None) for s in statuses)
+        # replayed-with-remaining-budget: later attempts have less
+        budgets = [e["args"]["remaining_budget_s"]
+                   for e in sorted(dec,
+                                   key=lambda e: e["args"]["attempt"])]
+        assert all(b is not None for b in budgets)
+        assert budgets[-1] < budgets[0]
+    finally:
+        fl.stop(timeout=15)
+
+
+# ---------------------------------------------------------------------------
+# tail sampling on the fleet: trouble always kept, OK bounded
+# ---------------------------------------------------------------------------
+
+def test_fleet_tail_sampling_keeps_all_errors_bounds_ok_traffic():
+    fl = make_fleet(n=2, keep_per_s=0.0001, deadline_s=2.0,
+                    max_attempts=1)
+    rng = np.random.RandomState(4)
+    try:
+        warm = fl.submit(feat(rng)).result(60)
+        assert warm.ok
+        # errors: every replica's next steps fail; with max_attempts=1
+        # each request resolves INTERNAL_ERROR
+        with faults.serving_step_failures(times=8):
+            errs = [fl.submit(feat(rng)).result(60) for _ in range(3)]
+        assert all(r.status is Status.INTERNAL_ERROR for r in errs)
+        oks = [fl.submit(feat(rng)).result(60) for _ in range(20)]
+        assert all(r.ok for r in oks)
+        kept = fl.kept_traces()
+        kept_ids = {k["trace_id"] for k in kept}
+        # 100% of error traces kept...
+        assert all(r.trace_id in kept_ids for r in errs)
+        # ...while OK traffic respects the (tiny) budget: the warm
+        # request may have taken the burst token; the 20 OKs cannot
+        # all be kept
+        ok_kept = [k for k in kept if k["status"] == "ok"
+                   and k["reason"] == "budget"]
+        assert len(ok_kept) <= 2
+        snap = fl.tracing.sampler.snapshot()
+        assert snap["dropped"] >= 18
+    finally:
+        fl.stop(timeout=15)
+
+
+# ---------------------------------------------------------------------------
+# exemplar mechanics on the registry histogram
+# ---------------------------------------------------------------------------
+
+def test_histogram_exemplars_snapshot_and_prometheus():
+    from bigdl_tpu.telemetry import Histogram, MetricsRegistry
+
+    h = Histogram(bounds=(0.1, 1.0))
+    h.observe(0.05, exemplar="aaaa")
+    h.observe(0.5)                        # no exemplar on this bucket
+    h.observe(0.05, exemplar="bbbb")      # newest wins per bucket
+    ex = h.exemplars()
+    assert ex == {0: {"value": 0.05, "trace_id": "bbbb"}}
+    assert h._data()["exemplars"] == {
+        "0": {"value": 0.05, "trace_id": "bbbb"}}
+    r = MetricsRegistry()
+    fam = r.histogram("lat_seconds", "t", bounds=(0.1, 1.0))
+    fam.observe(0.05, exemplar="cccc")
+    text = r.to_prometheus()
+    assert '# {trace_id="cccc"} 0.05' in text
+    # merged cluster views drop exemplars (per-host pointers)
+    from bigdl_tpu.telemetry import merge_metrics
+
+    snap = r.snapshot()["metrics"]
+    merged = merge_metrics([snap, snap])
+    series = merged["lat_seconds"]["series"][0]
+    assert "exemplars" not in series

@@ -545,6 +545,36 @@ def test_chaos_cluster_snapshot_accounts_wall_clock(tmp_path):
     report = render_report(cluster)
     assert "recovery" in report and "host0" in report
 
+    # the cluster-wide Perfetto timeline: per-host published step
+    # spans merged into ONE view (clock-aligned, skew-stamped), with
+    # the recovery window appearing exactly as often as it happened —
+    # and on the host that recovered, never duplicated by the merge
+    tl = cluster["timeline"]
+    assert tl is not None and "host0" in tl["hosts"]
+    events = [e for e in tl["traceEvents"] if e.get("ph") == "X"]
+    assert any(e["cat"] == "step" for e in events)
+    host0_pid = next(
+        e["pid"] for e in tl["traceEvents"]
+        if e.get("ph") == "M" and e["args"].get("host") == "host0")
+    recov = [e for e in events if e["cat"] == "recovery"]
+    assert len(recov) == int(tm.recoveries.value) >= 1
+    assert {e["pid"] for e in recov} == {host0_pid}
+    # skew stamps ride the process metadata when step histograms
+    # published (host_skew's source data)
+    metas = [e for e in tl["traceEvents"] if e.get("ph") == "M"]
+    assert any("step_time_skew" in e["args"] for e in metas)
+
+    # rendered by the CLI: tools/run_report.py --timeline
+    import tools.run_report as run_report
+
+    out_path = str(tmp_path / "timeline.json")
+    assert run_report.main([str(tmp_path / "snaps"),
+                            "--timeline", out_path]) == 0
+    with open(out_path) as f:
+        written = json.load(f)
+    assert any(e.get("cat") == "step"
+               for e in written["traceEvents"])
+
 
 # ---------------------------------------------------------------------------
 # profiling satellite: typed PhaseSplit keeps tuple unpacking

@@ -65,6 +65,14 @@ DEFAULT_TOLERANCES = {
     "fleet_goodput_per_chip": ("higher", 0.60),
     "fleet_p99_ms": ("lower", 0.75, 5.0),
     "fleet_recovery_s": ("lower", 1.00, 0.5),
+    # distributed request tracing (ISSUE 13): traced-vs-untraced
+    # overhead may only fall (the 0.5-percentage-point absolute floor
+    # absorbs 1-core scheduler jitter around the small baseline) and
+    # the p99 cohort's stitched wall-clock coverage may only rise — a
+    # falling coverage means replica fragments silently stopped
+    # publishing or stitching
+    "trace_overhead_pct": ("lower", 1.00, 0.5),
+    "trace_p99_coverage": ("higher", 0.05),
     # disaggregated serving leg (ISSUE 11): TTFT/TPOT on the 1-core CI
     # box are scheduler-noisy (wide tolerances, absolute floors); the
     # paged concurrency multiple is a deterministic arena-accounting

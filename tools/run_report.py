@@ -35,6 +35,11 @@ def main(argv=None) -> int:
     p.add_argument("--json", action="store_true",
                    help="emit the merged cluster view as JSON instead "
                         "of the text table")
+    p.add_argument("--timeline", nargs="?", const="-", default=None,
+                   metavar="OUT.json",
+                   help="emit the cluster-wide Perfetto timeline "
+                        "(per-host published spans, clock-aligned, "
+                        "skew-stamped) to OUT.json ('-' = stdout)")
     args = p.parse_args(argv)
 
     from bigdl_tpu.telemetry.aggregate import (merge_cluster,
@@ -47,6 +52,25 @@ def main(argv=None) -> int:
               f"{args.snapshot_dir!r}", file=sys.stderr)
         return 1
     cluster = merge_cluster(payloads)
+    if args.timeline is not None:
+        timeline = cluster.get("timeline")
+        if not timeline:
+            print("no host published spans — nothing to render "
+                  "(Telemetry.payload carries them since the tracing "
+                  "PR)", file=sys.stderr)
+            return 1
+        if args.timeline == "-":
+            print(json.dumps(timeline, indent=1))
+        else:
+            with open(args.timeline, "w") as f:
+                json.dump(timeline, f)
+            events = [e for e in timeline["traceEvents"]
+                      if e.get("ph") == "X"]
+            print(f"wrote {args.timeline}: {len(events)} spans from "
+                  f"{len(timeline['hosts'])} host(s) "
+                  f"({', '.join(timeline['hosts'])}) — load it at "
+                  f"ui.perfetto.dev")
+        return 0
     if args.json:
         print(json.dumps(cluster, indent=1))
     else:
