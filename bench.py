@@ -3040,6 +3040,307 @@ def run_blocksparse_bench() -> None:
 
 
 # --------------------------------------------------------------------------
+# SLO leg: the online health engine — detection latency, false
+# positives, recorder+engine overhead
+# --------------------------------------------------------------------------
+
+SLO_TIMEOUT = float(os.environ.get("BENCH_SLO_TIMEOUT", "240"))
+SLO_RESULT = "SLO_r01.json"
+
+
+def _slo_chaos_scenarios(eval_interval_s: float = 5.0,
+                         steady_intervals: int = 200):
+    """Deterministic chaos harness under an injected clock: scripted
+    fleet+training signal streams drive the default rule packs
+    through four injected breaches — shed ramp, loss divergence, MFU
+    collapse, replica kill — plus a steady control run.  Returns
+    per-scenario detection/resolution interval counts and the steady
+    pass's false-positive count (the acceptance bar: every breach
+    detected within 3 evaluation intervals, zero spurious alerts)."""
+    from bigdl_tpu.telemetry import (MetricRecorder, MetricsRegistry,
+                                     SloEngine, SloRule,
+                                     default_serving_rules,
+                                     default_training_rules)
+    from bigdl_tpu.telemetry import metric_names as M
+
+    def build():
+        clk = {"t": 0.0}
+        rec = MetricRecorder(clock=lambda: clk["t"])
+        rules = default_serving_rules(
+            "both", p99_high_s=0.5, shed_high=0.05,
+            error_budget=0.02, window_s=30.0, fast_window_s=15.0,
+            slow_window_s=60.0, for_intervals=2, resolve_intervals=2)
+        rules += [r for r in default_training_rules(
+            goodput_floor=0.5, loss_window_s=60.0,
+            divergence_ratio=1.5, mfu_drop_frac=0.5, window_s=60.0,
+            for_intervals=2, resolve_intervals=2)
+            # the stall rule legitimately fires on a converged flat
+            # loss; the chaos scenarios exercise divergence
+            if r.name != "training/loss_stall"]
+        rules.append(SloRule(
+            name="replica/r1/health_feed",
+            family=M.REPLICA_P99_SECONDS, labels={"replica": "r1"},
+            kind="absent", window_s=2 * eval_interval_s + 1.0,
+            resolve_intervals=1,
+            description="replica r1 health feed went silent"))
+        eng = SloEngine(rec, rules=rules,
+                        registry=MetricsRegistry(),
+                        clock=lambda: clk["t"])
+        state = {"clk": clk, "rec": rec, "eng": eng, "shed": 0,
+                 "total": 0, "loss": 4.0, "mfu": 0.5}
+        return state
+
+    def tick(st, shed_frac=0.0, diverge=False, kill_replica=False,
+             mfu=None):
+        st["clk"]["t"] += eval_interval_s
+        rec, L = st["rec"], {"pool": "both"}
+        n = 500
+        st["shed"] += int(n * shed_frac)
+        st["total"] += n
+        rec.observe(M.AUTOSCALE_POOL_P99_SECONDS, 0.040, labels=L)
+        rec.observe(M.AUTOSCALE_POOL_SHED_RATE, shed_frac, labels=L)
+        rec.observe(M.AUTOSCALE_POOL_KV_OCCUPANCY, 0.3, labels=L)
+        rec.observe(M.AUTOSCALE_POOL_SHED_TOTAL, st["shed"],
+                    labels=L, kind="counter")
+        rec.observe(M.AUTOSCALE_POOL_REQUESTS_TOTAL, st["total"],
+                    labels=L, kind="counter")
+        st["loss"] *= 1.8 if diverge else 0.98
+        rec.observe(M.TRAIN_LOSS, st["loss"])
+        rec.observe(M.TRAIN_STEP_TIME_SECONDS, 0.1)
+        rec.observe(M.GOODPUT_PRODUCTIVE_FRACTION, 0.97)
+        if mfu is not None:
+            st["mfu"] = mfu
+        rec.observe(M.PERF_MFU, st["mfu"])
+        if not kill_replica:
+            rec.observe(M.REPLICA_P99_SECONDS, 0.02,
+                        labels={"replica": "r1"})
+        return st["eng"].evaluate()
+
+    # --- steady control: full-length run, zero alerts expected -------
+    st = build()
+    false_positives = 0
+    for _ in range(steady_intervals):
+        false_positives += len(tick(st))
+
+    # --- injected breaches, one scenario run -------------------------
+    st = build()
+    for _ in range(20):                       # warmup, steady
+        false_positives += len(tick(st))
+    scenarios = {}
+
+    def run_scenario(name, expect_rule, breach_kw, recover_kw,
+                     max_detect=3, max_resolve=40):
+        detect = None
+        for i in range(1, max_detect + 1):
+            fired = [a.rule for a in tick(st, **breach_kw)
+                     if a.state == "firing"]
+            if expect_rule in fired:
+                detect = i
+                break
+        # hold the breach a few more intervals (the burn-rate rule
+        # joins during the shed ramp hold)
+        for _ in range(4):
+            tick(st, **breach_kw)
+        resolve = None
+        for i in range(1, max_resolve + 1):
+            tick(st, **recover_kw)
+            if not st["eng"].firing():
+                resolve = i
+                break
+        scenarios[name] = {
+            "detected_in_intervals": detect,
+            "resolved_in_intervals": resolve,
+            "expected_rule": expect_rule,
+        }
+
+    run_scenario("shed_ramp", "serving/both/shed_rate",
+                 dict(shed_frac=0.30), dict())
+    run_scenario("loss_divergence", "training/loss_divergence",
+                 dict(diverge=True), dict())
+    run_scenario("mfu_collapse", "training/mfu_collapse",
+                 dict(mfu=0.1), dict(mfu=0.5))
+    run_scenario("replica_kill", "replica/r1/health_feed",
+                 dict(kill_replica=True), dict())
+
+    detects = [s["detected_in_intervals"] for s in scenarios.values()]
+    resolves = [s["resolved_in_intervals"] for s in scenarios.values()]
+    return {
+        "eval_interval_s": eval_interval_s,
+        "steady_intervals": steady_intervals,
+        "scenarios": scenarios,
+        "all_detected": all(d is not None for d in detects),
+        "all_resolved": all(r is not None for r in resolves),
+        "max_detection_intervals": (max(detects)
+                                    if all(d is not None
+                                           for d in detects)
+                                    else None),
+        "detection_latency_s": (max(detects) * eval_interval_s
+                                if all(d is not None
+                                       for d in detects) else None),
+        "false_positives": false_positives,
+    }
+
+
+def _slo_measurements(eval_interval_s: float = 5.0,
+                      steady_intervals: int = 200,
+                      overhead_steps: int = 600,
+                      overhead_batch: int = 512,
+                      overhead_hidden: int = 128,
+                      overhead_repeats: int = 3,
+                      monitor_every: int = 32):
+    """The online-health-engine leg: (1) deterministic chaos
+    scenarios under an injected clock (detection latency on an
+    injected shed ramp / loss divergence / MFU collapse / replica
+    kill, false positives on a steady control), (2) recorder+engine
+    overhead on the SAME compiled step loop the telemetry leg
+    measures — telemetry-only vs telemetry+TrainingHealthMonitor at
+    the ``monitor_every``-step evaluation cadence, min-of-repeats
+    walls — and (3) per-op primitive costs."""
+    from bigdl_tpu import nn
+    from bigdl_tpu.dataset import Sample, array
+    from bigdl_tpu.optim import SGD, max_iteration
+    from bigdl_tpu.optim.optimizer import LocalOptimizer
+    from bigdl_tpu.telemetry import (MetricRecorder, MetricsRegistry,
+                                     SloEngine, Telemetry,
+                                     TrainingHealthMonitor,
+                                     default_training_rules)
+    from bigdl_tpu.telemetry import metric_names as M
+
+    import logging
+
+    import numpy as np
+
+    out = _slo_chaos_scenarios(eval_interval_s=eval_interval_s,
+                               steady_intervals=steady_intervals)
+
+    # --- overhead vs the telemetry leg's instrumented loop -----------
+    rng = np.random.RandomState(0)
+    x = rng.rand(1024, 16).astype(np.float32)
+    w = rng.rand(16, 1).astype(np.float32)
+    y = (x @ w + 0.3).astype(np.float32)
+    data = array([Sample(x[i], y[i]) for i in range(len(x))])
+    bigdl_log = logging.getLogger("bigdl_tpu")
+    prev_level = bigdl_log.level
+    bigdl_log.setLevel(logging.WARNING)
+
+    def run(with_monitor: bool) -> float:
+        model = nn.Sequential(nn.Linear(16, overhead_hidden),
+                              nn.Tanh(),
+                              nn.Linear(overhead_hidden, 1))
+        opt = LocalOptimizer(model, data, nn.MSECriterion(),
+                             batch_size=overhead_batch)
+        opt.set_optim_method(SGD(learning_rate=0.01))
+        opt.set_end_when(max_iteration(overhead_steps))
+        opt.set_telemetry(Telemetry(registry=MetricsRegistry()))
+        if with_monitor:
+            opt.set_health_monitor(TrainingHealthMonitor(
+                rules=default_training_rules(),
+                every_n_steps=monitor_every))
+        t0 = time.monotonic()
+        opt.optimize()
+        return time.monotonic() - t0
+
+    tel_walls, mon_walls = [], []
+    try:
+        for _ in range(max(1, overhead_repeats)):
+            tel_walls.append(run(False))
+            mon_walls.append(run(True))
+    finally:
+        bigdl_log.setLevel(prev_level)
+    tel, mon = min(tel_walls), min(mon_walls)
+    # informational only: on this 1-core container the A/B wall noise
+    # (±10-25% scheduler jitter) swamps the ~20µs/step signal even
+    # under min-of-repeats, so the JUDGED overhead below is the
+    # directly measured amortized per-step monitor cost over the
+    # loop's measured step time — stable run to run, and what the tax
+    # actually is
+    wall_overhead_pct = 100.0 * (mon - tel) / max(tel, 1e-9)
+    step_s = tel / max(1, overhead_steps)
+
+    # --- per-op primitive costs + the judged amortized tax -----------
+    rec = MetricRecorder()
+    n = 50_000
+    t0 = time.perf_counter()
+    for i in range(n):
+        # descending feed: the engine loops below must measure
+        # evaluation cost, not fire the stall rule
+        rec.observe(M.TRAIN_LOSS, float(n - i))
+    observe_ns = (time.perf_counter() - t0) / n * 1e9
+    eng = SloEngine(rec, rules=default_training_rules(),
+                    registry=MetricsRegistry())
+    n_eval = 2_000
+    t0 = time.perf_counter()
+    for _ in range(n_eval):
+        eng.evaluate()
+    evaluate_us = (time.perf_counter() - t0) / n_eval * 1e6
+    # amortized monitor cost per driver iteration, rings at steady
+    # state (full windows — the honest worst case for the reducers)
+    amon = TrainingHealthMonitor(rules=default_training_rules(),
+                                 every_n_steps=monitor_every,
+                                 registry=MetricsRegistry())
+    prev = bigdl_log.level
+    bigdl_log.setLevel(logging.ERROR)   # transitions are console I/O
+    try:
+        for i in range(2_000):          # fill the rings
+            amon.on_step(i, 4.0 * 0.999 ** i, step_s)
+        n_mon = 20_000
+        t0 = time.perf_counter()
+        for i in range(n_mon):
+            amon.on_step(i, 3.0, step_s)
+        monitor_step_us = (time.perf_counter() - t0) / n_mon * 1e6
+    finally:
+        bigdl_log.setLevel(prev)
+    overhead_pct = 100.0 * (monitor_step_us * 1e-6) / max(step_s,
+                                                          1e-9)
+
+    out.update({
+        "overhead_steps": overhead_steps,
+        "monitor_every_n_steps": monitor_every,
+        "telemetry_wall_s": round(tel, 3),
+        "monitored_wall_s": round(mon, 3),
+        "wall_overhead_pct": round(wall_overhead_pct, 2),
+        "step_ms": round(step_s * 1e3, 3),
+        "monitor_step_us": round(monitor_step_us, 1),
+        "overhead_pct": round(overhead_pct, 2),
+        "recorder_observe_ns": round(observe_ns, 0),
+        "engine_evaluate_us": round(evaluate_us, 1),
+    })
+    return out
+
+
+def run_slo_bench() -> None:
+    """--slo mode: the online health engine — chaos detection
+    latency + false positives under an injected clock, recorder+
+    engine overhead on the instrumented step loop — writes
+    SLO_r01.json, prints the one JSON line."""
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    out = {"bench": "slo", "backend": "cpu",
+           "measured_at": _utc_now()}
+    try:
+        out.update(_slo_measurements())
+        out.update({
+            "metric": "SLO detection latency on injected breaches",
+            "value": out.get("detection_latency_s") or 0.0,
+            "unit": "s",
+            "target": "<= 3 evaluation intervals, 0 false positives, "
+                      "<= 1% overhead",
+        })
+    except Exception as e:
+        out["error"] = f"{type(e).__name__}: {e}"[:500]
+        out.update({"metric": "SLO detection latency on injected "
+                              "breaches",
+                    "value": 0.0, "unit": "s"})
+    try:
+        with open(os.path.join(_here(), SLO_RESULT), "w") as f:
+            json.dump(out, f, indent=1)
+    except OSError:
+        pass
+    print(json.dumps(out), flush=True)
+
+
+# --------------------------------------------------------------------------
 # Perf ledger: the append-only trajectory record the sentinel guards
 # --------------------------------------------------------------------------
 
@@ -3070,6 +3371,8 @@ LEDGER_FIELDS = (
     "checkpoint_blocked_s",
     "sharding_composed_steps_per_sec", "sharding_fsdp_param_bytes_frac",
     "dlrm_steps_per_sec", "dlrm_collective_bytes_per_step",
+    "slo_detection_latency_s", "slo_false_positives",
+    "slo_overhead_pct",
     "resnet50_conv_fallback",
     "blocksparse_t4096_mfu", "blocksparse_speedup_x",
     "attn_kernel_fallback",
@@ -3136,6 +3439,14 @@ def ledger_record(result: dict) -> dict:
     flat["dlrm_steps_per_sec"] = dlrm.get("steps_per_sec")
     flat["dlrm_collective_bytes_per_step"] = dlrm.get(
         "collective_bytes_per_step")
+    # the online health engine (ISSUE 14): detection latency may only
+    # fall, the steady control's false-positive count must stay ZERO,
+    # and the recorder+engine overhead may only fall — the online SLO
+    # layer must never get slower to notice or noisier to trust
+    slo = result.get("slo") or {}
+    flat["slo_detection_latency_s"] = slo.get("detection_latency_s")
+    flat["slo_false_positives"] = slo.get("false_positives")
+    flat["slo_overhead_pct"] = slo.get("overhead_pct")
     # the block-sparse kernel family (ISSUE 12): the T4096 MFU rides
     # the TPU worker's executed-basis row; the speedup multiple prefers
     # the worker's measured wall ratio and falls back to the CPU leg's
@@ -3604,6 +3915,32 @@ def main(ledger: bool = True, probe: bool = True) -> None:
                     or "dlrm leg returned nothing"}
     result["dlrm"] = dlrm
 
+    # slo leg: the online health engine — chaos detection latency +
+    # false positives under an injected clock, recorder+engine
+    # overhead on the instrumented step loop (backend-independent,
+    # lands in SLO_r01.json) — best-effort like the other legs;
+    # BENCH_SLO_TIMEOUT=0 disables it.
+    if SLO_TIMEOUT <= 0:
+        slo = {"skipped": "BENCH_SLO_TIMEOUT=0"}
+    else:
+        ok, slres, note = _run_sub(["--slo"], SLO_TIMEOUT)
+        if ok and slres and "error" not in slres:
+            slo = {
+                "detection_latency_s": slres.get(
+                    "detection_latency_s"),
+                "max_detection_intervals": slres.get(
+                    "max_detection_intervals"),
+                "false_positives": slres.get("false_positives"),
+                "all_detected": slres.get("all_detected"),
+                "all_resolved": slres.get("all_resolved"),
+                "overhead_pct": slres.get("overhead_pct"),
+                "source": SLO_RESULT,
+            }
+        else:
+            slo = {"error": (slres or {}).get("error") or note
+                   or "slo leg returned nothing"}
+    result["slo"] = slo
+
     # blocksparse leg: the BLaST kernel lab — full-mask parity, the
     # executed-work-∝-density accounting proof, and the sparse-FLOPs
     # correction round trip (backend-independent, lands in
@@ -3664,7 +4001,7 @@ def main(ledger: bool = True, probe: bool = True) -> None:
             # whatever the stale chip record carried
             for leg in ("serving", "fleet", "disagg", "elastic",
                         "integrity", "telemetry", "sharding", "dlrm",
-                        "blocksparse"):
+                        "slo", "blocksparse"):
                 if result.get(leg) is not None:
                     merged[leg] = result[leg]
             result = merged
@@ -3693,6 +4030,7 @@ if __name__ == "__main__":
     p.add_argument("--telemetry", action="store_true")
     p.add_argument("--sharding", action="store_true")
     p.add_argument("--dlrm", action="store_true")
+    p.add_argument("--slo", action="store_true")
     p.add_argument("--blocksparse", action="store_true")
     p.add_argument("--worker", choices=["tpu", "cpu"])
     # every orchestrated run appends to PERF_LEDGER.jsonl by default;
@@ -3726,6 +4064,8 @@ if __name__ == "__main__":
         run_sharding_bench()
     elif a.dlrm:
         run_dlrm_bench()
+    elif a.slo:
+        run_slo_bench()
     elif a.blocksparse:
         run_blocksparse_bench()
     elif a.worker:

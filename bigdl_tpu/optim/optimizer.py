@@ -140,6 +140,10 @@ class Optimizer:
         # registry + structured tracer + goodput ledger — off unless
         # set_telemetry attaches one
         self.telemetry = None
+        # online health verdicts (telemetry/slo.py): loss/step-time/
+        # goodput/MFU SLO rules evaluated WHILE the run is live — off
+        # unless set_health_monitor attaches a TrainingHealthMonitor
+        self.health_monitor = None
         # --- async everything (docs/async.md) -------------------------
         # background snapshot-then-write checkpointing: serialize at
         # the step boundary (synchronous — bitwise-identical bytes),
@@ -404,6 +408,43 @@ class Optimizer:
         if self.elastic is not None:
             self.elastic.telemetry = telemetry
         return self
+
+    def set_health_monitor(self, monitor):
+        """Attach a :class:`bigdl_tpu.telemetry.TrainingHealthMonitor`:
+        the step loop then feeds it per-iteration loss and step time,
+        it evaluates the training SLO rule pack (loss-descent stall/
+        divergence, step-time drift, goodput floor, MFU collapse) at
+        its cadence, and :meth:`health_verdict` answers the live
+        :class:`~bigdl_tpu.telemetry.HealthVerdict` — the watchdog
+        hook the continuous-learning loop consults while serving.
+        A monitor built without a telemetry bundle adopts this
+        optimizer's at attach time.  Pass ``None`` to detach."""
+        self.health_monitor = monitor
+        if monitor is not None and monitor.telemetry is None \
+                and self.telemetry is not None:
+            monitor.telemetry = self.telemetry
+            if getattr(self.telemetry, "slo", None) is None:
+                self.telemetry.slo = monitor.engine
+        return self
+
+    def health_verdict(self):
+        """The live training health verdict
+        (:class:`~bigdl_tpu.telemetry.HealthVerdict`), or None when no
+        monitor is attached."""
+        return (self.health_monitor.verdict()
+                if self.health_monitor is not None else None)
+
+    def _health_step(self, state, loss: float, seconds: float):
+        """Per-iteration health feed (no-op without a monitor): the
+        monitor samples at its own cadence and must never take down
+        training."""
+        hm = self.health_monitor
+        if hm is None:
+            return
+        try:
+            hm.on_step(state["neval"], loss, seconds)
+        except Exception:
+            log.debug("health monitor step failed", exc_info=True)
 
     def set_elastic(self, context):
         """Attach an elastic-cluster context
@@ -1240,6 +1281,7 @@ class Optimizer:
                               phase_split=trace_split, skipped=skipped)
                 first_step = False
                 self._check_loss_anomaly(loss, skipped)
+                self._health_step(state, loss, train_time)
                 params = self._maybe_corrupt_params(state, params)
                 self._record_fingerprint(state, loss, float(gnorm),
                                          (x, y), lambda: params,

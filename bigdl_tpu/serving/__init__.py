@@ -65,6 +65,7 @@ from .batcher import MicroBatcher
 from .breaker import CircuitBreaker
 from .compile_cache import set_compile_cache_dir
 from .fleet import FleetQuorumError, ReplicaAgent, ServingFleet
+from .health import FleetHealthMonitor, ReplicaHealthPolicy
 from .kvpool import KVPagePool, PageLease, PoolExhausted
 from .metrics import ServingMetrics
 from .pools import HandoffCorrupt
@@ -77,9 +78,11 @@ from .swap import load_verified_params
 
 __all__ = [
     "AutoscalePolicy", "Autoscaler", "CircuitBreaker",
-    "FleetQuorumError", "FleetRouter", "HandoffCorrupt",
+    "FleetHealthMonitor", "FleetQuorumError", "FleetRouter",
+    "HandoffCorrupt",
     "InferenceServer", "KVPagePool", "MicroBatcher", "PageLease",
-    "PoolExhausted", "ReplicaAgent", "ReplicaTraceSink",
+    "PoolExhausted", "ReplicaAgent", "ReplicaHealthPolicy",
+    "ReplicaTraceSink",
     "RequestTracer", "ServeFuture", "ServeResult",
     "ServingFleet", "ServingMetrics", "Status",
     "load_verified_params", "set_compile_cache_dir",

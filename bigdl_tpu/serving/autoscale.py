@@ -30,6 +30,17 @@ Every decision is a structured event (kept in ``decisions``, logged)
 plus a ``bigdl_autoscale_decisions_total{pool,direction}`` counter in
 the router registry, so the scaling history is scrape-visible next to
 the request metrics it acted on.
+
+Since the online health engine (``telemetry/slo.py``) the breach
+signal is, by default, an **SLO verdict**: the per-pool signals feed a
+:class:`~bigdl_tpu.telemetry.timeseries.MetricRecorder`, each raw
+watermark is a declarative rule in a
+:class:`~bigdl_tpu.telemetry.slo.SloEngine`, and a breach is a FIRING
+alert — same thresholds, same hysteresis/cooldown/bounds semantics
+(decision-for-decision identical, tested), but every breach and
+recovery is now a structured ``bigdl_alerts_total`` transition an
+operator can scrape and page on.  ``signal_source="raw"`` keeps the
+pre-SLO inline-comparison path as the fallback.
 """
 from __future__ import annotations
 
@@ -37,7 +48,7 @@ import logging
 import math
 import time
 from dataclasses import dataclass, field
-from typing import Callable, Dict, List, Optional, Sequence
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 from .pools import serves_phase
 
@@ -111,7 +122,11 @@ class Autoscaler:
                  policy: Optional[AutoscalePolicy] = None,
                  policies: Optional[Dict[str, AutoscalePolicy]] = None,
                  pools: Optional[Sequence[str]] = None,
+                 signal_source: str = "slo",
                  clock: Callable[[], float] = time.monotonic):
+        if signal_source not in ("raw", "slo"):
+            raise ValueError(f"signal_source {signal_source!r} not "
+                             f"raw|slo")
         self.fleet = fleet
         self.replica_factory = replica_factory
         if pools is None:
@@ -131,6 +146,129 @@ class Autoscaler:
                 "bigdl_autoscale_decisions_total",
                 "autoscaler actions per pool and direction",
                 labels=("pool", "direction"))
+        #: "slo" (the default) evaluates the breach predicates as SLO
+        #: rules over a MetricRecorder — identical thresholds/
+        #: hysteresis/cooldown semantics, but every breach/recovery is
+        #: a structured Alert + ``bigdl_alerts_total`` transition, and
+        #: the per-pool signal history is queryable.  "raw" is the
+        #:  pre-SLO inline-comparison path, kept as the fallback.
+        self.signal_source = signal_source
+        self.slo_engine = None
+        self._slo_recorder = None
+        self._pool_rules: Dict[str, Tuple[str, ...]] = {}
+        if signal_source == "slo":
+            self._build_slo_engine()
+
+    # ------------------------------------------------------ slo plumbing
+    def _build_slo_engine(self):
+        from ..telemetry import metric_names as M
+        from ..telemetry.slo import SloEngine, SloRule
+        from ..telemetry.timeseries import MetricRecorder
+
+        self._slo_recorder = MetricRecorder(clock=self._clock)
+        self.slo_engine = SloEngine(
+            self._slo_recorder,
+            registry=self.fleet.router.metrics.registry,
+            clock=self._clock)
+        for pool in self.pools:
+            policy = self.policies[pool]
+            L = {"pool": pool}
+            # one rule per raw breach predicate, SAME thresholds, with
+            # for/resolve_intervals=1: the autoscaler's own
+            # breach_streak/sustain keeps hysteresis semantics
+            # IDENTICAL to the raw path (one firing == one raw
+            # breach).  staleness_s=0.0 means ONLY a signal fed this
+            # very round yields a verdict — the recorder's staleness
+            # gate IS the traffic-activity gate (an inactive pool's
+            # p99/queue are simply not refreshed, so their rules
+            # render no verdict and the breach list excludes them)
+            rules = [
+                SloRule(name=f"autoscale/{pool}/p99",
+                        family=M.AUTOSCALE_POOL_P99_SECONDS, labels=L,
+                        kind="threshold", reduce="last", op=">=",
+                        threshold=policy.p99_high_s,
+                        window_s=3600.0, staleness_s=0.0,
+                        description=f"{pool} p99 >= "
+                                    f"{policy.p99_high_s}s"),
+                SloRule(name=f"autoscale/{pool}/shed",
+                        family=M.AUTOSCALE_POOL_SHED_RATE, labels=L,
+                        kind="threshold", reduce="last", op=">=",
+                        threshold=policy.shed_high,
+                        window_s=3600.0, staleness_s=0.0,
+                        description=f"{pool} shed rate >= "
+                                    f"{policy.shed_high}"),
+                SloRule(name=f"autoscale/{pool}/queue",
+                        family=M.AUTOSCALE_POOL_QUEUE_DEPTH, labels=L,
+                        kind="threshold", reduce="last", op=">=",
+                        threshold=policy.queue_high,
+                        window_s=3600.0, staleness_s=0.0,
+                        description=f"{pool} queue >= "
+                                    f"{policy.queue_high}"),
+                SloRule(name=f"autoscale/{pool}/kv",
+                        family=M.AUTOSCALE_POOL_KV_OCCUPANCY,
+                        labels=L, kind="threshold", reduce="last",
+                        op=">=",
+                        threshold=policy.kv_occupancy_high,
+                        window_s=3600.0, staleness_s=0.0,
+                        description=f"{pool} kv occupancy >= "
+                                    f"{policy.kv_occupancy_high}"),
+            ]
+            for rule in rules:
+                self.slo_engine.add_rule(rule)
+            self._pool_rules[pool] = tuple(r.name for r in rules)
+
+    def _slo_feed(self, pool: str, sig: dict, active: bool,
+                  now: float):
+        """Feed this round's pool signals into the recorder.  The
+        traffic-activity gate becomes the recorder's STALENESS gate:
+        over no fresh traffic the windowed p99/queue are stale
+        history, so they are simply not refreshed and their rules
+        render no verdict (never a breach).  Shed/KV are refreshed
+        unconditionally — a quiet pool's shed rate is honestly 0 and
+        occupancy is held state, not history."""
+        from ..telemetry import metric_names as M
+
+        r = self._slo_recorder
+        L = {"pool": pool}
+        if active:
+            r.observe(M.AUTOSCALE_POOL_P99_SECONDS, sig["p99_s"],
+                      labels=L, now=now)
+            r.observe(M.AUTOSCALE_POOL_QUEUE_DEPTH,
+                      sig["queue_depth"], labels=L, now=now)
+        # the raw predicate is (shed_rate >= high AND shed_delta > 0):
+        # a window with no shed events reads 0.0, never a breach
+        r.observe(M.AUTOSCALE_POOL_SHED_RATE,
+                  sig["shed_rate"] if sig["shed_delta"] > 0 else 0.0,
+                  labels=L, now=now)
+        r.observe(M.AUTOSCALE_POOL_KV_OCCUPANCY, sig["kv_occupancy"],
+                  labels=L, now=now)
+        # cumulative pool counters: the error-budget burn-rate view
+        # (default_serving_rules) and any scraper ride these
+        st = self._state[pool]
+        r.observe(M.AUTOSCALE_POOL_SHED_TOTAL,
+                  float(sum(st.last_shed.values())), labels=L,
+                  kind="counter", now=now)
+        r.observe(M.AUTOSCALE_POOL_REQUESTS_TOTAL,
+                  float(sum(st.last_total.values())), labels=L,
+                  kind="counter", now=now)
+
+    def _slo_breaches(self, pool: str, now: float) -> List[str]:
+        """The pool's firing rules WITH a verdict this round, as
+        breach descriptions — the SLO verdicts the control logic
+        consumes in place of the raw comparisons.  A rule frozen by
+        the staleness gate (inactive pool: p99/queue not refreshed)
+        contributes nothing, exactly the raw activity gate."""
+        out = []
+        for a in self.slo_engine.firing(self._pool_rules[pool]):
+            if a.get("last_verdict_at") is None \
+                    or a["last_verdict_at"] < now:
+                continue
+            if isinstance(a["value"], (int, float)):
+                out.append(f"{a['rule']}: {a['description']} "
+                           f"(value={a['value']:.4g})")
+            else:
+                out.append(f"{a['rule']}: {a['description']}")
+        return out
 
     # ------------------------------------------------------------ signals
     def _pool_health(self, pool: str) -> Dict[str, dict]:
@@ -235,29 +373,54 @@ class Autoscaler:
     def evaluate_once(self) -> List[dict]:
         """One control round over every managed pool.  Returns the
         decisions taken this round (possibly empty — sustained-breach
-        hysteresis and cooldowns mean MOST rounds act on nothing)."""
+        hysteresis and cooldowns mean MOST rounds act on nothing).
+
+        With ``signal_source="slo"`` (the default) the breach
+        predicates are SLO rules: signals feed the recorder (gated —
+        an inactive pool's p99/queue are not refreshed, so their
+        rules render no verdict), ONE engine evaluation fires/resolves
+        the per-pool rules as structured alerts, and the breach list
+        is the pool's fresh firing set — identical decisions to the
+        raw path, now alert-visible.  Scale-down idleness stays a raw
+        capacity read in both modes (quiet is not an SLO breach)."""
+        now = self._clock()
+        signals: Dict[str, dict] = {}
+        actives: Dict[str, bool] = {}
+        for pool in self.pools:
+            policy = self.policies[pool]
+            sig = signals[pool] = self.pool_signals(pool)
+            gate = policy.idle_requests_delta
+            actives[pool] = (gate is None
+                             or sig["requests_delta"] > gate)
+            if self.slo_engine is not None:
+                self._slo_feed(pool, sig, actives[pool], now)
+        if self.slo_engine is not None:
+            self.slo_engine.evaluate(now=now)
         taken = []
         for pool in self.pools:
             policy = self.policies[pool]
             st = self._state[pool]
-            sig = self.pool_signals(pool)
-            gate = policy.idle_requests_delta
-            active = gate is None or sig["requests_delta"] > gate
-            breaches = []
-            if active and sig["p99_s"] >= policy.p99_high_s:
-                breaches.append(f"p99 {sig['p99_s']:.3f}s >= "
-                                f"{policy.p99_high_s}s")
-            if sig["shed_rate"] >= policy.shed_high \
-                    and sig["shed_delta"] > 0:
-                breaches.append(f"shed rate {sig['shed_rate']:.3f} >= "
-                                f"{policy.shed_high}")
-            if active and sig["queue_depth"] >= policy.queue_high:
-                breaches.append(f"queue {sig['queue_depth']} >= "
-                                f"{policy.queue_high}")
-            if sig["kv_occupancy"] >= policy.kv_occupancy_high:
-                breaches.append(
-                    f"kv occupancy {sig['kv_occupancy']:.2f} >= "
-                    f"{policy.kv_occupancy_high}")
+            sig = signals[pool]
+            active = actives[pool]
+            if self.slo_engine is not None:
+                breaches = self._slo_breaches(pool, now)
+            else:
+                breaches = []
+                if active and sig["p99_s"] >= policy.p99_high_s:
+                    breaches.append(f"p99 {sig['p99_s']:.3f}s >= "
+                                    f"{policy.p99_high_s}s")
+                if sig["shed_rate"] >= policy.shed_high \
+                        and sig["shed_delta"] > 0:
+                    breaches.append(
+                        f"shed rate {sig['shed_rate']:.3f} >= "
+                        f"{policy.shed_high}")
+                if active and sig["queue_depth"] >= policy.queue_high:
+                    breaches.append(f"queue {sig['queue_depth']} >= "
+                                    f"{policy.queue_high}")
+                if sig["kv_occupancy"] >= policy.kv_occupancy_high:
+                    breaches.append(
+                        f"kv occupancy {sig['kv_occupancy']:.2f} >= "
+                        f"{policy.kv_occupancy_high}")
             idle = (sig["shed_delta"] == 0
                     and sig["queue_depth"] <= policy.queue_idle
                     and sig["kv_occupancy"]
@@ -266,7 +429,6 @@ class Autoscaler:
                          or sig["p99_s"] <= policy.p99_idle_s))
             st.breach_streak = st.breach_streak + 1 if breaches else 0
             st.idle_streak = st.idle_streak + 1 if idle else 0
-            now = self._clock()
             if now - st.last_action_t < policy.cooldown_s:
                 continue  # hold: the last action is still settling
             before = len(self.decisions)

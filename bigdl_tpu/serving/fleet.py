@@ -163,6 +163,8 @@ class ServingFleet:
                  router_kw: Optional[dict] = None,
                  tracing: bool = False,
                  trace_kw: Optional[dict] = None,
+                 health: bool = False,
+                 health_kw: Optional[dict] = None,
                  clock: Callable[[], float] = time.monotonic):
         if not servers:
             raise ValueError("a fleet needs at least one replica")
@@ -218,6 +220,17 @@ class ServingFleet:
                     srv.trace_sink = self._make_sink(rid)
         self.router = FleetRouter(self.servers, coordinator,
                                   **router_kw)
+        # per-replica SLO health (serving/health.py): each pump round
+        # evaluates the per-replica rule pack over published health
+        # and marks breaching replicas degraded on the router —
+        # answering-but-answering-badly replicas leave rotation
+        # through the same eject machinery silence does
+        self.health_monitor = None
+        if health:
+            from .health import FleetHealthMonitor
+
+            self.health_monitor = FleetHealthMonitor(
+                self, clock=clock, **(health_kw or {}))
         self.deploys = 0
         self.deploy_rollbacks = 0
         self._pump_thread: Optional[threading.Thread] = None
@@ -313,6 +326,10 @@ class ServingFleet:
         deterministic membership transitions."""
         for agent in list(self.agents.values()):
             agent.pump()
+        if self.health_monitor is not None:
+            # evaluate BEFORE the refresh so a fresh degradation mark
+            # is acted on (ejected) in this same round
+            self.health_monitor.observe()
         self.router.refresh()
 
     def _pump_loop(self):
@@ -518,6 +535,7 @@ class ServingFleet:
         "bigdl_serving_hedges_total", "bigdl_serving_retries_total",
         "bigdl_fleet_dispatch_total",
         "bigdl_autoscale_decisions_total",
+        "bigdl_alerts_total", "bigdl_alerts_active",
     )
 
     def _router_fold_metrics(self) -> dict:
@@ -551,6 +569,8 @@ class ServingFleet:
             "deploys": self.deploys,
             "deploy_rollbacks": self.deploy_rollbacks,
             "goodput_per_chip": self.goodput_per_chip(),
+            "health": (self.health_monitor.snapshot()
+                       if self.health_monitor is not None else None),
             "metrics": merge_metrics(registries),
         }
 

@@ -146,6 +146,11 @@ class FleetRouter:
         self._health: Dict[str, dict] = {}
         self._inflight: Dict[str, int] = {r: 0 for r in self.replicas}
         self._breakers: Dict[str, CircuitBreaker] = {}
+        # replica -> reason: marked by the SLO health monitor on a
+        # per-replica rule breach.  A degraded replica is unroutable
+        # and EJECTED at the next refresh (the breaker-open path), and
+        # is not re-admitted until the mark clears
+        self._degraded: Dict[str, str] = {}
         self._dispatch_total = self.metrics.registry.counter(
             "bigdl_fleet_dispatch_total",
             "router dispatches per replica and terminal status",
@@ -165,11 +170,15 @@ class FleetRouter:
 
     def live(self) -> Tuple[str, ...]:
         """Members currently routable: health known-ready (or not yet
-        reported) and router-side breaker not rejecting."""
+        reported), not SLO-degraded, and router-side breaker not
+        rejecting."""
         with self._lock:
             members, health = self._members, dict(self._health)
+            degraded = set(self._degraded)
         out = []
         for r in members:
+            if r in degraded:
+                continue
             h = health.get(r)
             if h is not None and not h.get("ready", True):
                 continue
@@ -177,6 +186,33 @@ class FleetRouter:
                 continue
             out.append(r)
         return tuple(out)
+
+    # -------------------------------------------------- SLO degradation
+    def mark_degraded(self, replica: str, reason: str = "") -> None:
+        """An SLO rule breached on this replica (serving/health.py):
+        stop routing to it NOW and eject it from membership at the
+        next refresh — the same machinery a reported-open breaker
+        rides.  Idempotent."""
+        with self._lock:
+            known = replica in self.replicas
+            already = replica in self._degraded
+            self._degraded[str(replica)] = str(reason)
+        if known and not already:
+            log.warning("fleet: replica %s marked DEGRADED (%s)",
+                        replica, reason or "slo breach")
+
+    def clear_degraded(self, replica: str) -> None:
+        """The breaching rule resolved: the replica may re-admit
+        through the normal returner path (beats + reports ready)."""
+        with self._lock:
+            was = self._degraded.pop(str(replica), None)
+        if was is not None:
+            log.info("fleet: replica %s degradation cleared", replica)
+
+    @property
+    def degraded(self) -> Dict[str, str]:
+        with self._lock:
+            return dict(self._degraded)
 
     def health_of(self, replica: str) -> Optional[dict]:
         with self._lock:
@@ -196,31 +232,40 @@ class FleetRouter:
             h = read_health(c.transport, r)
             if h is not None:
                 health[r] = h
+        with self._lock:
+            degraded_marks = set(self._degraded)
         dead = [m for m in members if m not in alive]
         breaker_open = [
             m for m in members if m in alive
             and (health.get(m) or {}).get("breaker_state") == "open"]
-        out = dead + breaker_open
+        degraded = [m for m in members
+                    if m in alive and m not in breaker_open
+                    and m in degraded_marks]
+        out = dead + breaker_open + degraded
         if out:
             survivors = [m for m in members if m not in out]
             if survivors:
                 n2 = c.propose(
                     survivors,
                     f"fleet eject: dead={dead} "
-                    f"breaker_open={breaker_open}", expect=n)
+                    f"breaker_open={breaker_open} "
+                    f"degraded={degraded}", expect=n)
                 if n2 is not None:
                     for m in out:
                         c.evict(m, "missed heartbeats" if m in dead
-                                else "breaker open")
+                                else ("slo degraded" if m in degraded
+                                      else "breaker open"))
                     self.ejections += len(out)
                     log.warning(
-                        "fleet: ejected %s (dead=%s breaker_open=%s), "
-                        "incarnation %d members=%s", out, dead,
-                        breaker_open, n2, survivors)
+                        "fleet: ejected %s (dead=%s breaker_open=%s "
+                        "degraded=%s), incarnation %d members=%s",
+                        out, dead, breaker_open, degraded, n2,
+                        survivors)
                 n, members = c.membership()
         rejoiners = [
             r for r in sorted(alive)
             if r not in members and r in self.replicas
+            and r not in degraded_marks
             and (health.get(r) or {}).get("ready")]
         if rejoiners:
             grown = sorted(set(members) | set(rejoiners))
@@ -253,6 +298,7 @@ class FleetRouter:
             self.replicas.pop(replica, None)
             self._health.pop(replica, None)
             self._breakers.pop(replica, None)
+            self._degraded.pop(replica, None)
         c = self.coordinator
         n, members = c.membership()
         if replica in members:
@@ -291,9 +337,11 @@ class FleetRouter:
             members = self._members
             health = dict(self._health)
             inflight = dict(self._inflight)
+            degraded = set(self._degraded)
         ranked = []
         for r in members:
-            if r in exclude or r not in self.replicas:
+            if r in exclude or r not in self.replicas \
+                    or r in degraded:
                 continue
             h = health.get(r)
             if h is not None and not h.get("ready", True):
@@ -727,6 +775,7 @@ class FleetRouter:
         return {
             "members": members,
             "live": list(self.live()),
+            "degraded": self.degraded,
             "inflight": inflight,
             "pools": {"prefill": list(self.pool_members("prefill")),
                       "decode": list(self.pool_members("decode"))},

@@ -30,33 +30,43 @@ import time
 from typing import Optional
 
 from .aggregate import (
-    collect_snapshots, merge_cluster, merge_metrics, merge_timeline,
-    publish_snapshot, read_snapshot_dir, write_snapshot,
+    collect_snapshots, merge_alerts, merge_cluster, merge_metrics,
+    merge_timeline, publish_snapshot, read_snapshot_dir,
+    write_snapshot,
 )
 from .device_info import DeviceSpec, device_spec, peak_flops_per_sec
 from .goodput import GOODPUT_CATEGORIES, GoodputLedger
+from .metric_names import METRIC_FAMILY_NAMES
 from .perf import PerfAccountant, StepCost, classify_roofline
 from .publish import BackgroundPublisher
 from .registry import (
     Counter, Gauge, Histogram, MetricsRegistry, default_buckets,
     default_registry, reset_default_registry,
 )
+from .slo import (Alert, HealthVerdict, SloEngine, SloRule,
+                  TrainingHealthMonitor, default_serving_rules,
+                  default_training_rules)
 from .slog import configure_logging, get_logger
+from .timeseries import MetricRecorder
 from .trace_context import (REQUEST_CATEGORIES, TRACE_KV_PREFIX,
                             TailSampler, TraceContext)
 from .tracer import CATEGORIES, STEP_CATEGORIES, Span, Tracer
 
 __all__ = [
-    "BackgroundPublisher", "CATEGORIES", "GOODPUT_CATEGORIES",
+    "Alert", "BackgroundPublisher", "CATEGORIES",
+    "GOODPUT_CATEGORIES",
     "Counter", "DeviceSpec",
-    "Gauge", "Histogram", "MetricsRegistry", "GoodputLedger",
+    "Gauge", "HealthVerdict", "Histogram", "METRIC_FAMILY_NAMES",
+    "MetricRecorder", "MetricsRegistry", "GoodputLedger",
     "PerfAccountant", "REQUEST_CATEGORIES", "STEP_CATEGORIES",
+    "SloEngine", "SloRule",
     "Span", "StepCost", "TRACE_KV_PREFIX", "TailSampler",
-    "Telemetry", "TraceContext", "Tracer",
+    "Telemetry", "TraceContext", "Tracer", "TrainingHealthMonitor",
     "classify_roofline", "collect_snapshots", "configure_logging",
-    "default_buckets", "default_registry", "device_spec", "get_logger",
-    "merge_cluster", "merge_metrics", "merge_timeline",
-    "peak_flops_per_sec",
+    "default_buckets", "default_registry", "default_serving_rules",
+    "default_training_rules", "device_spec", "get_logger",
+    "merge_alerts", "merge_cluster", "merge_metrics",
+    "merge_timeline", "peak_flops_per_sec",
     "publish_snapshot", "read_snapshot_dir", "reset_default_registry",
     "write_snapshot",
 ]
@@ -97,6 +107,10 @@ class Telemetry:
         self.trace_every = max(0, int(trace_every))
         self.incarnation = 0
         self._steps_seen = 0
+        #: optional online SLO engine (telemetry/slo.py) — a
+        #: TrainingHealthMonitor built over this bundle registers
+        #: itself here so payload() publishes the active-alert view
+        self.slo = None
         r = self.registry
         # bind the CONCRETE unlabeled series (family.labels()), not the
         # family wrapper: the per-step hooks below run inside the
@@ -274,6 +288,10 @@ class Telemetry:
             "clock_anchor": {"mono": self.tracer.clock(),
                              "wall": time.time()},
             "perf": self.perf.payload(),
+            # active/recent SLO alerts (None without an engine) — the
+            # cluster fold unions these into the run-report alert table
+            "alerts": (self.slo.snapshot() if self.slo is not None
+                       else None),
         }
 
     def write_snapshot(self, directory: Optional[str] = None,

@@ -28,8 +28,9 @@ from typing import Dict, List, Optional, Sequence
 from .goodput import GoodputLedger
 
 __all__ = [
-    "TM_PREFIX", "collect_snapshots", "merge_cluster", "merge_metrics",
-    "merge_perf", "merge_timeline", "publish_snapshot",
+    "TM_PREFIX", "collect_snapshots", "merge_alerts", "merge_cluster",
+    "merge_metrics", "merge_perf", "merge_timeline",
+    "metrics_to_prometheus", "publish_snapshot",
     "read_snapshot_dir", "write_snapshot",
 ]
 
@@ -163,15 +164,106 @@ def _fold_series(cur: dict, series: dict, kind: str):
                 cur.get("buckets") and series.get("buckets"):
             cur["buckets"] = [a + b for a, b in zip(cur["buckets"],
                                                     series["buckets"])]
-        else:  # geometry drift: keep count/sum, drop the buckets
-            cur.pop("buckets", None)
+            # exemplars DO merge: a trace id is a fleet-wide pointer
+            # (the trc/ fragments live on the shared transport, not in
+            # a per-host store), so the merged bucket keeps the NEWEST
+            # exemplar per bucket across hosts — before this fix the
+            # fold silently discarded every exemplar the PR 13 tracing
+            # attached, orphaning the OpenMetrics trace links in every
+            # fleet-level scrape
+            merged_ex = dict(cur.get("exemplars") or {})
+            for idx, ex in (series.get("exemplars") or {}).items():
+                have = merged_ex.get(idx)
+                if have is None or float(ex.get("ts") or 0.0) \
+                        >= float(have.get("ts") or 0.0):
+                    merged_ex[idx] = dict(ex)
+            if merged_ex:
+                cur["exemplars"] = merged_ex
+            else:
+                cur.pop("exemplars", None)
+        else:  # geometry drift: keep count/sum, drop buckets AND
+            cur.pop("buckets", None)   # their per-bucket exemplars
+            cur.pop("exemplars", None)
         # per-series quantiles do not merge; the cluster view keeps
-        # count/sum/min/max (+ merged buckets when geometries match).
-        # Exemplars are per-host pointers into per-host trace stores —
-        # a merged bucket cannot keep one honestly, so they drop too.
+        # count/sum/min/max (+ merged buckets when geometries match)
         cur.pop("p50", None)
         cur.pop("p99", None)
-        cur.pop("exemplars", None)
+
+
+def metrics_to_prometheus(metrics: dict) -> str:
+    """Prometheus/OpenMetrics text of a snapshot-shaped metrics dict —
+    including a MERGED cluster view (:func:`merge_metrics` output), so
+    the fleet-level scrape carries the folded histograms WITH their
+    surviving exemplars (the round-trip the exemplar-merge fix is
+    tested through).  Mirrors ``MetricsRegistry.to_prometheus``."""
+    from .registry import _esc_help, _fmt_float, _label_str
+
+    lines = []
+    for name, fam in sorted((metrics or {}).items()):
+        kind = fam.get("type")
+        if fam.get("help"):
+            lines.append(f"# HELP {name} {_esc_help(fam['help'])}")
+        lines.append(f"# TYPE {name} {kind}")
+        for series in fam.get("series", ()):
+            labels = series.get("labels") or {}
+            if kind == "histogram":
+                bounds = series.get("bounds")
+                buckets = series.get("buckets")
+                if bounds and buckets:
+                    exemplars = series.get("exemplars") or {}
+                    cum = 0
+                    for i, (bound, c) in enumerate(zip(
+                            list(bounds) + [float("inf")], buckets)):
+                        cum += c
+                        le = dict(labels, le=_fmt_float(bound))
+                        line = f"{name}_bucket{_label_str(le)} {cum}"
+                        ex = exemplars.get(str(i), exemplars.get(i))
+                        if ex is not None:
+                            line += (' # {trace_id="%s"} %s'
+                                     % (ex["trace_id"],
+                                        _fmt_float(ex["value"])))
+                        lines.append(line)
+                lines.append(f"{name}_sum{_label_str(labels)} "
+                             f"{_fmt_float(series.get('sum', 0.0))}")
+                lines.append(f"{name}_count{_label_str(labels)} "
+                             f"{series.get('count', 0)}")
+            else:
+                lines.append(f"{name}{_label_str(labels)} "
+                             f"{_fmt_float(series.get('value', 0.0))}")
+    return "\n".join(lines) + "\n"
+
+
+def merge_alerts(payloads: Dict[str, dict]) -> Optional[dict]:
+    """Union per-host SLO-engine snapshots (``payload["alerts"]``,
+    see ``Telemetry.payload``) into one cluster alert view: every
+    host's active alerts (host-stamped), recent transitions in time
+    order, and per-state totals.  None when no host published an
+    engine snapshot."""
+    active = []
+    recent = []
+    totals: Dict[str, int] = {}
+    hosts = []
+    for host, p in sorted(payloads.items()):
+        snap = (p or {}).get("alerts")
+        if not snap:
+            continue
+        hosts.append(host)
+        for a in snap.get("active", ()):
+            active.append(dict(a, host=host))
+        for a in snap.get("recent", ()):
+            recent.append(dict(a, host=host))
+            totals[a.get("state", "?")] = \
+                totals.get(a.get("state", "?"), 0) + 1
+    if not hosts:
+        return None
+    recent.sort(key=lambda a: a.get("at") or 0.0)
+    worst = "ok"
+    if any(a.get("severity") == "page" for a in active):
+        worst = "critical"
+    elif active:
+        worst = "degraded"
+    return {"hosts": hosts, "active": active, "recent": recent[-64:],
+            "totals": totals, "verdict": worst}
 
 
 def host_skew(payloads: Dict[str, dict]) -> Dict[str, dict]:
@@ -322,4 +414,7 @@ def merge_cluster(payloads: Dict[str, dict]) -> dict:
         # the cluster-wide Perfetto timeline (None when no host
         # published spans — the payloads' span export is bounded)
         "timeline": merge_timeline(payloads, skew=skew),
+        # the cluster alert view (None when no host runs an SLO
+        # engine) — tools/run_report.py --alerts renders it
+        "alerts": merge_alerts(payloads),
     }

@@ -138,7 +138,9 @@ class Histogram:
 
     def observe(self, v: float, exemplar: Optional[str] = None):
         """Record one observation; ``exemplar`` optionally attaches a
-        trace id to the covering bucket (newest wins per bucket)."""
+        trace id to the covering bucket (newest wins per bucket; the
+        wall-clock ``ts`` stamp is what lets the cross-host merge keep
+        the newest exemplar per bucket ACROSS hosts)."""
         v = float(v)
         with self._lock:
             idx = bisect.bisect_left(self.bounds, v)
@@ -149,7 +151,8 @@ class Histogram:
             self.max = v if self.max is None else max(self.max, v)
             if exemplar is not None:
                 self._exemplars[idx] = {"value": v,
-                                        "trace_id": str(exemplar)}
+                                        "trace_id": str(exemplar),
+                                        "ts": time.time()}
             if self._window > 0:
                 self._samples.append(v)
                 if len(self._samples) > self._window:

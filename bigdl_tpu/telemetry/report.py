@@ -18,10 +18,13 @@ def _bar(frac: float, width: int = 24) -> str:
     return "#" * n + "." * (width - n)
 
 
-def render_report(cluster: dict, top_n: int = 6) -> str:
+def render_report(cluster: dict, top_n: int = 6,
+                  alerts: bool = False) -> str:
     """Text run report from a merged cluster view
     (:func:`~.aggregate.merge_cluster`): goodput breakdown, top span
-    categories, per-host step-time skew."""
+    categories, per-host step-time skew.  ``alerts=True`` adds the
+    active/fired SLO alert table (``tools/run_report.py --alerts``)
+    next to the goodput ledger."""
     lines: List[str] = []
     hosts = cluster.get("hosts") or []
     gp = cluster.get("goodput") or {}
@@ -39,6 +42,8 @@ def render_report(cluster: dict, top_n: int = 6) -> str:
         frac = s / wall if wall > 0 else 0.0
         lines.append(f"  {cat:<12} {s:>10.2f}s  {100 * frac:>5.1f}%  "
                      f"|{_bar(frac)}|")
+    if alerts:
+        lines.extend(_render_alerts(cluster.get("alerts")))
     spans: Dict[str, float] = cluster.get("span_totals") or {}
     if spans:
         lines.append("")
@@ -63,6 +68,41 @@ def render_report(cluster: dict, top_n: int = 6) -> str:
         lines.extend(_render_perf(perf))
     lines.append("======================================================")
     return "\n".join(lines)
+
+
+def _render_alerts(alerts) -> List[str]:
+    """The SLO alert section (:func:`~.aggregate.merge_alerts`
+    output): cluster verdict, the active-alert table, and recent
+    firing/resolved transitions in time order."""
+    lines: List[str] = [""]
+    lines.append("-- slo alerts ----------------------------------------")
+    if not alerts:
+        lines.append("  no host published an SLO engine snapshot")
+        return lines
+    totals = alerts.get("totals") or {}
+    lines.append(
+        f"  verdict: {alerts.get('verdict', 'ok')}   "
+        f"active: {len(alerts.get('active') or ())}   "
+        f"fired: {totals.get('firing', 0)}   "
+        f"resolved: {totals.get('resolved', 0)}")
+    active = alerts.get("active") or []
+    if active:
+        lines.append(f"  {'rule':<32} {'sev':<7} {'host':<10} value")
+        for a in active:
+            val = a.get("value")
+            val_s = (f"{val:.4g}" if isinstance(val, (int, float))
+                     else "n/a")
+            lines.append(f"  {a.get('rule', '?'):<32} "
+                         f"{a.get('severity', '?'):<7} "
+                         f"{a.get('host', '?'):<10} {val_s}")
+    recent = alerts.get("recent") or []
+    if recent:
+        lines.append(f"  recent transitions ({len(recent)}):")
+        for a in recent[-10:]:
+            lines.append(
+                f"    [{a.get('state', '?'):<8}] "
+                f"{a.get('rule', '?'):<32} {a.get('reason', '')}")
+    return lines
 
 
 def _human_flops(v: float) -> str:

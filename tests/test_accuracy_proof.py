@@ -5,6 +5,8 @@ triggers, validation, summaries, checkpoints, restore."""
 import pytest
 
 
+@pytest.mark.slow  # 25-epoch accuracy proof (~20s); the lstm/gru
+# lifecycle accuracy specs below stay in the budgeted run
 def test_lenet_digits_full_lifecycle_accuracy():
     from bigdl_tpu.examples.lenet_digits_accuracy import main
 

@@ -83,6 +83,7 @@ def test_fallback_merges_persisted_tpu_numbers(tmp_path):
                 "BENCH_TELEMETRY_TIMEOUT": "0",
                 "BENCH_SHARDING_TIMEOUT": "0",
                 "BENCH_DLRM_TIMEOUT": "0",
+                "BENCH_SLO_TIMEOUT": "0",
                 "BENCH_BLOCKSPARSE_TIMEOUT": "0"})
     # --no-ledger: a test invocation must not append to the repo's
     # judged PERF_LEDGER.jsonl trajectory
@@ -463,6 +464,59 @@ def test_blocksparse_measurements_contract():
         "blocksparse": {"speedup_x": out["speedup_x"]}})
     assert rec2["blocksparse_speedup_x"] == 1.7
     assert rec2["blocksparse_t4096_mfu"] == 0.56
+    for key in bench.LEDGER_FIELDS:
+        assert key in rec
+
+
+def test_slo_measurements_contract():
+    """The SLO leg's measurement dict carries the judged fields
+    (per-scenario detection/resolution intervals under the injected
+    clock, steady-pass false positives, recorder+engine overhead and
+    per-op costs) — the chaos part runs in-process at full scale
+    (injected clock: cheap), the overhead loop tiny; the full leg is
+    `--slo` and its one JSON line lands in SLO_r01.json."""
+    bench = _bench()
+    out = bench._slo_measurements(overhead_steps=12,
+                                  overhead_batch=256,
+                                  overhead_repeats=1,
+                                  steady_intervals=60)
+    # the acceptance bar: every injected breach (shed ramp, loss
+    # divergence, MFU collapse, replica kill) detected within 3
+    # evaluation intervals and resolved after recovery
+    assert set(out["scenarios"]) == {"shed_ramp", "loss_divergence",
+                                     "mfu_collapse", "replica_kill"}
+    for name, s in out["scenarios"].items():
+        assert s["detected_in_intervals"] is not None, (name, s)
+        assert s["detected_in_intervals"] <= 3, (name, s)
+        assert s["resolved_in_intervals"] is not None, (name, s)
+    assert out["all_detected"] is True
+    assert out["all_resolved"] is True
+    assert out["max_detection_intervals"] <= 3
+    assert out["detection_latency_s"] == \
+        out["max_detection_intervals"] * out["eval_interval_s"]
+    # zero spurious alerts on the steady control run
+    assert out["false_positives"] == 0
+    # overhead: the judged number is the amortized per-step monitor
+    # cost over the loop's measured step time (the A/B wall delta is
+    # informational — 1-core scheduler noise swamps it); the <=1% bar
+    # is judged on the full leg's longer loop, the tiny in-process run
+    # only guards against a rogue order-of-magnitude regression
+    assert isinstance(out["overhead_pct"], float)
+    assert out["overhead_pct"] < 50.0, out
+    assert out["monitor_step_us"] > 0
+    assert out["step_ms"] > 0
+    assert isinstance(out["wall_overhead_pct"], float)
+    assert 0 < out["recorder_observe_ns"] < 1e5
+    assert 0 < out["engine_evaluate_us"] < 1e5
+    # and the record flattens into the schema-stable ledger fields
+    rec = bench.ledger_record({"slo": {
+        "detection_latency_s": out["detection_latency_s"],
+        "false_positives": out["false_positives"],
+        "overhead_pct": out["overhead_pct"]}})
+    assert rec["slo_detection_latency_s"] == \
+        out["detection_latency_s"]
+    assert rec["slo_false_positives"] == 0
+    assert rec["slo_overhead_pct"] == out["overhead_pct"]
     for key in bench.LEDGER_FIELDS:
         assert key in rec
 

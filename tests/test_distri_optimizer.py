@@ -163,6 +163,9 @@ def test_checkpoint_retry_recovers(tmp_path):
     assert opt.optim_method.state["neval"] > 10
 
 
+@pytest.mark.slow  # ~13s epoch sweep; the pad-and-mask contract
+# stays budgeted via test_distri_multi_axis
+# ::test_partial_batch_trains_on_three_axis_mesh
 def test_partial_batches_train_all_records():
     """Dataset size % (batch, mesh) != 0: every record still trains
     (pad-and-mask), and the weights move under the trailing batch

@@ -147,6 +147,9 @@ class TestZooTrainer:
                       "--max-epoch", "1"])
         assert model is not None
 
+    @pytest.mark.slow  # dp x tp CLI lifecycle: the mesh numerics
+    # ride tier-1 via test_distri_multi_axis; the plain CLI path
+    # stays budgeted through test_lenet_cli_trains
     def test_lenet_cli_distributed_tensor_parallel(self):
         from bigdl_tpu.models.train import main
 
@@ -155,6 +158,8 @@ class TestZooTrainer:
                       "--tensor-parallel", "2"])
         assert model is not None
 
+    @pytest.mark.slow  # 3-axis CLI lifecycle: the dp x sp x tp
+    # numerics ride tier-1 via test_transformer_spmd
     def test_transformer_cli_three_axis(self):
         # long-context extension workload: dp x sp x tp through the zoo
         # CLI, ring attention + Megatron split + on-mesh validation

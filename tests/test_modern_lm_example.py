@@ -11,7 +11,15 @@ pytest.importorskip("orbax.checkpoint")
 from bigdl_tpu.examples.modern_lm_stack import main  # noqa: E402
 
 
-@pytest.mark.parametrize("argv", [[], ["--moe", "8"], ["--pipeline", "2"]])
+# the MoE and pipeline modes ride the slow tier: the budgeted run
+# keeps the dense mode's full lifecycle (load -> finetune -> resume ->
+# generate), and the MoE/pipeline numerics are covered much more
+# tightly by test_moe.py / test_pipeline_parallel.py
+@pytest.mark.parametrize("argv", [
+    [],
+    pytest.param(["--moe", "8"], marks=pytest.mark.slow),
+    pytest.param(["--pipeline", "2"], marks=pytest.mark.slow),
+])
 def test_modern_lm_stack_modes(argv, capsys):
     main(argv + ["--iterations", "30"])
     out = capsys.readouterr().out

@@ -221,6 +221,8 @@ def _lm_batch(n, seed=0):
             r.randint(1, 18, (n, 6)).astype(np.float32))
 
 
+@pytest.mark.slow  # ~12s twin; the masked variant below pins the
+# same expert grad-reduction rule in the budgeted run
 def test_spmd_train_step_expert_grads_match_dense_twin():
     """spmd.make_train_step over a data mesh with expert-sharded MoE
     stacks: loss and updated params (router AND expert weights) must

@@ -365,18 +365,23 @@ def test_histogram_exemplars_snapshot_and_prometheus():
     h.observe(0.5)                        # no exemplar on this bucket
     h.observe(0.05, exemplar="bbbb")      # newest wins per bucket
     ex = h.exemplars()
-    assert ex == {0: {"value": 0.05, "trace_id": "bbbb"}}
-    assert h._data()["exemplars"] == {
-        "0": {"value": 0.05, "trace_id": "bbbb"}}
+    assert set(ex) == {0}
+    assert ex[0]["value"] == 0.05 and ex[0]["trace_id"] == "bbbb"
+    assert ex[0]["ts"] > 0                # the merge's newest-wins key
+    data_ex = h._data()["exemplars"]
+    assert data_ex["0"]["trace_id"] == "bbbb"
     r = MetricsRegistry()
     fam = r.histogram("lat_seconds", "t", bounds=(0.1, 1.0))
     fam.observe(0.05, exemplar="cccc")
     text = r.to_prometheus()
     assert '# {trace_id="cccc"} 0.05' in text
-    # merged cluster views drop exemplars (per-host pointers)
+    # merged cluster views keep the newest exemplar per bucket (trace
+    # ids are fleet-wide pointers on the shared transport) — the fold
+    # used to silently discard them; see
+    # test_exemplars_survive_cross_host_merge for the round trip
     from bigdl_tpu.telemetry import merge_metrics
 
     snap = r.snapshot()["metrics"]
     merged = merge_metrics([snap, snap])
     series = merged["lat_seconds"]["series"][0]
-    assert "exemplars" not in series
+    assert series["exemplars"]["0"]["trace_id"] == "cccc"

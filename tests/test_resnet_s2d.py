@@ -85,6 +85,8 @@ def test_resnet50_stem_arg_validated():
         ResNet50(10, stem="S2D")
 
 
+@pytest.mark.slow  # full-res ResNet-50 fwd+train step (~22s); the
+# stem exactness specs above keep the S2D contract in tier-1
 def test_resnet50_s2d_forward_and_train_step():
     model = ResNet50(10, stem="s2d")
     crit = nn.ClassNLLCriterion()

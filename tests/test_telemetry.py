@@ -627,3 +627,131 @@ def test_retry_and_watchdog_count_into_default_registry():
     with pytest.raises(HungCollectiveError):
         wd.run(lambda cancel: time.sleep(5))
     assert val("bigdl_watchdog_trips_total") == trips0 + 1
+
+
+# ---------------------------------------------------------------------------
+# lint: every bigdl_* metric family name literal comes from ONE shared
+# constant table (telemetry/metric_names.py) — a renamed family can
+# never silently orphan an SLO rule
+# ---------------------------------------------------------------------------
+
+#: a quoted family-shaped literal: bigdl_ plus >= 2 more segments (the
+#: bare package name "bigdl_tpu" and tempfile prefixes ending in "_"
+#: are not family names and do not match)
+_METRIC_LITERAL = re.compile(
+    r"""["'](bigdl_[a-z0-9]+(?:_[a-z0-9]+)+)["']""")
+
+
+def test_metric_family_names_come_from_shared_table():
+    """Every ``"bigdl_*"`` metric-family string literal anywhere in
+    bigdl_tpu/ must be a member of
+    ``telemetry.metric_names.METRIC_FAMILY_NAMES`` — the span-category
+    lint pattern applied to metric names.  Alert rules reference
+    families through the same table, so the rule set and the
+    registration sites can never drift apart."""
+    import os
+
+    from bigdl_tpu.telemetry.metric_names import METRIC_FAMILY_NAMES
+
+    assert len(METRIC_FAMILY_NAMES) > 40    # the table is populated
+    for name in METRIC_FAMILY_NAMES:
+        assert _METRIC_LITERAL.match(f'"{name}"'), name
+
+    pkg = os.path.join(os.path.dirname(__file__), "..", "bigdl_tpu")
+    offenders = []
+    for dirpath, _dirs, files in os.walk(pkg):
+        for fname in sorted(files):
+            if not fname.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fname)
+            rel = os.path.relpath(path, pkg)
+            with open(path) as f:
+                for lineno, line in enumerate(f, 1):
+                    code = line.split("#", 1)[0]
+                    for name in _METRIC_LITERAL.findall(code):
+                        if name not in METRIC_FAMILY_NAMES:
+                            offenders.append(
+                                f"bigdl_tpu/{rel}:{lineno}: family "
+                                f"{name!r} not in metric_names"
+                                f".METRIC_FAMILY_NAMES: "
+                                f"{line.strip()}")
+    assert not offenders, (
+        "metric family names outside the shared table (declare them "
+        "in telemetry/metric_names.py):\n" + "\n".join(offenders))
+
+
+# ---------------------------------------------------------------------------
+# exemplars survive the cross-host merge (the fold used to drop them)
+# ---------------------------------------------------------------------------
+
+def test_exemplars_survive_cross_host_merge_roundtrip():
+    """Two hosts' histograms with exemplars fold into one cluster
+    series keeping the NEWEST exemplar per bucket, and the merged
+    view round-trips through the OpenMetrics text exporter with the
+    exemplar syntax intact."""
+    from bigdl_tpu.telemetry.aggregate import (merge_metrics,
+                                               metrics_to_prometheus)
+
+    bounds = (0.1, 1.0)
+
+    def host(trace_low, trace_high, ts):
+        r = MetricsRegistry()
+        h = r.histogram("bigdl_serving_latency_seconds", "lat",
+                        bounds=bounds)
+        h.observe(0.05, exemplar=trace_low)
+        h.observe(0.5, exemplar=trace_high)
+        snap = r.snapshot()["metrics"]
+        # pin deterministic publish stamps (observe() stamps wall
+        # clock; the merge keys on ts, so forge distinct ones)
+        for series in snap["bigdl_serving_latency_seconds"]["series"]:
+            for ex in series["exemplars"].values():
+                ex["ts"] = ts
+        return snap
+
+    older = host("aaaa", "bbbb", ts=100.0)
+    newer = host("cccc", "dddd", ts=200.0)
+    merged = merge_metrics([older, newer])
+    series = merged["bigdl_serving_latency_seconds"]["series"][0]
+    # buckets added; the NEWEST exemplar won each bucket
+    assert series["count"] == 4
+    ex = series["exemplars"]
+    assert ex["0"]["trace_id"] == "cccc"
+    assert ex["1"]["trace_id"] == "dddd"
+    # fold order must not matter (newest-wins is by stamp, not order)
+    merged2 = merge_metrics([newer, older])
+    assert merged2["bigdl_serving_latency_seconds"]["series"][0][
+        "exemplars"] == ex
+    # ...and the merged view exports OpenMetrics text with exemplars
+    text = metrics_to_prometheus(merged)
+    assert '# {trace_id="cccc"} 0.05' in text
+    assert '# {trace_id="dddd"} 0.5' in text
+    # a minimal parse recovers cumulative bucket counts from the
+    # merged text (the round trip: registry -> snapshot -> merge ->
+    # exposition)
+    bucket_lines = [ln for ln in text.splitlines()
+                    if ln.startswith(
+                        "bigdl_serving_latency_seconds_bucket")]
+    assert len(bucket_lines) == 3          # 2 bounds + +Inf
+    counts = [int(ln.split(" # ")[0].rsplit(" ", 1)[1])
+              for ln in bucket_lines]
+    assert counts == [2, 4, 4]
+
+
+def test_exemplar_merge_drops_on_geometry_drift():
+    """Mismatched bucket geometry already drops the buckets — the
+    exemplars (bucket-indexed) must go with them, never attach to the
+    wrong ladder."""
+    from bigdl_tpu.telemetry.aggregate import merge_metrics
+
+    def host(bounds):
+        r = MetricsRegistry()
+        h = r.histogram("bigdl_serving_latency_seconds", "lat",
+                        bounds=bounds)
+        h.observe(0.05, exemplar="eeee")
+        return r.snapshot()["metrics"]
+
+    merged = merge_metrics([host((0.1, 1.0)), host((0.2, 2.0))])
+    series = merged["bigdl_serving_latency_seconds"]["series"][0]
+    assert "buckets" not in series
+    assert "exemplars" not in series
+    assert series["count"] == 2            # count/sum still honest

@@ -72,6 +72,8 @@ def test_resnet_cifar_roundtrip(tmp_path):
     roundtrip(model, x, tmp_path)
 
 
+@pytest.mark.slow  # heaviest roundtrip (~15s); branch/Concat
+# coverage stays via test_residual_graph_model_roundtrip
 def test_inception_v1_roundtrip(tmp_path):
     """Inception-v1 branch modules (Concat fan-out) + LRN sandwich."""
     from bigdl_tpu.models.inception import InceptionV1NoAuxClassifier

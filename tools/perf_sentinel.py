@@ -105,6 +105,17 @@ DEFAULT_TOLERANCES = {
     # — a rise means the sparse wire silently stopped engaging
     "dlrm_steps_per_sec": ("higher", 0.50),
     "dlrm_collective_bytes_per_step": ("lower", 0.25),
+    # online health engine (ISSUE 14): detection latency on the
+    # injected breaches is deterministic (injected clock) and may
+    # only fall (one-interval abs floor absorbs a rule-pack retune);
+    # the steady control's false-positive count must stay ZERO (any
+    # rise fails — a noisy health engine is worse than none); the
+    # recorder+engine overhead on the instrumented step loop may only
+    # fall (1-percentage-point abs floor absorbs 1-core scheduler
+    # jitter around the small baseline)
+    "slo_detection_latency_s": ("lower", 0.50, 5.0),
+    "slo_false_positives": ("lower", 0.0),
+    "slo_overhead_pct": ("lower", 1.00, 1.0),
     # block-sparse kernels (ISSUE 12): the T4096 executed-basis MFU
     # may only rise (null until the next TPU window measures it); the
     # speedup multiple is the measured wall ratio on TPU and the

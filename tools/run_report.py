@@ -40,6 +40,11 @@ def main(argv=None) -> int:
                    help="emit the cluster-wide Perfetto timeline "
                         "(per-host published spans, clock-aligned, "
                         "skew-stamped) to OUT.json ('-' = stdout)")
+    p.add_argument("--alerts", action="store_true",
+                   help="include the SLO alert section (cluster "
+                        "verdict, active alerts, recent firing/"
+                        "resolved transitions) next to the goodput "
+                        "ledger")
     args = p.parse_args(argv)
 
     from bigdl_tpu.telemetry.aggregate import (merge_cluster,
@@ -74,7 +79,8 @@ def main(argv=None) -> int:
     if args.json:
         print(json.dumps(cluster, indent=1))
     else:
-        print(render_report(cluster, top_n=args.top))
+        print(render_report(cluster, top_n=args.top,
+                            alerts=args.alerts))
     return 0
 
 
