@@ -2846,6 +2846,297 @@ def run_dlrm_bench() -> None:
 
 
 # --------------------------------------------------------------------------
+# Sync leg: relaxed synchrony — periodic averaging vs lockstep, and the
+# relax-before-evict straggler story (ISSUE 15)
+# --------------------------------------------------------------------------
+
+SYNC_TIMEOUT = float(os.environ.get("BENCH_SYNC_TIMEOUT", "300"))
+SYNC_RESULT = "SYNC_r01.json"
+
+
+def _sync_measurements(steps: int = 24, batch: int = 256,
+                       n_records: int = 2048, period: int = 8,
+                       straggler_steps: int = 14,
+                       straggler: bool = True, lr: float = 0.1):
+    """The relaxed-synchrony leg (ISSUE 15), on 8 forced-host CPU
+    devices:
+
+    * **lockstep vs periodic(k) pass** — the SAME MLP + seeded
+      classification stream under the default lockstep plan and under
+      ``Rule(".*", P(), sync=f"periodic({period})")``: judged
+      steps/sec (post-compile) for both, plus the plan-derived
+      ``bigdl_perf_collective_bytes`` gauge — periodic(k) must move
+      >= 4x fewer collective bytes/step (accounting: the averaging
+      ring / k), with loss descending in both passes;
+    * **straggler pass** — a 3-host elastic gang with one chronic
+      straggler (a simulated member publishing 1s step times), run
+      twice: ``relax_before_evict`` (the averaging period widens, no
+      eviction, training never stops) vs the eviction path (straggler
+      voted out -> restore + mesh re-derivation + recompile).  Judged:
+      wall clock first-loss -> last-loss for the same step budget and
+      the time-to-loss-target advantage (relaxed reaches the eviction
+      run's final loss in a fraction of its wall)."""
+    import tempfile
+    import jax
+    import numpy as np
+
+    from bigdl_tpu import nn
+    from bigdl_tpu.dataset import Sample
+    from bigdl_tpu.dataset.dataset import array
+    from bigdl_tpu.optim import SGD, max_iteration, several_iteration
+    from bigdl_tpu.optim.distri_optimizer import DistriOptimizer
+    from bigdl_tpu.parallel.plan import Plan, Rule
+    from bigdl_tpu.telemetry import MetricsRegistry, Telemetry
+    from bigdl_tpu.utils.rng import set_global_seed
+    from jax.sharding import PartitionSpec as P
+
+    import logging
+
+    if jax.device_count() < 8:
+        raise RuntimeError(
+            f"sync leg needs 8 forced-host devices, have "
+            f"{jax.device_count()}")
+    bigdl_log = logging.getLogger("bigdl_tpu")
+    prev_level = bigdl_log.level
+    bigdl_log.setLevel(logging.ERROR)
+    # the trace-profiled iteration's xplane parse costs seconds of
+    # pure measurement overhead — every judged wall runs unprofiled
+    prev_profile = os.environ.get("BIGDL_METRICS_PROFILEINTERVAL")
+    os.environ["BIGDL_METRICS_PROFILEINTERVAL"] = "0"
+
+    class _Losses:
+        def __init__(self):
+            self.values = []
+            self.walls = []
+
+        def add_scalar(self, name, value, step):
+            if name == "Loss":
+                self.values.append(float(value))
+                self.walls.append(time.monotonic())
+
+    rng = np.random.RandomState(3)
+    xs = rng.rand(n_records, 64).astype(np.float32)
+    ys = (1 + (xs.sum(1) > 32)).astype(np.float32)
+    samples = [Sample(x, y) for x, y in zip(xs, ys)]
+
+    def model_fn():
+        return nn.Sequential(nn.Linear(64, 256), nn.Tanh(),
+                             nn.Linear(256, 64), nn.Tanh(),
+                             nn.Linear(64, 2), nn.LogSoftMax())
+
+    def run(plan):
+        set_global_seed(7)
+        model = model_fn()
+        tm = Telemetry(registry=MetricsRegistry())
+        rec = _Losses()
+        opt = DistriOptimizer(model, array(samples),
+                              nn.ClassNLLCriterion(), batch_size=batch)
+        opt.set_optim_method(SGD(learning_rate=lr))
+        opt.set_end_when(max_iteration(steps))
+        opt.set_telemetry(tm)
+        opt.set_train_summary(rec)
+        if plan is not None:
+            opt.set_sharding_plan(plan)
+        t0 = time.monotonic()
+        opt.optimize()
+        wall = time.monotonic() - t0
+        compile_s = float(tm.compile_seconds.sum)
+        sps = (steps - 1) / max(wall - compile_s, 1e-9)
+        snap = tm.registry.snapshot()["metrics"]
+
+        def gauge(name):
+            series = (snap.get(name) or {}).get("series") or []
+            return float(series[0]["value"]) if series else None
+
+        return {"steps_per_sec": round(sps, 3), "losses": rec.values,
+                "collective_bytes": gauge("bigdl_perf_collective_bytes"),
+                "sync_saved": gauge("bigdl_perf_sync_bytes_saved")}
+
+    def run_straggler(relax: bool):
+        from bigdl_tpu.resilience import (CollectiveWatchdog,
+                                          ElasticContext,
+                                          ElasticCoordinator,
+                                          InMemoryKV, RetryPolicy,
+                                          SimulatedHost,
+                                          StepTimeEstimator)
+        from bigdl_tpu.resilience.elastic import StragglerPolicy
+
+        kv = InMemoryKV()
+        coord = ElasticCoordinator("host0", kv, heartbeat_timeout=0.3)
+        coord.bootstrap(["host0", "host1", "host2"])
+        sims = [SimulatedHost("host1", kv, heartbeat_timeout=0.3),
+                SimulatedHost("host2", kv, heartbeat_timeout=0.3,
+                              step_time=1.0)]
+        pol = StragglerPolicy(skew_threshold=3.0, patience=2,
+                              eviction_budget=1, sustain=0.0,
+                              relax_before_evict=relax,
+                              relax_factor=2.0, max_relax_rounds=8)
+        ctx = ElasticContext(
+            coord,
+            watchdog=CollectiveWatchdog(StepTimeEstimator(
+                floor=0.75, multiplier=4.0, min_samples=3,
+                warmup_deadline=15.0)),
+            straggler=pol, rendezvous_timeout=2.0,
+            regrow_after_steps=10000)
+        srng = np.random.RandomState(7)
+        sxs = srng.rand(120, 8).astype(np.float32)
+        sys_ = (1 + (sxs.sum(1) > 4)).astype(np.float32)
+        ssamples = [Sample(x, y) for x, y in zip(sxs, sys_)]
+        set_global_seed(7)
+        model = nn.Sequential(nn.Linear(8, 16), nn.Tanh(),
+                              nn.Linear(16, 2), nn.LogSoftMax())
+        rec = _Losses()
+        opt = DistriOptimizer(model, array(ssamples),
+                              nn.ClassNLLCriterion(), batch_size=12)
+        opt.set_optim_method(SGD(learning_rate=0.2))
+        opt.set_sharding_plan(
+            Plan([Rule(".*", P(), sync="periodic(2)")]))
+        opt.set_end_when(max_iteration(straggler_steps))
+        opt.set_checkpoint(tempfile.mkdtemp(prefix="sync_bench_"),
+                           several_iteration(1))
+        opt.set_retry_policy(RetryPolicy(max_retries=10,
+                                         backoff_base=0.01,
+                                         backoff_max=0.05))
+        opt.set_elastic(ctx)
+        opt.set_train_summary(rec)
+        for s in sims:
+            s.start()
+        try:
+            opt.optimize()
+        finally:
+            for s in sims:
+                s.stop()
+        return {"losses": rec.values, "walls": rec.walls,
+                "evictions": ctx.counters()["evictions"],
+                "incarnation_changes":
+                    ctx.counters()["incarnation_changes"],
+                "relax_rounds": pol.relax_rounds}
+
+    try:
+        lock = run(None)
+        per = run(Plan([Rule(".*", P(),
+                             sync=f"periodic({int(period)})")]))
+        strag = None
+        if straggler:
+            rel = run_straggler(True)
+            ev = run_straggler(False)
+            span = lambda r: (r["walls"][-1] - r["walls"][0]
+                              if len(r["walls"]) > 1 else 0.0)
+            wall_rel, wall_ev = span(rel), span(ev)
+            sps = lambda w: round((straggler_steps - 1)
+                                  / max(w, 1e-9), 3)
+            target = ev["losses"][-1] if ev["losses"] else None
+            t_rel = wall_rel
+            if target is not None:
+                for w, l in zip(rel["walls"], rel["losses"]):
+                    if l <= target:
+                        t_rel = w - rel["walls"][0]
+                        break
+            strag = {
+                "steps": straggler_steps,
+                "relaxed_wall_s": round(wall_rel, 3),
+                "evict_wall_s": round(wall_ev, 3),
+                "relaxed_steps_per_sec": sps(wall_rel),
+                "evict_steps_per_sec": sps(wall_ev),
+                "relaxed_time_to_target_s": round(t_rel, 3),
+                "loss_target": (round(target, 5)
+                                if target is not None else None),
+                "relaxed_evictions": rel["evictions"],
+                "evict_evictions": ev["evictions"],
+                "relax_rounds": rel["relax_rounds"],
+                "relaxed_loss_descending": bool(
+                    rel["losses"] and rel["losses"][-1]
+                    < rel["losses"][0]),
+                "evict_loss_descending": bool(
+                    ev["losses"] and ev["losses"][-1] < ev["losses"][0]),
+                # the judged multiple (the acceptance's "steps/sec
+                # under an injected straggler vs the eviction path"):
+                # same step budget, first-loss -> last-loss walls —
+                # the eviction path's restore + mesh re-derivation +
+                # recompile is inside its span, the relaxed path has
+                # neither (time-to-target above is informational)
+                "straggler_advantage_x": round(
+                    wall_ev / max(wall_rel, 1e-9), 2),
+            }
+    finally:
+        bigdl_log.setLevel(prev_level)
+        if prev_profile is None:
+            os.environ.pop("BIGDL_METRICS_PROFILEINTERVAL", None)
+        else:
+            os.environ["BIGDL_METRICS_PROFILEINTERVAL"] = prev_profile
+
+    ll, pl = lock["losses"], per["losses"]
+    ratio = None
+    if lock["collective_bytes"] and per["collective_bytes"]:
+        ratio = lock["collective_bytes"] / per["collective_bytes"]
+    out = {
+        "devices": 8,
+        "mesh": "data=8",
+        "period": int(period),
+        "steps": steps, "batch": batch,
+        "lockstep_steps_per_sec": lock["steps_per_sec"],
+        "periodic_steps_per_sec": per["steps_per_sec"],
+        "lockstep_collective_bytes_per_step": lock["collective_bytes"],
+        "periodic_collective_bytes_per_step": per["collective_bytes"],
+        "sync_bytes_saved_per_step": per["sync_saved"],
+        "collective_bytes_reduction_x": (round(ratio, 2)
+                                         if ratio else None),
+        "lockstep_loss_descending": bool(ll and ll[-1] < ll[0]),
+        "periodic_loss_descending": bool(pl and pl[-1] < pl[0]),
+        "loss_first": round(pl[0], 5) if pl else None,
+        "loss_last": round(pl[-1], 5) if pl else None,
+        # the forced-host simulation runs all 8 "devices" on ONE core
+        # pool, so local SGD's per-replica optimizer work serializes
+        # and periodic steps/sec reads BELOW lockstep here — on real
+        # multi-host silicon each replica's work is its own chip's.
+        # The judged wins are the deterministic amortized wire (the
+        # reduction ratio above) and the straggler pass's wall clock.
+        "note": "periodic steps/sec on forced-host CPU serializes "
+                "per-replica work; wire + straggler walls are the "
+                "judged numbers",
+    }
+    if strag is not None:
+        out["straggler"] = strag
+        out["straggler_advantage_x"] = strag["straggler_advantage_x"]
+    return out
+
+
+def run_sync_bench() -> None:
+    """--sync mode: relaxed synchrony on 8 forced-host CPU devices —
+    lockstep vs periodic(8) wire + throughput, and the straggler
+    relax-vs-evict chaos pass — writes SYNC_r01.json, prints the one
+    JSON line."""
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8").strip()
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    out = {"bench": "sync", "backend": "cpu",
+           "forced_host_devices": 8, "measured_at": _utc_now()}
+    try:
+        out.update(_sync_measurements())
+        out.update({
+            "metric": "periodic(8) collective-bytes reduction vs "
+                      "lockstep",
+            "value": out.get("collective_bytes_reduction_x") or 0.0,
+            "unit": "x",
+        })
+    except Exception as e:
+        out["error"] = f"{type(e).__name__}: {e}"[:500]
+        out.update({"metric": "periodic(8) collective-bytes reduction "
+                              "vs lockstep",
+                    "value": 0.0, "unit": "x"})
+    try:
+        with open(os.path.join(_here(), SYNC_RESULT), "w") as f:
+            json.dump(out, f, indent=1)
+    except OSError:
+        pass
+    print(json.dumps(out), flush=True)
+
+
+# --------------------------------------------------------------------------
 # Block-sparse kernel leg: BLaST skip accounting + parity (ISSUE 12)
 # --------------------------------------------------------------------------
 
@@ -3371,6 +3662,8 @@ LEDGER_FIELDS = (
     "checkpoint_blocked_s",
     "sharding_composed_steps_per_sec", "sharding_fsdp_param_bytes_frac",
     "dlrm_steps_per_sec", "dlrm_collective_bytes_per_step",
+    "sync_periodic_steps_per_sec", "sync_bytes_per_step",
+    "sync_straggler_advantage_x",
     "slo_detection_latency_s", "slo_false_positives",
     "slo_overhead_pct",
     "resnet50_conv_fallback",
@@ -3439,6 +3732,19 @@ def ledger_record(result: dict) -> dict:
     flat["dlrm_steps_per_sec"] = dlrm.get("steps_per_sec")
     flat["dlrm_collective_bytes_per_step"] = dlrm.get(
         "collective_bytes_per_step")
+    # the relaxed-synchrony leg (ISSUE 15): periodic(8) throughput may
+    # only rise; its amortized collective bytes/step is a deterministic
+    # plan/accounting property and may only fall — relaxed synchrony
+    # must never silently stop paying; the straggler advantage (relax-
+    # before-evict vs the eviction path on time-to-loss-target) may
+    # only rise, with an absolute floor absorbing 1-core wall noise
+    syncleg = result.get("sync") or {}
+    flat["sync_periodic_steps_per_sec"] = syncleg.get(
+        "periodic_steps_per_sec")
+    flat["sync_bytes_per_step"] = syncleg.get(
+        "periodic_collective_bytes_per_step")
+    flat["sync_straggler_advantage_x"] = syncleg.get(
+        "straggler_advantage_x")
     # the online health engine (ISSUE 14): detection latency may only
     # fall, the steady control's false-positive count must stay ZERO,
     # and the recorder+engine overhead may only fall — the online SLO
@@ -3915,6 +4221,35 @@ def main(ledger: bool = True, probe: bool = True) -> None:
                     or "dlrm leg returned nothing"}
     result["dlrm"] = dlrm
 
+    # sync leg: relaxed synchrony — lockstep vs periodic(8) wire +
+    # throughput and the straggler relax-vs-evict pass on a forced-
+    # host CPU mesh (backend-independent, lands in SYNC_r01.json) —
+    # best-effort like the other legs; BENCH_SYNC_TIMEOUT=0 disables.
+    if SYNC_TIMEOUT <= 0:
+        sync = {"skipped": "BENCH_SYNC_TIMEOUT=0"}
+    else:
+        ok, syres, note = _run_sub(["--sync"], SYNC_TIMEOUT)
+        if ok and syres and "error" not in syres:
+            sync = {
+                "periodic_steps_per_sec": syres.get(
+                    "periodic_steps_per_sec"),
+                "lockstep_steps_per_sec": syres.get(
+                    "lockstep_steps_per_sec"),
+                "periodic_collective_bytes_per_step": syres.get(
+                    "periodic_collective_bytes_per_step"),
+                "collective_bytes_reduction_x": syres.get(
+                    "collective_bytes_reduction_x"),
+                "straggler_advantage_x": syres.get(
+                    "straggler_advantage_x"),
+                "periodic_loss_descending": syres.get(
+                    "periodic_loss_descending"),
+                "source": SYNC_RESULT,
+            }
+        else:
+            sync = {"error": (syres or {}).get("error") or note
+                    or "sync leg returned nothing"}
+    result["sync"] = sync
+
     # slo leg: the online health engine — chaos detection latency +
     # false positives under an injected clock, recorder+engine
     # overhead on the instrumented step loop (backend-independent,
@@ -4001,7 +4336,7 @@ def main(ledger: bool = True, probe: bool = True) -> None:
             # whatever the stale chip record carried
             for leg in ("serving", "fleet", "disagg", "elastic",
                         "integrity", "telemetry", "sharding", "dlrm",
-                        "slo", "blocksparse"):
+                        "sync", "slo", "blocksparse"):
                 if result.get(leg) is not None:
                     merged[leg] = result[leg]
             result = merged
@@ -4030,6 +4365,7 @@ if __name__ == "__main__":
     p.add_argument("--telemetry", action="store_true")
     p.add_argument("--sharding", action="store_true")
     p.add_argument("--dlrm", action="store_true")
+    p.add_argument("--sync", dest="sync_leg", action="store_true")
     p.add_argument("--slo", action="store_true")
     p.add_argument("--blocksparse", action="store_true")
     p.add_argument("--worker", choices=["tpu", "cpu"])
@@ -4064,6 +4400,8 @@ if __name__ == "__main__":
         run_sharding_bench()
     elif a.dlrm:
         run_dlrm_bench()
+    elif a.sync_leg:
+        run_sync_bench()
     elif a.slo:
         run_slo_bench()
     elif a.blocksparse:

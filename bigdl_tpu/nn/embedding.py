@@ -54,6 +54,15 @@ class ShardedEmbedding(TensorModule):
     size — a non-dividing mesh (e.g. after an elastic shrink to an odd
     survivor count) degrades to a full replica with a warning from the
     plan, never dropping rows.
+
+    ``staleness`` opts THIS table into bounded-staleness sparse
+    updates when it replicates (``derive_plan`` stamps its rule
+    ``sync="stale(s)"``, overriding the global ``bigdl.sync.staleness``
+    knob): lookups proceed against the local replica while the
+    index+row exchange is in flight, peers' updates applying up to
+    ``s`` steps late — Parallax's hybrid, per table (docs/
+    distributed.md "Synchrony").  Row-SHARDED tables ignore it (each
+    row has exactly one copy; the lookup exchange is the forward).
     """
 
     #: derive_plan stamps this module's rules ``transport="sparse"``
@@ -61,7 +70,8 @@ class ShardedEmbedding(TensorModule):
 
     def __init__(self, n_index: int, n_output: int,
                  axis_name: Optional[str] = "data",
-                 padding_value: float = 0):
+                 padding_value: float = 0,
+                 staleness: Optional[int] = None):
         super().__init__()
         if n_index < 1 or n_output < 1:
             raise ValueError(
@@ -70,6 +80,9 @@ class ShardedEmbedding(TensorModule):
         self.n_index, self.n_output = int(n_index), int(n_output)
         self.axis_name = axis_name
         self.padding_value = padding_value
+        # per-module staleness bound (derive_plan's _sparse_param_info
+        # reads it); None = follow the bigdl.sync.* knobs
+        self.sync_staleness = int(staleness) if staleness else None
         self.reset()
 
     def reset(self):

@@ -99,6 +99,22 @@ class Optimizer:
         # consumed by the derived plan; explicit plans carry their own
         _sd = get_property("bigdl.sparse.density")
         self.sparse_density = float(_sd) if _sd else None
+        # relaxed-synchrony defaults for the derived plan's sparse-
+        # table rules (parallel/plan.py "Synchrony"; bigdl.sync.period
+        # / bigdl.sync.staleness properties set the defaults, None =
+        # lockstep).  Dense rules opt in per rule via an explicit plan.
+        _syp = get_property("bigdl.sync.period")
+        self.sync_period = int(_syp) if _syp else None
+        _sys = get_property("bigdl.sync.staleness")
+        self.sync_staleness = int(_sys) if _sys else None
+        # relaxed-synchrony checkpoint plumbing: the newest per-replica
+        # snapshot (rides the trainState leg so resume is bitwise
+        # across an averaging boundary), the restored one (consumed
+        # once by the next _plan_loop), and the membership-change flag
+        # that forces an averaging round instead of resuming divergence
+        self._sync_snapshot = None
+        self._sync_resume = None
+        self._sync_force_average = False
         # how the last profiled iteration's phase split was measured:
         # "trace" (jax.profiler device events) or None (not profiled)
         self.phase_source = None
@@ -276,6 +292,30 @@ class Optimizer:
         property default (1/16).  See docs/distributed.md "Gradient
         transport"."""
         self.sparse_density = float(density) if density else None
+        return self
+
+    def set_sync_period(self, k: Optional[int]):
+        """Default averaging period for the derived plan's RELAXABLE
+        rules (data-replicated sparse tables — the Parallax hybrid:
+        dense MLP rules stay lockstep): the table runs local SGD and
+        every ``k``-th step its replicas (and momentum-style optimizer
+        slots) all-reduce-average, cutting the per-step wire by ``k``.
+        ``None`` restores the ``bigdl.sync.period`` property default
+        (lockstep).  Dense leaves opt in per rule via
+        ``set_sharding_plan`` with ``Rule(..., sync="periodic(k)")``.
+        See docs/distributed.md "Synchrony"."""
+        self.sync_period = int(k) if k else None
+        return self
+
+    def set_sync_staleness(self, s: Optional[int]):
+        """Default staleness bound for the derived plan's sparse-table
+        rules: lookups proceed against the local replica while the
+        index+row exchange is in flight — peers' sparse updates apply
+        up to ``s`` steps late (bounded staleness, enforced by the
+        step-phase watermark).  ``None`` restores the
+        ``bigdl.sync.staleness`` property default (lockstep).  See
+        docs/distributed.md "Synchrony"."""
+        self.sync_staleness = int(s) if s else None
         return self
 
     def set_drop_module_property(self, drop_percentage, max_drop_percentage,
@@ -595,7 +635,8 @@ class Optimizer:
 
     def _tm_analyze(self, fn, *args, label: str = "train_step",
                     collective_bytes: float = 0.0,
-                    sparse_bytes_saved: float = 0.0, **kwargs):
+                    sparse_bytes_saved: float = 0.0,
+                    sync_bytes_saved: float = 0.0, **kwargs):
         """Feed the step program to the telemetry PerfAccountant: XLA
         cost-model FLOPs/bytes from lowering ``fn`` with the driver's
         concrete args (no compile, no execution — lowering only traces
@@ -609,6 +650,7 @@ class Optimizer:
         tm.perf.analyze_jitted(fn, *args, label=label,
                                collective_bytes=collective_bytes,
                                sparse_bytes_saved=sparse_bytes_saved,
+                               sync_bytes_saved=sync_bytes_saved,
                                **kwargs)
 
     # -- determinism + integrity plumbing (docs/determinism.md) ---------
@@ -689,11 +731,18 @@ class Optimizer:
         the exact next batch"."""
         from ..utils.rng import RNG
 
-        return {"version": 1,
-                "rng": RNG().state_dict(),
-                "dataset": self.dataset.state_dict(),
-                "records_this_epoch": int(
-                    state.get("records_this_epoch", 0))}
+        out = {"version": 1,
+               "rng": RNG().state_dict(),
+               "dataset": self.dataset.state_dict(),
+               "records_this_epoch": int(
+                   state.get("records_this_epoch", 0))}
+        if self._sync_snapshot is not None:
+            # relaxed synchrony: the exact per-replica stacks + stale
+            # pending buffers — what makes resume bitwise across an
+            # averaging boundary (docs/distributed.md "Synchrony");
+            # the step-phase counters ride optimMethod's state table
+            out["sync"] = self._sync_snapshot
+        return out
 
     def _apply_train_state(self, ts: dict):
         from ..utils.rng import RNG
@@ -703,6 +752,7 @@ class Optimizer:
         RNG().load_state_dict(ts["rng"])
         self.dataset.load_state_dict(ts.get("dataset") or {})
         self._resume_cursor = int(ts.get("records_this_epoch", 0))
+        self._sync_resume = ts.get("sync")
 
     def _consume_resume_cursor(self, data_iter, epoch_size: int) -> int:
         """Fast-forward a fresh epoch iterator past the records the
@@ -1090,9 +1140,17 @@ class Optimizer:
             self.elastic.attach(n_devices=len(jax.devices()),
                                 batch_size=self.batch_size,
                                 mesh_template=mesh)
+            first_attempt = [True]
 
             def attempt():
                 self._elastic_begin()
+                if not first_attempt[0]:
+                    # a membership change (or any elastic re-entry)
+                    # forces an immediate averaging round: no survivor
+                    # carries unaveraged local-SGD divergence across an
+                    # incarnation boundary (docs/elastic.md)
+                    self._sync_force_average = True
+                first_attempt[0] = False
                 return self._plan_loop(self.elastic.current_mesh())
 
             return self._with_retry(attempt)
@@ -1111,7 +1169,9 @@ class Optimizer:
             guard=self.gradient_guard, with_gnorm=True,
             n_microbatch=self.pipeline_microbatch,
             fsdp_min_bytes=self.fsdp_min_bytes,
-            sparse_density=self.sparse_density)
+            sparse_density=self.sparse_density,
+            sync_period=self.sync_period,
+            sync_staleness=self.sync_staleness)
 
     def _publish_plan_metrics(self, engine, params):
         """Addressable-param-bytes gauges: the FSDP acceptance
@@ -1147,7 +1207,34 @@ class Optimizer:
         model, optim = self.model, self.optim_method
         model.training()
         engine = self._plan_engine(mesh)
-        params, slots, buffers = engine.init_state()
+        # relaxed synchrony (parallel/plan.py "Synchrony"): restore
+        # the exact per-replica stacks for bitwise resume — unless a
+        # membership change forced an averaging round, in which case
+        # every survivor re-seeds from the averaged checkpoint params
+        sync_resume, self._sync_resume = self._sync_resume, None
+        if self._sync_force_average:
+            self._sync_force_average = False
+            if engine.has_relaxed and sync_resume is not None:
+                log.warning(
+                    "relaxed synchrony: membership change — forcing an "
+                    "averaging round; survivors re-seed their replica "
+                    "stacks from the averaged checkpoint params")
+                sync_resume = None
+        params, slots, buffers = engine.init_state(
+            sync_resume=sync_resume)
+        sync_state = (engine.init_sync_state(sync_resume)
+                      if engine.has_relaxed else None)
+        sync_phases = None
+        if engine.has_relaxed and engine.periodic_cadences:
+            # step-phase counters, one per averaging cadence group —
+            # checkpointed in optimMethod's state table so the
+            # averaging schedule resumes exactly where it left off
+            saved = self.optim_method.state.get("sync_phase")
+            n_groups = len(engine.periodic_cadences)
+            sync_phases = (list(saved)
+                           if isinstance(saved, (list, tuple))
+                           and len(saved) == n_groups
+                           else [0] * n_groups)
         self._publish_plan_metrics(engine, params)
         pad_multiple = engine.pad_multiple
         n_seq = engine.n_seq
@@ -1229,6 +1316,30 @@ class Optimizer:
                             and state["neval"] % profile_interval == 0
                             and not mask_kw)
 
+                # relaxed synchrony: advance the step-phase counters
+                # and fire this iteration's averaging flags (host-side
+                # — the flags are traced args, so the program never
+                # recompiles; an elastic relax-before-evict verdict
+                # widens the effective period here)
+                sync_kw = {}
+                if engine.has_relaxed:
+                    vals = [0] * engine.n_flags
+                    if sync_phases is not None:
+                        relax_f = (getattr(self.elastic,
+                                           "sync_relax_factor",
+                                           lambda: 1.0)()
+                                   if self.elastic is not None else 1.0)
+                        for gi, cad in enumerate(
+                                engine.periodic_cadences):
+                            sync_phases[gi] += 1
+                            eff = max(1, int(round(cad * relax_f)))
+                            if sync_phases[gi] >= eff:
+                                vals[gi] = 1
+                                sync_phases[gi] = 0
+                        state["sync_phase"] = list(sync_phases)
+                    sync_kw = {"sync_flags": np.asarray(vals, np.int32),
+                               "sync_state": sync_state}
+
                 lr = optim.get_current_lr()
                 t0 = time.time()
                 if first_step and not mask_kw \
@@ -1240,16 +1351,23 @@ class Optimizer:
                     # bytes come from the PLAN now — tensor-parallel
                     # and FSDP traffic is counted per leaf, not assumed
                     # to be a data-parallel ring.
+                    analyze_extra = ()
+                    if engine.has_relaxed:
+                        analyze_extra = (
+                            jnp.zeros((engine.n_flags,), jnp.int32),
+                            sync_state)
                     self._tm_analyze(
                         engine.jitted_for(x, y, False), params, slots,
                         buffers, jnp.float32(lr), jax.random.PRNGKey(0),
-                        x, y,
+                        x, y, *analyze_extra,
                         collective_bytes=engine.collective_bytes,
-                        sparse_bytes_saved=engine.sparse_bytes_saved)
+                        sparse_bytes_saved=engine.sparse_bytes_saved,
+                        sync_bytes_saved=engine.sync_bytes_saved)
 
                 def dispatch():
                     return engine.step(params, slots, buffers, lr, x, y,
-                                       rng=next_jax_key(), **mask_kw)
+                                       rng=next_jax_key(), **sync_kw,
+                                       **mask_kw)
 
                 trace_split = None
                 if profiled:
@@ -1274,7 +1392,9 @@ class Optimizer:
                     loss = float(out[0])  # device sync; the feed's
                     #                       producer keeps prefetching
                     train_time = time.time() - t0
-                _, params, slots, buffers, step_ok, gnorm = out
+                _, params, slots, buffers, step_ok, gnorm = out[:6]
+                if engine.has_relaxed:
+                    sync_state = out[6]
                 skipped = not bool(step_ok)
                 self._tm_step(state, train_time, stall_time, n_records,
                               compiled=first_step,
@@ -1362,7 +1482,7 @@ class Optimizer:
                                         eval_cache)
                 if do_checkpoint or self._preempted():
                     self._plan_checkpoint(engine, state, params, slots,
-                                          buffers)
+                                          buffers, sync_state)
                 if self._preempted():
                     self._drain_checkpoints()
                     log.warning("preemption requested — checkpointed at "
@@ -1381,15 +1501,26 @@ class Optimizer:
         self._tm_finish(state)
         return model
 
-    def _plan_checkpoint(self, engine, state, params, slots, buffers):
+    def _plan_checkpoint(self, engine, state, params, slots, buffers,
+                         sync_state=None):
         if self.checkpoint_path is None:
             return
         if self.checkpoint_format == "orbax":
             # sharded async save straight from the device trees — no
-            # host gather, no unpack
+            # host gather, no unpack (checkpoint_tree rejects relaxed-
+            # synchrony state loudly: the replica stacks ride the
+            # pickle trainState leg only)
             tree, kind = engine.checkpoint_tree(params, slots, buffers)
             self._orbax_save(state, tree, kind=kind)
             return
+        if engine.has_relaxed:
+            # snapshot the exact per-replica stacks + pending buffers
+            # BEFORE the averaged sync_to_model write — the model leg
+            # carries the replica mean, the trainState leg the truth
+            self._sync_snapshot = engine.sync_snapshot(params, slots,
+                                                       sync_state)
+        else:
+            self._sync_snapshot = None  # a swapped plan must not leak
         # host-gather for the whole-module pickle checkpoint
         # (model-sharded and FSDP leaves reassemble on fetch)
         engine.sync_to_model(params, slots, buffers)
@@ -1405,6 +1536,9 @@ class Optimizer:
             return
         from .evaluator import evaluate_dataset
 
+        # relaxed-synchrony replica stacks collapse to their mean for
+        # validation (the local-SGD read-out; a no-op otherwise)
+        params = engine.eval_params(params)
         mesh = engine.mesh
         if engine.kind == "packed":
             if cache.get("fwd") is None:

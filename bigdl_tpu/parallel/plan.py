@@ -59,9 +59,9 @@ from ..utils.jax_compat import shard_map
 
 log = logging.getLogger("bigdl_tpu")
 
-__all__ = ["Rule", "Plan", "TRANSPORTS", "derive_plan", "named_leaves",
-           "match_partition_rules", "compile_step_with_plan",
-           "CompiledPlanStep", "spec_table"]
+__all__ = ["Rule", "Plan", "TRANSPORTS", "SYNCS", "derive_plan",
+           "named_leaves", "match_partition_rules",
+           "compile_step_with_plan", "CompiledPlanStep", "spec_table"]
 
 
 # ---------------------------------------------------------------------------
@@ -110,6 +110,27 @@ def _map_named(fn, tree, sep: str = "/"):
     return rec(tree, ())
 
 
+def _slot_tree_like(slots, per_param, default):
+    """Mirror :func:`spmd.slot_specs`' structural rule for ANY per-param
+    annotation tree: slot subtrees structured like the param tree
+    inherit ``per_param`` (momentum/Adam moments follow their params);
+    everything else (step counters) gets ``default``."""
+    ptreedef = jax.tree_util.tree_structure(per_param)
+
+    def rec(s):
+        if jax.tree_util.tree_structure(s) == ptreedef:
+            return per_param
+        if isinstance(s, dict):
+            return {k: rec(v) for k, v in s.items()}
+        if isinstance(s, tuple) and hasattr(s, "_fields"):
+            return type(s)(*(rec(v) for v in s))
+        if isinstance(s, (tuple, list)):
+            return type(s)(rec(v) for v in s)
+        return default
+
+    return rec(slots)
+
+
 # ---------------------------------------------------------------------------
 # rules + plan
 # ---------------------------------------------------------------------------
@@ -123,6 +144,45 @@ def _map_named(fn, tree, sep: str = "/"):
 #: loudly at plan-construction time.
 TRANSPORTS = ("dense", "sparse")
 
+#: synchrony vocabulary a :class:`Rule` may carry (docs/distributed.md
+#: "Synchrony").  ``"step"`` = the classic lockstep reduction on every
+#: iteration (the default — compiles the exact pre-sync program);
+#: ``"periodic(k)"`` = local SGD: the leaf's gradient never crosses the
+#: data axis, each data replica keeps its own copy, and every k-th step
+#: the copies (and their momentum-style optimizer slots) all-reduce-
+#: average under a traced flag — the DeepSpark/SparkNet relaxation
+#: (arxiv 1602.08191) that trains through stragglers and cuts the
+#: per-step wire by k; ``"stale(s)"`` = bounded-staleness sparse
+#: updates for sparse-transport leaves: the local replica updates with
+#: its own gradient immediately while the peers' index+row exchange is
+#: applied up to ``s`` steps late (Parallax, arxiv 1808.02621 — sparse
+#: embedding tables tolerate staleness dense MLPs don't).  Anything
+#: else is rejected loudly at plan-construction time.
+SYNCS = ("step", "periodic(k)", "stale(s)")
+
+_SYNC_RE = re.compile(r"^(?:step|periodic\((\d+)\)|stale\((\d+)\))$")
+
+
+def _parse_sync(sync: str):
+    """``"step" | "periodic(k)" | "stale(s)"`` -> ``(kind, n)``; raises
+    on anything outside the :data:`SYNCS` vocabulary."""
+    m = _SYNC_RE.match(str(sync))
+    if m is None:
+        raise ValueError(
+            f"unknown synchrony {sync!r} — expected one of {SYNCS} "
+            "(docs/distributed.md \"Synchrony\")")
+    if m.group(1) is not None:
+        k = int(m.group(1))
+        if k < 1:
+            raise ValueError(f"periodic({k}) needs a period >= 1")
+        return ("periodic", k)
+    if m.group(2) is not None:
+        s = int(m.group(2))
+        if s < 1:
+            raise ValueError(f"stale({s}) needs a staleness bound >= 1")
+        return ("stale", s)
+    return ("step", 0)
+
 
 class Rule(NamedTuple):
     """One ordered partition rule: the first ``re.search`` match wins.
@@ -135,13 +195,18 @@ class Rule(NamedTuple):
     rule's leaves (see :data:`TRANSPORTS`): ``"sparse"`` ships
     ``(row_indices, row_values)`` over the data axis instead of the
     dense all-reduce — with an automatic density-threshold fallback to
-    dense per leaf (docs/distributed.md "Gradient transport")."""
+    dense per leaf (docs/distributed.md "Gradient transport").
+    ``sync`` picks the rule's synchrony (see :data:`SYNCS`):
+    ``"periodic(k)"`` runs local SGD with k-step parameter averaging,
+    ``"stale(s)"`` bounded-staleness sparse updates — both opt-in per
+    rule, never a silent numerics change."""
 
     pattern: str
     spec: P
     fsdp: bool = False
     reason: str = ""
     transport: str = "dense"
+    sync: str = "step"
 
 
 class _Entry(NamedTuple):
@@ -149,6 +214,7 @@ class _Entry(NamedTuple):
     fsdp: bool
     rule: Optional[Rule]
     transport: str = "dense"
+    sync: str = "step"
 
 
 def _spec_axes(spec) -> Tuple[str, ...]:
@@ -199,6 +265,20 @@ class Plan:
                     "with fsdp=True — FSDP gradients already ride the "
                     "gather's reduce-scatter transpose; sparse "
                     "transport applies to data-replicated tables only")
+            kind, _ = _parse_sync(r.sync)  # rejects unknown values
+            if kind != "step" and r.fsdp:
+                raise ValueError(
+                    f"rule {r.pattern!r} combines sync={r.sync!r} with "
+                    "fsdp=True — an FSDP leaf has exactly one copy "
+                    "sharded over the data axis, so there are no "
+                    "replicas to run local SGD on; relaxed synchrony "
+                    "applies to data-replicated leaves only")
+            if kind == "stale" and r.transport != "sparse":
+                raise ValueError(
+                    f"rule {r.pattern!r} asks for sync={r.sync!r} on "
+                    f"transport={r.transport!r} — stale(s) is the "
+                    "bounded-staleness SPARSE update path (Parallax); "
+                    "use sync='periodic(k)' for dense leaves")
         self.mesh = mesh
         self.fsdp_min_bytes = fsdp_min_bytes
         self.data_axis = data_axis
@@ -279,19 +359,41 @@ class Plan:
             if fsdp and not self._fits(spec, shape):
                 spec = P(*(self._strip_data(p) for p in spec))
                 fsdp = False
-            if not fsdp and rule.transport != "sparse":
+            sync = self._effective_sync(name, rule.sync, spec)
+            if not fsdp and rule.transport != "sparse" and sync == "step":
                 # sparse-transport leaves keep their replica: the whole
                 # point is that their gradient wire is already cheap,
-                # so the FSDP threshold rule must not claim them
+                # so the FSDP threshold rule must not claim them; the
+                # same holds for relaxed-synchrony leaves — local SGD
+                # needs a whole replica per data shard
                 spec = self._maybe_auto_fsdp(spec, leaf)
                 fsdp = self.data_axis in _spec_axes(spec) and \
                     spec != self._degrade(rule.spec)
                 if fsdp:
-                    return _Entry(spec, True, rule, "dense")
-            return _Entry(spec, fsdp, rule, rule.transport)
+                    return _Entry(spec, True, rule, "dense", "step")
+            return _Entry(spec, fsdp, rule, rule.transport, sync)
         raise ValueError(
             f"no partition rule matched param {name!r} — append a "
             "catch-all Rule('.*', P()) for replicate-by-default plans")
+
+    def _effective_sync(self, name: str, sync: str, spec: P) -> str:
+        """A rule's sync resolved against the leaf's final spec: a leaf
+        SHARDED over the data axis has exactly one copy of each element
+        — there are no replicas to relax, so ``periodic``/``stale``
+        degrade to ``"step"`` with a warning (row-sharded embedding
+        tables: the lookup exchange is the row's only copy)."""
+        kind, _ = _parse_sync(sync)
+        if kind == "step":
+            return "step"
+        if self.data_axis in _spec_axes(spec):
+            log.warning(
+                "sharding plan: %s asks for sync=%r but is sharded "
+                "over the data axis (%s) — each element has exactly "
+                "one copy, so the leaf runs sync='step' (relaxed "
+                "synchrony applies to data-replicated leaves)",
+                name, sync, _spec_str(spec))
+            return "step"
+        return sync
 
     def _strip_unfit(self, spec: P, shape) -> P:
         """Drop every spec dim whose combined axis size does not divide
@@ -383,15 +485,29 @@ class Plan:
         return any(t == "sparse" for t in
                    jax.tree_util.tree_leaves(self.transport_tree(tree)))
 
+    def sync_tree(self, tree):
+        """Per-leaf effective synchrony pytree (``"step"`` /
+        ``"periodic(k)"`` / ``"stale(s)"`` strings)."""
+        return jax.tree_util.tree_map(
+            lambda e: e.sync, self.entries(tree),
+            is_leaf=lambda e: isinstance(e, _Entry))
+
+    def has_relaxed(self, tree) -> bool:
+        """True when any leaf's effective sync is not ``"step"``."""
+        return any(s != "step" for s in
+                   jax.tree_util.tree_leaves(self.sync_tree(tree)))
+
     def named_entries(self, tree):
         return named_leaves(self.entries(tree),
                             is_leaf=lambda x: isinstance(x, _Entry))
 
     def table(self, tree) -> dict:
-        """``{path name: "spec | transport [markers]"}`` — the
-        golden-test / docs view; the transport column rides every row
-        (``BIGDL_REGEN_PLAN_GOLDENS=1`` regenerates the fixtures)."""
+        """``{path name: "spec | transport | sync [markers]"}`` — the
+        golden-test / docs view; the transport and sync columns ride
+        every row (``BIGDL_REGEN_PLAN_GOLDENS=1`` regenerates the
+        fixtures)."""
         return {name: (_spec_str(e.spec) + " | " + e.transport
+                       + " | " + e.sync
                        + (" [fsdp]" if e.fsdp else ""))
                 for name, e in self.named_entries(tree)}
 
@@ -436,6 +552,12 @@ class Plan:
         transpose."""
         if entry.transport != "sparse" or entry.fsdp:
             return False
+        if _parse_sync(entry.sync)[0] == "periodic":
+            # local SGD: the leaf's gradient never crosses the data
+            # axis between averaging rounds, so the per-step sparse
+            # wire never runs (the averaging round is accounted as
+            # amortized dense bytes in collective_bytes)
+            return False
         if self.data_axis in _spec_axes(entry.spec):
             return False
         if self._mesh_size(self.data_axis) <= 1:
@@ -472,7 +594,14 @@ class Plan:
           the data-axis component is the ACTUAL index+value wire,
           ``(n_d - 1) x K x (row bytes + 4)`` with
           ``K = ceil(rows x sparse_density)`` — not the dense formula;
-          any other replicated axes still all-reduce the dense rows.
+          any other replicated axes still all-reduce the dense rows;
+        * ``sync="periodic(k)"`` leaf: the data-axis component is the
+          AMORTIZED averaging wire — the k-step parameter-averaging
+          all-reduce's ring bytes divided by k (relaxed synchrony is
+          cheaper, never free); other replicated axes still pmean the
+          gradient every step.  ``stale(s)`` sparse leaves are
+          unchanged: their index+value exchange still runs every step
+          (only its *application* is allowed to lag).
 
         On a pure-data mesh with a replicate-everything plan this is
         exactly the old hard-wired ``2(n-1)/n x param bytes`` ring
@@ -506,6 +635,20 @@ class Plan:
             elif self.sparse_engaged(leaf, entry):
                 # index+value wire over data; dense over the rest
                 total += self.sparse_wire_bytes(leaf)
+                r = 1
+                for a in axes:
+                    if a not in sharded and a != self.data_axis:
+                        r *= self._mesh_size(a)
+                if r > 1:
+                    total += 2.0 * (r - 1) / r * local
+            elif _parse_sync(entry.sync)[0] == "periodic" \
+                    and self.data_axis in axes \
+                    and self.data_axis not in sharded:
+                # local SGD: the averaging round's ring bytes / k, plus
+                # the every-step gradient pmean over any OTHER
+                # replicated axes (model peers stay lockstep)
+                k = _parse_sync(entry.sync)[1]
+                total += self._dense_data_wire(leaf, local) / k
                 r = 1
                 for a in axes:
                     if a not in sharded and a != self.data_axis:
@@ -546,6 +689,46 @@ class Plan:
                 - self.sparse_wire_bytes(leaf)
         return saved
 
+    def sync_bytes_saved(self, tree) -> float:
+        """Wire bytes one step does NOT move because relaxed synchrony
+        replaced the lockstep data-axis reduction (the
+        ``bigdl_perf_sync_bytes_saved`` gauge): per ``periodic(k)``
+        leaf, the lockstep data-axis wire it would have paid every
+        step (the sparse index+value wire when the leaf would have
+        engaged sparse transport under ``sync="step"``, the dense ring
+        otherwise) minus the amortized averaging bytes (ring / k).
+        ``stale(s)`` leaves save nothing here — their exchange still
+        runs every step."""
+        if self.mesh is None:
+            return 0.0
+        saved = 0.0
+        leaves = dict(named_leaves(tree))
+        for name, entry in self.named_entries(tree):
+            kind, k = _parse_sync(entry.sync)
+            if kind != "periodic":
+                continue
+            if self.data_axis in _spec_axes(entry.spec) or entry.fsdp:
+                continue
+            n_d = self._mesh_size(self.data_axis)
+            if n_d <= 1:
+                continue
+            leaf = leaves[name]
+            shape = tuple(getattr(leaf, "shape", ()) or ())
+            nbytes = float(int(np.prod(shape or (1,)))
+                           * jnp.dtype(leaf.dtype).itemsize)
+            shard_n = 1
+            for a in _spec_axes(entry.spec):
+                shard_n *= self._mesh_size(a)
+            local = nbytes / max(shard_n, 1)
+            dense = self._dense_data_wire(leaf, local)
+            # what the leaf would have paid under sync="step"
+            step_entry = entry._replace(sync="step")
+            step_wire = (self.sparse_wire_bytes(leaf)
+                         if self.sparse_engaged(leaf, step_entry)
+                         else dense)
+            saved += max(0.0, step_wire - dense / k)
+        return saved
+
 
 def _spec_str(spec: P) -> str:
     if not tuple(spec):
@@ -572,22 +755,29 @@ def spec_table(specs) -> dict:
 # default rule derivation (param_specs-style module introspection)
 # ---------------------------------------------------------------------------
 
-def _sparse_param_names(module, prefix=()):
+def _sparse_param_info(module, prefix=()):
     """'/'-joined param-tree names whose owning module opted into
     sparse gradient transport (``sparse_grads = True`` — e.g.
     ``nn.ShardedEmbedding``: a Zipf-skewed batch touches a vanishing
-    fraction of its rows, Parallax's motivating case)."""
+    fraction of its rows, Parallax's motivating case), mapped to the
+    module's own ``sync_staleness`` override (None = follow the
+    ``bigdl.sync.*`` knobs)."""
     from ..nn.module import Container
 
-    out = set()
+    out = {}
     if getattr(module, "sparse_grads", False):
+        stale = getattr(module, "sync_staleness", None)
         for name, _ in named_leaves(module.param_tree()):
-            out.add("/".join(prefix + (name,)) if name
-                    else "/".join(prefix))
+            out["/".join(prefix + (name,)) if name
+                else "/".join(prefix)] = stale
     elif isinstance(module, Container):
         for i, child in enumerate(module.modules):
-            out |= _sparse_param_names(child, prefix + (str(i),))
+            out.update(_sparse_param_info(child, prefix + (str(i),)))
     return out
+
+
+def _sparse_param_names(module, prefix=()):
+    return set(_sparse_param_info(module, prefix))
 
 
 def derive_plan(model, mesh: Mesh, *, model_axis: Optional[str] = "model",
@@ -595,6 +785,8 @@ def derive_plan(model, mesh: Mesh, *, model_axis: Optional[str] = "model",
                 n_pipe: Optional[int] = None,
                 fsdp_min_bytes: Optional[int] = None,
                 sparse_density: Optional[float] = None,
+                sync_period: Optional[int] = None,
+                sync_staleness: Optional[int] = None,
                 extra_rules: Sequence[Rule] = ()) -> Plan:
     """The default :class:`Plan` for ``model`` on ``mesh``.
 
@@ -608,13 +800,36 @@ def derive_plan(model, mesh: Mesh, *, model_axis: Optional[str] = "model",
     (see :meth:`Plan._maybe_auto_fsdp`).  Modules with
     ``sparse_grads = True`` get their rules stamped
     ``transport="sparse"`` (docs/distributed.md "Gradient
-    transport")."""
+    transport").
+
+    ``sync_period`` / ``sync_staleness`` (the ``bigdl.sync.period`` /
+    ``bigdl.sync.staleness`` properties, ``Optimizer.set_sync_period``
+    / ``set_sync_staleness``) set the default SYNCHRONY for the
+    sparse-grads module rules — Parallax's hybrid, as two rule lines:
+    dense MLP rules stay ``sync="step"``; a replicated sparse table's
+    rule defaults to ``stale(s)`` when a staleness bound is armed
+    (module-level ``staleness=`` overrides the global knob), else
+    ``periodic(k)`` when an averaging period is armed; row-sharded
+    table rules stay ``"step"`` (the lookup exchange is the row's only
+    copy).  Dense rules opt in per rule via ``extra_rules``
+    (docs/distributed.md "Synchrony")."""
     from .spmd import param_specs as module_specs
 
+    if sync_period is None:
+        from ..utils.engine import get_property
+
+        _sp = get_property("bigdl.sync.period")
+        sync_period = int(_sp) if _sp else None
+    if sync_staleness is None:
+        from ..utils.engine import get_property
+
+        _ss = get_property("bigdl.sync.staleness")
+        sync_staleness = int(_ss) if _ss else None
     model_axis = (model_axis if model_axis is not None
                   and model_axis in mesh.axis_names else None)
     rules = list(extra_rules)
-    sparse_names = _sparse_param_names(model)
+    sparse_info = _sparse_param_info(model)
+    sparse_names = set(sparse_info)
     if pipe_axis is not None:
         if sparse_names:
             raise NotImplementedError(
@@ -636,10 +851,22 @@ def derive_plan(model, mesh: Mesh, *, model_axis: Optional[str] = "model",
         if not isinstance(spec, P):
             continue
         transport = "sparse" if name in sparse_names else "dense"
+        sync = "step"
+        if transport == "sparse" and not tuple(spec):
+            # data-REPLICATED sparse table: the leaf class that
+            # tolerates relaxed synchrony (Parallax) — stale-bounded
+            # sparse updates when a staleness bound is armed, local
+            # SGD with periodic averaging when only a period is;
+            # row-sharded tables (tuple(spec) non-empty) stay "step"
+            stale = sparse_info.get(name) or sync_staleness
+            if stale:
+                sync = f"stale({int(stale)})"
+            elif sync_period:
+                sync = f"periodic({int(sync_period)})"
         if tuple(spec) or transport == "sparse":
             rules.append(Rule("^" + re.escape(name) + "$", spec,
                               reason="introspection",
-                              transport=transport))
+                              transport=transport, sync=sync))
     rules.append(Rule(".*", P(), reason="default"))
     return Plan(rules, mesh=mesh, fsdp_min_bytes=fsdp_min_bytes,
                 sparse_density=sparse_density)
@@ -674,24 +901,156 @@ class CompiledPlanStep:
     #   kind, mesh, plan, model, optim, param_specs, slot_specs,
     #   buffer_specs, input_spec, io_spec, pad_multiple, step,
     #   jitted_for, collective_bytes, sparse_bytes_saved,
-    #   transport_table, has_fsdp, n_data, n_seq
+    #   sync_bytes_saved, transport_table, sync_table, relaxed,
+    #   periodic_cadences, stale_cadences, n_flags, has_relaxed,
+    #   has_fsdp, n_data, n_seq
 
-    def init_state(self):
+    def init_state(self, sync_resume=None):
         """Fresh device-placed (params, slots, buffers) from the live
         model/optimizer — device_put COPIES, so the donating step can
         never eat the model's own arrays (the retry loop re-enters
-        here after a restore)."""
+        here after a restore).
+
+        Relaxed-synchrony leaves (``sync="periodic(k)"/"stale(s)"``)
+        are stacked with a leading ``[n_data]`` replica dim sharded
+        over the data axis — per-replica divergence is explicit device
+        state, never a "replicated" array whose shards secretly
+        differ.  ``sync_resume`` (the trainState checkpoint's ``sync``
+        leg) restores the exact per-replica stacks for bitwise resume;
+        absent or shape-mismatched (an elastic shrink changed n_data),
+        every replica seeds from the model's averaged params — the
+        forced averaging round a membership change demands."""
         from ..optim.optimizer import _resume_slots
 
+        resume = sync_resume or {}
         host = self._host_params()
         put = lambda tree, specs: jax.tree_util.tree_map(
             lambda a, s: jax.device_put(
                 jnp.asarray(a), NamedSharding(self.mesh, s)), tree, specs)
+        slots_host = _resume_slots(self.optim,
+                                   self.optim.init_state(host))
+        if self.relaxed:
+            host = self._stack_tree(host, self.relaxed,
+                                    resume.get("params"))
+            slot_relaxed = self._slot_relaxed(slots_host)
+            slots_host = self._stack_tree(slots_host, slot_relaxed,
+                                          resume.get("slots"))
         params = put(host, self.param_specs)
-        slots = _resume_slots(self.optim, self.optim.init_state(host))
-        slots = put(slots, self.slot_specs)
+        slots = put(slots_host, self.slot_specs)
         buffers = put(self.model.buffer_tree(), self.buffer_specs)
         return params, slots, buffers
+
+    # -- relaxed-synchrony state plumbing (docs/distributed.md) ---------
+    def _slot_relaxed(self, slots) -> dict:
+        """``{slot path: (kind, cadence)}`` for slot leaves that follow
+        a relaxed param (the :func:`_slot_tree_like` structural rule —
+        momentum-style slots replicate per data shard with their
+        params; counters stay shared)."""
+        if not self.relaxed:
+            return {}
+        # string tags (tuples would read as pytree nodes and break the
+        # structural match)
+        per_param = _map_named(
+            lambda nm, l: ("%s:%d" % self.relaxed[nm]
+                           if nm in self.relaxed else ""),
+            self._host_params())
+        tagged = _slot_tree_like(slots, per_param, "")
+        return {name: tag for name, tag in named_leaves(tagged) if tag}
+
+    def _stack_tree(self, tree, relaxed_names, resume_by_name=None):
+        """Host-side replica stacking: each relaxed leaf becomes
+        ``[n_data, *shape]`` — the checkpointed stack when its shape
+        still matches, a broadcast of the (averaged) host value
+        otherwise."""
+        resume_by_name = resume_by_name or {}
+
+        def stack(name, leaf):
+            if name not in relaxed_names:
+                return leaf
+            arr = np.asarray(leaf)
+            want = (self.n_data,) + arr.shape
+            saved = resume_by_name.get(name)
+            if saved is not None and tuple(np.shape(saved)) == want:
+                return np.asarray(saved)
+            return np.broadcast_to(arr, want).copy()
+
+        return _map_named(stack, tree)
+
+    def _unstack_host(self, tree, relaxed_names):
+        """Collapse host-side replica stacks: float leaves average (the
+        local-SGD read-out), everything else takes replica 0."""
+        def unstack(name, leaf):
+            if name not in relaxed_names:
+                return leaf
+            arr = np.asarray(leaf)
+            if np.issubdtype(arr.dtype, np.floating):
+                return arr.mean(axis=0).astype(arr.dtype)
+            return arr[0]
+
+        return _map_named(unstack, tree)
+
+    def init_sync_state(self, sync_resume=None):
+        """Device-placed relaxed-synchrony side state: the stale
+        leaves' pending peer-contribution buffers (zeros on a fresh
+        start; the checkpointed values on a bitwise resume).  ``{}``
+        when the plan has relaxed leaves but none stale; None when
+        every leaf is lockstep."""
+        if not self.has_relaxed:
+            return None
+        resume = (sync_resume or {}).get("pending") or {}
+        pending = {}
+        specs_by_name = dict(named_leaves(self.param_specs))
+        params_by_name = dict(named_leaves(self._host_params()))
+        for name in self.stale_cadences:
+            shape = (self.n_data,) + tuple(
+                np.shape(params_by_name[name]))
+            saved = resume.get(name)
+            arr = (np.asarray(saved)
+                   if saved is not None
+                   and tuple(np.shape(saved)) == shape
+                   else np.zeros(shape, np.float32))
+            pending[name] = jax.device_put(
+                jnp.asarray(arr, jnp.float32),
+                NamedSharding(self.mesh, specs_by_name[name]))
+        return pending
+
+    def sync_snapshot(self, params, slots, sync_state) -> Optional[dict]:
+        """Host snapshot of every per-replica stack + pending buffer —
+        the trainState checkpoint leg that makes resume bitwise across
+        an averaging boundary (None when nothing is relaxed)."""
+        if not self.relaxed:
+            return None
+        host_p = jax.device_get(params)
+        host_s = jax.device_get(slots)
+        out = {"params": {name: np.asarray(leaf)
+                          for name, leaf in named_leaves(host_p)
+                          if name in self.relaxed},
+               "slots": {name: np.asarray(leaf)
+                         for name, leaf in named_leaves(host_s)
+                         if name in self._slot_relaxed(host_s)}}
+        if sync_state:
+            out["pending"] = {name: np.asarray(jax.device_get(leaf))
+                              for name, leaf in sync_state.items()}
+        return out
+
+    def eval_params(self, params):
+        """The validation view of the device params: relaxed stacks
+        collapse to their replica mean (the local-SGD read-out), so
+        the eval forwards see model-shaped leaves."""
+        if not self.relaxed:
+            return params
+        if getattr(self, "_eval_view", None) is None:
+            names = dict(self.relaxed)
+
+            def view(p):
+                return _map_named(
+                    lambda nm, l: (jnp.mean(l, axis=0)
+                                   if nm in names and jnp.issubdtype(
+                                       l.dtype, jnp.floating)
+                                   else (l[0] if nm in names else l)), p)
+
+            self._eval_view = jax.jit(view)
+        return self._eval_view(params)
 
     def _host_params(self):
         if self.kind == "packed":
@@ -703,20 +1062,35 @@ class CompiledPlanStep:
     def sync_to_model(self, params, slots, buffers):
         """Write the device trees back into the module/optimizer
         (device_get reassembles model-sharded and FSDP leaves — the
-        out_specs make every output a global array)."""
+        out_specs make every output a global array; relaxed-synchrony
+        replica stacks collapse to their mean, the local-SGD final
+        model)."""
         if self.kind == "packed":
             from .pipeline import unpack_params
 
             unpack_params(jax.device_get(params), self.model)
-        else:
-            self.model.set_param_tree(jax.device_get(params))
-            self.model.set_buffer_tree(jax.device_get(buffers))
-        self.optim._slots = jax.device_get(slots)
+            self.optim._slots = jax.device_get(slots)
+            return
+        host_p = jax.device_get(params)
+        host_s = jax.device_get(slots)
+        if self.relaxed:
+            host_p = self._unstack_host(host_p, self.relaxed)
+            host_s = self._unstack_host(host_s,
+                                        self._slot_relaxed(host_s))
+        self.model.set_param_tree(host_p)
+        self.model.set_buffer_tree(jax.device_get(buffers))
+        self.optim._slots = host_s
 
     def checkpoint_tree(self, params, slots, buffers):
         """(orbax tree, kind) for the sharded-checkpoint path."""
         from ..optim.optimizer import Optimizer
 
+        if self.relaxed:
+            raise NotImplementedError(
+                "orbax checkpoints do not carry relaxed-synchrony "
+                "replica stacks yet — checkpoint sync='periodic/stale' "
+                "runs with the pickle format (its trainState leg "
+                "captures the per-replica state for bitwise resume)")
         if self.kind == "packed":
             return Optimizer._orbax_tree(params, slots), "packed"
         return Optimizer._orbax_tree(params, slots, buffers), "model"
@@ -768,6 +1142,8 @@ def compile_step_with_plan(model, criterion, optim, mesh: Mesh,
                            remat: Optional[bool] = None,
                            fsdp_min_bytes: Optional[int] = None,
                            sparse_density: Optional[float] = None,
+                           sync_period: Optional[int] = None,
+                           sync_staleness: Optional[int] = None,
                            data_axis: str = "data", seq_axis: str = "seq",
                            model_axis: str = "model",
                            pipe_axis: str = "pipe") -> CompiledPlanStep:
@@ -830,7 +1206,9 @@ def compile_step_with_plan(model, criterion, optim, mesh: Mesh,
     if plan is None:
         plan = derive_plan(model, mesh, model_axis=m_ax,
                            fsdp_min_bytes=fsdp_min_bytes,
-                           sparse_density=sparse_density)
+                           sparse_density=sparse_density,
+                           sync_period=sync_period,
+                           sync_staleness=sync_staleness)
     else:
         plan = plan.bind(mesh)
     host_params = model.param_tree()
@@ -853,9 +1231,63 @@ def compile_step_with_plan(model, criterion, optim, mesh: Mesh,
     transport_table = {}
     _entries_by_name = dict(plan.named_entries(host_params))
 
+    # -- per-leaf synchrony (docs/distributed.md "Synchrony") -----------
+    # relaxed leaves keep one whole replica PER DATA SHARD: the engine
+    # stacks them with a leading [n_data] dim sharded over the data
+    # axis, so per-replica divergence is explicit, honest device state
+    # and checkpoints capture it exactly.  sync_table records every
+    # decision for diagnosability (the transport_table pattern).
+    sync_table = {}
+    relaxed = {}
+    for _name, _leaf in named_leaves(host_params):
+        _e = _entries_by_name[_name]
+        _kind, _cadence = _parse_sync(_e.sync)
+        if _kind == "step":
+            continue
+        if d_ax is None or n_data <= 1:
+            sync_table[_name] = ("step (single data shard — nothing "
+                                 "to relax)")
+            continue
+        relaxed[_name] = (_kind, _cadence)
+        sync_table[_name] = (
+            f"periodic (params + momentum slots average every "
+            f"{_cadence} steps)" if _kind == "periodic" else
+            f"stale (sparse exchange every step; peers' rows applied "
+            f"one step late, bound {_cadence})")
+    has_relaxed = bool(relaxed)
+    periodic_cadences = tuple(sorted(
+        {c for k_, c in relaxed.values() if k_ == "periodic"}))
+    stale_cadences = {n: c for n, (k_, c) in relaxed.items()
+                      if k_ == "stale"}
+    n_flags = max(1, len(periodic_cadences))
+    if has_relaxed:
+        # stacked replica specs: the leading [n_data] dim shards over
+        # data; the leaf's own dims keep their (model/seq) spec parts
+        pspecs = _map_named(
+            lambda nm, s: P(d_ax, *tuple(s)) if nm in relaxed else s,
+            pspecs)
+        sslots = slot_specs(optim.init_state(host_params), pspecs)
+        # per-cadence membership masks for the averaging lax.cond
+        # (static bools at trace time; slot masks follow the params
+        # through the slot_specs structural rule)
+        _slots0 = optim.init_state(host_params)
+        group_param_masks = {
+            c: _map_named(
+                lambda nm, l, _c=c: relaxed.get(nm) == ("periodic", _c),
+                host_params)
+            for c in periodic_cadences}
+        group_slot_masks = {
+            c: _slot_tree_like(_slots0, group_param_masks[c], False)
+            for c in periodic_cadences}
+
     def _k_of(name, leaf):
         e = _entries_by_name[name]
         if e.transport != "sparse":
+            return 0
+        if relaxed.get(name, ("", 0))[0] == "periodic":
+            transport_table[name] = (
+                "local (periodic sync — the gradient never crosses "
+                "the data axis between averaging rounds)")
             return 0
         if d_ax is None or n_data <= 1:
             transport_table[name] = "dense (single data shard)"
@@ -935,9 +1367,28 @@ def compile_step_with_plan(model, criterion, optim, mesh: Mesh,
         out = lax.cond(overflow, dense_branch, sparse_branch, flat)
         return out.reshape(g.shape)
 
+    # per-leaf sync kind for the reduction rule ("step" | "periodic" |
+    # "stale" — static strings at trace time)
+    sync_kind_tree = _map_named(
+        lambda nm, l: relaxed.get(nm, ("step", 0))[0], host_params)
+    k_by_name = dict(named_leaves(k_tree))
+
     def _make_reduce_grad(masked):
         """The one gradient-reduction rule (module docstring)."""
-        def reduce_grad(g, spec, k):
+        def reduce_grad(g, spec, k, sync):
+            if sync != "step":
+                # relaxed synchrony: the data axis is NOT reduced here
+                # — the replica trains on its own local-mean gradient
+                # (local SGD); stale leaves add the peers' one-step-
+                # late contribution in _stale_exchange below.  Other
+                # axes (seq/model peers of the SAME replica) stay
+                # lockstep.
+                for ax, n in ((s_ax, n_seq), (m_ax, n_model)):
+                    if ax is None:
+                        continue
+                    g = g / n if _spec_has(spec, ax) else lax.pmean(g,
+                                                                    ax)
+                return g
             if d_ax:
                 if _spec_has(spec, d_ax):
                     # FSDP (gather transpose), expert stacks and
@@ -964,6 +1415,61 @@ def compile_step_with_plan(model, criterion, optim, mesh: Mesh,
             return g
 
         return reduce_grad
+
+    def _unstack_params(p):
+        """Each shard's [1, ...] relaxed slices -> model-shaped leaves
+        for the forward (AD restores the stacked shape on the grads)."""
+        if not has_relaxed:
+            return p
+        return _map_named(
+            lambda nm, l: l[0] if nm in relaxed else l, p)
+
+    def _stale_exchange(grads, pending, masked):
+        """Bounded-staleness sparse updates (Parallax): each shard
+        applies its OWN gradient immediately plus the peers' summed
+        contribution from the PREVIOUS step (the exchange 'in flight'
+        — staleness exactly one step, within any declared bound s).
+        The exchange itself still runs every step on the sparse
+        index+value wire (accounting unchanged), it just stops gating
+        the update application."""
+        new_pending = {}
+
+        def per(name, g):
+            if name not in stale_cadences:
+                return g
+            k = k_by_name.get(name, 0)
+            gl = g[0]  # the shard's replica slice, model-shaped
+            # sum over data: the sparse wire when the budget engages
+            # (spec P() -> the overflow predicate pmax's over EVERY
+            # axis, so all shards branch together), dense psum when
+            # the density threshold fell back
+            total = (_sparse_allreduce(gl, k, P()) if k
+                     else lax.psum(gl, d_ax))
+            peers = total - gl
+            new_pending[name] = peers[jnp.newaxis]
+            stale_g = gl + pending[name][0]
+            if not masked:
+                stale_g = stale_g / n_data
+            return stale_g[jnp.newaxis]
+
+        return _map_named(per, grads), new_pending
+
+    def _make_group_avg(pmask, smask):
+        """The averaging round for one periodic cadence group: pmean
+        the group's replica stacks (params + floating slots) over the
+        data axis; counters and every other leaf pass through."""
+        def avg(operand):
+            p, s = operand
+            p2 = jax.tree_util.tree_map(
+                lambda a, m: lax.pmean(a, d_ax) if m else a, p, pmask)
+            s2 = jax.tree_util.tree_map(
+                lambda a, m: (lax.pmean(a, d_ax)
+                              if m and jnp.issubdtype(a.dtype,
+                                                      jnp.floating)
+                              else a), s, smask)
+            return p2, s2
+
+        return avg
 
     from ..optim.regularizer import (collect_regularizer_paths,
                                      regularizer_loss)
@@ -1034,7 +1540,13 @@ def compile_step_with_plan(model, criterion, optim, mesh: Mesh,
     def _make_local_step(masked):
         reduce_grad = _make_reduce_grad(masked)
 
-        def local_step(params, slots, buf, lr, rng, x, y, *mask_args):
+        def local_step(params, slots, buf, lr, rng, x, y, *extra):
+            if has_relaxed:
+                sync_flags, pending = extra[0], extra[1]
+                mask_args = extra[2:]
+            else:
+                sync_flags, pending = None, None
+                mask_args = extra
             if rng is not None and batch_axes:
                 # decorrelate dropout across batch shards; model peers
                 # keep the SAME key (slices of one logical model)
@@ -1042,7 +1554,8 @@ def compile_step_with_plan(model, criterion, optim, mesh: Mesh,
                     rng = jax.random.fold_in(rng, lax.axis_index(a))
 
             def loss_fn(p):
-                out, nb = _run_fwd(p, buf, x, True, rng)
+                out, nb = _run_fwd(_unstack_params(p), buf, x, True,
+                                   rng)
                 aux = aux_loss_term(nb, aux_paths) if aux_paths else 0.0
                 if masked:
                     # trailing partial batch: per-record loss weighted
@@ -1061,7 +1574,12 @@ def compile_step_with_plan(model, criterion, optim, mesh: Mesh,
             (loss, nb), grads = jax.value_and_grad(loss_fn,
                                                    has_aux=True)(params)
             grads = jax.tree_util.tree_map(reduce_grad, grads, pspecs,
-                                           k_tree)
+                                           k_tree, sync_kind_tree)
+            if stale_cadences:
+                grads, new_pending = _stale_exchange(grads, pending,
+                                                     masked)
+            else:
+                new_pending = pending
             if reg_paths:
                 # per-shard reg grads are exact — added AFTER the
                 # cross-shard reduction, never scaled by it
@@ -1095,7 +1613,9 @@ def compile_step_with_plan(model, criterion, optim, mesh: Mesh,
             if guard:
                 # NaN/Inf anywhere skips the whole update; pmin over
                 # every axis makes all shards agree, so sharded slices
-                # stay consistent
+                # stay consistent.  Relaxed leaves' grads are LOCAL on
+                # skip steps, but the pmin makes the skip decision
+                # uniform — shards never diverge on the guard.
                 ok_local = jnp.logical_and(tree_finite(grads),
                                            jnp.isfinite(loss))
                 ok = (lax.pmin(ok_local.astype(jnp.int32), all_axes) > 0
@@ -1103,8 +1623,23 @@ def compile_step_with_plan(model, criterion, optim, mesh: Mesh,
                 new_params = where_tree(ok, new_params, params)
                 new_slots = where_tree(ok, new_slots, slots)
                 nb = where_tree(ok, nb, buf)
+                if stale_cadences:
+                    new_pending = where_tree(ok, new_pending, pending)
             else:
                 ok = jnp.bool_(True)
+            # the periodic averaging round: one lax.cond per cadence
+            # group on its traced flag — averaging a skipped step's
+            # (reverted) replicas is harmless and keeps the cadence,
+            # so the round runs on both guard phases
+            for _gi, _cadence in enumerate(periodic_cadences):
+                avg = _make_group_avg(group_param_masks[_cadence],
+                                      group_slot_masks[_cadence])
+                new_params, new_slots = lax.cond(
+                    sync_flags[_gi] > 0, avg, lambda o: o,
+                    (new_params, new_slots))
+            if has_relaxed:
+                return (loss, new_params, new_slots, nb, ok, gn,
+                        new_pending)
             return loss, new_params, new_slots, nb, ok, gn
 
         return local_step
@@ -1123,6 +1658,15 @@ def compile_step_with_plan(model, criterion, optim, mesh: Mesh,
             else:
                 in_specs = (pspecs, sslots, bspecs, P(), P(),
                             io_spec(x), io_spec(y))
+                out_specs = (P(), pspecs, sslots, bspecs, P(), P())
+                if has_relaxed:
+                    # traced averaging flags (replicated) + the stale
+                    # leaves' pending buffers (stacked like their
+                    # params)
+                    pend_specs = {nm: _pspec_by_name[nm]
+                                  for nm in stale_cadences}
+                    in_specs = in_specs + (P(), pend_specs)
+                    out_specs = out_specs + (pend_specs,)
                 if masked:
                     # weight vector shards over data only (pad rows
                     # are whole records); the real count replicates
@@ -1130,19 +1674,33 @@ def compile_step_with_plan(model, criterion, optim, mesh: Mesh,
                 fn = shard_map(
                     _make_local_step(masked), mesh=mesh,
                     in_specs=in_specs,
-                    out_specs=(P(), pspecs, sslots, bspecs, P(), P()),
+                    out_specs=out_specs,
                     check_vma=False)
             _jitted_cache[key] = jax.jit(
                 fn, donate_argnums=(0, 1, 2) if donate else ())
         return _jitted_cache[key]
 
+    _pspec_by_name = dict(named_leaves(pspecs))
+    _shape_by_name = {nm: tuple(np.shape(leaf))
+                      for nm, leaf in named_leaves(host_params)}
+
     def step(params, slots, buffers, lr, x, y, rng=None, w=None,
-             total_w=None):
+             total_w=None, sync_flags=None, sync_state=None):
         x = jax.tree_util.tree_map(jnp.asarray, x)
         y = jax.tree_util.tree_map(jnp.asarray, y)
         if rng is None:  # deterministic default (ad-hoc/test use)
             rng = jax.random.PRNGKey(0)
         args = (params, slots, buffers, jnp.float32(lr), rng, x, y)
+        if has_relaxed:
+            flags = (jnp.zeros((n_flags,), jnp.int32)
+                     if sync_flags is None
+                     else jnp.asarray(sync_flags, jnp.int32))
+            pend = sync_state
+            if pend is None:  # ad-hoc use: fresh zero pending buffers
+                pend = {nm: jnp.zeros(
+                    (n_data,) + tuple(_shape_by_name[nm]), jnp.float32)
+                    for nm in stale_cadences}
+            args = args + (flags, pend)
         if w is not None:
             args = args + (jnp.asarray(w, jnp.float32),
                            jnp.float32(total_w))
@@ -1155,7 +1713,11 @@ def compile_step_with_plan(model, criterion, optim, mesh: Mesh,
         jitted_for=_jitted_for, pad_multiple=n_data,
         collective_bytes=plan.collective_bytes(host_params),
         sparse_bytes_saved=plan.sparse_bytes_saved(host_params),
-        transport_table=transport_table,
+        sync_bytes_saved=plan.sync_bytes_saved(host_params),
+        transport_table=transport_table, sync_table=sync_table,
+        relaxed=relaxed, periodic_cadences=periodic_cadences,
+        stale_cadences=stale_cadences, n_flags=n_flags,
+        has_relaxed=has_relaxed,
         has_fsdp=has_fsdp, n_data=n_data, n_seq=n_seq,
         n_model=n_model, n_pipe=1, model_axis=m_ax, seq_axis=s_ax,
         input_seq_dim=input_seq_dim)
@@ -1213,6 +1775,13 @@ def _compile_pipeline(model, criterion, optim, mesh, plan, d_ax, m_ax,
                 "pipeline layout — a transport='sparse' rule matched "
                 "the packed block stack; use a data [x model] mesh for "
                 "sparse-table models")
+    if plan.has_relaxed(packed0):
+        raise NotImplementedError(
+            "relaxed synchrony (sync='periodic(k)'/'stale(s)') does "
+            "not compose with the pipeline layout — the packed block "
+            "stack's stages hand activations forward every tick, so "
+            "there is no per-replica copy to let drift; train relaxed-"
+            "sync models on a data [x model] mesh")
     pspecs = plan.param_specs(packed0)
     sslots = slot_specs(optim.init_state(packed0), pspecs)
     all_axes = tuple(a for a in (d_ax, p_ax, m_ax) if a)
@@ -1325,7 +1894,10 @@ def _compile_pipeline(model, criterion, optim, mesh, plan, d_ax, m_ax,
         input_spec=in_batch, io_spec=io_spec, step=step,
         jitted_for=_jitted_for, pad_multiple=n_data * M,
         collective_bytes=plan.collective_bytes(packed0),
-        sparse_bytes_saved=0.0, transport_table={},
+        sparse_bytes_saved=0.0, sync_bytes_saved=0.0,
+        transport_table={}, sync_table={}, relaxed={},
+        periodic_cadences=(), stale_cadences={}, n_flags=0,
+        has_relaxed=False,
         has_fsdp=False, n_data=n_data, n_seq=1, n_model=n_model,
         n_pipe=S, n_microbatch=M, model_axis=m_ax, seq_axis=None,
         input_seq_dim=None)

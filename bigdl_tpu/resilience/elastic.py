@@ -355,10 +355,26 @@ class StragglerPolicy:
     — provided the ``eviction_budget`` (total evictions allowed for the
     run) is not spent.  The reference drop knobs map onto this via
     :meth:`from_drop_knobs`.
+
+    ``relax_before_evict`` interposes the relaxed-synchrony escape
+    hatch (docs/elastic.md): the first ``max_relax_rounds`` times a
+    host qualifies for eviction, the policy instead WIDENS the
+    effective local-SGD averaging period (:attr:`period_factor`
+    multiplies each ``periodic(k)`` rule's cadence — local steps keep
+    landing while the straggler lags, and the averaging collective
+    that would stall on it fires less often) and gives the host a
+    fresh patience window.  Only when the skew sustains past every
+    relax round does :meth:`victim` fall through to the eviction vote
+    — eviction becomes the last resort, not the first response.  A
+    round where every relaxed host is back under threshold resets the
+    factor to 1 (the schedule tightens back once the straggler
+    recovers).
     """
 
     def __init__(self, skew_threshold: float = 3.0, patience: int = 3,
                  eviction_budget: int = 1, sustain: float = 0.0,
+                 relax_before_evict: bool = False,
+                 relax_factor: float = 2.0, max_relax_rounds: int = 2,
                  clock: Callable[[], float] = time.monotonic):
         if skew_threshold <= 1.0:
             raise ValueError("skew_threshold must be > 1")
@@ -371,11 +387,26 @@ class StragglerPolicy:
         # count alone would let one stale spike read as a chronic
         # straggler within milliseconds.
         self.sustain = float(sustain)
+        self.relax_before_evict = bool(relax_before_evict)
+        if relax_factor <= 1.0:
+            raise ValueError("relax_factor must be > 1")
+        self.relax_factor = float(relax_factor)
+        self.max_relax_rounds = max(0, int(max_relax_rounds))
+        self.relax_rounds = 0
+        self.relaxed_hosts: Dict[str, int] = {}
         self._clock = clock
         self.evicted_count = 0
         self._streak: Dict[str, int] = {}
         self._since: Dict[str, float] = {}
         self.warnings: Dict[str, float] = {}
+
+    @property
+    def period_factor(self) -> float:
+        """Multiplier for every ``periodic(k)`` rule's effective
+        averaging period (1.0 = the configured cadence)."""
+        if not self.relax_before_evict or self.relax_rounds <= 0:
+            return 1.0
+        return self.relax_factor ** self.relax_rounds
 
     @classmethod
     def from_drop_knobs(cls, drop_percentage: float,
@@ -425,13 +456,21 @@ class StragglerPolicy:
                 self._streak[h] = 0
                 self._since.pop(h, None)
         self.warnings = warn
+        if self.relaxed_hosts and not any(h in warn
+                                          for h in self.relaxed_hosts):
+            # every relaxed host is back under threshold: tighten the
+            # averaging schedule back to its configured cadence
+            self.relax_rounds = 0
+            self.relaxed_hosts.clear()
         return warn
 
     def victim(self, exclude: Sequence[str] = ()) -> Optional[str]:
         """The host to vote out at the next incarnation boundary, or
-        None (nobody chronic, or budget spent).  Chronic = over
-        threshold for ``patience`` consecutive observations AND
-        ``sustain`` seconds of wall clock."""
+        None (nobody chronic, budget spent, or — under
+        ``relax_before_evict`` — a relax round absorbed the skew
+        instead).  Chronic = over threshold for ``patience``
+        consecutive observations AND ``sustain`` seconds of wall
+        clock."""
         if self.evicted_count >= self.eviction_budget:
             return None
         now = self._clock()
@@ -440,7 +479,21 @@ class StragglerPolicy:
              if s >= self.patience and h not in exclude
              and now - self._since.get(h, now) >= self.sustain),
             reverse=True)
-        return over[0][1] if over else None
+        if not over:
+            return None
+        host = over[0][1]
+        if self.relax_before_evict \
+                and self.relax_rounds < self.max_relax_rounds:
+            # widen the effective averaging period instead of voting:
+            # the straggler gets a fresh patience window to catch up
+            # under the cheaper schedule
+            self.relax_rounds += 1
+            self.relaxed_hosts[host] = self.relaxed_hosts.get(host,
+                                                              0) + 1
+            self._streak[host] = 0
+            self._since.pop(host, None)
+            return None
+        return host
 
     def record_eviction(self, host: str):
         self.evicted_count += 1
@@ -827,8 +880,19 @@ class ElasticContext:
                         "median step time (threshold %.1fx)", h, skew,
                         self.straggler.skew_threshold)
             self._scalar("StragglerSkew", skew)
+        before = self.sync_relax_factor()
         victim = self.straggler.victim(exclude=(self.coordinator.host,))
         if victim is None:
+            after = self.sync_relax_factor()
+            if after != before:
+                log.warning(
+                    "elastic: relax-before-evict widened the effective "
+                    "sync averaging period x%.1f (round %d/%d) instead "
+                    "of voting out the straggler — eviction is the "
+                    "last resort", after,
+                    self.straggler.relax_rounds,
+                    self.straggler.max_relax_rounds)
+                self._scalar("SyncRelaxFactor", after)
             return
         c = self.coordinator
         self.straggler.record_eviction(victim)
@@ -844,6 +908,15 @@ class ElasticContext:
         raise MembershipChangedError(
             f"straggler {victim} voted out — shrinking to {survivors}",
             incarnation=n2, members=survivors)
+
+    def sync_relax_factor(self) -> float:
+        """The live relaxed-synchrony period multiplier the driver
+        consults every iteration: 1.0 normally; >1 while the straggler
+        policy's ``relax_before_evict`` rounds are widening the
+        effective ``periodic(k)`` averaging cadence (docs/elastic.md)."""
+        s = self.straggler
+        return float(getattr(s, "period_factor", 1.0)) \
+            if s is not None else 1.0
 
     def run_step(self, dispatch: Callable, step: int):
         """Run one compiled step under the watchdog.  ``dispatch`` is

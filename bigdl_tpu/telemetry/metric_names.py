@@ -55,6 +55,7 @@ PERF_FLOPS_PER_STEP = "bigdl_perf_flops_per_step"
 PERF_BYTES_PER_STEP = "bigdl_perf_bytes_per_step"
 PERF_COLLECTIVE_BYTES = "bigdl_perf_collective_bytes"
 PERF_SPARSE_BYTES_SAVED = "bigdl_perf_sparse_bytes_saved"
+PERF_SYNC_BYTES_SAVED = "bigdl_perf_sync_bytes_saved"
 PERF_SPARSE_FLOPS_SKIPPED = "bigdl_perf_sparse_flops_skipped"
 PERF_ARITHMETIC_INTENSITY = "bigdl_perf_arithmetic_intensity"
 PERF_MFU = "bigdl_perf_mfu"

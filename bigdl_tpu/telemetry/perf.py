@@ -168,6 +168,12 @@ class PerfAccountant:
             "collective wire bytes per step NOT moved because sparse "
             "gradient transport replaced the dense all-reduce",
             labels=("program",))
+        self.sync_bytes_saved = r.gauge(
+            "bigdl_perf_sync_bytes_saved",
+            "collective wire bytes per step NOT moved because relaxed "
+            "synchrony (periodic(k) local SGD) replaced the lockstep "
+            "per-step reduction with amortized k-step averaging",
+            labels=("program",))
         self.sparse_flops_skipped_gauge = r.gauge(
             "bigdl_perf_sparse_flops_skipped",
             "dense-equivalent MXU FLOPs per step NOT executed because "
@@ -222,6 +228,7 @@ class PerfAccountant:
     def analyze_jitted(self, fn, *args, label: str = "train_step",
                        collective_bytes: float = 0.0,
                        sparse_bytes_saved: float = 0.0,
+                       sync_bytes_saved: float = 0.0,
                        **kwargs) -> Optional[StepCost]:
         """Lower a jitted callable with the driver's concrete args and
         read XLA's cost model — no compile, no execution, no donation
@@ -238,7 +245,8 @@ class PerfAccountant:
                       label, type(e).__name__, e)
             return None
         return self.on_program(label, cost,
-                               sparse_bytes_saved=sparse_bytes_saved)
+                               sparse_bytes_saved=sparse_bytes_saved,
+                               sync_bytes_saved=sync_bytes_saved)
 
     def analyze_compiled(self, compiled, label: str = "train_step",
                          collective_bytes: float = 0.0
@@ -261,7 +269,8 @@ class PerfAccountant:
         return self.on_program(label, cost)
 
     def on_program(self, label: str, cost: StepCost,
-                   sparse_bytes_saved: float = 0.0) -> StepCost:
+                   sparse_bytes_saved: float = 0.0,
+                   sync_bytes_saved: float = 0.0) -> StepCost:
         """Install an analyzed program: publish its static gauges and
         make it the one ``on_step`` attributes work to."""
         label = str(label)
@@ -279,6 +288,9 @@ class PerfAccountant:
         if sparse_bytes_saved:
             self.sparse_bytes_saved.labels(program=label).set(
                 float(sparse_bytes_saved))
+        if sync_bytes_saved:
+            self.sync_bytes_saved.labels(program=label).set(
+                float(sync_bytes_saved))
         if cost.arithmetic_intensity is not None:
             self.intensity.labels(program=label).set(
                 cost.arithmetic_intensity)

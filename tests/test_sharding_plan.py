@@ -204,12 +204,19 @@ def _golden_cases():
         RNG().set_seed(1)
         # 4 KiB shard threshold: the 512-row table row-shards over
         # data, the 64-row table replicates — BOTH carry the sparse
-        # transport column (the ISSUE 10 per-rule wire, visible in one
-        # committed table)
+        # transport column (the ISSUE 10 per-rule wire) AND the sync
+        # column shows the full ISSUE 15 vocabulary in one committed
+        # table: the replicated table defaults to stale(2) under the
+        # staleness knob (row-sharded rows have one copy — they stay
+        # "step"), and a user rule opts the bottom MLP into
+        # periodic(4) local SGD
         model = DLRM(dense_dim=4, table_sizes=(512, 64), embed_dim=8,
                      shard_min_bytes=4096)
         mesh = Mesh(devs, ("data",))
-        return model.param_tree(), derive_plan(model, mesh)
+        return model.param_tree(), derive_plan(
+            model, mesh, sync_staleness=2,
+            extra_rules=[Rule(r"^0/", P(), reason="user",
+                              sync="periodic(4)")])
 
     cases["resnet50"] = resnet50
     cases["transformerlm"] = transformerlm
@@ -362,8 +369,8 @@ def test_fsdp_specs_shard_large_leaves_only():
     table = plan.table(model.param_tree())
     assert "[fsdp]" in table["0/weight"]   # 512x256 f32 = 512 KiB
     assert "data" in table["0/weight"]
-    assert table["0/bias"] == "replicated | dense"
-    assert table["2/weight"] == "replicated | dense"  # 2x512 f32 = 4 KiB
+    assert table["0/bias"] == "replicated | dense | step"
+    assert table["2/weight"] == "replicated | dense | step"  # 2x512 f32 = 4 KiB
 
 
 # ---------------------------------------------------------------------------

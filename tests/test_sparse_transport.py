@@ -71,8 +71,8 @@ def test_table_carries_transport_column():
     plan = Plan([Rule("emb", P(), transport="sparse"),
                  Rule(".*", P())], mesh=mesh)
     table = plan.table(tree)
-    assert table["emb"] == "replicated | sparse"
-    assert table["w"] == "replicated | dense"
+    assert table["emb"] == "replicated | sparse | step"
+    assert table["w"] == "replicated | dense | step"
 
 
 def test_sparse_with_pipe_rejected_at_derive():
@@ -272,7 +272,7 @@ def test_sharded_embedding_degrades_to_replica_when_rows_dont_divide(
     with caplog.at_level(logging.WARNING, logger="bigdl_tpu"):
         plan = derive_plan(model, mesh)
         table = plan.table(model.param_tree())
-    assert table["1/weight"] == "replicated | sparse"
+    assert table["1/weight"] == "replicated | sparse | step"
     assert any("does not divide" in r.message for r in caplog.records)
 
 
