@@ -3632,6 +3632,162 @@ def run_slo_bench() -> None:
 
 
 # --------------------------------------------------------------------------
+# Continuous-learning loop bench (--loop): online train → verified
+# hot-swap → serve, burn-rate rollback under a regressed deploy
+# --------------------------------------------------------------------------
+
+LOOP_TIMEOUT = float(os.environ.get("BENCH_LOOP_TIMEOUT", "240"))
+LOOP_RESULT = "LOOP_r01.json"
+
+
+def _loop_measurements(intervals: int = 30,
+                       steps_per_interval: int = 4,
+                       n_replicas: int = 3,
+                       requests_per_interval: int = 8):
+    """The continuous-learning production loop end to end on a fake
+    clock: (1) a clean run — the model must measurably improve while
+    the fleet serves and confirmed hot-swaps land, with the training
+    slices' goodput (productive fraction of attributed wall) as the
+    headline; (2) a regressed deploy under live traffic — the
+    post-swap burn-rate watch fires and the fleet-wide verified
+    rollback's wall is the latency number; (3) the audit invariant —
+    a non-finite param tree never answered a request."""
+    import logging
+
+    import numpy as np
+
+    from bigdl_tpu import nn
+    from bigdl_tpu.dataset import Sample, array
+    from bigdl_tpu.loop import ContinuousLoop
+    from bigdl_tpu.optim import SGD
+    from bigdl_tpu.optim.optimizer import LocalOptimizer
+    from bigdl_tpu.resilience import faults
+    from bigdl_tpu.serving import ServingFleet
+    from bigdl_tpu.telemetry import (MetricsRegistry, Telemetry,
+                                     TrainingHealthMonitor,
+                                     default_loop_rules,
+                                     default_training_rules)
+
+    bigdl_log = logging.getLogger("bigdl_tpu")
+    prev_level = bigdl_log.level
+    bigdl_log.setLevel(logging.ERROR)
+
+    rng = np.random.RandomState(0)
+    w = rng.rand(8, 1).astype(np.float32)
+
+    def make_samples(n):
+        xs = rng.rand(n, 8).astype(np.float32)
+        return [Sample(xs[i], (xs[i] @ w).astype(np.float32))
+                for i in range(n)]
+
+    model = nn.Sequential(nn.Linear(8, 8), nn.Tanh(), nn.Linear(8, 1))
+    opt = LocalOptimizer(model, array(make_samples(512)),
+                         nn.MSECriterion(), batch_size=32)
+    opt.set_optim_method(SGD(learning_rate=0.05))
+    opt.set_telemetry(Telemetry(registry=MetricsRegistry()))
+    opt.set_health_monitor(TrainingHealthMonitor(
+        rules=[r for r in default_training_rules(divergence_ratio=4.0)
+               if r.name == "training/loss_divergence"],
+        every_n_steps=2))
+
+    t = [0.0]
+    fl = ServingFleet.build(
+        nn.Sequential(nn.Linear(8, 8), nn.Tanh(), nn.Linear(8, 1)),
+        n_replicas=n_replicas,
+        server_kw=dict(max_batch=8, max_queue=64),
+        heartbeat_timeout=5.0, pump_interval_s=0,
+        clock=lambda: t[0],
+        router_kw=dict(default_deadline_s=30.0, clock=lambda: t[0]))
+    fl.start()
+
+    loop = ContinuousLoop(
+        opt, fl, lambda: make_samples(16),
+        steps_per_interval=steps_per_interval, deploy_every=5,
+        watch_intervals=4, cooldown_intervals=2,
+        dataset_capacity=1024,
+        rules=default_loop_rules(interval_s=1.0, serve_budget=0.02),
+        interval_s=1.0, clock=lambda: t[0])
+
+    def step(n):
+        for _ in range(n):
+            loop.tick()
+            t[0] += 1.0
+            for f in [fl.submit(rng.rand(8).astype(np.float32))
+                      for _ in range(requests_per_interval)]:
+                f.result(60)
+
+    try:
+        # --- clean run: improve while serving, confirmed hot-swaps ---
+        step(intervals)
+        snap = loop.snapshot()
+        confirmed = snap["deploys"].get("confirmed", 0)
+        losses = list(loop.losses)
+        loss_first = float(np.mean(losses[:steps_per_interval]))
+        loss_last = float(np.mean(losses[-steps_per_interval:]))
+
+        # --- regressed deploy: burn fires, verified fleet rollback ---
+        while loop.state != "watch":
+            step(1)
+        with faults.serving_step_failures(times=6):
+            for _ in range(requests_per_interval):
+                fl.submit(rng.rand(8).astype(np.float32)).result(60)
+        step(2)
+        rolled_back = loop.deploy_outcomes["rolled_back"]
+        rollback_latency_s = loop.last_rollback_latency_s
+        return {
+            "intervals": intervals,
+            "steps_per_interval": steps_per_interval,
+            "n_replicas": n_replicas,
+            "confirmed_deploys": confirmed,
+            "loss_first": round(loss_first, 4),
+            "loss_last": round(loss_last, 4),
+            "loss_improvement_x": round(
+                loss_first / max(loss_last, 1e-9), 1),
+            "goodput": (None if snap["goodput"] is None
+                        else round(snap["goodput"], 4)),
+            "rollbacks_fired": rolled_back,
+            "rollback_latency_s": (
+                None if rollback_latency_s is None
+                else round(rollback_latency_s, 4)),
+            "bad_params_served": loop.bad_params_served,
+        }
+    finally:
+        bigdl_log.setLevel(prev_level)
+        fl.stop(timeout=10)
+
+
+def run_loop_bench() -> None:
+    """--loop mode: the continuous-learning production loop — goodput
+    while serving + confirmed hot-swaps on a clean run, burn-rate
+    rollback latency on a regressed deploy, bad-params-served audit —
+    writes LOOP_r01.json, prints the one JSON line."""
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    out = {"bench": "loop", "backend": "cpu",
+           "measured_at": _utc_now()}
+    try:
+        out.update(_loop_measurements())
+        out.update({
+            "metric": "continuous-loop goodput while serving",
+            "value": out.get("goodput") or 0.0,
+            "unit": "fraction",
+            "target": ">= 0.97 goodput, 0 bad params served, "
+                      "rollback through the verified install path",
+        })
+    except Exception as e:
+        out["error"] = f"{type(e).__name__}: {e}"[:500]
+        out.update({"metric": "continuous-loop goodput while serving",
+                    "value": 0.0, "unit": "fraction"})
+    try:
+        with open(os.path.join(_here(), LOOP_RESULT), "w") as f:
+            json.dump(out, f, indent=1)
+    except OSError:
+        pass
+    print(json.dumps(out), flush=True)
+
+
+# --------------------------------------------------------------------------
 # Perf ledger: the append-only trajectory record the sentinel guards
 # --------------------------------------------------------------------------
 
@@ -3666,6 +3822,8 @@ LEDGER_FIELDS = (
     "sync_straggler_advantage_x",
     "slo_detection_latency_s", "slo_false_positives",
     "slo_overhead_pct",
+    "loop_goodput", "loop_rollback_latency_s",
+    "loop_bad_params_served",
     "resnet50_conv_fallback",
     "blocksparse_t4096_mfu", "blocksparse_speedup_x",
     "attn_kernel_fallback",
@@ -3753,6 +3911,14 @@ def ledger_record(result: dict) -> dict:
     flat["slo_detection_latency_s"] = slo.get("detection_latency_s")
     flat["slo_false_positives"] = slo.get("false_positives")
     flat["slo_overhead_pct"] = slo.get("overhead_pct")
+    # the continuous-learning loop (ISSUE 17): goodput while serving
+    # may only rise, burn-rate rollback latency may only fall, and
+    # bad-params-served is a must-stay-zero invariant — a serve of an
+    # unverified param tree is never a regression to tolerate
+    loop = result.get("loop") or {}
+    flat["loop_goodput"] = loop.get("goodput")
+    flat["loop_rollback_latency_s"] = loop.get("rollback_latency_s")
+    flat["loop_bad_params_served"] = loop.get("bad_params_served")
     # the block-sparse kernel family (ISSUE 12): the T4096 MFU rides
     # the TPU worker's executed-basis row; the speedup multiple prefers
     # the worker's measured wall ratio and falls back to the CPU leg's
@@ -4276,6 +4442,30 @@ def main(ledger: bool = True, probe: bool = True) -> None:
                    or "slo leg returned nothing"}
     result["slo"] = slo
 
+    # loop leg: the continuous-learning production loop — goodput
+    # while serving + confirmed hot-swaps, burn-rate rollback latency,
+    # bad-params-served audit (backend-independent, lands in
+    # LOOP_r01.json) — best-effort like the other legs;
+    # BENCH_LOOP_TIMEOUT=0 disables it.
+    if LOOP_TIMEOUT <= 0:
+        loop = {"skipped": "BENCH_LOOP_TIMEOUT=0"}
+    else:
+        ok, lres, note = _run_sub(["--loop"], LOOP_TIMEOUT)
+        if ok and lres and "error" not in lres:
+            loop = {
+                "goodput": lres.get("goodput"),
+                "confirmed_deploys": lres.get("confirmed_deploys"),
+                "loss_improvement_x": lres.get("loss_improvement_x"),
+                "rollbacks_fired": lres.get("rollbacks_fired"),
+                "rollback_latency_s": lres.get("rollback_latency_s"),
+                "bad_params_served": lres.get("bad_params_served"),
+                "source": LOOP_RESULT,
+            }
+        else:
+            loop = {"error": (lres or {}).get("error") or note
+                    or "loop leg returned nothing"}
+    result["loop"] = loop
+
     # blocksparse leg: the BLaST kernel lab — full-mask parity, the
     # executed-work-∝-density accounting proof, and the sparse-FLOPs
     # correction round trip (backend-independent, lands in
@@ -4336,7 +4526,7 @@ def main(ledger: bool = True, probe: bool = True) -> None:
             # whatever the stale chip record carried
             for leg in ("serving", "fleet", "disagg", "elastic",
                         "integrity", "telemetry", "sharding", "dlrm",
-                        "sync", "slo", "blocksparse"):
+                        "sync", "slo", "loop", "blocksparse"):
                 if result.get(leg) is not None:
                     merged[leg] = result[leg]
             result = merged
@@ -4367,6 +4557,7 @@ if __name__ == "__main__":
     p.add_argument("--dlrm", action="store_true")
     p.add_argument("--sync", dest="sync_leg", action="store_true")
     p.add_argument("--slo", action="store_true")
+    p.add_argument("--loop", dest="loop_leg", action="store_true")
     p.add_argument("--blocksparse", action="store_true")
     p.add_argument("--worker", choices=["tpu", "cpu"])
     # every orchestrated run appends to PERF_LEDGER.jsonl by default;
@@ -4404,6 +4595,8 @@ if __name__ == "__main__":
         run_sync_bench()
     elif a.slo:
         run_slo_bench()
+    elif a.loop_leg:
+        run_loop_bench()
     elif a.blocksparse:
         run_blocksparse_bench()
     elif a.worker:

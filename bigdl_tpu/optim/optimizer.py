@@ -118,6 +118,16 @@ class Optimizer:
         # how the last profiled iteration's phase split was measured:
         # "trace" (jax.profiler device events) or None (not profiled)
         self.phase_source = None
+        # online-training slices (train_more / the continuous-learning
+        # loop) call optimize() every few steps — rebuilding the plan
+        # engine each call would re-trace the jitted step and bill the
+        # run a compile per slice.  When opted in, the compiled engine
+        # is cached per mesh identity and reused while model/plan
+        # knobs are untouched (elastic runs re-derive per attempt and
+        # never reuse).
+        self.reuse_compiled_engine = False
+        self._engine_cache = None
+        self._engine_cache_hit = False  # (mesh_key, engine)
         # --- resilience (bigdl_tpu/resilience/) -----------------------
         # gradient anomaly guard: NaN/Inf steps are skipped in-program
         # (params/slots/buffers ride through intact) and counted
@@ -473,6 +483,25 @@ class Optimizer:
         monitor is attached."""
         return (self.health_monitor.verdict()
                 if self.health_monitor is not None else None)
+
+    def train_more(self, n_steps: int) -> AbstractModule:
+        """Continue training for ``n_steps`` more iterations — the
+        online-training slice the continuous-learning loop drives.
+        The optim method's persisted state table carries ``neval`` /
+        ``epoch`` across calls, so each slice resumes exactly where
+        the last one stopped; this just extends the end trigger by
+        ``n_steps`` completed iterations and re-enters ``optimize()``.
+        Enables ``reuse_compiled_engine`` so back-to-back slices
+        dispatch into the cached jitted step instead of paying a
+        re-trace per slice."""
+        from .trigger import max_iteration
+
+        if n_steps < 1:
+            raise ValueError(f"n_steps must be >= 1, got {n_steps}")
+        self.reuse_compiled_engine = True
+        done = int(self.optim_method.state.get("neval", 1)) - 1
+        self.set_end_when(max_iteration(done + int(n_steps)))
+        return self.optimize()
 
     def _health_step(self, state, loss: float, seconds: float):
         """Per-iteration health feed (no-op without a monitor): the
@@ -1157,10 +1186,29 @@ class Optimizer:
         return self._with_retry(lambda: self._plan_loop(mesh))
 
     def _plan_engine(self, mesh):
-        """Compile the one step for this attempt's mesh."""
+        """Compile the one step for this attempt's mesh.  With
+        ``reuse_compiled_engine`` set (the online-training-slice path)
+        the engine is cached per mesh identity so back-to-back
+        ``optimize()`` calls dispatch straight into the already-jitted
+        step instead of re-tracing."""
+        key = None
+        if self.reuse_compiled_engine and self.elastic is None:
+            key = (tuple(mesh.devices.flatten().tolist()),
+                   tuple(mesh.axis_names))
+            if self._engine_cache is not None \
+                    and self._engine_cache[0] == key:
+                self._engine_cache_hit = True
+                return self._engine_cache[1]
+        self._engine_cache_hit = False
+        n_seq = mesh.shape.get("seq", 1)
+        engine = self._build_plan_engine(mesh, n_seq)
+        if key is not None:
+            self._engine_cache = (key, engine)
+        return engine
+
+    def _build_plan_engine(self, mesh, n_seq):
         from ..parallel.plan import compile_step_with_plan
 
-        n_seq = mesh.shape.get("seq", 1)
         return compile_step_with_plan(
             self.model, self.criterion, self.optim_method, mesh,
             plan=self.sharding_plan,
@@ -1260,12 +1308,23 @@ class Optimizer:
         # batch N+1's host prep overlaps the compiled step on batch N;
         # data_time below is the REAL empty-buffer stall only
         feed = self._make_feed(data_iter, epoch_size, records_this_epoch)
-        first_step = True  # first dispatch = XLA build (telemetry)
+        # first dispatch = XLA build (telemetry) — unless the engine
+        # came out of the train_more cache, in which case there is no
+        # build to attribute (goodput would book it as compile)
+        first_step = not getattr(self, "_engine_cache_hit", False)
+        # on that same cached re-entry (train_more slices) the first
+        # feed.get() wait is the prefetch thread spinning up at the
+        # slice boundary, not an empty-buffer stall — a real infeed
+        # stall would keep showing on the following iterations
+        warm_reentry = not first_step
         try:
             while not self.end_when(state):
                 state["epoch_finished"] = False
                 self._elastic_step_start(state)
                 item, stall_time = feed.get()
+                if warm_reentry:
+                    stall_time = 0.0
+                    warm_reentry = False
                 batch, x, y = item
                 n_records = batch.size()
                 mask_kw = {}

@@ -349,6 +349,70 @@ def partition_kv(replica: str):
 
 
 # ---------------------------------------------------------------------------
+# continuous-learning loop faults
+# ---------------------------------------------------------------------------
+# The ContinuousLoop (loop/continuous.py) consults check_loop_fault()
+# at two deterministic points: once per deploy attempt with
+# kind="poison_candidate" (the captured candidate tree is NaN-poisoned
+# before it reaches the fleet — the poisoned-artifact case every
+# replica canary must refuse), and once per ingest interval with
+# kind="diverge" (that interval's fresh samples are feature-scaled, so
+# the next training slice's loss spikes and the TrainingHealthMonitor's
+# divergence rule must gate the following deploy).
+
+_LOOP_LOCK = threading.Lock()
+_LOOP_FAULTS: list = []  # [dict(kind, remaining, fired, scale?)]
+
+
+def check_loop_fault(kind: str) -> Optional[dict]:
+    """Called by the ContinuousLoop at the injection point named by
+    ``kind``; consumes one budget unit and returns a copy of the armed
+    entry (carrying e.g. ``scale``), or None.  No-op (and free) when
+    nothing is registered."""
+    if not _LOOP_FAULTS:
+        return None
+    with _LOOP_LOCK:
+        for f in _LOOP_FAULTS:
+            if f["kind"] != kind or f["remaining"] <= 0:
+                continue
+            f["remaining"] -= 1
+            f["fired"] += 1
+            return dict(f)
+    return None
+
+
+@contextlib.contextmanager
+def _loop_fault(entry):
+    with _LOOP_LOCK:
+        _LOOP_FAULTS.append(entry)
+    try:
+        yield entry
+    finally:
+        with _LOOP_LOCK:
+            _LOOP_FAULTS.remove(entry)
+
+
+def poison_candidate(times: int = 1):
+    """NaN-poison the next ``times`` deploy candidates the
+    ContinuousLoop captures (via :func:`poison_params`) — the
+    poisoned-artifact deploy: every replica's canary must reject it
+    and the fleet must roll back, never serving a bad param."""
+    return _loop_fault({"kind": "poison_candidate",
+                        "remaining": int(times), "fired": 0})
+
+
+def loop_loss_divergence(times: int = 1, scale: float = 3.0):
+    """Feature-scale the next ``times`` ingest intervals' fresh
+    samples by ``scale`` — the loop's training loss spikes well above
+    its window minimum, the divergence SLO rule fires, and the deploy
+    gate must refuse to roll the damaged candidate until the loss
+    recovers (the scaled samples wash out of the bounded streaming
+    window)."""
+    return _loop_fault({"kind": "diverge", "remaining": int(times),
+                        "fired": 0, "scale": float(scale)})
+
+
+# ---------------------------------------------------------------------------
 # elastic (multi-host) faults
 # ---------------------------------------------------------------------------
 # The elastic step runner (resilience.elastic.ElasticContext.run_step)

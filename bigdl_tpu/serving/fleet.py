@@ -48,7 +48,7 @@ from ..resilience.elastic import ElasticCoordinator, InMemoryKV
 from .metrics import ServingMetrics
 from .router import FleetRouter, HEALTH_PREFIX
 from .server import InferenceServer
-from .swap import SwapRejected, load_verified_params
+from .swap import DeployInFlight, SwapRejected, load_verified_params
 
 log = logging.getLogger("bigdl_tpu")
 
@@ -233,6 +233,15 @@ class ServingFleet:
                 self, clock=clock, **(health_kw or {}))
         self.deploys = 0
         self.deploy_rollbacks = 0
+        # deploy-in-flight mutual exclusion: rolling_swap and
+        # rollback_last_deploy are fleet-wide critical sections — a
+        # second concurrent attempt is refused typed (DeployInFlight),
+        # never queued, so two rolls can never interleave partial
+        # installs across the replica set
+        self._deploy_lock = threading.Lock()
+        # the last completed roll's [(rid, (prior_params, prior_bufs))]
+        # — what an alert-driven rollback_last_deploy() re-installs
+        self._last_deploy: list = []
         self._pump_thread: Optional[threading.Thread] = None
         self._stop_pump = threading.Event()
 
@@ -421,6 +430,21 @@ class ServingFleet:
         log.info("fleet: removed replica %s (drained=%s)", rid, drain)
         return ok
 
+    def restart_replica(self, rid: str) -> InferenceServer:
+        """Revive a killed or stopped replica in place (crash
+        replacement): restart its server, clear the agent's killed
+        latch, and run one pump round so it beats with ``rejoin=True``
+        and re-admits through the normal returner path."""
+        srv = self.servers[rid]
+        agent = self.agents[rid]
+        if not srv.healthy():
+            srv.start()
+        agent.killed = False
+        agent.pump()
+        self.router.refresh()
+        log.info("fleet: restarted replica %s", rid)
+        return srv
+
     # ------------------------------------------------------------ deploys
     def rolling_swap(self, params=None, path: Optional[str] = None,
                      order=None) -> int:
@@ -443,49 +467,90 @@ class ServingFleet:
         Replicas that are not healthy (killed, draining) are skipped —
         they pick up current params through the normal swap path when
         they come back.
+
+        Exactly one deploy (or alert-driven rollback) may be in flight
+        fleet-wide: a concurrent attempt raises
+        :class:`~.swap.DeployInFlight` immediately, before any replica
+        is touched.
         """
         if (params is None) == (path is None):
             raise ValueError("pass exactly one of params/path")
-        if path is not None:
-            params = load_verified_params(path)
-        order = list(order) if order is not None \
-            else sorted(self.servers)
-        done = []  # [(rid, (prior_params, prior_buffers))]
-        for rid in order:
-            srv = self.servers.get(rid)
-            if srv is None or not srv.healthy():
-                log.warning("fleet: deploy skipping unhealthy "
-                            "replica %s", rid)
-                continue
-            ready = self.ready_count()
-            if ready < self.ready_quorum:
-                self._rollback(done)
-                self.deploy_rollbacks += 1
-                raise FleetQuorumError(
-                    f"deploy halted before {rid}: only {ready} "
-                    f"replica(s) ready, quorum is "
-                    f"{self.ready_quorum} — fleet rolled back")
-            prior = srv.current_params()
-            try:
-                srv.swap_params(params=params)
-            except SwapRejected as e:
-                self._rollback(done)
-                self.deploy_rollbacks += 1
-                raise SwapRejected(
-                    f"rolling deploy halted at {rid}: {e} — "
-                    f"{len(done)} already-swapped replica(s) rolled "
-                    f"back")
-            done.append((rid, prior))
-            log.info("fleet: deployed to %s (%d/%d)", rid, len(done),
-                     len(order))
-        self.deploys += 1
-        return len(done)
+        if not self._deploy_lock.acquire(blocking=False):
+            raise DeployInFlight(
+                "a rolling deploy is already in flight on this fleet "
+                "— refused before touching any replica")
+        try:
+            if path is not None:
+                params = load_verified_params(path)
+            order = list(order) if order is not None \
+                else sorted(self.servers)
+            done = []  # [(rid, (prior_params, prior_buffers))]
+            for rid in order:
+                srv = self.servers.get(rid)
+                if srv is None or not srv.healthy():
+                    log.warning("fleet: deploy skipping unhealthy "
+                                "replica %s", rid)
+                    continue
+                ready = self.ready_count()
+                if ready < self.ready_quorum:
+                    self._rollback(done)
+                    self.deploy_rollbacks += 1
+                    raise FleetQuorumError(
+                        f"deploy halted before {rid}: only {ready} "
+                        f"replica(s) ready, quorum is "
+                        f"{self.ready_quorum} — fleet rolled back")
+                prior = srv.current_params()
+                try:
+                    srv.swap_params(params=params)
+                except SwapRejected as e:
+                    self._rollback(done)
+                    self.deploy_rollbacks += 1
+                    raise SwapRejected(
+                        f"rolling deploy halted at {rid}: {e} — "
+                        f"{len(done)} already-swapped replica(s) "
+                        f"rolled back")
+                done.append((rid, prior))
+                log.info("fleet: deployed to %s (%d/%d)", rid,
+                         len(done), len(order))
+            self.deploys += 1
+            self._last_deploy = done
+            return len(done)
+        finally:
+            self._deploy_lock.release()
+
+    def rollback_last_deploy(self) -> int:
+        """Roll every replica of the last completed deploy back to its
+        captured prior params — the alert-driven entry point the
+        continuous-learning loop fires when the post-swap burn-rate
+        watch trips.  The rollback rides the same verified canary
+        install path as a deploy (each re-install records
+        ``outcome="rolled_back"``), holds the same deploy-in-flight
+        mutual exclusion, and consumes the captured set: a second call
+        with nothing newer deployed is a no-op returning 0."""
+        if not self._deploy_lock.acquire(blocking=False):
+            raise DeployInFlight(
+                "a rolling deploy is in flight — rollback refused; "
+                "retry after it settles")
+        try:
+            done, self._last_deploy = self._last_deploy, []
+            if not done:
+                return 0
+            self._rollback(done)
+            self.deploy_rollbacks += 1
+            log.warning("fleet: alert-driven rollback re-installed "
+                        "prior params on %d replica(s)", len(done))
+            return len(done)
+        finally:
+            self._deploy_lock.release()
 
     def _rollback(self, done):
         for rid, (prior_params, prior_buffers) in reversed(done):
             try:
+                # the rollback rides the full verified install path
+                # (canary included) — only its counter outcome differs
                 self.servers[rid].swap_params(params=prior_params,
-                                              buffers=prior_buffers)
+                                              buffers=prior_buffers,
+                                              outcome="rolled_back")
             except SwapRejected:
                 # the prior params were serving seconds ago; a canary
                 # refusing them now means something else is injecting
@@ -536,6 +601,9 @@ class ServingFleet:
         "bigdl_fleet_dispatch_total",
         "bigdl_autoscale_decisions_total",
         "bigdl_alerts_total", "bigdl_alerts_active",
+        # the continuous-learning loop registers its deploy outcomes
+        # in the router registry, so they fold into the fleet view too
+        "bigdl_loop_deploys_total",
     )
 
     def _router_fold_metrics(self) -> dict:

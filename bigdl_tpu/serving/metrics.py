@@ -157,11 +157,25 @@ class ServingMetrics:
     def record_depth(self, depth: int):
         self._depth.observe(depth)
 
-    def record_swap(self, installed: bool):
-        """One hot-swap outcome: ``installed`` or ``rejected`` (the
-        canary/verify refused it and the prior params keep serving)."""
-        self._swaps.labels(
-            outcome="installed" if installed else "rejected").inc()
+    #: the hot-swap outcome vocabulary: a normal install, a canary/
+    #: verify refusal (prior params keep serving), and a rollback
+    #: re-install (a fleet deploy halted or an SLO alert fired and the
+    #: captured prior params rode the verified install path back in)
+    SWAP_OUTCOMES = ("installed", "rejected", "rolled_back")
+
+    def record_swap(self, installed: bool = True,
+                    outcome: Optional[str] = None):
+        """One hot-swap outcome.  ``installed=True/False`` is the
+        legacy install/reject spelling; ``outcome`` names any member
+        of :data:`SWAP_OUTCOMES` directly — a fleet rollback records
+        ``rolled_back`` so the scraped counter distinguishes a
+        re-verified rollback install from a fresh deploy."""
+        if outcome is None:
+            outcome = "installed" if installed else "rejected"
+        if outcome not in self.SWAP_OUTCOMES:
+            raise ValueError(f"unknown swap outcome {outcome!r}; one "
+                             f"of {self.SWAP_OUTCOMES}")
+        self._swaps.labels(outcome=outcome).inc()
 
     def record_hedge(self, won: bool = False):
         """One hedging event: ``record_hedge()`` when the duplicate is
@@ -215,6 +229,13 @@ class ServingMetrics:
     def swap_rollbacks(self) -> int:
         return self._counter_value("bigdl_serving_swaps_total",
                                    outcome="rejected")
+
+    @property
+    def swaps_rolled_back(self) -> int:
+        """Rollback re-installs on this replica (the
+        ``outcome="rolled_back"`` leg of the swap counter)."""
+        return self._counter_value("bigdl_serving_swaps_total",
+                                   outcome="rolled_back")
 
     @property
     def hedges_fired(self) -> int:
@@ -328,6 +349,7 @@ class ServingMetrics:
             "padded_rows": self.padded_rows,
             "swaps": self.swaps,
             "swap_rollbacks": self.swap_rollbacks,
+            "swaps_rolled_back": self.swaps_rolled_back,
             "hedges_fired": self.hedges_fired,
             "hedges_won": self.hedges_won,
             "hedges_suppressed": self.hedges_suppressed,

@@ -487,7 +487,8 @@ class InferenceServer:
 
     # ------------------------------------------------------------ hot swap
     def swap_params(self, params: Any = None, path: Optional[str] = None,
-                    buffers: Any = None) -> bool:
+                    buffers: Any = None,
+                    outcome: str = "installed") -> bool:
         """Install new params atomically between batches.
 
         ``path`` loads through the crc32c-verified checkpoint path
@@ -497,7 +498,13 @@ class InferenceServer:
         params-finiteness check before any traffic has flowed) — a
         canary that raises or emits non-finite outputs raises
         :class:`SwapRejected` and the server keeps serving the prior
-        params.  Returns True on install."""
+        params.  Returns True on install.
+
+        ``outcome`` names the success leg of the swap counter —
+        ``"installed"`` for a deploy, ``"rolled_back"`` when a fleet
+        rollback re-installs captured prior params (the rollback rides
+        this exact verified canary path; only its accounting differs).
+        """
         if (params is None) == (path is None):
             raise ValueError("pass exactly one of params/path")
         t_swap = time.monotonic()
@@ -541,10 +548,11 @@ class InferenceServer:
             self._params = params
             if buffers is not None:
                 self._buffers = buffers
-        self.metrics.record_swap(installed=True)
-        note_swap("installed")
-        log.info("serving params hot-swapped%s",
-                 f" from {path}" if path else "")
+        self.metrics.record_swap(outcome=outcome)
+        note_swap(outcome)
+        log.info("serving params hot-swapped%s%s",
+                 f" from {path}" if path else "",
+                 " (rollback)" if outcome == "rolled_back" else "")
         return True
 
     def current_params(self):

@@ -26,6 +26,15 @@ class SwapRejected(RuntimeError):
     the server keeps serving the previous params."""
 
 
+class DeployInFlight(RuntimeError):
+    """A rolling deploy (or alert-driven rollback) is already in
+    flight on this fleet — the new attempt is refused, typed, before
+    any replica is touched.  Two interleaved rolls could leave the
+    fleet serving a mix of candidates with no prior-params set that
+    rolls either one back cleanly, so the deploy path is mutually
+    exclusive fleet-wide."""
+
+
 def load_verified_params(path: str) -> Any:
     """Load a checkpoint file for serving, refusing corrupt bytes.
 

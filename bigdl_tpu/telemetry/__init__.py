@@ -44,8 +44,9 @@ from .registry import (
     default_registry, reset_default_registry,
 )
 from .slo import (Alert, HealthVerdict, SloEngine, SloRule,
-                  TrainingHealthMonitor, default_serving_rules,
-                  default_training_rules)
+                  TrainingHealthMonitor, default_loop_rules,
+                  default_serving_rules, default_training_rules,
+                  ingest_deadman_rule)
 from .slog import configure_logging, get_logger
 from .timeseries import MetricRecorder
 from .trace_context import (REQUEST_CATEGORIES, TRACE_KV_PREFIX,
@@ -63,8 +64,9 @@ __all__ = [
     "Span", "StepCost", "TRACE_KV_PREFIX", "TailSampler",
     "Telemetry", "TraceContext", "Tracer", "TrainingHealthMonitor",
     "classify_roofline", "collect_snapshots", "configure_logging",
-    "default_buckets", "default_registry", "default_serving_rules",
-    "default_training_rules", "device_spec", "get_logger",
+    "default_buckets", "default_loop_rules", "default_registry",
+    "default_serving_rules", "default_training_rules", "device_spec",
+    "get_logger", "ingest_deadman_rule",
     "merge_alerts", "merge_cluster", "merge_metrics",
     "merge_timeline", "peak_flops_per_sec",
     "publish_snapshot", "read_snapshot_dir", "reset_default_registry",

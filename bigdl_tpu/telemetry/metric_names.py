@@ -111,6 +111,19 @@ TRAIN_LOSS = "bigdl_train_loss"
 TRAIN_STEP_TIME_SECONDS = "bigdl_train_step_time_seconds"
 GOODPUT_PRODUCTIVE_FRACTION = "bigdl_goodput_productive_fraction"
 
+# --- continuous-learning loop (loop/continuous.py) ------------------------
+#: deploy state-machine terminal outcomes, labeled {outcome}:
+#: confirmed | gated | rejected | rolled_back | refused
+LOOP_DEPLOYS_TOTAL = "bigdl_loop_deploys_total"
+#: cumulative fresh ingest batches the loop has absorbed — the series
+#: the ingest dead-man rule watches (a stalled stream goes silent here)
+LOOP_INGEST_BATCHES_TOTAL = "bigdl_loop_ingest_batches_total"
+#: fleet-wide served request totals the loop feeds its recorder each
+#: interval — the denominator/numerator of the post-swap burn-rate
+#: watch (bad = internal_error + unavailable + deadline_exceeded)
+LOOP_SERVED_REQUESTS_TOTAL = "bigdl_loop_served_requests_total"
+LOOP_SERVED_BAD_TOTAL = "bigdl_loop_served_bad_total"
+
 #: every bigdl_* metric family name any bigdl_tpu module may register
 #: or reference — the vocabulary the lint enforces
 METRIC_FAMILY_NAMES = frozenset(

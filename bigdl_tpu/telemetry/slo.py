@@ -58,8 +58,9 @@ log = logging.getLogger("bigdl_tpu")
 
 __all__ = [
     "Alert", "HealthVerdict", "SloEngine", "SloRule",
-    "TrainingHealthMonitor", "default_serving_rules",
-    "default_training_rules",
+    "TrainingHealthMonitor", "default_loop_rules",
+    "default_serving_rules", "default_training_rules",
+    "ingest_deadman_rule",
 ]
 
 _OPS = {
@@ -528,6 +529,57 @@ def default_training_rules(*, goodput_floor: float = 0.5,
                 min_samples=4,
                 description=f"MFU fell below {mfu_drop_frac:g}x its "
                             f"window maximum"),
+    ]
+
+
+def ingest_deadman_rule(*, window_s: float = 5.0,
+                        name: str = "loop/ingest_deadman",
+                        severity: str = "page") -> SloRule:
+    """The streaming-ingest dead-man switch: the continuous-learning
+    loop feeds its cumulative fresh-batch counter every interval that
+    delivers data; a stream that HAS delivered and then goes silent
+    for more than ``window_s`` fires this structured alert instead of
+    silently idling the trainer.  (A loop that has never ingested
+    renders no verdict — booting up is not a stall.)"""
+    return SloRule(
+        name=name, family=M.LOOP_INGEST_BATCHES_TOTAL, kind="absent",
+        window_s=window_s, severity=severity,
+        description=f"ingest stream silent > {window_s:g}s (dead-man)")
+
+
+def default_loop_rules(*, interval_s: float = 1.0,
+                       deadman_intervals: int = 5,
+                       serve_budget: float = 0.05,
+                       burn_factor: float = 2.0,
+                       fast_intervals: int = 4,
+                       slow_intervals: int = 16,
+                       for_intervals: int = 2,
+                       resolve_intervals: int = 2) -> List[SloRule]:
+    """The continuous-learning loop's rule pack: the ingest dead-man
+    switch plus the **post-swap burn-rate watch** — the SRE
+    multi-window error-budget burn over the fleet-wide served bad/
+    total counters the loop feeds each interval.  While a fresh deploy
+    is inside its watch window, a firing ``loop/serving_burn`` is the
+    signal that triggers automatic fleet-wide rollback
+    (``ServingFleet.rollback_last_deploy``); outside a watch it is an
+    ordinary page.  Windows are sized in loop intervals
+    (``interval_s`` scales them to the loop's cadence)."""
+    return [
+        ingest_deadman_rule(
+            window_s=deadman_intervals * interval_s),
+        SloRule(name="loop/serving_burn",
+                family=M.LOOP_SERVED_BAD_TOTAL,
+                total_family=M.LOOP_SERVED_REQUESTS_TOTAL,
+                kind="burn_rate", budget=serve_budget,
+                fast_window_s=fast_intervals * interval_s,
+                slow_window_s=slow_intervals * interval_s,
+                burn_factor=burn_factor,
+                for_intervals=for_intervals,
+                resolve_intervals=resolve_intervals,
+                description=f"fleet serving errors burning the "
+                            f"{100 * serve_budget:g}% budget at >= "
+                            f"{burn_factor:g}x in both windows "
+                            f"(post-swap watch)"),
     ]
 
 

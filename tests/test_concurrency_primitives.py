@@ -291,3 +291,63 @@ def test_retry_policy_fatal_classification_is_thread_safe():
             policy.run(lambda: (_ for _ in ()).throw(MemoryError()))
 
     _hammer(run)
+
+
+# ---------------------------------------------------------------------------
+# fleet deploy mutex (continuous-learning loop, PR 17)
+# ---------------------------------------------------------------------------
+
+def test_fleet_deploy_mutex_single_winner_no_partial_rolls():
+    """N threads race ``rolling_swap`` on one live fleet: the
+    deploy-in-flight mutex admits exactly ONE roll — every loser is
+    refused typed (:class:`DeployInFlight`), never queued — and the
+    fleet ends with the single winner's params installed everywhere:
+    two rolls can never interleave partial installs across the
+    replica set."""
+    import numpy as np
+
+    from bigdl_tpu import nn
+    from bigdl_tpu.resilience import faults
+    from bigdl_tpu.serving import ServingFleet
+    from bigdl_tpu.serving.swap import DeployInFlight
+
+    def model():
+        return nn.Sequential(nn.Linear(4, 8), nn.Tanh(),
+                             nn.Linear(8, 2))
+
+    fl = ServingFleet.build(
+        model(), n_replicas=3,
+        server_kw=dict(max_batch=8, max_queue=64),
+        heartbeat_timeout=5.0, pump_interval_s=0)
+    fl.start()
+    try:
+        twins = [model() for _ in range(N_THREADS)]
+        record_lock = threading.Lock()
+        wins, refused = [], []
+
+        def attempt(i):
+            try:
+                n = fl.rolling_swap(params=twins[i].param_tree())
+                with record_lock:
+                    wins.append((i, n))
+            except DeployInFlight:
+                with record_lock:
+                    refused.append(i)
+
+        # slow canaries keep the winning roll holding the deploy lock
+        # well past the losers' barrier-released attempts
+        with faults.serving_step_latency(0.25):
+            _hammer(attempt)
+        assert len(wins) == 1, wins
+        assert len(refused) == N_THREADS - 1
+        winner, n = wins[0]
+        assert n == 3
+        x = np.random.RandomState(0).rand(4).astype(np.float32)
+        want = np.asarray(twins[winner].forward(x[None]))[0]
+        for srv in fl.servers.values():
+            got = srv.submit(x).result(60)
+            assert got.ok
+            np.testing.assert_allclose(got.output, want, atol=1e-6)
+            assert srv.metrics.swaps == 1   # exactly one install each
+    finally:
+        fl.stop(timeout=10)

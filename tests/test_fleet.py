@@ -350,6 +350,35 @@ def test_midway_rejection_rolls_back_already_swapped():
         fl.stop(timeout=10)
 
 
+def test_alert_driven_rollback_rides_verified_path_and_accounts():
+    """``rollback_last_deploy()`` (the continuous loop's burn-rate
+    actuator) re-installs the captured prior params on every replica
+    of the last roll through the same verified canary install path,
+    each re-install recording ``outcome="rolled_back"``; a second call
+    is a no-op — the rollback consumed the deploy."""
+    fl = make_fleet(n=3, pump_interval_s=0)
+    fl.start()
+    rng = np.random.RandomState(0)
+    x = feat(rng)
+    try:
+        before = fl.submit(x).result(60).output
+        twin = small_model()
+        assert fl.rolling_swap(params=twin.param_tree()) == 3
+        assert fl.rollback_last_deploy() == 3
+        assert fl.deploy_rollbacks == 1
+        after = fl.submit(x).result(60)
+        assert after.ok
+        np.testing.assert_allclose(after.output, before, atol=1e-6)
+        for srv in fl.servers.values():
+            assert srv.metrics.swaps == 1
+            assert srv.metrics.swaps_rolled_back == 1
+        # consumed: a second watch trip has nothing left to undo
+        assert fl.rollback_last_deploy() == 0
+        assert fl.deploy_rollbacks == 1
+    finally:
+        fl.stop(timeout=10)
+
+
 def test_quorum_guard_refuses_degraded_deploy():
     fl = make_fleet(n=4, ready_quorum=3, pump_interval_s=0)
     fl.start()

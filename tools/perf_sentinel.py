@@ -127,6 +127,16 @@ DEFAULT_TOLERANCES = {
     "slo_detection_latency_s": ("lower", 0.50, 5.0),
     "slo_false_positives": ("lower", 0.0),
     "slo_overhead_pct": ("lower", 1.00, 1.0),
+    # continuous-learning loop (ISSUE 17): goodput while serving may
+    # only rise (2-point abs floor absorbs 1-core scheduler jitter
+    # near the 1.0 ceiling); burn-rate rollback latency may only fall
+    # (wide tolerance + abs floor — the wall of a few verified
+    # re-installs is tiny and jittery); bad-params-served must stay
+    # ZERO — serving an unverified param tree is never a regression
+    # to tolerate
+    "loop_goodput": ("higher", 0.05, 0.02),
+    "loop_rollback_latency_s": ("lower", 1.00, 0.5),
+    "loop_bad_params_served": ("lower", 0.0),
     # block-sparse kernels (ISSUE 12): the T4096 executed-basis MFU
     # may only rise (null until the next TPU window measures it); the
     # speedup multiple is the measured wall ratio on TPU and the
