@@ -19,6 +19,10 @@ from .linear import (
     LookupTable, MM, MV, Mul, MulConstant,
 )
 from .embedding import ShardedEmbedding
+from .embedding_store import (
+    EmbeddingStore, HotRowCache, MigrationCorrupt, StoreMigrating,
+    table_checksum,
+)
 from .activations import (
     Abs, Clamp, ELU, Exp, HardShrink, HardTanh, LeakyReLU, Log, LogSigmoid,
     LogSoftMax, Max, Mean, Min, Power, PReLU, ReLU, ReLU6, RReLU, Sigmoid,

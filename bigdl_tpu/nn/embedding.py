@@ -68,6 +68,9 @@ class ShardedEmbedding(TensorModule):
     #: derive_plan stamps this module's rules ``transport="sparse"``
     sparse_grads = True
 
+    #: host-memory backing (``attach_store``) — None = device-resident
+    _store = None
+
     def __init__(self, n_index: int, n_output: int,
                  axis_name: Optional[str] = "data",
                  padding_value: float = 0,
@@ -92,6 +95,53 @@ class ShardedEmbedding(TensorModule):
         self._register_param(
             "weight", w_init.init((self.n_index, self.n_output), ONE_D))
         return self
+
+    # -- host-memory backing (the parameter-server hybrid) --------------
+    def attach_store(self, store) -> "ShardedEmbedding":
+        """Back this table with a host-memory
+        :class:`~bigdl_tpu.nn.embedding_store.EmbeddingStore` leg.
+        The store owns durability and row re-partitioning (sealed-shard
+        migration, checkpointed legs, version-retired hot-row cache);
+        the module's device-resident ``weight`` becomes a working copy
+        refreshed from / flushed to the store at step boundaries —
+        tables that dwarf HBM skip the dense copy entirely and serve
+        through :class:`~bigdl_tpu.serving.sparse_fetch
+        .SparseFetchClient` instead."""
+        if (store.n_rows, store.dim) != (self.n_index, self.n_output):
+            raise ValueError(
+                f"store {store.table!r} is {store.n_rows}x{store.dim}, "
+                f"table wants {self.n_index}x{self.n_output}")
+        self._store = store
+        return self
+
+    def refresh_from_store(self):
+        """store → device: re-register ``weight`` from the live table
+        (dense materialization — only for tables that fit HBM)."""
+        if self._store is None:
+            raise ValueError("no store attached (attach_store first)")
+        self._register_param("weight",
+                             jnp.asarray(self._store.dense()))
+        return self
+
+    def flush_to_store(self, rows, grads, lr: float = 1.0):
+        """device → store: push one step's sparse row updates
+        (``-lr * grads[i]`` into ``rows[i]``) to the rows' OWNING leg —
+        the PS-style write the Parallax hybrid pairs with dense
+        all-reduce MLPs.  Rows this leg does not own are the caller's
+        to route (the store's consistent assignment says where)."""
+        import numpy as np
+
+        if self._store is None:
+            raise ValueError("no store attached (attach_store first)")
+        rows = [int(r) for r in np.asarray(rows).reshape(-1)]
+        g = np.asarray(grads, dtype=self._store.dtype)
+        g = g.reshape(len(rows), self.n_output)
+        mine = [i for i, r in enumerate(rows)
+                if self._store.owns_row(r)]
+        if mine:
+            self._store.apply_updates(
+                [rows[i] for i in mine], -float(lr) * g[mine])
+        return len(mine)
 
     def _n_shards(self) -> int:
         """Bound-axis size, or 1 when eager/unbound (the MoEFFN /

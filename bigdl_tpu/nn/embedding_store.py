@@ -1,0 +1,697 @@
+"""Parameter-server-scale embedding store with live row re-partitioning.
+
+PR 15's :class:`~bigdl_tpu.nn.embedding.ShardedEmbedding` proved the
+row-sharded table on a mesh, but it holds every shard in device memory
+and a membership change degrades non-dividing tables to full replicas.
+This module is the Parallax hybrid's other half (arxiv 1808.02621):
+**host-memory tables that dwarf HBM** (1e8-row capable — blocks are
+materialized lazily, so capacity costs nothing until rows are touched)
+with a device-side/serving-side hot-row cache keyed by the
+clickstream's Zipf skew, and — the robustness core — **live
+shrink/regrow row re-partitioning**:
+
+* **Ownership is consistent, not modular.**  Rows group into fixed
+  blocks and each block's owner is chosen by highest-random-weight
+  (rendezvous) hashing over the member set: removing one host moves
+  exactly the blocks it owned (~1/N of rows) and adding one steals
+  ~1/(N+1) — never a full reshuffle.  Every host derives the same
+  assignment from the member list alone, so there is no ownership
+  directory to keep consistent.
+
+* **Migration is sealed and verified.**  On membership change each
+  survivor re-derives ownership and ships the blocks it no longer owns
+  as crc32c-sealed shards through the elastic KV transport
+  (:class:`~bigdl_tpu.resilience.elastic.KVTransport` — the same
+  channel heartbeats and integrity votes ride).  Import verifies every
+  shard's checksum before a byte lands: a torn or bit-flipped shard
+  raises the typed :class:`MigrationCorrupt` and the importer
+  re-requests the block from the owner's **checkpointed leg** (its
+  crc-sidecar-verified block file) — a row is never silently
+  zero-filled or re-initialized.
+
+* **Versioned reads.**  Each repartition bumps the table version;
+  the :class:`HotRowCache` retires every cached row from prior
+  versions in O(1), and `read_rows` stamps the version it served so a
+  serving-side fetch can prove it never handed out a retired row
+  (``bad_rows_served == 0`` under chaos — see
+  :mod:`bigdl_tpu.serving.sparse_fetch`).
+
+The deterministic fault injectors driving the chaos tests live in
+:mod:`bigdl_tpu.resilience.faults` (``corrupt_migration_shard`` /
+``kill_host_mid_repartition``); the ownership function, migration
+state machine, and staleness bound are documented in
+``docs/embeddings.md``.
+"""
+from __future__ import annotations
+
+import base64
+import hashlib
+import json
+import os
+import threading
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+__all__ = [
+    "MigrationCorrupt", "StoreMigrating", "block_owner", "assign_blocks",
+    "HotRowCache", "EmbeddingStore", "table_checksum",
+]
+
+
+class MigrationCorrupt(RuntimeError):
+    """A migrating row shard failed its crc32c verify-on-import (torn
+    write, in-flight bit flip) AND the owner's checkpointed leg could
+    not supply a verified replacement.  ``code`` ``"DATA_LOSS"``:
+    continuing would train/serve on unknown bytes, so the import stops
+    loudly instead of zero-filling."""
+
+    code = "DATA_LOSS"
+
+    def __init__(self, message: str, table: str = "", block: int = -1):
+        super().__init__(message)
+        self.table = table
+        self.block = int(block)
+
+
+class StoreMigrating(RuntimeError):
+    """A read arrived while the store was mid-repartition and the row's
+    block is in flight.  Retryable (``code`` ``"UNAVAILABLE"``): the
+    serving fetch retries within its deadline budget or sheds typed —
+    it never serves a row it cannot verify."""
+
+    code = "UNAVAILABLE"
+
+
+# ---------------------------------------------------------------------------
+# consistent (rendezvous) block ownership
+# ---------------------------------------------------------------------------
+
+def _hrw_weight(table: str, block: int, member: str) -> int:
+    h = hashlib.blake2b(f"{table}/{block}/{member}".encode(),
+                        digest_size=8)
+    return int.from_bytes(h.digest(), "big")
+
+
+def block_owner(table: str, block: int,
+                members: Sequence[str]) -> str:
+    """Highest-random-weight owner of ``block`` among ``members`` —
+    every host computes the same answer from the member list alone,
+    and a 1-host delta re-assigns only that host's blocks."""
+    if not members:
+        raise ValueError(f"block_owner({table!r}, {block}): empty "
+                         "member set")
+    return max(sorted(members),
+               key=lambda m: _hrw_weight(table, block, m))
+
+
+def assign_blocks(table: str, n_blocks: int,
+                  members: Sequence[str]) -> Dict[int, str]:
+    """The full block → owner map for ``members`` (deterministic)."""
+    ms = sorted(set(members))
+    return {b: block_owner(table, b, ms) for b in range(int(n_blocks))}
+
+
+# ---------------------------------------------------------------------------
+# hot-row cache: version-retired, thread-safe
+# ---------------------------------------------------------------------------
+
+class HotRowCache:
+    """Bounded LRU of hot rows, invalidated **by table version**.
+
+    Every entry is stamped with the version it was read at; a
+    repartition bumps the cache's current version, retiring every
+    prior entry in O(1) — ``get`` refuses (and evicts) any entry whose
+    stamp is not current, and ``put`` refuses a stamp that is already
+    retired, so a lookup racing an invalidation can never resurrect a
+    stale row.  The staleness bound is therefore **one version**: a
+    cached row is served only while the version it was read at is
+    still the table's live version (docs/embeddings.md "Cache
+    staleness").
+    """
+
+    def __init__(self, capacity: int = 4096):
+        if capacity < 1:
+            raise ValueError(f"HotRowCache capacity must be >= 1, got "
+                             f"{capacity}")
+        self.capacity = int(capacity)
+        self._lock = threading.Lock()
+        self._data: Dict[int, Tuple[int, np.ndarray]] = {}
+        self._order: List[int] = []   # LRU order, oldest first
+        self._version = 0
+        self.hits = 0
+        self.misses = 0
+        self.stale_evictions = 0
+        self.rejected_puts = 0
+
+    @property
+    def version(self) -> int:
+        with self._lock:
+            return self._version
+
+    def bump_version(self, version: Optional[int] = None) -> int:
+        """Retire every entry cached before this call.  Monotonic:
+        a stale ``version`` argument never rewinds the cache."""
+        with self._lock:
+            if version is None:
+                self._version += 1
+            else:
+                self._version = max(self._version, int(version))
+            return self._version
+
+    def get(self, row: int) -> Optional[np.ndarray]:
+        with self._lock:
+            ent = self._data.get(row)
+            if ent is None:
+                self.misses += 1
+                return None
+            ver, vec = ent
+            if ver != self._version:
+                # retired version: evict, never serve
+                del self._data[row]
+                self._order.remove(row)
+                self.stale_evictions += 1
+                self.misses += 1
+                return None
+            self.hits += 1
+            self._order.remove(row)
+            self._order.append(row)
+            return vec
+
+    def put(self, row: int, vec: np.ndarray, version: int) -> bool:
+        """Insert ``row`` read at ``version``.  Refused (False) when
+        ``version`` is already retired — the lost-invalidation guard:
+        a fetch that started before a repartition must not overwrite
+        the bump that landed mid-flight."""
+        with self._lock:
+            if int(version) != self._version:
+                self.rejected_puts += 1
+                return False
+            if row in self._data:
+                self._order.remove(row)
+            elif len(self._data) >= self.capacity:
+                oldest = self._order.pop(0)
+                del self._data[oldest]
+            self._data[row] = (int(version), vec)
+            self._order.append(row)
+            return True
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._data)
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            total = self.hits + self.misses
+            return {
+                "version": self._version,
+                "size": len(self._data),
+                "capacity": self.capacity,
+                "hits": self.hits,
+                "misses": self.misses,
+                "hit_rate": (self.hits / total) if total else 0.0,
+                "stale_evictions": self.stale_evictions,
+                "rejected_puts": self.rejected_puts,
+            }
+
+
+# ---------------------------------------------------------------------------
+# the store
+# ---------------------------------------------------------------------------
+
+def _crc_fn():
+    from ..resilience.checkpoint import _native_crc
+
+    return _native_crc()
+
+
+class EmbeddingStore:
+    """One host's leg of a row-partitioned host-memory embedding table.
+
+    ``n_rows`` × ``dim`` rows group into blocks of ``block_rows``;
+    this host materializes only the blocks it owns **and has
+    touched** — an owned block reads as its deterministic
+    ``(seed, block)`` init until the first update lands, so a 1e8-row
+    table costs memory proportional to its hot set, not its
+    vocabulary.  All hosts derive the same init, which is what makes
+    the chaos e2e's bitwise-equality proof possible at all.
+
+    The migration channel (``kv``) is the elastic KV transport; the
+    checkpointed leg (``checkpoint_dir``, a shared filesystem like
+    FileKV's) is written by :meth:`checkpoint` with crc32c sidecars
+    and is both the corrupt-shard fallback and the dead-owner source.
+    """
+
+    #: KV key namespaces (under the elastic transport's flat keyspace)
+    _SHARD = "emb/shard/"
+    _ACK = "emb/ack/"
+
+    def __init__(self, table: str, n_rows: int, dim: int, host: str,
+                 members: Sequence[str], kv=None, *,
+                 block_rows: int = 4096, seed: int = 0,
+                 checkpoint_dir: Optional[str] = None,
+                 dtype=np.float32):
+        if n_rows < 1 or dim < 1:
+            raise ValueError(f"EmbeddingStore needs positive dims, got "
+                             f"({n_rows}, {dim})")
+        self.table = str(table)
+        self.n_rows = int(n_rows)
+        self.dim = int(dim)
+        self.host = str(host)
+        # a host NOT in ``members`` is a joiner: it owns nothing under
+        # the current assignment and acquires its blocks through its
+        # first :meth:`repartition` (the regrow path)
+        self.members: Tuple[str, ...] = tuple(sorted(set(members)))
+        self.kv = kv
+        self.block_rows = int(block_rows)
+        self.seed = int(seed)
+        self.checkpoint_dir = checkpoint_dir
+        self.dtype = np.dtype(dtype)
+        self.n_blocks = -(-self.n_rows // self.block_rows)
+        self.version = 0
+        self._lock = threading.RLock()
+        self._migrating = False
+        #: materialized owned blocks only (lazy capacity)
+        self._blocks: Dict[int, np.ndarray] = {}
+        #: owned blocks that have received updates since init
+        self._touched: set = set()
+        self._owners = assign_blocks(self.table, self.n_blocks,
+                                     self.members)
+        # counters the serving fetch / bench surface
+        self.rows_migrated = 0
+        self.migration_corrupt_detected = 0
+        self.recovered_from_checkpoint = 0
+        self.last_migration_s = 0.0
+
+    # -- ownership -------------------------------------------------------
+    def owner_of(self, block: int) -> str:
+        return self._owners[int(block)]
+
+    def owner_of_row(self, row: int) -> str:
+        return self._owners[int(row) // self.block_rows]
+
+    def owned_blocks(self) -> List[int]:
+        return [b for b, o in self._owners.items() if o == self.host]
+
+    def owns_row(self, row: int) -> bool:
+        return self.owner_of_row(row) == self.host
+
+    def _block_rows_extent(self, block: int) -> int:
+        lo = block * self.block_rows
+        return min(self.block_rows, self.n_rows - lo)
+
+    # -- block materialization ------------------------------------------
+    def _init_block(self, block: int) -> np.ndarray:
+        """Deterministic per-(seed, block) init — every host, every
+        incarnation, and the fault-free control run derive identical
+        bytes, so an untouched block never needs to move at all."""
+        rows = self._block_rows_extent(block)
+        rng = np.random.RandomState(
+            (self.seed * 1_000_003 + block) % (2 ** 31 - 1))
+        scale = 1.0 / max(self.dim, 1) ** 0.5
+        return (rng.standard_normal((rows, self.dim)) * scale).astype(
+            self.dtype)
+
+    def _get_block(self, block: int) -> np.ndarray:
+        b = int(block)
+        if self._owners[b] != self.host:
+            raise KeyError(
+                f"{self.table}: block {b} is owned by "
+                f"{self._owners[b]!r}, not {self.host!r}")
+        arr = self._blocks.get(b)
+        if arr is None:
+            arr = self._init_block(b)
+            self._blocks[b] = arr
+        return arr
+
+    # -- reads / writes --------------------------------------------------
+    def read_rows(self, rows: Sequence[int]) -> Tuple[np.ndarray, int]:
+        """Gather owned ``rows`` → ``([len, dim], version)``.  The
+        version stamp is taken under the same lock as the gather, so
+        the caller can cache the rows tagged with the exact version
+        they were consistent at.  Raises :class:`StoreMigrating` while
+        a repartition holds the table."""
+        with self._lock:
+            if self._migrating:
+                raise StoreMigrating(
+                    f"{self.table}: repartition in flight on "
+                    f"{self.host}")
+            out = np.empty((len(rows), self.dim), dtype=self.dtype)
+            for i, r in enumerate(rows):
+                r = int(r)
+                if not 0 <= r < self.n_rows:
+                    raise IndexError(f"row {r} outside [0, "
+                                     f"{self.n_rows})")
+                blk = self._get_block(r // self.block_rows)
+                out[i] = blk[r % self.block_rows]
+            return out, self.version
+
+    def apply_updates(self, rows: Sequence[int],
+                      deltas: np.ndarray) -> None:
+        """Add ``deltas[i]`` into owned row ``rows[i]`` (the PS-style
+        sparse update the training loop pushes; duplicate rows
+        accumulate in order)."""
+        deltas = np.asarray(deltas, dtype=self.dtype)
+        with self._lock:
+            if self._migrating:
+                raise StoreMigrating(
+                    f"{self.table}: repartition in flight on "
+                    f"{self.host}")
+            for i, r in enumerate(rows):
+                r = int(r)
+                b = r // self.block_rows
+                blk = self._get_block(b)
+                blk[r % self.block_rows] += deltas[i]
+                self._touched.add(b)
+
+    def dense(self) -> np.ndarray:
+        """The FULL table materialized (owned blocks from this leg,
+        peers' untouched blocks from the shared deterministic init) —
+        only sensible for tables that fit; the training↔device bridge
+        for :meth:`ShardedEmbedding.attach_store`."""
+        out = np.empty((self.n_rows, self.dim), dtype=self.dtype)
+        with self._lock:
+            for b in range(self.n_blocks):
+                lo = b * self.block_rows
+                n = self._block_rows_extent(b)
+                if self._owners[b] == self.host:
+                    out[lo:lo + n] = self._get_block(b)
+                else:
+                    out[lo:lo + n] = self._init_block(b)
+        return out
+
+    # -- checkpointed leg ------------------------------------------------
+    def _ckpt_path(self, block: int) -> str:
+        d = os.path.join(str(self.checkpoint_dir), self.table)
+        return os.path.join(d, f"block_{int(block):06d}.npy")
+
+    def checkpoint(self) -> int:
+        """Write every touched owned block with a crc32c sidecar
+        (atomic tmp+rename, the checkpoint layer's discipline) —
+        untouched blocks are reproducible from the deterministic init
+        and cost nothing.  Returns blocks written."""
+        if self.checkpoint_dir is None:
+            raise ValueError(f"{self.table}: no checkpoint_dir "
+                             "configured")
+        wrote = 0
+        with self._lock:
+            for b in sorted(self._touched):
+                if self._owners[b] != self.host:
+                    continue
+                self._checkpoint_block(b)
+                wrote += 1
+        return wrote
+
+    def _checkpoint_block(self, block: int) -> None:
+        from ..resilience.checkpoint import stream_crc32c, write_sidecar
+
+        path = self._ckpt_path(block)
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "wb") as f:
+            np.save(f, self._blocks[block])
+        os.replace(tmp, path)
+        write_sidecar(path, *stream_crc32c(path))
+
+    def _load_checkpointed_block(self, block: int) -> np.ndarray:
+        """The owner's checkpointed leg: verified load of one block
+        file; a missing file means the block was never touched (the
+        deterministic init IS its content); a corrupt file is
+        quarantined data loss, raised loudly."""
+        from ..resilience.checkpoint import verify_file
+
+        if self.checkpoint_dir is None:
+            raise MigrationCorrupt(
+                f"{self.table}: block {block} unrecoverable — no "
+                "checkpointed leg configured", self.table, block)
+        path = self._ckpt_path(block)
+        if not os.path.exists(path):
+            # never updated before the last checkpoint: init is exact
+            return self._init_block(block)
+        if verify_file(path) is not True:
+            raise MigrationCorrupt(
+                f"{self.table}: checkpointed leg for block {block} "
+                "failed its crc32c sidecar", self.table, block)
+        with open(path, "rb") as f:
+            arr = np.load(f)
+        return np.ascontiguousarray(arr, dtype=self.dtype)
+
+    # -- sealed shards over the KV transport -----------------------------
+    def _seal(self, block: int) -> str:
+        """One crc32c-sealed shard: checksum over the raw row bytes,
+        payload base64 over the same bytes.  The in-flight corruption
+        injector (``faults.corrupt_migration_shard``) flips a payload
+        bit AFTER sealing — exactly what a torn write looks like to
+        the importer's verify."""
+        from ..resilience import faults
+
+        arr = np.ascontiguousarray(self._get_block(block))
+        raw = arr.tobytes()
+        crc = _crc_fn()(raw, 0)
+        data = bytearray(raw)
+        flipped = faults.check_migration_fault(
+            "corrupt_shard", table=self.table, block=block)
+        if flipped:
+            data[len(data) // 2] ^= 0x10
+        return json.dumps({
+            "table": self.table, "block": int(block),
+            "rows": int(arr.shape[0]), "dim": int(arr.shape[1]),
+            "dtype": self.dtype.name, "crc32c": f"{crc:08x}",
+            "data": base64.b64encode(bytes(data)).decode("ascii"),
+        })
+
+    def _shard_key(self, version: int, block: int) -> str:
+        return f"{self._SHARD}{self.table}/{int(version)}/{int(block)}"
+
+    def _unseal(self, payload: str, block: int) -> np.ndarray:
+        rec = json.loads(payload)
+        raw = base64.b64decode(rec["data"])
+        crc = _crc_fn()(raw, 0)
+        if f"{crc:08x}" != rec["crc32c"]:
+            self.migration_corrupt_detected += 1
+            raise MigrationCorrupt(
+                f"{self.table}: shard for block {block} failed "
+                f"verify-on-import (got {crc:08x}, sealed "
+                f"{rec['crc32c']})", self.table, block)
+        return np.frombuffer(raw, dtype=np.dtype(rec["dtype"])).reshape(
+            rec["rows"], rec["dim"]).copy()
+
+    # -- the migration state machine -------------------------------------
+    def repartition(self, new_members: Sequence[str], *,
+                    dead: Sequence[str] = (),
+                    fetch_timeout: float = 5.0,
+                    poll: float = 0.005,
+                    clock=time.monotonic,
+                    sleep=time.sleep) -> dict:
+        """Live shrink/regrow: derive → export → (maybe die) → import
+        → ack → commit.  See docs/embeddings.md for the full state
+        machine; the invariants:
+
+        * only blocks whose owner changed move (~1/N of rows for a
+          1-host delta — consistent assignment);
+        * every imported byte passed a crc32c verify, either on the
+          sealed shard or on the owner's checkpointed leg;
+        * the version bump (and with it every hot-row cache
+          invalidation) happens only after every import verified.
+        """
+        from ..resilience import faults
+
+        t0 = clock()
+        new_ms = tuple(sorted(set(new_members)))
+        if self.host not in new_ms:
+            raise ValueError(f"{self.host!r} repartitioning itself out "
+                             f"of {new_ms}")
+        old_owners = self._owners
+        new_owners = assign_blocks(self.table, self.n_blocks, new_ms)
+        # every member must derive the SAME new version or shard keys
+        # miss.  The version is a property of the TRANSITION, not the
+        # committer: each ack records its target member set, so a leg
+        # adopts the version a peer already committed for this same
+        # member set (first committer defines it) and otherwise steps
+        # past every ack for other transitions — a joiner constructed
+        # at version 0 converges with survivors mid-stream, without an
+        # ownership directory.  Adoption is monotonicity-guarded
+        # (never below our own next version) so a revisited member set
+        # can never rewind the table version.
+        new_version = self.version + 1
+        if self.kv is not None:
+            prefix = f"{self._ACK}{self.table}/"
+            same = None
+            for key in self.kv.keys(prefix):
+                try:
+                    acked = int(key[len(prefix):].split("/", 1)[0])
+                except ValueError:
+                    continue
+                try:
+                    ms = tuple(sorted(json.loads(
+                        self.kv.get(key) or "{}").get("members", ())))
+                except (ValueError, AttributeError):
+                    ms = ()
+                if ms == new_ms:
+                    same = acked if same is None else max(same, acked)
+                else:
+                    new_version = max(new_version, acked + 1)
+            if same is not None and same >= self.version + 1:
+                new_version = same
+        dead = set(dead)
+
+        with self._lock:
+            self._migrating = True
+        try:
+            # -- export: seal every block leaving this host.  Each
+            # leaving block is checkpointed FIRST (touched blocks
+            # only; untouched ones are reproducible from init), so the
+            # checkpointed leg a corrupt-shard re-request falls back
+            # to is bitwise-current, not stale ----------------------------
+            exported = 0
+            for b in range(self.n_blocks):
+                if (old_owners[b] == self.host
+                        and new_owners[b] != self.host):
+                    if (self.checkpoint_dir is not None
+                            and b in self._touched):
+                        self._checkpoint_block(b)
+                    self.kv.put(self._shard_key(new_version, b),
+                                self._seal(b))
+                    exported += 1
+
+            # between ownership re-derivation and import-ack: the
+            # window kill_host_mid_repartition targets — a host dying
+            # here has exported nothing durable and acked nothing, so
+            # survivors re-derive without it and source its blocks
+            # from its checkpointed leg
+            faults.check_migration_fault("kill", host=self.host)
+
+            # -- import: every block arriving at this host -------------
+            imported = moved_rows = 0
+            for b in range(self.n_blocks):
+                if (new_owners[b] != self.host
+                        or old_owners[b] == self.host):
+                    continue
+                src = old_owners[b]
+                arr = self._import_block(
+                    b, src, new_version,
+                    src_dead=src in dead or src not in new_ms,
+                    fetch_timeout=fetch_timeout, poll=poll,
+                    clock=clock, sleep=sleep)
+                self._blocks[b] = arr
+                self._touched.add(b)
+                imported += 1
+                moved_rows += arr.shape[0]
+
+            # -- ack, then commit --------------------------------------
+            if self.kv is not None:
+                self.kv.put(
+                    f"{self._ACK}{self.table}/{new_version}/"
+                    f"{self.host}",
+                    json.dumps({"members": list(new_ms)}))
+            with self._lock:
+                for b in range(self.n_blocks):
+                    if (new_owners[b] != self.host
+                            and b in self._blocks):
+                        del self._blocks[b]
+                        self._touched.discard(b)
+                self._owners = new_owners
+                self.members = new_ms
+                self.version = new_version
+                self.rows_migrated += moved_rows
+        finally:
+            with self._lock:
+                self._migrating = False
+        self.last_migration_s = clock() - t0
+        return {
+            "version": new_version,
+            "exported_blocks": exported,
+            "imported_blocks": imported,
+            "moved_rows": moved_rows,
+            "recovered_from_checkpoint": self.recovered_from_checkpoint,
+            "wall_s": self.last_migration_s,
+        }
+
+    def _import_block(self, block: int, src: str, version: int, *,
+                      src_dead: bool, fetch_timeout: float,
+                      poll: float, clock, sleep) -> np.ndarray:
+        """One block's verified import: sealed shard off the KV
+        transport first; on corruption (typed
+        :class:`MigrationCorrupt`) or a dead/silent source, the
+        owner's checkpointed leg."""
+        key = self._shard_key(version, block)
+        deadline = clock() + float(fetch_timeout)
+        payload = None
+        if self.kv is not None and not src_dead:
+            while True:
+                payload = self.kv.get(key)
+                if payload is not None or clock() >= deadline:
+                    break
+                sleep(poll)
+        if payload is None:
+            # dead or silent old owner: its checkpointed leg is the
+            # only verified source left
+            self.recovered_from_checkpoint += 1
+            return self._load_checkpointed_block(block)
+        try:
+            return self._unseal(payload, block)
+        except MigrationCorrupt:
+            # torn/corrupt in flight → re-request from the owner's
+            # checkpointed leg (verified); if THAT fails the raise
+            # from _load_checkpointed_block propagates — never
+            # zero-filled
+            self.recovered_from_checkpoint += 1
+            return self._load_checkpointed_block(block)
+
+    # -- proof + introspection -------------------------------------------
+    def checksum(self) -> str:
+        """crc32c over this host's OWNED rows in block order — combine
+        legs with :func:`table_checksum` for the whole-table proof."""
+        crc_fn = _crc_fn()
+        crc = 0
+        with self._lock:
+            for b in sorted(self.owned_blocks()):
+                crc = crc_fn(
+                    np.ascontiguousarray(self._get_block(b)).tobytes(),
+                    crc)
+        return f"{crc:08x}"
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "table": self.table,
+                "host": self.host,
+                "version": self.version,
+                "members": list(self.members),
+                "n_rows": self.n_rows,
+                "dim": self.dim,
+                "block_rows": self.block_rows,
+                "owned_blocks": len(self.owned_blocks()),
+                "materialized_blocks": len(self._blocks),
+                "rows_migrated": self.rows_migrated,
+                "migration_corrupt_detected":
+                    self.migration_corrupt_detected,
+                "recovered_from_checkpoint":
+                    self.recovered_from_checkpoint,
+                "last_migration_s": self.last_migration_s,
+            }
+
+
+def table_checksum(stores: Sequence[EmbeddingStore]) -> str:
+    """The whole table's crc32c across one incarnation's legs, walked
+    in block order regardless of which leg owns which block — equal
+    strings mean bitwise-equal table contents, which is the proof the
+    chaos e2e pins across the membership boundary (checksum_tree's
+    discipline applied to the partitioned table)."""
+    if not stores:
+        raise ValueError("table_checksum of no legs")
+    by_host = {s.host: s for s in stores}
+    ref = stores[0]
+    crc_fn = _crc_fn()
+    crc = 0
+    for b in range(ref.n_blocks):
+        owner = ref.owner_of(b)
+        leg = by_host.get(owner)
+        if leg is None:
+            raise ValueError(f"no leg for owner {owner!r} of block "
+                             f"{b}")
+        with leg._lock:
+            arr = np.ascontiguousarray(leg._get_block(b))
+        crc = crc_fn(arr.tobytes(), crc)
+    return f"{crc:08x}"

@@ -162,6 +162,14 @@ SYNCS = ("step", "periodic(k)", "stale(s)")
 
 _SYNC_RE = re.compile(r"^(?:step|periodic\((\d+)\)|stale\((\d+)\))$")
 
+#: (table name, mesh shape) pairs whose degrade-to-replica warning has
+#: already fired — entry_for runs once per leaf per retrace, and a
+#: non-dividing table would otherwise repeat the same warning every
+#: shrink/regrow retrace.  Bounded: cleared wholesale at capacity (the
+#: set of live (table, mesh) pairs is tiny; losing dedup state just
+#: means one extra warning).
+_WARNED_REPLICA_TABLES: set = set()
+
 
 def _parse_sync(sync: str):
     """``"step" | "periodic(k)" | "stale(s)"`` -> ``(kind, n)``; raises
@@ -348,12 +356,20 @@ class Plan:
                 # a sharded table whose rows stop dividing (elastic
                 # shrink re-derives the mesh at survivor counts) falls
                 # back to a full replica — rows re-partition or
-                # replicate, they are never dropped
-                log.warning(
-                    "sharding plan: %s (%s) does not divide over spec "
-                    "%s — the table runs replicated (sparse transport "
-                    "still applies to its gradient)", name, shape,
-                    _spec_str(spec))
+                # replicate, they are never dropped.  Warn once per
+                # (table, mesh): entry_for reruns on every retrace
+                key = (name,
+                       tuple(sorted(self.mesh.shape.items()))
+                       if self.mesh is not None else None)
+                if key not in _WARNED_REPLICA_TABLES:
+                    if len(_WARNED_REPLICA_TABLES) >= 1024:
+                        _WARNED_REPLICA_TABLES.clear()
+                    _WARNED_REPLICA_TABLES.add(key)
+                    log.warning(
+                        "sharding plan: %s (%s) does not divide over "
+                        "spec %s — the table runs replicated (sparse "
+                        "transport still applies to its gradient)",
+                        name, shape, _spec_str(spec))
                 spec = self._strip_unfit(spec, shape)
             fsdp = rule.fsdp and self.data_axis in _spec_axes(spec)
             if fsdp and not self._fits(spec, shape):

@@ -591,6 +591,10 @@ class ElasticContext:
         # step critical path, with incarnation-keyed staleness discard.
         # Built lazily; close() joins it.
         self._publisher = None
+        # parameter-server embedding legs (nn/embedding_store.py):
+        # every adopted membership change re-partitions each attached
+        # table over the survivors before training resumes
+        self._embedding_stores: List = []
         # -- state ------------------------------------------------------
         self.incarnation: Optional[int] = None
         self.members: Tuple[str, ...] = ()
@@ -649,6 +653,37 @@ class ElasticContext:
                             float(max_drop_percentage),
                             int(warmup_iteration))
         return self
+
+    # -- parameter-server embedding legs ---------------------------------
+    def attach_embedding_store(self, store):
+        """Register this host's
+        :class:`~bigdl_tpu.nn.embedding_store.EmbeddingStore` leg: on
+        every adopted membership change the context re-partitions the
+        table over the survivors (sealed, crc32c-verified shards over
+        the SAME KV transport the membership protocol rides — the
+        store inherits the coordinator's transport if it has none), so
+        the optimize retry that follows
+        :class:`MembershipChangedError` resumes against re-owned,
+        verified rows — no step trains on a torn table."""
+        if store.kv is None:
+            store.kv = self.coordinator.transport
+        self._embedding_stores.append(store)
+        return self
+
+    def _repartition_stores(self):
+        for store in self._embedding_stores:
+            if store.members == self.members:
+                continue
+            dead = set(store.members) - set(self.members)
+            stats = store.repartition(self.members, dead=dead,
+                                      sleep=self._sleep)
+            log.warning(
+                "elastic: embedding table %r re-partitioned to "
+                "version %d over %d member(s) — %d block(s) in, "
+                "%d out, %d row(s) moved (%d from checkpointed legs)",
+                store.table, stats["version"], len(self.members),
+                stats["imported_blocks"], stats["exported_blocks"],
+                stats["moved_rows"], stats["recovered_from_checkpoint"])
 
     def counters(self) -> dict:
         return {
@@ -751,6 +786,7 @@ class ElasticContext:
                    "cluster membership reconfigurations adopted")
         log.warning("elastic: running incarnation %d with %d member(s) %s",
                     self.incarnation, len(self.members), self.members)
+        self._repartition_stores()
         self._scalar("Incarnation", self.incarnation)
         self._scalar("ClusterSize", len(self.members))
 

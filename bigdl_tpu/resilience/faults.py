@@ -640,3 +640,76 @@ def poison_params(tree):
         return a
 
     return jax.tree_util.tree_map(_poison, tree)
+
+# ---------------------------------------------------------------------------
+# migration (embedding row re-partition) faults
+# ---------------------------------------------------------------------------
+# Two deterministic injectors for the EmbeddingStore's live
+# shrink/regrow path (nn/embedding_store.py).  ``corrupt_migration_
+# shard`` flips one payload bit in a sealed row shard AFTER its crc32c
+# is computed — to the importer this is exactly a torn write, and the
+# verify-on-import must convert it into the typed ``MigrationCorrupt``
+# + a re-request from the owner's checkpointed leg, never a
+# zero-filled row.  ``kill_host_mid_repartition`` kills a host in the
+# narrow window between ownership re-derivation and import-ack — the
+# survivors must re-derive without it and source its blocks from its
+# checkpointed leg.
+
+_MIGRATION_LOCK = threading.Lock()
+_MIGRATION_FAULTS: list = []  # [dict(kind, host|table, remaining, fired)]
+
+
+def check_migration_fault(kind: str, host: Optional[str] = None,
+                          table: Optional[str] = None,
+                          block: Optional[int] = None) -> bool:
+    """Consulted by the store at its two deterministic injection
+    points: ``"corrupt_shard"`` while sealing a shard for the KV
+    transport (returns True when the armed fault consumed this shard
+    — the caller flips a payload bit), ``"kill"`` between ownership
+    re-derivation and import-ack (raises :class:`HostKilledError` for
+    the armed host).  No-op (and free) when nothing is armed."""
+    if not _MIGRATION_FAULTS:
+        return False
+    fault = None
+    with _MIGRATION_LOCK:
+        for f in _MIGRATION_FAULTS:
+            if f["kind"] != kind or f["remaining"] <= 0:
+                continue
+            if kind == "kill" and f["host"] != host:
+                continue
+            if (kind == "corrupt_shard" and f["table"] is not None
+                    and f["table"] != table):
+                continue
+            f["remaining"] -= 1
+            f["fired"] += 1
+            fault = dict(f)
+            break
+    if fault is None:
+        return False
+    if kind == "kill":
+        raise HostKilledError(
+            f"injected kill of {host} mid-repartition (between "
+            "ownership re-derivation and import-ack)")
+    return True
+
+
+def corrupt_migration_shard(table: Optional[str] = None,
+                            times: int = 1):
+    """Bit-flip ``times`` sealed row shards in flight (any table when
+    ``table`` is None).  The flip lands after the crc32c is sealed, so
+    verify-on-import MUST fail — the typed ``MigrationCorrupt`` +
+    checkpointed-leg re-request path is exercised end to end."""
+    return _elastic_fault_entry(_MIGRATION_LOCK, _MIGRATION_FAULTS, {
+        "kind": "corrupt_shard",
+        "table": None if table is None else str(table),
+        "remaining": int(times), "fired": 0})
+
+
+def kill_host_mid_repartition(host: str):
+    """Kill ``host`` inside its next repartition, between ownership
+    re-derivation and import-ack: it has acked nothing, so survivors
+    re-derive without it and its blocks come from its checkpointed
+    leg."""
+    return _elastic_fault_entry(_MIGRATION_LOCK, _MIGRATION_FAULTS, {
+        "kind": "kill", "host": str(host), "remaining": 1,
+        "fired": 0})

@@ -73,6 +73,7 @@ from .request_trace import (ReplicaTraceSink, RequestTracer,
                             trace_attribution, trace_coverage)
 from .router import FleetRouter
 from .server import InferenceServer
+from .sparse_fetch import FetchResult, SparseFetchClient
 from .status import ServeFuture, ServeResult, Status
 from .swap import load_verified_params
 
@@ -80,11 +81,12 @@ __all__ = [
     "AutoscalePolicy", "Autoscaler", "CircuitBreaker",
     "FleetHealthMonitor", "FleetQuorumError", "FleetRouter",
     "HandoffCorrupt",
+    "FetchResult",
     "InferenceServer", "KVPagePool", "MicroBatcher", "PageLease",
     "PoolExhausted", "ReplicaAgent", "ReplicaHealthPolicy",
     "ReplicaTraceSink",
     "RequestTracer", "ServeFuture", "ServeResult",
-    "ServingFleet", "ServingMetrics", "Status",
+    "ServingFleet", "ServingMetrics", "SparseFetchClient", "Status",
     "load_verified_params", "set_compile_cache_dir",
     "trace_attribution", "trace_coverage",
 ]

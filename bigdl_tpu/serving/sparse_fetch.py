@@ -1,0 +1,302 @@
+"""Remote sparse fetch: embedding lookups as guarded serving requests.
+
+DLRM inference through the fleet needs rows from the parameter-server-
+scale table (:class:`~bigdl_tpu.nn.embedding_store.EmbeddingStore`) —
+a vocabulary that dwarfs HBM never rides along with the dense model's
+params, so every lookup is a remote fetch against the live store legs.
+This module gives that fetch the SAME machinery every other serving
+request already rides (docs/serving.md):
+
+* **deadline budget** — a fetch carries a deadline; rows that cannot
+  be gathered in time are shed with the typed ``DEADLINE_EXCEEDED``,
+  never served late or guessed;
+* **retry within the budget** — a leg that is mid-repartition raises
+  the retryable :class:`~bigdl_tpu.nn.embedding_store.StoreMigrating`;
+  the fetch retries while budget remains, then sheds ``UNAVAILABLE``;
+* **circuit breaker per leg** — a leg that keeps failing trips its
+  breaker and is rejected fast (half-open probes ride the next fetch);
+* **hot-row cache** — Zipf-skewed lookups hit the version-stamped
+  :class:`~bigdl_tpu.nn.embedding_store.HotRowCache`; a repartition's
+  version bump retires every cached row in O(1), so a mid-migration
+  lookup either serves a row verified at the live version or sheds
+  typed.  ``bad_rows_served`` counts rows handed out at a retired
+  version — the audit every chaos test pins at **zero**.
+
+The table version rides health snapshots
+(``bigdl_embed_table_version``) exactly like replica health does, so
+the fleet's monitors see a stuck or runaway migration as a plain
+metric series.
+"""
+from __future__ import annotations
+
+import time
+from typing import Dict, Optional, Sequence
+
+import numpy as np
+
+from ..nn.embedding_store import (EmbeddingStore, HotRowCache,
+                                  StoreMigrating)
+from ..telemetry import metric_names as mn
+from .breaker import ADMIT, PROBE, CircuitBreaker
+from .status import ServeResult, Status
+
+__all__ = ["SparseFetchClient", "FetchResult"]
+
+
+class FetchResult:
+    """Terminal outcome of one sparse fetch (the lookup-shaped
+    :class:`~bigdl_tpu.serving.status.ServeResult`)."""
+
+    __slots__ = ("status", "rows", "version", "shed_rows", "error",
+                 "latency_s", "cache_hits")
+
+    def __init__(self, status: Status, rows=None, version=None,
+                 shed_rows=(), error=None, latency_s=0.0,
+                 cache_hits=0):
+        self.status = status
+        self.rows = rows
+        self.version = version
+        self.shed_rows = tuple(shed_rows)
+        self.error = error
+        self.latency_s = latency_s
+        self.cache_hits = cache_hits
+
+    @property
+    def ok(self) -> bool:
+        return self.status is Status.OK
+
+
+class SparseFetchClient:
+    """Deadline-budgeted, breaker-guarded row fetch against the live
+    store legs, with a version-stamped hot-row cache in front.
+
+    ``stores`` maps host → that host's :class:`EmbeddingStore` leg (the
+    in-process resolver; a networked deployment resolves to RPC stubs
+    with the same ``read_rows`` contract).  The member list — and with
+    it row routing — follows the legs' own consistent assignment, so
+    the client needs no ownership directory either.
+    """
+
+    def __init__(self, stores: Dict[str, EmbeddingStore], *,
+                 cache: Optional[HotRowCache] = None,
+                 cache_capacity: int = 4096,
+                 default_deadline_s: float = 1.0,
+                 retry_backoff_s: float = 0.002,
+                 breaker_kw: Optional[dict] = None,
+                 registry=None,
+                 clock=time.monotonic,
+                 sleep=time.sleep):
+        if not stores:
+            raise ValueError("SparseFetchClient needs at least one "
+                             "store leg")
+        self.stores = dict(stores)
+        ref = next(iter(self.stores.values()))
+        self.table = ref.table
+        self.cache = cache if cache is not None else HotRowCache(
+            cache_capacity)
+        self.default_deadline_s = float(default_deadline_s)
+        self.retry_backoff_s = float(retry_backoff_s)
+        self._clock = clock
+        self._sleep = sleep
+        self.breakers = {
+            h: CircuitBreaker(**(breaker_kw or {
+                "failure_threshold": 5, "reset_timeout": 0.25}))
+            for h in self.stores}
+        # the audit counters: served rows, typed sheds, and the
+        # must-stay-zero bad-rows count (a row handed out at a retired
+        # version)
+        self.rows_served = 0
+        self.rows_shed = 0
+        self.bad_rows_served = 0
+        self._bad_reported = 0
+        self.retries = 0
+        self._registry = registry
+        if registry is not None:
+            self._g_version = registry.gauge(
+                mn.EMBED_TABLE_VERSION,
+                "live embedding table version", ("table",))
+            self._c_hits = registry.counter(
+                mn.EMBED_CACHE_HITS_TOTAL,
+                "hot-row cache hits", ("table",))
+            self._c_misses = registry.counter(
+                mn.EMBED_CACHE_MISSES_TOTAL,
+                "hot-row cache misses", ("table",))
+            self._c_shed = registry.counter(
+                mn.EMBED_ROWS_SHED_TOTAL,
+                "rows shed typed instead of served unverified",
+                ("table",))
+            self._c_bad = registry.counter(
+                mn.EMBED_BAD_ROWS_TOTAL,
+                "rows served at a retired version (must stay 0)",
+                ("table",))
+
+    # ------------------------------------------------------------------
+    def _live_version(self) -> int:
+        return max(s.version for s in self.stores.values())
+
+    def _sync_cache_version(self) -> int:
+        """Adopt the legs' live version into the cache (monotonic) —
+        the invalidation edge every repartition publishes."""
+        v = self._live_version()
+        self.cache.bump_version(v)
+        return v
+
+    def fetch(self, rows: Sequence[int],
+              deadline_s: Optional[float] = None) -> FetchResult:
+        """Gather ``rows`` → ``FetchResult``.  OK carries the full
+        ``[len(rows), dim]`` matrix verified at one table version;
+        any other status carries ``shed_rows`` — the caller sheds or
+        retries, it never receives a partially-verified matrix."""
+        t0 = self._clock()
+        budget = (self.default_deadline_s if deadline_s is None
+                  else float(deadline_s))
+        deadline = t0 + budget
+        version = self._sync_cache_version()
+        rows = [int(r) for r in rows]
+        ref = next(iter(self.stores.values()))
+        out = np.empty((len(rows), ref.dim), dtype=ref.dtype)
+
+        # cache pass
+        missing: Dict[str, list] = {}
+        cache_hits = 0
+        for i, r in enumerate(rows):
+            vec = self.cache.get(r)
+            if vec is not None:
+                out[i] = vec
+                cache_hits += 1
+            else:
+                owner = ref.owner_of_row(r)
+                missing.setdefault(owner, []).append(i)
+        if self._registry is not None:
+            self._c_hits.labels(table=self.table).inc(cache_hits)
+            self._c_misses.labels(table=self.table).inc(
+                len(rows) - cache_hits)
+
+        # owner-grouped fetch with retry inside the deadline budget
+        for owner, idxs in missing.items():
+            res = self._fetch_leg(owner, [rows[i] for i in idxs],
+                                  deadline)
+            if isinstance(res, FetchResult):   # typed shed
+                self.rows_shed += sum(len(v) for v in missing.values())
+                if self._registry is not None:
+                    self._c_shed.labels(table=self.table).inc(
+                        sum(len(v) for v in missing.values()))
+                res.shed_rows = tuple(
+                    rows[i] for v in missing.values() for i in v)
+                res.latency_s = self._clock() - t0
+                res.cache_hits = cache_hits
+                return res
+            vecs, leg_version = res
+            # verify-before-serve: a row read at a version the table
+            # has moved past mid-fetch is never returned — re-read at
+            # the live version while budget remains, else shed typed.
+            while leg_version < self._live_version():
+                version = self._sync_cache_version()
+                if self._clock() >= deadline:
+                    self.rows_shed += len(idxs)
+                    if self._registry is not None:
+                        self._c_shed.labels(table=self.table).inc(
+                            len(idxs))
+                    return FetchResult(
+                        Status.DEADLINE_EXCEEDED,
+                        shed_rows=tuple(rows[i] for i in idxs),
+                        error="table version moved mid-fetch and the "
+                              "re-read budget is spent",
+                        latency_s=self._clock() - t0,
+                        cache_hits=cache_hits)
+                retry = self._fetch_leg(
+                    owner, [rows[i] for i in idxs], deadline)
+                if isinstance(retry, FetchResult):
+                    retry.latency_s = self._clock() - t0
+                    return retry
+                vecs, leg_version = retry
+            if leg_version < version:
+                # unreachable by construction — counting it is the
+                # audit the chaos bar pins at zero
+                self.bad_rows_served += len(idxs)
+            for j, i in enumerate(idxs):
+                out[i] = vecs[j]
+                self.cache.put(rows[i], vecs[j], leg_version)
+        self.rows_served += len(rows)
+        if self._registry is not None:
+            self._g_version.labels(table=self.table).set(
+                self._live_version())
+        return FetchResult(Status.OK, rows=out, version=version,
+                           latency_s=self._clock() - t0,
+                           cache_hits=cache_hits)
+
+    def _fetch_leg(self, owner: str, row_ids: Sequence[int],
+                   deadline: float):
+        """One leg's gather under breaker + retry-within-budget.
+        Returns ``(vecs, version)`` or a typed :class:`FetchResult`."""
+        store = self.stores.get(owner)
+        if store is None:
+            return FetchResult(
+                Status.UNAVAILABLE,
+                error=f"no live leg for owner {owner!r}")
+        br = self.breakers[owner]
+        while True:
+            verdict = br.acquire()
+            if verdict not in (ADMIT, PROBE):
+                return FetchResult(
+                    Status.UNAVAILABLE,
+                    error=f"breaker open for leg {owner!r}")
+            try:
+                vecs, version = store.read_rows(row_ids)
+            except StoreMigrating as e:
+                br.record_failure()
+                self.retries += 1
+                if self._clock() + self.retry_backoff_s >= deadline:
+                    return FetchResult(Status.DEADLINE_EXCEEDED,
+                                       error=str(e))
+                self._sleep(self.retry_backoff_s)
+                continue
+            except Exception as e:  # leg fault: typed, never a guess
+                br.record_failure()
+                return FetchResult(Status.INTERNAL_ERROR,
+                                   error=f"{type(e).__name__}: {e}")
+            br.record_success()
+            return vecs, version
+
+    # ------------------------------------------------------------------
+    def embed(self, indices: np.ndarray,
+              deadline_s: Optional[float] = None) -> ServeResult:
+        """Batch-of-lookups convenience for serving paths: 1-based
+        float indices (the :class:`LookupTable` convention the
+        clickstream emits) → ``ServeResult`` whose output is the
+        ``indices.shape + (dim,)`` embedded block."""
+        idx = np.asarray(indices)
+        flat = np.clip(idx.astype(np.int64) - 1, 0,
+                       next(iter(self.stores.values())).n_rows - 1)
+        res = self.fetch(flat.reshape(-1).tolist(),
+                         deadline_s=deadline_s)
+        if not res.ok:
+            return ServeResult(status=res.status, error=res.error,
+                               latency_s=res.latency_s)
+        out = res.rows.reshape(idx.shape + (res.rows.shape[-1],))
+        return ServeResult(status=Status.OK, output=out,
+                           latency_s=res.latency_s)
+
+    def health_snapshot(self) -> dict:
+        """What a replica publishes about its sparse-fetch dependency
+        — the table version gauge plus the audit counters, shaped like
+        every other ``srvhealth`` payload field."""
+        snap = {
+            "table": self.table,
+            "table_version": self._live_version(),
+            "rows_served": self.rows_served,
+            "rows_shed": self.rows_shed,
+            "bad_rows_served": self.bad_rows_served,
+            "retries": self.retries,
+            "cache": self.cache.snapshot(),
+            "breakers": {h: b.snapshot()["state"]
+                         for h, b in self.breakers.items()},
+        }
+        if self._registry is not None:
+            self._g_version.labels(table=self.table).set(
+                snap["table_version"])
+            bad = self.bad_rows_served - self._bad_reported
+            if bad > 0:
+                self._c_bad.labels(table=self.table).inc(bad)
+                self._bad_reported = self.bad_rows_served
+        return snap

@@ -124,6 +124,25 @@ LOOP_INGEST_BATCHES_TOTAL = "bigdl_loop_ingest_batches_total"
 LOOP_SERVED_REQUESTS_TOTAL = "bigdl_loop_served_requests_total"
 LOOP_SERVED_BAD_TOTAL = "bigdl_loop_served_bad_total"
 
+# --- parameter-server embedding store (nn/embedding_store.py +
+# --- serving/sparse_fetch.py) ---------------------------------------------
+#: the live table version per table (labels: table) — bumped by every
+#: repartition; the serving fetch publishes it in health snapshots and
+#: the hot-row cache retires every entry from prior versions
+EMBED_TABLE_VERSION = "bigdl_embed_table_version"
+#: hot-row cache traffic on the remote-sparse-fetch path (labels: table)
+EMBED_CACHE_HITS_TOTAL = "bigdl_embed_cache_hits_total"
+EMBED_CACHE_MISSES_TOTAL = "bigdl_embed_cache_misses_total"
+#: rows moved by live re-partitioning (labels: table) — ~1/N of the
+#: table per 1-host delta under consistent assignment
+EMBED_ROWS_MIGRATED_TOTAL = "bigdl_embed_rows_migrated_total"
+#: lookups shed typed (deadline/migration/breaker) instead of served
+#: unverified (labels: table)
+EMBED_ROWS_SHED_TOTAL = "bigdl_embed_rows_shed_total"
+#: rows served that failed verification — the must-stay-zero audit
+#: every embedding chaos test pins (labels: table)
+EMBED_BAD_ROWS_TOTAL = "bigdl_embed_bad_rows_total"
+
 #: every bigdl_* metric family name any bigdl_tpu module may register
 #: or reference — the vocabulary the lint enforces
 METRIC_FAMILY_NAMES = frozenset(

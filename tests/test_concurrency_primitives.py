@@ -351,3 +351,84 @@ def test_fleet_deploy_mutex_single_winner_no_partial_rolls():
             assert srv.metrics.swaps == 1   # exactly one install each
     finally:
         fl.stop(timeout=10)
+
+
+# ---------------------------------------------------------------------------
+# HotRowCache (nn/embedding_store.py) — version-retired row cache
+# ---------------------------------------------------------------------------
+
+def test_hot_row_cache_version_retirement_under_contention():
+    """N threads race get/put against a version-bumping invalidator.
+
+    The staleness invariant (docs/embeddings.md "Cache staleness"): a
+    returned vector's stamped version is >= the cache version observed
+    BEFORE the get — a bump retires every prior entry, so no thread may
+    ever read a row cached at a version older than one it has already
+    seen retired.  Each put stamps the vector's contents with the
+    version it was inserted at, so a violation is self-evident in the
+    returned bytes.
+    """
+    import numpy as np
+
+    from bigdl_tpu.nn import HotRowCache
+
+    cache = HotRowCache(capacity=64)
+    rows = list(range(32))
+    stop = threading.Event()
+
+    def invalidator():
+        while not stop.is_set():
+            cache.bump_version()
+
+    inv = threading.Thread(target=invalidator)
+    inv.start()
+    try:
+        def work(i):
+            rng = np.random.RandomState(i)
+            for _ in range(400):
+                r = int(rng.choice(rows))
+                seen = cache.version
+                vec = cache.get(r)
+                if vec is not None:
+                    # the stamp rode in the payload: serving a version
+                    # older than one this thread already observed means
+                    # a retired row escaped
+                    assert int(vec[0]) >= seen
+                v = cache.version
+                ok = cache.put(r, np.full(4, float(v)), v)
+                if ok:
+                    # an accepted put was current AT INSERT; it may be
+                    # retired by now, which get must then refuse
+                    got = cache.get(r)
+                    if got is not None:
+                        assert int(got[0]) >= v
+
+        _hammer(work)
+    finally:
+        stop.set()
+        inv.join(timeout=10)
+        assert not inv.is_alive()
+    snap = cache.snapshot()
+    # the invalidator guarantees both guard paths actually exercised
+    assert snap["stale_evictions"] > 0 or snap["rejected_puts"] > 0
+
+
+def test_hot_row_cache_put_refuses_retired_version():
+    """The lost-invalidation guard, deterministically: a put stamped
+    with a version the cache has moved past is refused, never
+    inserted."""
+    import numpy as np
+
+    from bigdl_tpu.nn import HotRowCache
+
+    cache = HotRowCache(capacity=8)
+    v0 = cache.version
+    assert cache.put(1, np.ones(2), v0)
+    cache.bump_version()
+    assert not cache.put(2, np.ones(2), v0)      # retired stamp
+    assert cache.get(2) is None
+    assert cache.get(1) is None                  # retired entry evicted
+    snap = cache.snapshot()
+    assert snap["rejected_puts"] == 1
+    assert snap["stale_evictions"] == 1
+    assert len(cache) == 0
