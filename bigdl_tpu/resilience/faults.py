@@ -713,3 +713,76 @@ def kill_host_mid_repartition(host: str):
     return _elastic_fault_entry(_MIGRATION_LOCK, _MIGRATION_FAULTS, {
         "kind": "kill", "host": str(host), "remaining": 1,
         "fired": 0})
+
+
+# ---------------------------------------------------------------------------
+# multi-tenant (registry + admission) faults
+# ---------------------------------------------------------------------------
+# Two deterministic injectors for the multi-tenant serving layer
+# (serving/registry.py).  ``tenant_flood`` is an open-loop overload on
+# one tenant: the AdmissionController consults check_tenant_flood() at
+# every admission decision and counts the armed phantom inflight units
+# against that tenant's quota — the flooding tenant hits its weighted
+# budget and sheds typed OVERLOADED while every other tenant's budget
+# is untouched, with no wall-clock race.  ``unregister_model_mid_
+# flight`` vanishes a registry entry at the model's next lookup (one
+# consume), so requests already queued for it must resolve typed
+# NOT_FOUND with their admission slots and KV pages released.
+
+_TENANT_LOCK = threading.Lock()
+_TENANT_FAULTS: list = []  # [dict(kind, tenant|model, remaining, fired, rps?)]
+
+
+def check_tenant_flood(tenant: str) -> int:
+    """Called by the AdmissionController at each admission decision:
+    returns the phantom inflight units armed against ``tenant`` (the
+    simulated open-loop flood, counted against its quota), consuming
+    one budget unit per call.  0 (and free) when nothing is armed."""
+    if not _TENANT_FAULTS:
+        return 0
+    with _TENANT_LOCK:
+        for f in _TENANT_FAULTS:
+            if (f["kind"] == "flood" and f["tenant"] == tenant
+                    and f["remaining"] > 0):
+                f["remaining"] -= 1
+                f["fired"] += 1
+                return int(f["rps"])
+    return 0
+
+
+def check_registry_fault(model: str) -> bool:
+    """Called by the ModelRegistry at each lookup: True when an armed
+    ``unregister_model_mid_flight`` fault fires for ``model`` (the
+    registry then drops the entry — requests queued for it resolve
+    typed NOT_FOUND).  No-op (and free) when nothing is armed."""
+    if not _TENANT_FAULTS:
+        return False
+    with _TENANT_LOCK:
+        for f in _TENANT_FAULTS:
+            if (f["kind"] == "unregister" and f["model"] == model
+                    and f["remaining"] > 0):
+                f["remaining"] -= 1
+                f["fired"] += 1
+                return True
+    return False
+
+
+def tenant_flood(tenant: str, rps: int, times: int = 1 << 30):
+    """Open-loop overload on ``tenant``: the next ``times`` admission
+    decisions see ``rps`` phantom inflight requests charged against its
+    quota, so the flooding tenant saturates its weighted budget and
+    sheds typed OVERLOADED while under-quota tenants keep their full
+    budget — the noisy-neighbor case admission control must contain."""
+    return _elastic_fault_entry(_TENANT_LOCK, _TENANT_FAULTS, {
+        "kind": "flood", "tenant": str(tenant), "rps": int(rps),
+        "remaining": int(times), "fired": 0})
+
+
+def unregister_model_mid_flight(model: str):
+    """Vanish ``model``'s registry entry at its next lookup, with
+    requests still queued for it: every queued request must resolve
+    typed NOT_FOUND (never INTERNAL_ERROR), its admission slot released
+    and its KV pages returned to the pool."""
+    return _elastic_fault_entry(_TENANT_LOCK, _TENANT_FAULTS, {
+        "kind": "unregister", "model": str(model), "remaining": 1,
+        "fired": 0})

@@ -50,7 +50,7 @@ import time
 from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
-from .pools import serves_phase
+from .pools import serves_phase, split_pool
 
 log = logging.getLogger("bigdl_tpu")
 
@@ -110,9 +110,13 @@ class Autoscaler:
     replica_factory : ``(replica_id, role) -> InferenceServer`` —
         builds an UNSTARTED server for a scale-up;
         :meth:`~.fleet.ServingFleet.add_replica` starts it.
-    pools : role pools to manage; defaults to the distinct roles the
-        fleet's replicas advertise (a homogeneous fleet scales its one
-        ``both`` pool).
+    pools : pools to manage.  A pool spec is a bare role
+        (``"decode"``) or a tenant-scoped ``"model:role"``
+        (:func:`~.pools.split_pool`), so a multi-tenant fleet sizes
+        each (model, phase) pool independently.  Defaults to the
+        distinct (model, role) combinations the fleet's replicas
+        advertise — a homogeneous single-model fleet scales its one
+        ``both`` pool exactly as before.
     policy / policies : one shared :class:`AutoscalePolicy` or a
         per-pool dict.
     """
@@ -130,8 +134,12 @@ class Autoscaler:
         self.fleet = fleet
         self.replica_factory = replica_factory
         if pools is None:
-            pools = tuple(sorted({getattr(s, "role", "both")
-                                  for s in fleet.servers.values()}))
+            combos = set()
+            for s in fleet.servers.values():
+                role = getattr(s, "role", "both")
+                m = getattr(s, "model_name", None)
+                combos.add(role if m is None else f"{m}:{role}")
+            pools = tuple(sorted(combos))
         self.pools = tuple(pools)
         base = policy or AutoscalePolicy()
         self.policies = {p: (policies or {}).get(p, base)
@@ -275,10 +283,12 @@ class Autoscaler:
         """Health snapshots of the replicas serving ``pool`` — the
         SAME view the router routes on.  A replica with no snapshot
         yet contributes nothing (it is not routable either)."""
+        model, role = split_pool(pool)
         out = {}
         for rid in self.fleet.servers:
             h = self.fleet.router.health_of(rid)
-            if h is not None and serves_phase(h.get("role"), pool):
+            if h is not None and serves_phase(h.get("role"), role) \
+                    and (model is None or h.get("model") == model):
                 out[rid] = h
         return out
 
@@ -314,11 +324,16 @@ class Autoscaler:
         }
 
     def pool_size(self, pool: str) -> int:
-        """Replicas whose EXACT role is ``pool`` — what scaling
-        actuates (a ``both`` member is never retired by a phase
-        pool's scale-down)."""
-        return sum(1 for s in self.fleet.servers.values()
-                   if getattr(s, "role", "both") == pool)
+        """Replicas whose EXACT role (and model, for a tenant-scoped
+        pool) matches ``pool`` — what scaling actuates (a ``both``
+        member is never retired by a phase pool's scale-down, and one
+        model's pool never retires another model's replica)."""
+        model, role = split_pool(pool)
+        return sum(
+            1 for s in self.fleet.servers.values()
+            if getattr(s, "role", "both") == role
+            and (model is None
+                 or getattr(s, "model_name", None) == model))
 
     def replica_counts(self) -> Dict[str, int]:
         """{pool: replica count} — one timeline sample for the bench."""
@@ -339,7 +354,9 @@ class Autoscaler:
     def _scale_up(self, pool: str, reason: str, signals: dict):
         st = self._state[pool]
         st.spawned += 1
-        rid = f"{pool}-as{st.spawned}"
+        # "model:role" pools keep the fleet's dash-separated replica
+        # naming ("alpha:decode" spawns "alpha-decode-as1")
+        rid = f"{pool.replace(':', '-')}-as{st.spawned}"
         server = self.replica_factory(rid, pool)
         self.fleet.add_replica(rid, server)
         st.last_action_t = self._clock()
@@ -350,11 +367,16 @@ class Autoscaler:
     def _retire_candidate(self, pool: str) -> Optional[str]:
         """Last-in-first-out: prefer autoscaler-spawned replicas (the
         capacity this loop added), newest name first."""
-        exact = sorted(rid for rid, s in self.fleet.servers.items()
-                       if getattr(s, "role", "both") == pool)
+        model, role = split_pool(pool)
+        exact = sorted(
+            rid for rid, s in self.fleet.servers.items()
+            if getattr(s, "role", "both") == role
+            and (model is None
+                 or getattr(s, "model_name", None) == model))
         if not exact:
             return None
-        spawned = [r for r in exact if f"{pool}-as" in r]
+        marker = f"{pool.replace(':', '-')}-as"
+        spawned = [r for r in exact if marker in r]
         return (spawned or exact)[-1]
 
     def _scale_down(self, pool: str, reason: str, signals: dict):

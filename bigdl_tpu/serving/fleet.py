@@ -92,6 +92,11 @@ class ReplicaAgent:
             "queue_depth": h["queue_depth"],
             "breaker_state": h["breaker"]["state"],
             "role": h.get("role", "both"),
+            # multi-tenant fleets: which (model, version) this replica
+            # advertises — the router routes model-addressed requests
+            # over the advertising subset only
+            "model": h.get("model"),
+            "model_version": h.get("model_version"),
             "p99_s": m._lat.quantile(0.99),
             "served_ok": int(m.counts["ok"]),
             # shed/total ride along so the autoscaler can derive a
@@ -233,15 +238,18 @@ class ServingFleet:
                 self, clock=clock, **(health_kw or {}))
         self.deploys = 0
         self.deploy_rollbacks = 0
-        # deploy-in-flight mutual exclusion: rolling_swap and
-        # rollback_last_deploy are fleet-wide critical sections — a
-        # second concurrent attempt is refused typed (DeployInFlight),
-        # never queued, so two rolls can never interleave partial
-        # installs across the replica set
-        self._deploy_lock = threading.Lock()
-        # the last completed roll's [(rid, (prior_params, prior_bufs))]
+        # deploy-in-flight mutual exclusion, PER REPLICA: a roll
+        # acquires (non-blocking, sorted — no deadlock) the lock of
+        # every replica it will touch, so two model-scoped deploys on
+        # disjoint replica sets proceed concurrently while any overlap
+        # — including two fleet-wide rolls — is refused typed
+        # (DeployInFlight), never queued, before any replica is touched
+        self._deploy_table_lock = threading.Lock()
+        self._deploy_locks: Dict[str, threading.Lock] = {}
+        # the last completed roll per deploy scope (model name, or
+        # None for a fleet-wide roll): [(rid, prior, prior_version)]
         # — what an alert-driven rollback_last_deploy() re-installs
-        self._last_deploy: list = []
+        self._last_deploy: Dict[Optional[str], list] = {}
         self._pump_thread: Optional[threading.Thread] = None
         self._stop_pump = threading.Event()
 
@@ -315,6 +323,61 @@ class ServingFleet:
             servers[rid] = InferenceServer(model, name=rid, **kw)
         return cls(servers, transport, **fleet_kw)
 
+    @classmethod
+    def build_multi(cls, models: Dict[str, object],
+                    n_replicas_each: int = 2, transport=None,
+                    server_kw: Optional[dict] = None,
+                    versions: Optional[Dict[str, str]] = None,
+                    quotas: Optional[Dict[str, float]] = None,
+                    admission_capacity: Optional[int] = None,
+                    deadline_budgets: Optional[Dict[str, float]] = None,
+                    kv_pages: Optional[int] = None,
+                    kv_page_size: int = 16,
+                    **fleet_kw) -> "ServingFleet":
+        """Stamp out a multi-tenant fleet: ``n_replicas_each`` replicas
+        per model (named ``<model>-r<i>``), each advertising its
+        (model, version) through the health snapshot the router routes
+        on, behind one pre-wired
+        :class:`~.registry.ModelRegistry` and
+        :class:`~.registry.AdmissionController`.
+
+        ``quotas`` are per-tenant admission weights (default: equal
+        weight per model), ``admission_capacity`` the fleet-wide
+        inflight ceiling the weights slice (default: 4 × replicas),
+        ``deadline_budgets`` optional per-tenant deadline ceilings.
+        ``kv_pages`` gives every replica its own paged pool whose
+        ``default_owner`` is the replica's model, so decoder-internal
+        page allocations are charged to the right tenant."""
+        from .registry import AdmissionController, ModelRegistry
+
+        registry = ModelRegistry()
+        servers: Dict[str, InferenceServer] = {}
+        for model_name in sorted(models):
+            model = models[model_name]
+            version = (versions or {}).get(model_name, "v1")
+            registry.register(model_name, version)
+            for i in range(int(n_replicas_each)):
+                rid = f"{model_name}-r{i}"
+                kw = dict(server_kw or {})
+                if kv_pages:
+                    from .kvpool import KVPagePool
+
+                    kw["kv_pool"] = KVPagePool.for_model(
+                        model, kv_pages, page_size=kv_page_size)
+                servers[rid] = InferenceServer(
+                    model, name=rid, model_name=model_name,
+                    model_version=version, **kw)
+        admission = AdmissionController(
+            admission_capacity if admission_capacity is not None
+            else 4 * len(servers),
+            quotas=quotas if quotas is not None
+            else {m: 1.0 for m in models},
+            deadline_budgets=deadline_budgets)
+        router_kw = dict(fleet_kw.pop("router_kw", None) or {})
+        router_kw.setdefault("model_registry", registry)
+        router_kw.setdefault("admission", admission)
+        return cls(servers, transport, router_kw=router_kw, **fleet_kw)
+
     # ------------------------------------------------------------ lifecycle
     def start(self) -> "ServingFleet":
         for srv in self.servers.values():
@@ -365,8 +428,8 @@ class ServingFleet:
         return ok
 
     # ------------------------------------------------------------ routing
-    def submit(self, feature, deadline_s=None):
-        return self.router.submit(feature, deadline_s=deadline_s)
+    def submit(self, feature, deadline_s=None, **kw):
+        return self.router.submit(feature, deadline_s=deadline_s, **kw)
 
     def submit_generate(self, prompt_ids, max_new, **kw):
         return self.router.submit_generate(prompt_ids, max_new, **kw)
@@ -446,9 +509,40 @@ class ServingFleet:
         return srv
 
     # ------------------------------------------------------------ deploys
+    def _acquire_deploy_locks(self, rids):
+        """Non-blocking, sorted acquisition of the per-replica deploy
+        locks for ``rids``.  Any lock already held means another
+        deploy/rollback is touching an overlapping replica set —
+        everything taken so far is released and the whole operation is
+        refused typed (:class:`~.swap.DeployInFlight`) before any
+        replica is touched.  Sorted order keeps two overlapping
+        acquisitions deadlock-free."""
+        acquired = []
+        for rid in sorted(set(rids)):
+            with self._deploy_table_lock:
+                lk = self._deploy_locks.setdefault(
+                    rid, threading.Lock())
+            if not lk.acquire(blocking=False):
+                for got in reversed(acquired):
+                    got.release()
+                raise DeployInFlight(
+                    f"a deploy is already in flight on replica {rid} "
+                    f"— refused before touching any replica")
+            acquired.append(lk)
+        return acquired
+
     def rolling_swap(self, params=None, path: Optional[str] = None,
-                     order=None) -> int:
-        """Fleet-wide verified deploy, one replica at a time.
+                     order=None, model: Optional[str] = None,
+                     version: Optional[str] = None) -> int:
+        """Verified deploy, one replica at a time.
+
+        ``model`` scopes the roll to the replicas serving that model
+        (a tenant-scoped deploy on a multi-tenant fleet — replicas of
+        other models are never locked, never touched); ``model=None``
+        rolls the whole fleet.  ``version`` stamps the installed
+        params' advertised model version (health snapshots and the
+        model registry pick it up), and a rollback re-installs the
+        prior version alongside the prior params.
 
         ``path`` loads ONCE through the crc32c-verified checkpoint
         path (corrupt bytes refuse the whole deploy before any replica
@@ -456,52 +550,65 @@ class ServingFleet:
         :meth:`~.server.InferenceServer.swap_params`; the first
         :class:`SwapRejected` halts the roll and **rolls back every
         already-swapped replica** to its captured prior params.
-        Before each replica swaps, the fleet must hold
-        ``ready_quorum`` ready replicas (the install is atomic between
-        batches and a failed canary leaves the old params serving, so
-        the target itself stays in rotation — the guard is against
-        rolling a deploy through an already-degraded fleet) —
-        otherwise :class:`FleetQuorumError` (and rollback of anything
-        already swapped).  Returns the number of replicas deployed.
+        Before each replica swaps, the deploy scope must hold its
+        ready quorum (fleet-wide: ``ready_quorum``; model-scoped: a
+        strict majority of that model's replicas) — otherwise
+        :class:`FleetQuorumError` (and rollback of anything already
+        swapped).  Returns the number of replicas deployed.
 
         Replicas that are not healthy (killed, draining) are skipped —
         they pick up current params through the normal swap path when
         they come back.
 
-        Exactly one deploy (or alert-driven rollback) may be in flight
-        fleet-wide: a concurrent attempt raises
+        Mutual exclusion is per replica: a concurrent deploy/rollback
+        touching ANY overlapping replica raises
         :class:`~.swap.DeployInFlight` immediately, before any replica
-        is touched.
+        is touched, while deploys on disjoint models proceed
+        concurrently.
         """
         if (params is None) == (path is None):
             raise ValueError("pass exactly one of params/path")
-        if not self._deploy_lock.acquire(blocking=False):
-            raise DeployInFlight(
-                "a rolling deploy is already in flight on this fleet "
-                "— refused before touching any replica")
+        if model is not None:
+            targets = sorted(
+                rid for rid, srv in self.servers.items()
+                if getattr(srv, "model_name", None) == model)
+            if not targets:
+                raise ValueError(
+                    f"no replica serves model {model!r}")
+        else:
+            targets = sorted(self.servers)
+        if order is not None:
+            known = set(targets)
+            order = [rid for rid in order if rid in known]
+        else:
+            order = targets
+        locks = self._acquire_deploy_locks(targets)
         try:
             if path is not None:
                 params = load_verified_params(path)
-            order = list(order) if order is not None \
-                else sorted(self.servers)
-            done = []  # [(rid, (prior_params, prior_buffers))]
+            quorum = (self.ready_quorum if model is None
+                      else len(targets) // 2 + 1)
+            done = []  # [(rid, (prior_params, prior_bufs), prior_ver)]
             for rid in order:
                 srv = self.servers.get(rid)
                 if srv is None or not srv.healthy():
                     log.warning("fleet: deploy skipping unhealthy "
                                 "replica %s", rid)
                     continue
-                ready = self.ready_count()
-                if ready < self.ready_quorum:
+                ready = (self.ready_count() if model is None else
+                         sum(1 for r in targets
+                             if self.servers[r].ready()))
+                if ready < quorum:
                     self._rollback(done)
                     self.deploy_rollbacks += 1
                     raise FleetQuorumError(
                         f"deploy halted before {rid}: only {ready} "
-                        f"replica(s) ready, quorum is "
-                        f"{self.ready_quorum} — fleet rolled back")
+                        f"replica(s) ready, quorum is {quorum} — "
+                        f"rolled back")
                 prior = srv.current_params()
+                prior_version = getattr(srv, "model_version", None)
                 try:
-                    srv.swap_params(params=params)
+                    srv.swap_params(params=params, version=version)
                 except SwapRejected as e:
                     self._rollback(done)
                     self.deploy_rollbacks += 1
@@ -509,47 +616,66 @@ class ServingFleet:
                         f"rolling deploy halted at {rid}: {e} — "
                         f"{len(done)} already-swapped replica(s) "
                         f"rolled back")
-                done.append((rid, prior))
+                done.append((rid, prior, prior_version))
                 log.info("fleet: deployed to %s (%d/%d)", rid,
                          len(done), len(order))
             self.deploys += 1
-            self._last_deploy = done
+            with self._deploy_table_lock:
+                self._last_deploy[model] = done
+            if (model is not None and version is not None
+                    and self.router.model_registry is not None):
+                # advertise the new version fleet-wide (per-replica
+                # health snapshots catch up at the next pump)
+                self.router.model_registry.register(model, version)
             return len(done)
         finally:
-            self._deploy_lock.release()
+            for lk in reversed(locks):
+                lk.release()
 
-    def rollback_last_deploy(self) -> int:
-        """Roll every replica of the last completed deploy back to its
-        captured prior params — the alert-driven entry point the
-        continuous-learning loop fires when the post-swap burn-rate
-        watch trips.  The rollback rides the same verified canary
-        install path as a deploy (each re-install records
-        ``outcome="rolled_back"``), holds the same deploy-in-flight
-        mutual exclusion, and consumes the captured set: a second call
-        with nothing newer deployed is a no-op returning 0."""
-        if not self._deploy_lock.acquire(blocking=False):
-            raise DeployInFlight(
-                "a rolling deploy is in flight — rollback refused; "
-                "retry after it settles")
+    def rollback_last_deploy(self, model: Optional[str] = None) -> int:
+        """Roll every replica of the last completed deploy (for
+        ``model``'s scope; ``None`` = the last fleet-wide roll) back
+        to its captured prior params — the alert-driven entry point
+        the continuous-learning loop fires when the post-swap
+        burn-rate watch trips.  The rollback rides the same verified
+        canary install path as a deploy (each re-install records
+        ``outcome="rolled_back"``), holds the same per-replica deploy
+        locks, and consumes the captured set: a second call with
+        nothing newer deployed is a no-op returning 0."""
+        with self._deploy_table_lock:
+            pending = list(self._last_deploy.get(model, ()))
+        if not pending:
+            return 0
+        locks = self._acquire_deploy_locks(e[0] for e in pending)
         try:
-            done, self._last_deploy = self._last_deploy, []
+            with self._deploy_table_lock:
+                done = self._last_deploy.pop(model, [])
             if not done:
                 return 0
             self._rollback(done)
             self.deploy_rollbacks += 1
+            if (model is not None
+                    and self.router.model_registry is not None
+                    and done[0][2] is not None):
+                # re-advertise the prior version alongside the prior
+                # params
+                self.router.model_registry.register(model, done[0][2])
             log.warning("fleet: alert-driven rollback re-installed "
                         "prior params on %d replica(s)", len(done))
             return len(done)
         finally:
-            self._deploy_lock.release()
+            for lk in reversed(locks):
+                lk.release()
 
     def _rollback(self, done):
-        for rid, (prior_params, prior_buffers) in reversed(done):
+        for rid, (prior_params, prior_buffers), prior_version \
+                in reversed(done):
             try:
                 # the rollback rides the full verified install path
                 # (canary included) — only its counter outcome differs
                 self.servers[rid].swap_params(params=prior_params,
                                               buffers=prior_buffers,
+                                              version=prior_version,
                                               outcome="rolled_back")
             except SwapRejected:
                 # the prior params were serving seconds ago; a canary
@@ -604,6 +730,10 @@ class ServingFleet:
         # the continuous-learning loop registers its deploy outcomes
         # in the router registry, so they fold into the fleet view too
         "bigdl_loop_deploys_total",
+        # multi-tenant families only the router populates (admission
+        # decisions, per-tenant dispatch, inflight gauge, typed sheds)
+        "bigdl_tenant_dispatch_total", "bigdl_tenant_admission_total",
+        "bigdl_tenant_inflight", "bigdl_tenant_sheds_total",
     )
 
     def _router_fold_metrics(self) -> dict:
@@ -636,6 +766,9 @@ class ServingFleet:
             },
             "deploys": self.deploys,
             "deploy_rollbacks": self.deploy_rollbacks,
+            # per-tenant request/shed fold (router-side attribution —
+            # one row per tenant, empty dict on single-model fleets)
+            "tenants": self.router.metrics.tenants(),
             "goodput_per_chip": self.goodput_per_chip(),
             "health": (self.health_monitor.snapshot()
                        if self.health_monitor is not None else None),
@@ -659,11 +792,17 @@ class ServingFleet:
         n, _ = self.router.coordinator.membership()
         paths = []
         for rid, srv in sorted(self.servers.items()):
+            serving = srv.metrics.snapshot()
+            # the router's tenants map is the authoritative per-tenant
+            # accounting (it sees every request, including sheds that
+            # never reach a replica); the replicas' copies would
+            # double-count against it in the merge
+            serving.pop("tenants", None)
             payload = {
                 "host": rid,
                 "incarnation": n,
                 "metrics": srv.metrics.registry.snapshot()["metrics"],
-                "serving": srv.metrics.snapshot(),
+                "serving": serving,
             }
             paths.append(write_snapshot(directory, rid, payload))
         paths.append(write_snapshot(directory, "fleet-router", {

@@ -89,6 +89,9 @@ class FleetHealthMonitor:
             clock=self._clock)
         #: replica -> its rule names (installed lazily on first feed)
         self._replica_rules: Dict[str, List[str]] = {}
+        #: replica -> the label set its rules/series were installed
+        #: under (includes ``model`` on multi-tenant fleets)
+        self._replica_labels: Dict[str, Dict[str, str]] = {}
         #: last-seen health publish stamp per replica — a KV snapshot
         #: that stopped CHANGING is a dead feed, however fresh the
         #: router's last read of it looks
@@ -97,9 +100,17 @@ class FleetHealthMonitor:
         self._marked: Dict[str, bool] = {}
 
     # ------------------------------------------------------------ rules
-    def _rules_for(self, rid: str) -> List[SloRule]:
+    def _rules_for(self, rid: str,
+                   model: Optional[str] = None) -> List[SloRule]:
         p = self.policy
+        # multi-tenant fleets label the replica's rules (and therefore
+        # its alerts) with the model it advertises, so a firing rule
+        # attributes to ONE tenant — and since a replica serves one
+        # model, marking it degraded ejects capacity from that tenant
+        # only, never unrouting the other tenants' replicas
         L = {"replica": rid}
+        if model is not None:
+            L = {"replica": rid, "model": str(model)}
         return [
             SloRule(name=f"replica/{rid}/p99",
                     family=M.REPLICA_P99_SECONDS, labels=L,
@@ -130,17 +141,19 @@ class FleetHealthMonitor:
                                 f"silent"),
         ]
 
-    def _ensure_rules(self, rid: str):
+    def _ensure_rules(self, rid: str, model: Optional[str] = None):
         if rid in self._replica_rules:
             return
-        rules = self._rules_for(rid)
+        rules = self._rules_for(rid, model=model)
         for rule in rules:
             self.engine.add_rule(rule)
         self._replica_rules[rid] = [r.name for r in rules]
+        self._replica_labels[rid] = dict(rules[0].labels)
 
     def _retire_rules(self, rid: str):
         for name in self._replica_rules.pop(rid, ()):
             self.engine.remove_rule(name)
+        self._replica_labels.pop(rid, None)
         self._last_ts.pop(rid, None)
         if self._marked.pop(rid, None):
             self.fleet.router.clear_degraded(rid)
@@ -163,8 +176,8 @@ class FleetHealthMonitor:
             if self._last_ts.get(rid) == ts:
                 continue               # feed stopped: let it go stale
             self._last_ts[rid] = ts
-            self._ensure_rules(rid)
-            L = {"replica": rid}
+            self._ensure_rules(rid, model=h.get("model"))
+            L = self._replica_labels[rid]
             r = self.recorder
             if h.get("p99_s") is not None:
                 r.observe(M.REPLICA_P99_SECONDS, float(h["p99_s"]),

@@ -73,26 +73,29 @@ class PageLease:
     ``release`` is idempotent (the exhaustion/cancel/kill paths may
     race a finally-block release)."""
 
-    __slots__ = ("pool", "pages", "_released")
+    __slots__ = ("pool", "pages", "owner", "_released")
 
-    def __init__(self, pool: "KVPagePool", pages: List[int]):
+    def __init__(self, pool: "KVPagePool", pages: List[int],
+                 owner: Optional[str] = None):
         self.pool = pool
         self.pages = list(pages)
+        self.owner = owner
         self._released = False
 
     def extend(self, n: int = 1) -> None:
         """Grow by ``n`` pages (raises :class:`PoolExhausted` — the
         already-held pages stay held; the caller decides whether to
-        shed and release)."""
+        shed and release).  Growth is charged to the lease's owner, so
+        a long decode keeps paying against its tenant's page budget."""
         if self._released:
             raise RuntimeError("lease already released")
-        self.pages.extend(self.pool._take(n))
+        self.pages.extend(self.pool._take(n, self.owner))
 
     def release(self) -> None:
         if self._released:
             return
         self._released = True
-        self.pool._give(self.pages)
+        self.pool._give(self.pages, self.owner)
 
     @property
     def released(self) -> bool:
@@ -139,6 +142,19 @@ class KVPagePool:
         self.frees = 0
         self.exhaustions = 0
         self.high_water = 0
+        # owner-scoped accounting (multi-tenant fleets): pages held and
+        # optional hard budgets per owner.  An owner over its budget is
+        # refused (PoolExhausted → typed OVERLOADED shed) even while
+        # the free list could cover it — one tenant's long decodes can
+        # never exhaust the shared arena for everyone else.
+        self._held = {}
+        self._budgets = {}
+        #: owner charged for allocations that don't name one — set to
+        #: the serving model's name so decoder-internal allocs (the
+        #: paged decode path allocates from inside models.generate)
+        #: land on the right tenant without plumbing owner through the
+        #: decoder
+        self.default_owner: Optional[str] = None
 
     # ------------------------------------------------------------ sizing
     @classmethod
@@ -225,8 +241,30 @@ class KVPagePool:
                 jnp.asarray(v_pages, dt))
 
     # ------------------------------------------------------------ alloc
-    def _take(self, n: int) -> List[int]:
+    def set_owner_budget(self, owner: str, pages: int) -> None:
+        """Cap ``owner`` at ``pages`` held pages — allocations past the
+        cap raise :class:`PoolExhausted` even with free pages, so the
+        over-budget owner sheds typed while other owners keep the
+        arena."""
         with self._lock:
+            self._budgets[str(owner)] = int(pages)
+
+    def owner_held(self, owner: str) -> int:
+        with self._lock:
+            return self._held.get(str(owner), 0)
+
+    def _take(self, n: int, owner: Optional[str] = None) -> List[int]:
+        if owner is None:
+            owner = self.default_owner
+        with self._lock:
+            if owner is not None:
+                held = self._held.get(owner, 0)
+                budget = self._budgets.get(owner)
+                if budget is not None and held + n > budget:
+                    self.exhaustions += 1
+                    raise PoolExhausted(
+                        f"owner {owner!r} needs {n} page(s) but holds "
+                        f"{held} of its {budget}-page budget")
             if n > len(self._free):
                 self.exhaustions += 1
                 raise PoolExhausted(
@@ -234,19 +272,29 @@ class KVPagePool:
                     f"{self.num_pages}")
             pages, self._free = self._free[:n], self._free[n:]
             self.allocs += n
+            if owner is not None:
+                self._held[owner] = self._held.get(owner, 0) + n
             in_use = self.num_pages - len(self._free)
             self.high_water = max(self.high_water, in_use)
             return pages
 
-    def _give(self, pages: List[int]) -> None:
+    def _give(self, pages: List[int],
+              owner: Optional[str] = None) -> None:
         with self._lock:
             self._free.extend(pages)
             self.frees += len(pages)
+            if owner is not None and owner in self._held:
+                self._held[owner] = max(
+                    0, self._held[owner] - len(pages))
 
-    def alloc(self, n: int) -> PageLease:
+    def alloc(self, n: int, owner: Optional[str] = None) -> PageLease:
         """Lease ``n`` pages (raises :class:`PoolExhausted` when the
-        free list cannot cover it — shed, don't wait)."""
-        return PageLease(self, self._take(n))
+        free list cannot cover it — shed, don't wait).  ``owner``
+        (default: the pool's ``default_owner``) is charged for the
+        pages against its optional budget."""
+        if owner is None:
+            owner = self.default_owner
+        return PageLease(self, self._take(n, owner), owner)
 
     @property
     def free_pages(self) -> int:
@@ -259,7 +307,9 @@ class KVPagePool:
     def stats(self) -> dict:
         with self._lock:
             free = len(self._free)
+            by_owner = {o: h for o, h in self._held.items() if h}
         return {
+            "by_owner": by_owner,
             "num_pages": self.num_pages,
             "free_pages": free,
             "in_use": self.num_pages - free,

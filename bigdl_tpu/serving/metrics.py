@@ -99,6 +99,39 @@ class ServingMetrics:
             "decode seconds per generated token "
             "(time-per-output-token)",
             bounds=_LATENCY_BUCKETS, window=window)
+        # per-tenant twins of the request/shed/phase families.  The
+        # registry pins each family to ONE label tuple, so tenant
+        # observability lives in parallel bigdl_tenant_* families
+        # (metric_names.py) instead of widening the existing ones;
+        # series only appear for requests that actually carry a tenant,
+        # so single-model fleets pay nothing
+        self._tenant_requests = self.registry.counter(
+            "bigdl_tenant_requests_total",
+            "terminal request statuses per tenant",
+            labels=("tenant", "status"))
+        self._tenant_sheds = self.registry.counter(
+            "bigdl_tenant_sheds_total",
+            "admission rejections per tenant (reason: tenant_quota = "
+            "weighted fair shed of the over-quota tenant, global = "
+            "fleet-wide exhaustion, not_found = unregistered model)",
+            labels=("tenant", "reason"))
+        self._tenant_phase = self.registry.histogram(
+            "bigdl_tenant_phase_seconds",
+            "wall seconds per generation phase per tenant",
+            labels=("tenant", "phase"), bounds=_LATENCY_BUCKETS,
+            window=window)
+        self._tenant_ttft = self.registry.histogram(
+            "bigdl_tenant_ttft_seconds",
+            "time-to-first-token per tenant",
+            labels=("tenant",), bounds=_LATENCY_BUCKETS, window=window)
+        self._tenant_tpot = self.registry.histogram(
+            "bigdl_tenant_tpot_seconds",
+            "time-per-output-token per tenant",
+            labels=("tenant",), bounds=_LATENCY_BUCKETS, window=window)
+        self._tenant_kv_held = self.registry.gauge(
+            "bigdl_tenant_kv_pages_held",
+            "KV pages currently held per pool owner",
+            labels=("tenant",))
         # KV page-pool occupancy gauges (zero-valued when the server
         # has no pool — the fleet fold may sum them safely)
         self._kv_total = self.registry.gauge(
@@ -142,17 +175,29 @@ class ServingMetrics:
     # ------------------------------------------------------------------
     def record(self, status: Status, latency_s: float = 0.0,
                queued_s: float = 0.0,
-               trace_id: Optional[str] = None):
+               trace_id: Optional[str] = None,
+               tenant: Optional[str] = None):
         """One terminal request outcome.  ``trace_id`` (a KEPT
         distributed trace) attaches as a Prometheus-style exemplar to
         the latency bucket the request landed in — the scraped
-        histogram links straight to a stitched timeline."""
+        histogram links straight to a stitched timeline.  ``tenant``
+        additionally lands the outcome in the per-tenant twin family."""
         with self._lock:
             self.counts[status.value] += 1
         self._requests.labels(status=status.value).inc()
+        if tenant is not None:
+            self._tenant_requests.labels(
+                tenant=str(tenant), status=status.value).inc()
         if status is Status.OK:
             self._lat.observe(latency_s, exemplar=trace_id)
             self._queued.observe(queued_s)
+
+    def record_shed(self, tenant: str, reason: str):
+        """One per-tenant admission rejection (``tenant_quota`` |
+        ``global`` | ``not_found``) — the series the weighted-shed
+        ordering and victim-sheds-zero audits read."""
+        self._tenant_sheds.labels(tenant=str(tenant),
+                                  reason=str(reason)).inc()
 
     def record_depth(self, depth: int):
         self._depth.observe(depth)
@@ -192,15 +237,23 @@ class ServingMetrics:
     def record_retry(self):
         self._retries.inc()
 
-    def record_phase(self, phase: str, seconds: float):
+    def record_phase(self, phase: str, seconds: float,
+                     tenant: Optional[str] = None):
         """One generation phase's wall time (``prefill`` | ``decode``)."""
         self._phase.labels(phase=phase).observe(seconds)
+        if tenant is not None:
+            self._tenant_phase.labels(
+                tenant=str(tenant), phase=phase).observe(seconds)
 
-    def record_ttft(self, seconds: float):
+    def record_ttft(self, seconds: float, tenant: Optional[str] = None):
         self._ttft.observe(seconds)
+        if tenant is not None:
+            self._tenant_ttft.labels(tenant=str(tenant)).observe(seconds)
 
-    def record_tpot(self, seconds: float):
+    def record_tpot(self, seconds: float, tenant: Optional[str] = None):
         self._tpot.observe(seconds)
+        if tenant is not None:
+            self._tenant_tpot.labels(tenant=str(tenant)).observe(seconds)
 
     def set_kv_pool(self, stats: Optional[dict]):
         """Refresh the KV page-pool gauges from
@@ -210,6 +263,9 @@ class ServingMetrics:
         self._kv_total.set(float(stats.get("num_pages", 0)))
         self._kv_free.set(float(stats.get("free_pages", 0)))
         self._kv_occupancy.set(float(stats.get("occupancy", 0.0)))
+        for owner, held in (stats.get("by_owner") or {}).items():
+            self._tenant_kv_held.labels(tenant=str(owner)).set(
+                float(held))
 
     def _counter_value(self, name: str, **labels) -> int:
         fam = self.registry.get(name)
@@ -321,6 +377,34 @@ class ServingMetrics:
                 pass
         return out
 
+    def tenants(self) -> dict:
+        """Per-tenant request/shed counts folded from the tenant twin
+        families — {} on a fleet that never carried a tenant."""
+        out: Dict[str, dict] = {}
+
+        def _tenant(name):
+            return out.setdefault(
+                name, {"requests": {}, "sheds": {}, "total": 0,
+                       "served_ok": 0, "shed_total": 0})
+
+        fam = self.registry.get("bigdl_tenant_requests_total")
+        if fam is not None:
+            for lbl, child in fam.series():
+                d = _tenant(lbl.get("tenant"))
+                n = int(child.value)
+                d["requests"][lbl.get("status")] = n
+                d["total"] += n
+                if lbl.get("status") == Status.OK.value:
+                    d["served_ok"] += n
+        fam = self.registry.get("bigdl_tenant_sheds_total")
+        if fam is not None:
+            for lbl, child in fam.series():
+                d = _tenant(lbl.get("tenant"))
+                n = int(child.value)
+                d["sheds"][lbl.get("reason")] = n
+                d["shed_total"] += n
+        return out
+
     def snapshot(self) -> dict:
         gpc = self.goodput_per_chip()
         with self._lock:
@@ -337,6 +421,7 @@ class ServingMetrics:
             "internal_error":
                 counts[Status.INTERNAL_ERROR.value],
             "cancelled": counts[Status.CANCELLED.value],
+            "not_found": counts[Status.NOT_FOUND.value],
             "shed_rate": (counts[Status.OVERLOADED.value]
                           / total) if total else 0.0,
             "latency_p50_s": self._lat.quantile(0.50),
@@ -370,6 +455,7 @@ class ServingMetrics:
             "flops_total": gpc["flops_total"],
             "model_flops_per_sec": gpc["model_flops_per_sec"],
             "serving_mfu": gpc["mfu"],
+            "tenants": self.tenants(),
         }
 
     def to_summary(self, summary, step: int):
@@ -378,7 +464,7 @@ class ServingMetrics:
         (e.g. :class:`~bigdl_tpu.visualization.summary.ServingSummary`).
         """
         for key, val in self.snapshot().items():
-            if val is None:
+            if not isinstance(val, (int, float)):
                 continue
             summary.add_scalar(f"serving/{key}", float(val), step)
         return summary

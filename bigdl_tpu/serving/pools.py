@@ -32,7 +32,8 @@ from ..visualization.crc32c import crc32c
 
 __all__ = ["PREFILL", "DECODE", "BOTH", "ROLES", "HandoffCorrupt",
            "serialize_handoff", "deserialize_handoff",
-           "peek_handoff_trace", "serves_phase", "pool_members"]
+           "peek_handoff_trace", "serves_phase", "split_pool",
+           "pool_members"]
 
 PREFILL = "prefill"
 DECODE = "decode"
@@ -57,11 +58,27 @@ def serves_phase(role: Optional[str], phase: str) -> bool:
     return r == BOTH or r == phase
 
 
+def split_pool(pool: str) -> Tuple[Optional[str], str]:
+    """Parse a pool spec into ``(model, role)``.  A bare role
+    (``"decode"``) is the classic fleet-wide phase pool
+    (``(None, "decode")``); a ``"model:role"`` spec scopes the pool to
+    one tenant's replicas on a multi-tenant fleet — the autoscaler
+    sizes each (model, phase) pool independently."""
+    if ":" in pool:
+        model, role = pool.split(":", 1)
+        return model, role
+    return None, pool
+
+
 def pool_members(health: Dict[str, dict], phase: str) -> Tuple[str, ...]:
-    """Members of one phase pool, from the router's health view."""
+    """Members of one pool, from the router's health view.  ``phase``
+    accepts the same specs :func:`split_pool` does — a bare role or a
+    tenant-scoped ``model:role``."""
+    model, role = split_pool(phase)
     return tuple(sorted(
         r for r, h in health.items()
-        if serves_phase((h or {}).get("role"), phase)))
+        if serves_phase((h or {}).get("role"), role)
+        and (model is None or (h or {}).get("model") == model)))
 
 
 def serialize_handoff(k_pages: np.ndarray, v_pages: np.ndarray,

@@ -430,13 +430,21 @@ class RequestTracer:
             state.kept = True
             closed = [a for a in state.attempts if a.get("closed")]
             handoffs = list(state.handoffs)
+        # multi-tenant attribution rides the root span + kept summary:
+        # one kept trace names the tenant/model/version it served
+        tenancy = {}
+        if state.ctx.tenant is not None:
+            tenancy = {"tenant": state.ctx.tenant,
+                       "model": state.ctx.model,
+                       "model_version": state.ctx.model_version}
         self.sink.record(state.ctx, f"request:{state.kind}", "request",
                          state.t0, latency_s, kind=state.kind,
                          status=status, span_id=1,
                          deadline_s=state.deadline_s,
                          retried=state.retried, hedged=state.hedged,
                          keep_reason=reason,
-                         lost_attempts=sorted(state.lost_attempts))
+                         lost_attempts=sorted(state.lost_attempts),
+                         **tenancy)
         if state.queue_window is not None:
             self.sink.record(state.ctx, "router_queue", "queue",
                              state.queue_window[0],
@@ -453,6 +461,7 @@ class RequestTracer:
                 "reason": reason, "t0": state.t0,
                 "retried": state.retried, "hedged": state.hedged,
                 "lost_attempts": sorted(state.lost_attempts),
+                **tenancy,
             }
             while len(self._kept) > self.keep_max:
                 self._kept.popitem(last=False)
@@ -676,8 +685,11 @@ def trace_attribution(trace: dict) -> Optional[dict]:
     critical = ranked[0][1] if ranked else None
     busiest = max(by_replica.items(), key=lambda kv: kv[1])[0] \
         if by_replica else None
+    root_args = root.get("args") or {}
     return {
         "wall_s": wall,
+        "tenant": root_args.get("tenant"),
+        "model": root_args.get("model"),
         "phases": {p: round(s, 6) for p, s in sorted(phases.items())},
         "compute_by_replica": {h: round(s, 6)
                                for h, s in sorted(by_replica.items())},

@@ -110,10 +110,22 @@ class FleetRouter:
                  = None,
                  max_workers: int = 16,
                  tracing=None,
+                 model_registry=None,
+                 admission=None,
+                 default_model: Optional[str] = None,
                  clock: Callable[[], float] = time.monotonic):
         self.replicas = dict(replicas)
         self.coordinator = coordinator
         self.metrics = metrics or ServingMetrics()
+        #: multi-tenant routing (serving.registry): ``model_registry``
+        #: answers "does this model exist" at admission and per attempt
+        #: (a miss is typed NOT_FOUND — no queue slot, no retry burn);
+        #: ``admission`` enforces per-tenant weighted quotas with fair
+        #: shedding before global shedding.  Both None = single-model
+        #: fleet, zero new cost on the request path.
+        self.model_registry = model_registry
+        self.admission = admission
+        self.default_model = default_model
         self.max_attempts = max(1, int(max_attempts))
         self.default_deadline_s = default_deadline_s
         self.hedge = bool(hedge)
@@ -155,6 +167,18 @@ class FleetRouter:
             "bigdl_fleet_dispatch_total",
             "router dispatches per replica and terminal status",
             labels=("replica", "status"))
+        self._tenant_dispatch = self.metrics.registry.counter(
+            "bigdl_tenant_dispatch_total",
+            "router dispatches per tenant, replica and terminal "
+            "status", labels=("tenant", "replica", "status"))
+        self._tenant_admission = self.metrics.registry.counter(
+            "bigdl_tenant_admission_total",
+            "admission decisions per tenant (admitted | tenant_quota "
+            "| global | not_found)", labels=("tenant", "decision"))
+        self._tenant_inflight = self.metrics.registry.gauge(
+            "bigdl_tenant_inflight",
+            "admitted requests currently in flight per tenant",
+            labels=("tenant",))
         self.ejections = 0
         self.readmissions = 0
         self._pool = ThreadPoolExecutor(
@@ -322,15 +346,18 @@ class FleetRouter:
                 br = self._breakers[replica] = self._breaker_factory()
             return br
 
-    def _pick(self, exclude=(), phase: Optional[str] = None
-              ) -> Optional[str]:
+    def _pick(self, exclude=(), phase: Optional[str] = None,
+              model: Optional[str] = None) -> Optional[str]:
         """Least-loaded ready member outside ``exclude`` whose router-
         side breaker admits traffic, optionally restricted to the
         replicas serving ``phase`` (``prefill`` | ``decode`` — role
         advertised in the health snapshot, unreported roles count as
-        ``both``).  The breaker is only ``acquire``d on the replica
-        actually chosen, so a half-open probe slot is never burned on
-        a replica we don't dispatch to."""
+        ``both``) and/or advertising ``model`` (multi-tenant routing:
+        only replicas whose health snapshot names the model are
+        candidates — a replica that has not reported cannot prove it
+        serves the model and is skipped).  The breaker is only
+        ``acquire``d on the replica actually chosen, so a half-open
+        probe slot is never burned on a replica we don't dispatch to."""
         from .pools import serves_phase
 
         with self._lock:
@@ -348,6 +375,9 @@ class FleetRouter:
                 continue
             if phase is not None and not serves_phase(
                     (h or {}).get("role"), phase):
+                continue
+            if model is not None \
+                    and (h or {}).get("model") != model:
                 continue
             load = inflight.get(r, 0) + int(
                 (h or {}).get("queue_depth", 0))
@@ -370,7 +400,7 @@ class FleetRouter:
             if serves_phase((health.get(r) or {}).get("role"), phase)))
 
     def _resolve(self, fut: ServeFuture, result: ServeResult,
-                 t0: float, trace=None):
+                 t0: float, trace=None, tenant: Optional[str] = None):
         result.latency_s = self._clock() - t0
         kept = None
         if trace is not None:
@@ -385,55 +415,119 @@ class FleetRouter:
             result.trace_id = trace.ctx.trace_id
         self.metrics.record(
             result.status, result.latency_s, result.queued_s,
-            trace_id=(result.trace_id if kept else None))
+            trace_id=(result.trace_id if kept else None),
+            tenant=tenant)
         fut._resolve(result)
 
     def submit(self, feature,
-               deadline_s: Optional[float] = None) -> ServeFuture:
+               deadline_s: Optional[float] = None,
+               model: Optional[str] = None,
+               tenant: Optional[str] = None) -> ServeFuture:
         """Route one classification request across the fleet.  Returns
         a future that resolves to the winning replica's ServeResult
-        (or a typed router-level failure)."""
-        return self._enqueue("classify", feature, None, deadline_s)
+        (or a typed router-level failure).  ``model`` routes over the
+        replicas advertising it (typed NOT_FOUND when unregistered);
+        ``tenant`` names the quota the request admits under (defaults
+        to the model name)."""
+        return self._enqueue("classify", feature, None, deadline_s,
+                             model=model, tenant=tenant)
 
     def submit_generate(self, prompt_ids, max_new: int,
                         eos_id: Optional[int] = None,
                         pad_id: Optional[int] = None,
-                        deadline_s: Optional[float] = None
+                        deadline_s: Optional[float] = None,
+                        model: Optional[str] = None,
+                        tenant: Optional[str] = None
                         ) -> ServeFuture:
         """Route one generation request across the fleet."""
         return self._enqueue("generate", prompt_ids,
-                             (int(max_new), eos_id, pad_id), deadline_s)
+                             (int(max_new), eos_id, pad_id), deadline_s,
+                             model=model, tenant=tenant)
 
-    def _enqueue(self, kind, payload, opts, deadline_s) -> ServeFuture:
+    def _enqueue(self, kind, payload, opts, deadline_s,
+                 model: Optional[str] = None,
+                 tenant: Optional[str] = None) -> ServeFuture:
         fut = ServeFuture()
         now = self._clock()
+        model = model if model is not None else self.default_model
+        tenant = tenant if tenant is not None else model
+        # admission-order contract: registry miss resolves typed
+        # NOT_FOUND before any queue slot or quota charge; then the
+        # tenant's deadline budget clamps; then the weighted quota
+        # check admits or sheds — all before the dispatch pool sees
+        # the request
+        version = None
+        if self.model_registry is not None and model is not None:
+            version = self.model_registry.lookup(model)
+            if version is None:
+                if tenant is not None:
+                    self._tenant_admission.labels(
+                        tenant=tenant, decision="not_found").inc()
+                    self.metrics.record_shed(tenant, "not_found")
+                self._resolve(fut, ServeResult(
+                    Status.NOT_FOUND,
+                    error=f"model {model!r} is not registered"),
+                    now, tenant=tenant)
+                return fut
         if deadline_s is None:
             deadline_s = self.default_deadline_s
+        if self.admission is not None and tenant is not None:
+            deadline_s = self.admission.deadline_for(tenant, deadline_s)
         deadline = None if deadline_s is None \
             else now + float(deadline_s)
         if self._closed:
             self._resolve(fut, ServeResult(
-                Status.UNAVAILABLE, error="router closed"), now)
+                Status.UNAVAILABLE, error="router closed"), now,
+                tenant=tenant)
             return fut
+        if self.admission is not None and tenant is not None:
+            ok, decision = self.admission.try_admit(tenant)
+            self._tenant_admission.labels(
+                tenant=tenant, decision=decision).inc()
+            if not ok:
+                # weighted fair shedding: "tenant_quota" sheds ONLY the
+                # over-quota tenant; "global" is fleet-wide exhaustion
+                self.metrics.record_shed(tenant, decision)
+                self._resolve(fut, ServeResult(
+                    Status.OVERLOADED,
+                    error=f"tenant {tenant!r} admission refused "
+                          f"({decision})"), now, tenant=tenant)
+                return fut
+            self._tenant_inflight.labels(tenant=tenant).set(
+                float(self.admission.inflight(tenant)))
+
+            def _release(_f, _tenant=tenant):
+                self.admission.release(_tenant)
+                self._tenant_inflight.labels(tenant=_tenant).set(
+                    float(self.admission.inflight(_tenant)))
+
+            # the slot returns exactly when the single-assignment
+            # future resolves — typed shed, OK, cancel, all paths
+            fut.add_done_callback(_release)
         # the TraceContext is minted HERE — at submit, before any
         # dispatch — so router-pool wait is part of the trace too
         trace = self.tracing.begin(kind, deadline_s) \
             if self.tracing is not None else None
+        if trace is not None and (tenant is not None
+                                  or model is not None):
+            trace.ctx.tenant = tenant
+            trace.ctx.model = model
+            trace.ctx.model_version = version
         drive = self._drive
         if kind == "generate" and self.disaggregate:
             drive = self._drive_disagg
         try:
             self._pool.submit(drive, kind, payload, opts,
-                              deadline, fut, now, trace)
+                              deadline, fut, now, trace, model, tenant)
         except RuntimeError:  # closed between the check and the submit
             self._resolve(fut, ServeResult(
                 Status.UNAVAILABLE, error="router closed"), now,
-                trace)
+                trace, tenant=tenant)
         return fut
 
     def _dispatch(self, replica: str, kind, payload, opts,
                   remaining: Optional[float],
-                  trace=None) -> ServeFuture:
+                  trace=None, tenant: Optional[str] = None) -> ServeFuture:
         with self._lock:
             client = self.replicas.get(replica)
             if client is None:
@@ -462,6 +556,10 @@ class FleetRouter:
             if res is not None:
                 self._dispatch_total.labels(
                     replica=_replica, status=res.status.value).inc()
+                if tenant is not None:
+                    self._tenant_dispatch.labels(
+                        tenant=tenant, replica=_replica,
+                        status=res.status.value).inc()
 
         # the forked context rides the dispatch only when tracing is
         # on — untraced dispatch keeps the pre-trace call signature
@@ -557,12 +655,19 @@ class FleetRouter:
 
     def _attempt_loop(self, kind, payload, opts,
                       deadline: Optional[float],
-                      trace=None) -> ServeResult:
+                      trace=None, model: Optional[str] = None,
+                      tenant: Optional[str] = None) -> ServeResult:
         """The failover core: least-loaded dispatch within the kind's
         role pool, retryable outcomes retried on a different replica
         with the REMAINING deadline budget, optional hedging.  Always
         returns a typed ServeResult — the disaggregated drive chains
         two of these (prefill, then decode) under one budget.
+
+        ``model`` restricts every pick to replicas advertising it and
+        re-checks the registry each attempt, so an entry that vanishes
+        mid-flight (unregister_model_mid_flight) converts the request
+        to typed NOT_FOUND instead of retrying forever against a pool
+        that no longer serves it.
 
         With ``trace``, every dispatch (primary, retry, hedge) forks
         the request's TraceContext with the budget that remains at
@@ -589,7 +694,18 @@ class FleetRouter:
                     Status.UNAVAILABLE,
                     error=f"no attempt succeeded in "
                           f"{self.max_attempts}")
-            primary = self._pick(exclude=tried, phase=phase)
+            if model is not None and self.model_registry is not None \
+                    and self.model_registry.lookup(model) is None:
+                # the registry entry vanished with this request in
+                # flight: typed NOT_FOUND, no further retry burn
+                if tenant is not None:
+                    self.metrics.record_shed(tenant, "not_found")
+                return ServeResult(
+                    Status.NOT_FOUND,
+                    error=f"model {model!r} unregistered mid-flight "
+                          f"after {attempts} attempt(s)")
+            primary = self._pick(exclude=tried, phase=phase,
+                                 model=model)
             if primary is None:
                 # nothing routable outside the tried set: degrade
                 # typed (the single-server OVERLOADED/UNAVAILABLE
@@ -597,7 +713,9 @@ class FleetRouter:
                 return last or ServeResult(
                     Status.UNAVAILABLE,
                     error="no ready replica"
-                          + (f" in the {phase} pool" if phase else ""))
+                          + (f" in the {phase} pool" if phase else "")
+                          + (f" advertising model {model!r}"
+                             if model else ""))
             if attempts > 0:
                 self.metrics.record_retry()
             attempts += 1
@@ -608,7 +726,7 @@ class FleetRouter:
                     trace, primary, kind, remaining)
             pending = {primary: self._dispatch(
                 primary, kind, payload, opts, remaining,
-                trace=ctxs.get(primary))}
+                trace=ctxs.get(primary), tenant=tenant)}
             hedge_replica = None
             if self.hedge and not pending[primary].done():
                 delay = self._hedge_delay()
@@ -628,7 +746,7 @@ class FleetRouter:
                             if rem2 is None or rem2 > 0:
                                 hedge_replica = self._pick(
                                     exclude=tried | {primary},
-                                    phase=phase)
+                                    phase=phase, model=model)
                             if hedge_replica is not None:
                                 self.metrics.record_hedge(won=False)
                                 if tr is not None:
@@ -640,7 +758,8 @@ class FleetRouter:
                                     self._dispatch(
                                         hedge_replica, kind, payload,
                                         opts, rem2,
-                                        trace=ctxs.get(hedge_replica))
+                                        trace=ctxs.get(hedge_replica),
+                                        tenant=tenant)
             statuses: Dict[str, str] = {}
             on_result = None
             if tr is not None:
@@ -697,16 +816,22 @@ class FleetRouter:
             return result
 
     def _drive(self, kind, payload, opts, deadline: Optional[float],
-               fut: ServeFuture, t0: float, trace=None):
+               fut: ServeFuture, t0: float, trace=None,
+               model: Optional[str] = None,
+               tenant: Optional[str] = None):
         if trace is not None:
             self.tracing.router_queue(trace, t0, self._clock())
         self._resolve(fut, self._attempt_loop(kind, payload, opts,
-                                              deadline, trace=trace),
-                      t0, trace)
+                                              deadline, trace=trace,
+                                              model=model,
+                                              tenant=tenant),
+                      t0, trace, tenant=tenant)
 
     def _drive_disagg(self, kind, payload, opts,
                       deadline: Optional[float], fut: ServeFuture,
-                      t0: float, trace=None):
+                      t0: float, trace=None,
+                      model: Optional[str] = None,
+                      tenant: Optional[str] = None):
         """Disaggregated generate: a prefill dispatch (routed within
         the prefill pool; returns the crc-sealed KV handoff + first
         token) then a decode dispatch (routed within the decode pool)
@@ -723,9 +848,10 @@ class FleetRouter:
         if trace is not None:
             self.tracing.router_queue(trace, t0, self._clock())
         pre = self._attempt_loop("prefill", payload, (), deadline,
-                                 trace=trace)
+                                 trace=trace, model=model,
+                                 tenant=tenant)
         if pre.status is not Status.OK:
-            self._resolve(fut, pre, t0, trace)
+            self._resolve(fut, pre, t0, trace, tenant=tenant)
             return
         t_hand = self._clock()
         try:
@@ -734,14 +860,15 @@ class FleetRouter:
             self._resolve(fut, ServeResult(
                 Status.INTERNAL_ERROR,
                 error=f"prefill handoff unusable: "
-                      f"{type(e).__name__}: {e}"), t0, trace)
+                      f"{type(e).__name__}: {e}"), t0, trace,
+                tenant=tenant)
             return
-        self.metrics.record_ttft(self._clock() - t0)
+        self.metrics.record_ttft(self._clock() - t0, tenant=tenant)
         max_new = opts[0]
         if max_new <= 1:
             self._resolve(fut, ServeResult(
                 Status.OK, output=np.asarray([first], np.int32),
-                queued_s=pre.queued_s), t0, trace)
+                queued_s=pre.queued_s), t0, trace, tenant=tenant)
             return
         if trace is not None:
             # the router-side handoff hop: blob verify + re-dispatch
@@ -749,14 +876,15 @@ class FleetRouter:
                                  self._clock() - t_hand,
                                  blob_bytes=len(pre.output))
         dec = self._attempt_loop("decode", pre.output, opts, deadline,
-                                 trace=trace)
+                                 trace=trace, model=model,
+                                 tenant=tenant)
         if dec.status is not Status.OK:
-            self._resolve(fut, dec, t0, trace)
+            self._resolve(fut, dec, t0, trace, tenant=tenant)
             return
         dec.output = np.concatenate(
             [np.asarray([first], np.int32),
              np.asarray(dec.output, np.int32)])
-        self._resolve(fut, dec, t0, trace)
+        self._resolve(fut, dec, t0, trace, tenant=tenant)
 
     # ------------------------------------------------------------ lifecycle
     def close(self, wait: bool = True):
@@ -777,6 +905,10 @@ class FleetRouter:
             "live": list(self.live()),
             "degraded": self.degraded,
             "inflight": inflight,
+            "registry": (self.model_registry.models()
+                         if self.model_registry is not None else None),
+            "admission": (self.admission.snapshot()
+                          if self.admission is not None else None),
             "pools": {"prefill": list(self.pool_members("prefill")),
                       "decode": list(self.pool_members("decode"))},
             "disaggregate": self.disaggregate,

@@ -137,7 +137,9 @@ class InferenceServer:
                  generate_dtype=None, name: Optional[str] = None,
                  kv_pool=None, role: str = "both",
                  kv_page_window: Optional[int] = None,
-                 kv_page_globals: int = 1, trace_sink=None):
+                 kv_page_globals: int = 1, trace_sink=None,
+                 model_name: Optional[str] = None,
+                 model_version: str = "v1"):
         from ..optim._sharding_utils import data_mesh
         from .pools import ROLES
 
@@ -147,6 +149,12 @@ class InferenceServer:
         #: faults
         self.name = name
         self.model = model
+        #: multi-tenant identity: which registered model (and version)
+        #: this replica serves — advertised in the health snapshot so
+        #: the FleetRouter's ModelRegistry routing dispatches on it.
+        #: None = single-model fleet (pre-registry behavior unchanged)
+        self.model_name = model_name
+        self.model_version = str(model_version)
         #: paged KV arena (``serving.kvpool.KVPagePool``): when set,
         #: generation serves through the paged decode path — each
         #: request holds pages for the positions it actually fills
@@ -176,6 +184,10 @@ class InferenceServer:
             raise ValueError(
                 f"role {role!r} requires a kv_pool (the prefill/"
                 f"decode split moves KV pages between pools)")
+        if kv_pool is not None and model_name is not None \
+                and kv_pool.default_owner is None:
+            # decoder-internal page allocs charge this model's tenant
+            kv_pool.default_owner = model_name
         self.mesh = data_mesh(mesh)
         self._n_dev = self.mesh.shape["data"] if self.mesh is not None \
             else 1
@@ -287,6 +299,9 @@ class InferenceServer:
             "breaker": self.breaker.snapshot(),
             "role": self.role,
         }
+        if self.model_name is not None:
+            out["model"] = self.model_name
+            out["model_version"] = self.model_version
         if self.kv_pool is not None:
             out["kv"] = self.kv_pool.stats()
         return out
@@ -488,7 +503,8 @@ class InferenceServer:
     # ------------------------------------------------------------ hot swap
     def swap_params(self, params: Any = None, path: Optional[str] = None,
                     buffers: Any = None,
-                    outcome: str = "installed") -> bool:
+                    outcome: str = "installed",
+                    version: Optional[str] = None) -> bool:
         """Install new params atomically between batches.
 
         ``path`` loads through the crc32c-verified checkpoint path
@@ -548,6 +564,10 @@ class InferenceServer:
             self._params = params
             if buffers is not None:
                 self._buffers = buffers
+        if version is not None:
+            # the advertised (model, version) pair tracks the install —
+            # a rollback passes the prior version back in
+            self.model_version = str(version)
         self.metrics.record_swap(outcome=outcome)
         note_swap(outcome)
         log.info("serving params hot-swapped%s%s",
@@ -570,11 +590,21 @@ class InferenceServer:
         return self._preemption is not None \
             and self._preemption.should_stop
 
+    def _tenant_of(self, req: Request) -> Optional[str]:
+        """The tenant a request's phase/latency samples attribute to:
+        the trace's tenant when the router stamped one, else this
+        replica's model (one model ≈ one tenant), else None (untagged
+        single-model fleets pay no tenant series)."""
+        tenant = getattr(req.trace, "tenant", None) \
+            if req.trace is not None else None
+        return tenant if tenant is not None else self.model_name
+
     def _resolve(self, req: Request, result: ServeResult):
         now = time.monotonic()
         result.latency_s = now - req.submitted_at
         self.metrics.record(result.status, result.latency_s,
-                            result.queued_s)
+                            result.queued_s,
+                            tenant=self._tenant_of(req))
         if req.trace is not None:
             result.trace_id = req.trace.trace_id
             if result.status is not Status.OK:
@@ -848,9 +878,11 @@ class InferenceServer:
                     t0 = time.monotonic()
                     seq = decoder.start(params, req.payload)
                     prefill_s = time.monotonic() - t0
-                    self.metrics.record_phase("prefill", prefill_s)
+                    self.metrics.record_phase("prefill", prefill_s,
+                                              tenant=self._tenant_of(req))
                     self.metrics.record_ttft(
-                        time.monotonic() - req.submitted_at)
+                        time.monotonic() - req.submitted_at,
+                        tenant=self._tenant_of(req))
                     self._trace(req, "prefill", "prefill", t0,
                                 prefill_s,
                                 prompt_len=int(req.payload.shape[0]),
@@ -912,9 +944,11 @@ class InferenceServer:
             seq, req = entry["seq"], entry["req"]
             seq.release()
             decode_s = time.monotonic() - entry["t_decode"]
-            self.metrics.record_phase("decode", decode_s)
+            self.metrics.record_phase("decode", decode_s,
+                                      tenant=self._tenant_of(req))
             if entry["steps"]:
-                self.metrics.record_tpot(decode_s / entry["steps"])
+                self.metrics.record_tpot(decode_s / entry["steps"],
+                                         tenant=self._tenant_of(req))
             self._trace(req, "decode", "decode", entry["t_decode"],
                         decode_s, steps=entry["steps"],
                         tokens=len(entry["toks"]))

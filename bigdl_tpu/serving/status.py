@@ -30,6 +30,10 @@ class Status(enum.Enum):
     INTERNAL_ERROR = "internal_error"
     #: the server was hard-stopped with the request still queued
     CANCELLED = "cancelled"
+    #: the request named a model/version no replica advertises (or the
+    #: registry entry vanished mid-flight) — resolved typed at
+    #: admission, never retried, never surfaced as INTERNAL_ERROR
+    NOT_FOUND = "not_found"
 
 
 @dataclass

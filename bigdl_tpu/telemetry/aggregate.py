@@ -400,6 +400,23 @@ def merge_cluster(payloads: Dict[str, dict]) -> dict:
         for cat, secs in (p.get("span_totals") or {}).items():
             spans[cat] = spans.get(cat, 0.0) + float(secs)
     skew = host_skew(payloads)
+    # per-tenant serving fold (multi-tenant fleets: each replica's
+    # "serving" section carries a tenants map — counters sum)
+    tenants: Dict[str, dict] = {}
+    for p in payloads.values():
+        for t, rec in ((p.get("serving") or {}).get("tenants")
+                       or {}).items():
+            agg = tenants.setdefault(
+                t, {"requests": {}, "sheds": {}, "total": 0,
+                    "served_ok": 0, "shed_total": 0})
+            for status, n in (rec.get("requests") or {}).items():
+                agg["requests"][status] = \
+                    agg["requests"].get(status, 0) + int(n)
+            for reason, n in (rec.get("sheds") or {}).items():
+                agg["sheds"][reason] = \
+                    agg["sheds"].get(reason, 0) + int(n)
+            for key in ("total", "served_ok", "shed_total"):
+                agg[key] += int(rec.get(key) or 0)
     return {
         "hosts": hosts,
         "incarnation": max(
@@ -409,6 +426,9 @@ def merge_cluster(payloads: Dict[str, dict]) -> dict:
         "metrics": merge_metrics(
             [p.get("metrics") or {} for p in payloads.values()]),
         "span_totals": dict(sorted(spans.items())),
+        # per-tenant serving outcomes (empty on single-model fleets /
+        # training-only runs) — tools/run_report.py renders the table
+        "tenants": dict(sorted(tenants.items())),
         "per_host_skew": skew,
         "perf": merge_perf(payloads),
         # the cluster-wide Perfetto timeline (None when no host

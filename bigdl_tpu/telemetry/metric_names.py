@@ -87,6 +87,24 @@ SERVING_KV_OCCUPANCY = "bigdl_serving_kv_occupancy"
 FLEET_DISPATCH_TOTAL = "bigdl_fleet_dispatch_total"
 AUTOSCALE_DECISIONS_TOTAL = "bigdl_autoscale_decisions_total"
 
+# --- multi-tenant fleet (serving/registry.py, router.py, metrics.py) ------
+#: per-tenant twins of the serving families.  The metrics registry pins
+#: each family to ONE label tuple, so tenant observability lives in
+#: parallel ``bigdl_tenant_*`` families rather than widening the
+#: existing ones (which would break every registered series).
+TENANT_REQUESTS_TOTAL = "bigdl_tenant_requests_total"
+TENANT_SHEDS_TOTAL = "bigdl_tenant_sheds_total"
+TENANT_PHASE_SECONDS = "bigdl_tenant_phase_seconds"
+TENANT_TTFT_SECONDS = "bigdl_tenant_ttft_seconds"
+TENANT_TPOT_SECONDS = "bigdl_tenant_tpot_seconds"
+TENANT_DISPATCH_TOTAL = "bigdl_tenant_dispatch_total"
+#: router admission decisions, labeled {tenant, decision}:
+#: admitted | tenant_quota | global | not_found | flood
+TENANT_ADMISSION_TOTAL = "bigdl_tenant_admission_total"
+TENANT_INFLIGHT = "bigdl_tenant_inflight"
+#: KV pages currently held per pool owner (labels: tenant)
+TENANT_KV_PAGES_HELD = "bigdl_tenant_kv_pages_held"
+
 # --- the online health engine (timeseries.py + slo.py) -------------------
 #: structured alert transitions, labeled {rule, severity, state}
 ALERTS_TOTAL = "bigdl_alerts_total"

@@ -54,6 +54,21 @@ def render_report(cluster: dict, top_n: int = 6,
                              key=lambda kv: -kv[1])[:top_n]:
             lines.append(f"  {cat:<12} {s:>10.2f}s  "
                          f"{100 * s / total:>5.1f}%")
+    tenants = cluster.get("tenants") or {}
+    if tenants:
+        lines.append("")
+        lines.append("-- per-tenant serving --------------------------------")
+        for t, rec in sorted(tenants.items()):
+            total = int(rec.get("total") or 0)
+            ok = int(rec.get("served_ok") or 0)
+            shed = int(rec.get("shed_total") or 0)
+            reasons = ", ".join(
+                f"{r}={n}" for r, n
+                in sorted((rec.get("sheds") or {}).items()))
+            lines.append(
+                f"  {t:<12} {total:>8} req  ok {ok:>8}  "
+                f"shed {shed:>6}" + (f"  ({reasons})" if reasons
+                                     else ""))
     skew = cluster.get("per_host_skew") or {}
     if skew:
         lines.append("")

@@ -418,6 +418,7 @@ class SloEngine:
 # ---------------------------------------------------------------------------
 
 def default_serving_rules(pool: str = "both", *,
+                          tenant: Optional[str] = None,
                           p99_high_s: float = 0.5,
                           shed_high: float = 0.02,
                           kv_occupancy_high: float = 0.90,
@@ -432,7 +433,16 @@ def default_serving_rules(pool: str = "both", *,
     """The serving rule pack for ONE role pool, over the per-pool
     signals the autoscaler feeds its recorder: p99, shed rate, KV
     occupancy thresholds plus the multi-window shed error-budget
-    burn."""
+    burn.
+
+    ``tenant`` instantiates the pack per tenant on a multi-tenant
+    fleet: the rules watch that tenant's ``model:role`` pool series
+    (the spec :func:`~bigdl_tpu.serving.pools.split_pool` parses, the
+    series a tenant-scoped autoscaler pool feeds) under distinct rule
+    names — each tenant's pack fires and resolves independently, so
+    one tenant burning its budget never marks another tenant's
+    traffic degraded."""
+    pool = pool if tenant is None else f"{tenant}:{pool}"
     L = {"pool": pool}
     return [
         SloRule(name=f"serving/{pool}/p99",

@@ -99,6 +99,12 @@ class TraceContext:
     sampled: bool = True
     attempt: int = 0
     phase: Optional[str] = None  # prefill | decode | None
+    #: multi-tenant attribution — which tenant/model/version this
+    #: request belongs to, so one kept trace is enough to diagnose a
+    #: noisy-neighbor incident.  None on single-model fleets.
+    tenant: Optional[str] = None
+    model: Optional[str] = None
+    model_version: Optional[str] = None
 
     @classmethod
     def mint(cls, deadline_s: Optional[float] = None,
@@ -119,7 +125,9 @@ class TraceContext:
                         else remaining_s),
             sampled=self.sampled,
             attempt=self.attempt if attempt is None else int(attempt),
-            phase=self.phase if phase is None else phase)
+            phase=self.phase if phase is None else phase,
+            tenant=self.tenant, model=self.model,
+            model_version=self.model_version)
 
     def to_wire(self) -> dict:
         """JSON-serializable wire form (submit kwargs, handoff-blob
@@ -128,6 +136,8 @@ class TraceContext:
             "trace_id": self.trace_id, "span_id": int(self.span_id),
             "deadline_s": self.deadline_s, "sampled": bool(self.sampled),
             "attempt": int(self.attempt), "phase": self.phase,
+            "tenant": self.tenant, "model": self.model,
+            "model_version": self.model_version,
         }
 
     @classmethod
@@ -146,7 +156,10 @@ class TraceContext:
                 deadline_s=wire.get("deadline_s"),
                 sampled=bool(wire.get("sampled", True)),
                 attempt=int(wire.get("attempt", 0)),
-                phase=wire.get("phase"))
+                phase=wire.get("phase"),
+                tenant=wire.get("tenant"),
+                model=wire.get("model"),
+                model_version=wire.get("model_version"))
         except (TypeError, KeyError, ValueError):
             return None
 

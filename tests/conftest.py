@@ -20,8 +20,32 @@ import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 
+import sys  # noqa: E402
+
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
+
+_EXIT_STATUS = None
+
+
+def pytest_sessionfinish(session, exitstatus):
+    global _EXIT_STATUS
+    _EXIT_STATUS = int(exitstatus)
+
+
+def pytest_unconfigure(config):
+    # A full run accumulates hundreds of jitted XLA executables whose
+    # teardown (GC + backend destruction) costs ~30s at interpreter
+    # exit — wall-clock the tier-1 timeout budget cannot spare, with
+    # nothing worth collecting. Hard-exit with pytest's own status;
+    # unconfigure runs after the terminal summary, so no output is
+    # lost. BIGDL_TEST_FAST_EXIT=0 opts out (e.g. for profiling
+    # teardown itself).
+    if _EXIT_STATUS is not None and \
+            os.environ.get("BIGDL_TEST_FAST_EXIT", "1") != "0":
+        sys.stdout.flush()
+        sys.stderr.flush()
+        os._exit(_EXIT_STATUS)
 
 
 @pytest.fixture(autouse=True)

@@ -87,7 +87,8 @@ def test_fallback_merges_persisted_tpu_numbers(tmp_path):
                 "BENCH_SLO_TIMEOUT": "0",
                 "BENCH_LOOP_TIMEOUT": "0",
                 "BENCH_BLOCKSPARSE_TIMEOUT": "0",
-                "BENCH_EMBED_TIMEOUT": "0"})
+                "BENCH_EMBED_TIMEOUT": "0",
+                "BENCH_TENANT_TIMEOUT": "0"})
     # --no-ledger: a test invocation must not append to the repo's
     # judged PERF_LEDGER.jsonl trajectory
     out = subprocess.run(
@@ -631,6 +632,43 @@ def test_embed_measurements_contract():
     assert rec["embed_migration_s"] == out["migration_s"]
     assert rec["embed_cache_hit_rate"] == out["cache_hit_rate"]
     assert rec["embed_bad_rows_served"] == 0
+    for key in bench.LEDGER_FIELDS:
+        assert key in rec
+
+
+def test_tenant_measurements_contract():
+    """The multi-tenant leg's measurement dict carries the judged
+    fields: the victim tenant's contended-over-solo p99 ratio, the
+    must-stay-zero victim shed rate (fair admission never bills the
+    aggressor's flood to the victim), the rejected poisoned deploy,
+    and the bad-params audit across BOTH tenants — a small in-process
+    run; the full leg is `--tenant` and its one JSON line lands in
+    TENANT_r01.json."""
+    bench = _bench()
+    out = bench._tenant_measurements(solo_requests=30,
+                                     contended_requests=30,
+                                     flood_threads=2)
+    assert out["solo_p99_ms"] > 0
+    assert out["contended_p99_ms"] > 0
+    assert out["isolation_p99_ratio"] > 0
+    # the victim shed NOTHING while the aggressor flooded open-loop
+    assert out["victim_requests"] >= 30
+    assert out["victim_shed_rate"] == 0.0
+    assert out["aggressor_requests"] > 0
+    # the poisoned aggressor deploy was rejected by the canary and
+    # nothing non-finite was ever served to either tenant
+    assert out["poisoned_deploy_rejected"] is True
+    assert out["bad_params_served"] == 0
+    assert out["all_typed"] is True
+    # and the record flattens into the schema-stable ledger fields
+    rec = bench.ledger_record({"tenant": {
+        "isolation_p99_ratio": out["isolation_p99_ratio"],
+        "victim_shed_rate": out["victim_shed_rate"],
+        "bad_params_served": out["bad_params_served"]}})
+    assert rec["tenant_isolation_p99_ratio"] \
+        == out["isolation_p99_ratio"]
+    assert rec["tenant_victim_shed_rate"] == 0.0
+    assert rec["tenant_bad_params_served"] == 0
     for key in bench.LEDGER_FIELDS:
         assert key in rec
 

@@ -432,3 +432,54 @@ def test_hot_row_cache_put_refuses_retired_version():
     assert snap["rejected_puts"] == 1
     assert snap["stale_evictions"] == 1
     assert len(cache) == 0
+
+
+# ---------------------------------------------------------------------------
+# AdmissionController (multi-tenant quota accounting)
+# ---------------------------------------------------------------------------
+
+def test_admission_quota_hammer_never_exceeds_or_leaks():
+    """N threads hammer concurrent admit/release across two tenants:
+    neither tenant's observed inflight ever exceeds its derived slot
+    budget, the fleet total never exceeds capacity, and after the storm
+    drains every slot is released — no quota slot leaks, none goes
+    negative."""
+    from bigdl_tpu.serving.registry import AdmissionController
+
+    ac = AdmissionController(capacity=12,
+                             quotas={"alpha": 2.0, "beta": 1.0})
+    budgets = {t: ac.budget(t) for t in ("alpha", "beta")}
+    assert budgets == {"alpha": 8, "beta": 4}
+    lock = threading.Lock()
+    admitted = {"alpha": 0, "beta": 0}
+
+    def work(i):
+        tenant = "alpha" if i % 2 == 0 else "beta"
+        held = 0
+        for k in range(200):
+            ok, decision = ac.try_admit(tenant)
+            if ok:
+                held += 1
+                with lock:
+                    admitted[tenant] += 1
+            else:
+                assert decision in (ac.TENANT_QUOTA, ac.GLOBAL)
+            # the invariants, read mid-storm
+            snap = ac.snapshot()
+            assert snap["total_inflight"] <= snap["capacity"]
+            for t, b in budgets.items():
+                assert 0 <= snap["inflight"].get(t, 0) <= b
+            if held and (k % 3 == 0):
+                ac.release(tenant)
+                held -= 1
+        for _ in range(held):
+            ac.release(tenant)
+
+    _hammer(work)
+    snap = ac.snapshot()
+    assert snap["total_inflight"] == 0
+    assert snap["inflight"] == {"alpha": 0, "beta": 0}
+    assert admitted["alpha"] > 0 and admitted["beta"] > 0
+    # over-release must clamp at zero, never go negative
+    ac.release("alpha")
+    assert ac.inflight("alpha") == 0

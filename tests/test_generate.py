@@ -122,6 +122,8 @@ def test_top_p_nucleus_restricts_support():
     assert open_p.min() >= 1 and open_p.max() <= VOCAB
 
 
+@pytest.mark.slow  # ~7s; the EOS variant below runs the same
+# brute-force oracle (plus finished-beam handling) in the budgeted run
 def test_beam_search_exhaustive_oracle():
     """With enough beams to hold every prefix, beam search must find
     the globally best sequence — pinned against brute force over all

@@ -93,6 +93,9 @@ def _midsize_cnn():
         nn.SoftMax().set_name("prob"))
 
 
+@pytest.mark.slow  # ~9s scale contract; the Caffe persist/load
+# protocol stays budgeted via test_interop.py
+# ::test_caffe_persist_and_load_graph
 def test_caffe_midsize_artifact_roundtrip(tmp_path):
     """An ~8M-param CNN through the Caffe persister: the on-disk
     prototxt+caffemodel pair reloads into an equivalent network."""

@@ -390,6 +390,9 @@ def test_aux_loss_enters_the_spmd_step_loss():
     assert np.abs(r0 - r1).max() > 1e-7
 
 
+@pytest.mark.slow  # ~7s; the aux value/step wiring stays budgeted
+# via test_aux_loss_value_matches_hand_formula +
+# test_aux_loss_enters_the_spmd_step_loss
 def test_aux_loss_ep_matches_dense_twin_multi_shard():
     """The EP aux term uses GLOBAL routing statistics (pmean'd over the
     axis), so loss AND params after one step match the dense twin
@@ -464,6 +467,8 @@ def _long_lm(moe_axis, seq_strategy="dense", seed=17, aux=0.3):
                          moe_aux_coef=aux, seq_strategy=seq_strategy)
 
 
+@pytest.mark.slow  # ~9s twin; the masked variant below pins the
+# same EP x SP rule plus the tail-batch mask in the budgeted run
 def test_moe_seq_parallel_matches_dense_twin():
     """EP x SP (long-context MoE): ring attention over the seq axis +
     expert dispatch over the data axis; loss and every updated param

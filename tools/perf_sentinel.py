@@ -158,6 +158,18 @@ DEFAULT_TOLERANCES = {
     "embed_migration_s": ("lower", 1.00, 0.5),
     "embed_cache_hit_rate": ("higher", 0.10, 0.02),
     "embed_bad_rows_served": ("lower", 0.0),
+    # multi-tenant fleet (ISSUE 19): the victim tenant's contended-
+    # over-solo p99 ratio may only fall (wide tolerance + abs floor —
+    # at millisecond solo latencies on the shared-CPU CI box the
+    # flood's scheduler pressure dominates the ratio's noise); the
+    # victim shed rate must stay ZERO (fair admission may never bill
+    # the aggressor's flood to the victim's budget) and bad-params-
+    # served must stay ZERO — a poisoned deploy that installs, or a
+    # non-finite output served to EITHER tenant, is never a
+    # regression to tolerate
+    "tenant_isolation_p99_ratio": ("lower", 1.00, 3.0),
+    "tenant_victim_shed_rate": ("lower", 0.0),
+    "tenant_bad_params_served": ("lower", 0.0),
 }
 
 

@@ -86,9 +86,29 @@ def analyze(traces: dict) -> dict:
         if by_replica else None
     coverages = [r["coverage"] for r in rows
                  if r["coverage"] is not None]
+    # per-tenant attribution (multi-tenant fleets stamp the tenant on
+    # the root span): queue/compute/kv seconds + wall per tenant, so a
+    # noisy-neighbor incident reads straight off kept traces
+    tenants = {}
+    for r in rows:
+        t = r.get("tenant")
+        if t is None:
+            continue
+        agg = tenants.setdefault(
+            t, {"traces": 0, "wall_s": 0.0, "phase_seconds": {}})
+        agg["traces"] += 1
+        agg["wall_s"] += r["wall_s"]
+        for ph, s in r["phases"].items():
+            agg["phase_seconds"][ph] = \
+                agg["phase_seconds"].get(ph, 0.0) + s
+    for agg in tenants.values():
+        agg["wall_s"] = round(agg["wall_s"], 6)
+        agg["phase_seconds"] = {p: round(s, 6) for p, s
+                                in sorted(agg["phase_seconds"].items())}
     return {
         "traces": len(rows),
         "rows": rows,
+        "tenants": tenants,
         "coverage_min": round(min(coverages), 4) if coverages else None,
         "coverage_mean": round(sum(coverages) / len(coverages), 4)
         if coverages else None,
@@ -122,6 +142,18 @@ def render(report: dict, top: int = 10) -> str:
         lines.append("  critical path: %s (busiest replica: %s)"
                      % (cohort["critical_phase"],
                         cohort["critical_replica"]))
+    tenants = report.get("tenants") or {}
+    if tenants:
+        lines.append("")
+        lines.append("-- per-tenant attribution " + "-" * 28)
+        for t, agg in sorted(tenants.items()):
+            phases = " ".join(
+                "%s=%.3fms" % (p, s * 1e3)
+                for p, s in sorted(agg["phase_seconds"].items(),
+                                   key=lambda kv: -kv[1])[:4])
+            lines.append("  %-12s %3d trace(s)  wall %8.3fms  %s"
+                         % (t, agg["traces"], agg["wall_s"] * 1e3,
+                            phases))
     lines.append("")
     lines.append("-- slowest traces " + "-" * 36)
     rows = sorted(report["rows"], key=lambda r: -r["wall_s"])[:top]
