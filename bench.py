@@ -4119,6 +4119,353 @@ def run_tenant_bench() -> None:
 
 
 # --------------------------------------------------------------------------
+# Incident engine bench (--incident): chaos-scored causal attribution —
+# five injected fault classes judged top-1 against the ground-truth
+# chaos journal, clean-control false incidents, capture latency, and
+# the amortized per-pump-round observe/journal tax
+# --------------------------------------------------------------------------
+
+INCIDENT_TIMEOUT = float(os.environ.get("BENCH_INCIDENT_TIMEOUT",
+                                        "240"))
+INCIDENT_RESULT = "INCIDENT_r01.json"
+
+
+def _incident_scenarios(eval_interval_s: float = 5.0,
+                        steady_intervals: int = 200):
+    """Deterministic attribution harness under an injected clock: five
+    fault classes — replica kill, poisoned deploy, tenant flood,
+    straggler delay, KV-pool exhaustion — each armed through the REAL
+    chaos injectors (``resilience/faults.py`` journals ``chaos_inject``
+    with ``ground_truth=True`` into the default change journal, pinned
+    to the fake clock) while scripted metric streams breach an SLO rule
+    and open an incident.  Benign distractor events (autoscale moves,
+    confirmed deploys elsewhere, membership churn — including one
+    landing AFTER the injection) are journaled around every arm, so
+    top-1 blame is a genuine ranking problem, not a last-event grab.
+    A full-length steady control run counts false incidents (the
+    must-stay-zero)."""
+    from bigdl_tpu.resilience import faults
+    from bigdl_tpu.telemetry import (IncidentEngine, IncidentPolicy,
+                                     MetricRecorder, MetricsRegistry,
+                                     SloEngine, SloRule,
+                                     reset_default_journal)
+    from bigdl_tpu.telemetry import metric_names as M
+
+    def build(rules):
+        clk = {"t": 1000.0}
+        rec = MetricRecorder(clock=lambda: clk["t"])
+        jr = reset_default_journal(clock=lambda: clk["t"])
+        eng = SloEngine(rec, rules=rules, registry=MetricsRegistry(),
+                        clock=lambda: clk["t"])
+        ie = IncidentEngine(
+            rec, journal=jr, engine=eng, registry=MetricsRegistry(),
+            policy=IncidentPolicy(
+                pre_window_s=12 * eval_interval_s, post_intervals=2),
+            clock=lambda: clk["t"])
+        return {"clk": clk, "rec": rec, "jr": jr, "eng": eng,
+                "ie": ie}
+
+    def distractors(jr):
+        # production-style (ground_truth=False) noise: scoped moves on
+        # OTHER replicas/models and one fleet-wide membership change
+        jr.record("autoscale_up", "scale decode 2->3",
+                  source="serving.autoscale", pool="decode",
+                  replica="r9")
+        jr.record("deploy_confirmed", "version=v7 replicas=2",
+                  source="serving.fleet", model="beta")
+        jr.record("membership_change", "incarnation=4 reason=join",
+                  source="resilience.elastic", host="host-2")
+
+    scenarios = {}
+    caps = []
+    hits = 0
+
+    def run_scenario(name, rules, feed, breach_feed, injector,
+                     max_intervals=24):
+        nonlocal hits
+        st = build(rules)
+        clk, rec, eng, ie, jr = (st["clk"], st["rec"], st["eng"],
+                                 st["ie"], st["jr"])
+        finalized = []
+
+        def tick(breached):
+            clk["t"] += eval_interval_s
+            (breach_feed if breached else feed)(st)
+            finalized.extend(ie.observe(eng.evaluate()))
+
+        for _ in range(6):
+            tick(False)
+        distractors(jr)                # noise well before the fault
+        for _ in range(4):
+            tick(False)
+        detect = None
+        with injector():
+            # late noise the proximity term must rank below the cause
+            jr.record("autoscale_down", "scale decode 3->2",
+                      source="serving.autoscale", pool="decode",
+                      replica="r9")
+            for i in range(1, max_intervals + 1):
+                tick(True)
+                if detect is None and ie.opened_total:
+                    detect = i
+                if finalized:
+                    break
+        inc = finalized[0].to_dict() if finalized else None
+        top = ((inc or {}).get("suspects") or [{}])[0]
+        hit = bool(top.get("ground_truth"))
+        hits += int(hit)
+        if inc is not None:
+            caps.append(inc["capture_latency_s"])
+        scenarios[name] = {
+            "rule": rules[0].name,
+            "detected_in_intervals": detect,
+            "finalized": inc is not None,
+            "top1_kind": top.get("kind"),
+            "top1_scope": top.get("scope"),
+            "top1_ground_truth": hit,
+            "incident": inc,
+        }
+
+    L = {"replica": "r1"}
+
+    def healthy_replica(st):
+        st["rec"].observe(M.REPLICA_P99_SECONDS, 0.05, labels=L)
+        st["rec"].observe(M.REPLICA_QUEUE_DEPTH, 2.0, labels=L)
+
+    def silent_replica(st):
+        # the kill: the feed stops, the absent rule trips
+        st["rec"].observe(M.REPLICA_QUEUE_DEPTH, 2.0,
+                          labels={"replica": "r9"})
+
+    run_scenario(
+        "replica_kill",
+        [SloRule(name="replica/r1/health_feed",
+                 family=M.REPLICA_P99_SECONDS, labels=L,
+                 kind="absent",
+                 window_s=2 * eval_interval_s + 1.0,
+                 resolve_intervals=1,
+                 description="replica r1 health feed went silent")],
+        healthy_replica, silent_replica,
+        lambda: faults.kill_replica("r1"))
+
+    def steady_loss(st):
+        st["rec"].observe(M.TRAIN_LOSS, st.setdefault("loss", 1.0))
+
+    def diverging_loss(st):
+        st["loss"] = st.setdefault("loss", 1.0) * 1.9
+        st["rec"].observe(M.TRAIN_LOSS, st["loss"])
+
+    def poisoned_deploy():
+        # the loop ships the poisoned candidate: the (non-GT)
+        # deploy_started the pipeline itself journals rides along
+        ctx = faults.poison_candidate()
+        from bigdl_tpu.telemetry.events import record_change
+        record_change("deploy_started", "version=v8",
+                      source="loop.continuous", model="alpha")
+        return ctx
+
+    run_scenario(
+        "poisoned_deploy",
+        [SloRule(name="training/loss_divergence",
+                 family=M.TRAIN_LOSS, kind="threshold",
+                 reduce="last", op=">=", threshold=3.0,
+                 window_s=12 * eval_interval_s, for_intervals=2,
+                 resolve_intervals=2,
+                 description="training loss diverging")],
+        steady_loss, diverging_loss, poisoned_deploy)
+
+    TA = {"tenant": "alpha"}
+
+    def calm_tenant(st):
+        st["rec"].observe(M.AUTOSCALE_POOL_SHED_RATE, 0.0, labels=TA)
+
+    def shedding_tenant(st):
+        st["rec"].observe(M.AUTOSCALE_POOL_SHED_RATE, 0.5, labels=TA)
+
+    run_scenario(
+        "tenant_flood",
+        [SloRule(name="tenant/alpha/shed_rate",
+                 family=M.AUTOSCALE_POOL_SHED_RATE, labels=TA,
+                 kind="threshold", reduce="last", op=">=",
+                 threshold=0.2, window_s=6 * eval_interval_s,
+                 for_intervals=2, resolve_intervals=2,
+                 description="tenant alpha shedding")],
+        calm_tenant, shedding_tenant,
+        lambda: faults.tenant_flood("alpha", rps=64))
+
+    R7 = {"replica": "r7"}
+
+    def fast_replica(st):
+        st["rec"].observe(M.REPLICA_P99_SECONDS, 0.05, labels=R7)
+
+    def straggling_replica(st):
+        st["rec"].observe(M.REPLICA_P99_SECONDS, 2.5, labels=R7)
+
+    run_scenario(
+        "straggler_delay",
+        [SloRule(name="replica/r7/p99",
+                 family=M.REPLICA_P99_SECONDS, labels=R7,
+                 kind="threshold", reduce="last", op=">=",
+                 threshold=1.0, window_s=6 * eval_interval_s,
+                 for_intervals=2, resolve_intervals=2,
+                 description="replica r7 p99 >= 1s")],
+        fast_replica, straggling_replica,
+        lambda: faults.delay_replica("r7", 0.4))
+
+    R3 = {"replica": "r3"}
+
+    def roomy_kv(st):
+        st["rec"].observe(M.AUTOSCALE_POOL_KV_OCCUPANCY, 0.4,
+                          labels=R3)
+
+    def exhausted_kv(st):
+        # partitioned from the fleet KV transport, its pages never
+        # free: occupancy pins at the ceiling
+        st["rec"].observe(M.AUTOSCALE_POOL_KV_OCCUPANCY, 0.99,
+                          labels=R3)
+
+    run_scenario(
+        "kv_exhaustion",
+        [SloRule(name="replica/r3/kv_occupancy",
+                 family=M.AUTOSCALE_POOL_KV_OCCUPANCY, labels=R3,
+                 kind="threshold", reduce="last", op=">=",
+                 threshold=0.95, window_s=6 * eval_interval_s,
+                 for_intervals=2, resolve_intervals=2,
+                 description="replica r3 KV pool exhausted")],
+        roomy_kv, exhausted_kv,
+        lambda: faults.partition_kv("r3"))
+
+    # --- steady control: full-length run, zero incidents expected ----
+    st = build([SloRule(name="replica/r1/p99",
+                        family=M.REPLICA_P99_SECONDS, labels=L,
+                        kind="threshold", reduce="last", op=">=",
+                        threshold=1.0,
+                        window_s=6 * eval_interval_s,
+                        for_intervals=2, resolve_intervals=2,
+                        description="replica r1 p99 >= 1s"),
+                SloRule(name="tenant/alpha/shed_rate",
+                        family=M.AUTOSCALE_POOL_SHED_RATE, labels=TA,
+                        kind="threshold", reduce="last", op=">=",
+                        threshold=0.2,
+                        window_s=6 * eval_interval_s,
+                        for_intervals=2, resolve_intervals=2,
+                        description="tenant alpha shedding")])
+    for i in range(steady_intervals):
+        st["clk"]["t"] += eval_interval_s
+        healthy_replica(st)
+        calm_tenant(st)
+        if i % 20 == 0:       # routine churn must not open incidents
+            distractors(st["jr"])
+        st["ie"].observe(st["eng"].evaluate())
+    false_incidents = st["ie"].opened_total
+
+    reset_default_journal()   # unpin the fake clock
+    detects = [s["detected_in_intervals"]
+               for s in scenarios.values()]
+    return {
+        "eval_interval_s": eval_interval_s,
+        "steady_intervals": steady_intervals,
+        "scenarios": scenarios,
+        "attribution_top1": hits,
+        "attribution_total": len(scenarios),
+        "attribution_top1_frac": round(hits / len(scenarios), 4),
+        "all_finalized": all(s["finalized"]
+                             for s in scenarios.values()),
+        "max_detection_intervals": (max(detects)
+                                    if all(d is not None
+                                           for d in detects)
+                                    else None),
+        "capture_latency_s": (round(max(caps), 6) if caps else None),
+        "false_incidents": int(false_incidents),
+    }
+
+
+def _incident_measurements(eval_interval_s: float = 5.0,
+                           steady_intervals: int = 200,
+                           pump_interval_s: float = 0.05):
+    """The incident-engine leg: (1) the deterministic five-fault
+    attribution harness + clean control, (2) the amortized tax an idle
+    incident engine adds to each fleet pump round — one
+    ``IncidentEngine.observe`` on the round's (empty) transitions plus
+    one journal write, judged against the ``pump_interval_s`` cadence
+    the engine actually rides (the ``FleetHealthMonitor`` chain)."""
+    from bigdl_tpu.telemetry import (ChangeJournal, IncidentEngine,
+                                     MetricRecorder, MetricsRegistry,
+                                     SloEngine,
+                                     default_training_rules)
+    from bigdl_tpu.telemetry import metric_names as M
+
+    out = _incident_scenarios(eval_interval_s=eval_interval_s,
+                              steady_intervals=steady_intervals)
+
+    # --- amortized per-round tax -------------------------------------
+    jr = ChangeJournal(registry=MetricsRegistry())
+    n = 50_000
+    t0 = time.perf_counter()
+    for i in range(n):
+        jr.record("autoscale_up", "scale 2->3", pool="decode",
+                  replica=f"r{i & 7}")
+    record_ns = (time.perf_counter() - t0) / n * 1e9
+    rec = MetricRecorder()
+    eng = SloEngine(rec, rules=default_training_rules(),
+                    registry=MetricsRegistry())
+    ie = IncidentEngine(rec, journal=jr, engine=eng,
+                        registry=MetricsRegistry())
+    for i in range(2_000):     # fill the rings, engine steady
+        rec.observe(M.TRAIN_LOSS, float(4_000 - i))
+    n_obs = 20_000
+    t0 = time.perf_counter()
+    for _ in range(n_obs):
+        ie.observe(())
+    observe_us = (time.perf_counter() - t0) / n_obs * 1e6
+    # one idle observe + one journal write per pump round — the
+    # honest steady-state tax at the cadence the engine rides
+    round_us = observe_us + record_ns * 1e-3
+    overhead_pct = 100.0 * (round_us * 1e-6) / pump_interval_s
+
+    out.update({
+        "pump_interval_s": pump_interval_s,
+        "journal_record_ns": round(record_ns, 0),
+        "incident_observe_us": round(observe_us, 2),
+        "overhead_pct": round(overhead_pct, 4),
+    })
+    return out
+
+
+def run_incident_bench() -> None:
+    """--incident mode: the incident-engine pass — top-1 causal
+    attribution on five injected fault classes, clean-control false
+    incidents, capture latency, amortized observe tax — writes
+    INCIDENT_r01.json, prints the one JSON line."""
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    out = {"bench": "incident", "backend": "cpu",
+           "measured_at": _utc_now()}
+    try:
+        out.update(_incident_measurements())
+        out.update({
+            "metric": "top-1 causal attribution on injected faults",
+            "value": out.get("attribution_top1_frac") or 0.0,
+            "unit": "frac",
+            "target": ">= 4/5 top-1 vs ground truth, 0 false "
+                      "incidents over the clean control, < 2% "
+                      "observe overhead",
+        })
+    except Exception as e:
+        out["error"] = f"{type(e).__name__}: {e}"[:500]
+        out.update({"metric": "top-1 causal attribution on injected "
+                              "faults",
+                    "value": 0.0, "unit": "frac"})
+    try:
+        with open(os.path.join(_here(), INCIDENT_RESULT), "w") as f:
+            json.dump(out, f, indent=1)
+    except OSError:
+        pass
+    print(json.dumps(out), flush=True)
+
+
+# --------------------------------------------------------------------------
 # Perf ledger: the append-only trajectory record the sentinel guards
 # --------------------------------------------------------------------------
 
@@ -4162,6 +4509,8 @@ LEDGER_FIELDS = (
     "embed_bad_rows_served",
     "tenant_isolation_p99_ratio", "tenant_victim_shed_rate",
     "tenant_bad_params_served",
+    "incident_attribution_top1", "incident_false_positives",
+    "incident_capture_latency_s", "incident_overhead_pct",
     "vs_baseline",
 )
 
@@ -4283,6 +4632,19 @@ def ledger_record(result: dict) -> dict:
         "isolation_p99_ratio")
     flat["tenant_victim_shed_rate"] = tenant.get("victim_shed_rate")
     flat["tenant_bad_params_served"] = tenant.get("bad_params_served")
+    # the incident-engine leg (ISSUE 20): top-1 attribution vs the
+    # ground-truth chaos journal may only rise, the clean control's
+    # false-incident count must stay ZERO, and capture latency +
+    # amortized observe overhead may only fall — blame that gets
+    # vaguer, noisier or slower to freeze is never a regression to
+    # tolerate
+    incident = result.get("incident") or {}
+    flat["incident_attribution_top1"] = incident.get(
+        "attribution_top1_frac")
+    flat["incident_false_positives"] = incident.get("false_incidents")
+    flat["incident_capture_latency_s"] = incident.get(
+        "capture_latency_s")
+    flat["incident_overhead_pct"] = incident.get("overhead_pct")
     rec = {"schema": LEDGER_SCHEMA,
            "ts": result.get("measured_at") or _utc_now(),
            "recorded_at": _utc_now()}
@@ -4905,6 +5267,34 @@ def main(ledger: bool = True, probe: bool = True) -> None:
                       or "tenant leg returned nothing"}
     result["tenant"] = tenant
 
+    # incident leg: the chaos-scored causal-attribution pass — top-1
+    # blame vs the ground-truth chaos journal across five injected
+    # fault classes, clean-control false incidents, capture latency,
+    # amortized observe tax (backend-independent, lands in
+    # INCIDENT_r01.json) — best-effort like the other legs;
+    # BENCH_INCIDENT_TIMEOUT=0 disables it.
+    if INCIDENT_TIMEOUT <= 0:
+        incident = {"skipped": "BENCH_INCIDENT_TIMEOUT=0"}
+    else:
+        ok, ires, note = _run_sub(["--incident"], INCIDENT_TIMEOUT)
+        if ok and ires and "error" not in ires:
+            incident = {
+                "attribution_top1": ires.get("attribution_top1"),
+                "attribution_total": ires.get("attribution_total"),
+                "attribution_top1_frac": ires.get(
+                    "attribution_top1_frac"),
+                "false_incidents": ires.get("false_incidents"),
+                "max_detection_intervals": ires.get(
+                    "max_detection_intervals"),
+                "capture_latency_s": ires.get("capture_latency_s"),
+                "overhead_pct": ires.get("overhead_pct"),
+                "source": INCIDENT_RESULT,
+            }
+        else:
+            incident = {"error": (ires or {}).get("error") or note
+                        or "incident leg returned nothing"}
+    result["incident"] = incident
+
     if not from_tpu:
         # the tunnel dies for hours at a time: the judged artifact must
         # still CARRY the chip numbers, honestly stamped — merge the
@@ -4938,7 +5328,7 @@ def main(ledger: bool = True, probe: bool = True) -> None:
             for leg in ("serving", "fleet", "disagg", "elastic",
                         "integrity", "telemetry", "sharding", "dlrm",
                         "sync", "slo", "loop", "blocksparse", "embed",
-                        "tenant"):
+                        "tenant", "incident"):
                 if result.get(leg) is not None:
                     merged[leg] = result[leg]
             result = merged
@@ -4973,6 +5363,8 @@ if __name__ == "__main__":
     p.add_argument("--blocksparse", action="store_true")
     p.add_argument("--embed", dest="embed_leg", action="store_true")
     p.add_argument("--tenant", dest="tenant_leg", action="store_true")
+    p.add_argument("--incident", dest="incident_leg",
+                   action="store_true")
     p.add_argument("--worker", choices=["tpu", "cpu"])
     # every orchestrated run appends to PERF_LEDGER.jsonl by default;
     # --no-ledger keeps scratch runs out of the judged trajectory
@@ -5017,6 +5409,8 @@ if __name__ == "__main__":
         run_embed_bench()
     elif a.tenant_leg:
         run_tenant_bench()
+    elif a.incident_leg:
+        run_incident_bench()
     elif a.worker:
         run_worker(a.worker)
     else:

@@ -58,6 +58,7 @@ from ..serving.fleet import FleetQuorumError
 from ..serving.status import Status
 from ..serving.swap import DeployInFlight, SwapRejected
 from ..telemetry import metric_names as M
+from ..telemetry.events import record_change as _record_change
 from ..telemetry.slo import SloEngine, default_loop_rules
 from ..telemetry.timeseries import MetricRecorder
 
@@ -200,10 +201,21 @@ class ContinuousLoop:
         log.info("loop[%d]: %s %s", self.intervals, kind, detail)
         return ev
 
+    #: loop deploy-outcome -> change-journal kind (gated/refused never
+    #: reach the fleet, so the journal hears about them only here)
+    _OUTCOME_EVENTS = {"confirmed": "deploy_confirmed",
+                       "rolled_back": "deploy_rolled_back",
+                       "gated": "deploy_rejected",
+                       "rejected": "deploy_rejected",
+                       "refused": "deploy_rejected"}
+
     def _finish_deploy(self, outcome: str, **detail):
         assert outcome in DEPLOY_OUTCOMES
         self.deploy_outcomes[outcome] += 1
         self._deploys_total.labels(outcome=outcome).inc()
+        _record_change(self._OUTCOME_EVENTS[outcome],
+                       f"loop outcome={outcome}",
+                       source="loop.continuous")
         self._event("deploy", state=outcome, **detail)
 
     # ------------------------------------------------------------ phases

@@ -49,6 +49,7 @@ import urllib.parse
 from typing import (Callable, Dict, List, Optional, Sequence, Set,
                     Tuple)
 
+from ..telemetry.events import record_change as _record_change
 from ..telemetry.registry import default_registry
 from .watchdog import CollectiveWatchdog, HungCollectiveError
 
@@ -306,6 +307,10 @@ class ElasticCoordinator:
             "by": self.host}))
         log.warning("elastic: proposed incarnation %d (%s) members=%s",
                     n, reason, sorted(set(members)))
+        _record_change("membership_change",
+                       f"incarnation={n} reason={reason} "
+                       f"members={len(set(members))}",
+                       source="resilience.elastic", host=self.host)
         self.ack(n)
         return n
 
@@ -334,12 +339,16 @@ class ElasticCoordinator:
     def evict(self, host: str, reason: str):
         self.transport.put(_EVICTED + str(host), json.dumps(
             {"reason": str(reason), "by": self.host}))
+        _record_change("membership_evict", str(reason),
+                       source="resilience.elastic", host=host)
 
     def evicted(self) -> Set[str]:
         return {k[len(_EVICTED):] for k in self.transport.keys(_EVICTED)}
 
     def readmit(self, host: str):
         self.transport.delete(_EVICTED + str(host))
+        _record_change("membership_readmit",
+                       source="resilience.elastic", host=host)
 
 
 # ---------------------------------------------------------------------------

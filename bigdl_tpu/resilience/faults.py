@@ -152,6 +152,27 @@ def truncate(path: str, keep_fraction: float = 0.5):
     return keep
 
 
+
+def _journal_chaos(event: str, entry: dict):
+    """Record a chaos injector's arm/disarm into the change journal,
+    tagged ``ground_truth=True`` — the scoreable cause benches judge
+    blame rankings against (docs/observability.md "Incidents").  Lazy
+    import keeps faults importable independent of telemetry."""
+    from ..telemetry.events import record_change
+
+    detail = " ".join(
+        f"{k}={entry[k]}" for k in ("kind", "substr", "seconds",
+                                    "rps", "at_step", "scale")
+        if entry.get(k) is not None)
+    record_change(event, detail, ground_truth=True,
+                  source="resilience.faults",
+                  host=entry.get("host"),
+                  replica=entry.get("replica") or entry.get("server"),
+                  tenant=entry.get("tenant"),
+                  model=entry.get("model"),
+                  table=entry.get("table"))
+
+
 # ---------------------------------------------------------------------------
 # ingest I/O faults
 # ---------------------------------------------------------------------------
@@ -183,11 +204,13 @@ def io_faults(substr: str, times: int = 1, exc_type=OSError):
              "exc_type": exc_type}
     with _IO_LOCK:
         _IO_FAULTS.append(entry)
+    _journal_chaos("chaos_inject", entry)
     try:
         yield entry
     finally:
         with _IO_LOCK:
             _IO_FAULTS.remove(entry)
+        _journal_chaos("chaos_clear", entry)
 
 
 # ---------------------------------------------------------------------------
@@ -250,11 +273,13 @@ def serving_step_failures(times: int = 1, exc_type=RuntimeError,
              "server": None if server is None else str(server)}
     with _SERVING_LOCK:
         _SERVING_FAULTS.append(entry)
+    _journal_chaos("chaos_inject", entry)
     try:
         yield entry
     finally:
         with _SERVING_LOCK:
             _SERVING_FAULTS.remove(entry)
+        _journal_chaos("chaos_clear", entry)
 
 
 @contextlib.contextmanager
@@ -269,11 +294,13 @@ def serving_step_latency(seconds: float, times: int = 1 << 30,
              "server": None if server is None else str(server)}
     with _SERVING_LOCK:
         _SERVING_FAULTS.append(entry)
+    _journal_chaos("chaos_inject", entry)
     try:
         yield entry
     finally:
         with _SERVING_LOCK:
             _SERVING_FAULTS.remove(entry)
+        _journal_chaos("chaos_clear", entry)
 
 
 # ---------------------------------------------------------------------------
@@ -313,11 +340,13 @@ def check_fleet_fault(replica: str) -> Optional[str]:
 def _fleet_fault(entry):
     with _FLEET_LOCK:
         _FLEET_FAULTS.append(entry)
+    _journal_chaos("chaos_inject", entry)
     try:
         yield entry
     finally:
         with _FLEET_LOCK:
             _FLEET_FAULTS.remove(entry)
+        _journal_chaos("chaos_clear", entry)
 
 
 def kill_replica(replica: str):
@@ -385,11 +414,13 @@ def check_loop_fault(kind: str) -> Optional[dict]:
 def _loop_fault(entry):
     with _LOOP_LOCK:
         _LOOP_FAULTS.append(entry)
+    _journal_chaos("chaos_inject", entry)
     try:
         yield entry
     finally:
         with _LOOP_LOCK:
             _LOOP_FAULTS.remove(entry)
+        _journal_chaos("chaos_clear", entry)
 
 
 def poison_candidate(times: int = 1):
@@ -481,11 +512,13 @@ def check_elastic_fault(host: str, step: int, cancel_event=None):
 def _elastic_fault(entry):
     with _ELASTIC_LOCK:
         _ELASTIC_FAULTS.append(entry)
+    _journal_chaos("chaos_inject", entry)
     try:
         yield entry
     finally:
         with _ELASTIC_LOCK:
             _ELASTIC_FAULTS.remove(entry)
+        _journal_chaos("chaos_clear", entry)
 
 
 def kill_host(host: str, at_step: int):
@@ -560,11 +593,13 @@ def flip_param_bits(host: str, at_step: int, times: int = 1):
 def _elastic_fault_entry(lock, registry, entry):
     with lock:
         registry.append(entry)
+    _journal_chaos("chaos_inject", entry)
     try:
         yield entry
     finally:
         with lock:
             registry.remove(entry)
+        _journal_chaos("chaos_clear", entry)
 
 
 def corrupt_checksum(host: str, step: int, value: str) -> str:

@@ -50,6 +50,7 @@ import time
 from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
+from ..telemetry.events import record_change as _record_change
 from .pools import serves_phase, split_pool
 
 log = logging.getLogger("bigdl_tpu")
@@ -348,6 +349,9 @@ class Autoscaler:
         self.decisions.append(event)
         self._decisions_total.labels(pool=pool,
                                      direction=direction).inc()
+        _record_change(f"autoscale_{direction}", str(reason),
+                       source="serving.autoscale", pool=pool,
+                       replica=replica)
         log.info("autoscale: %s %s (%s) — %s", direction, replica,
                  pool, reason)
 

@@ -21,22 +21,27 @@ from __future__ import annotations
 
 import threading
 import time
-from typing import Callable
+from typing import Callable, Optional
 
 CLOSED = "closed"
 OPEN = "open"
 HALF_OPEN = "half_open"
 
 
-def _count_transition(to_state: str):
+def _count_transition(to_state: str, owner: Optional[str] = None):
     """Breaker state transitions land in the process-wide telemetry
-    registry (docs/observability.md) — labeled by destination state."""
+    registry (docs/observability.md) — labeled by destination state —
+    and in the change journal, scoped to the owning replica when the
+    breaker has one (the router stamps ``owner`` on construction)."""
+    from ..telemetry.events import record_change
     from ..telemetry.registry import default_registry
 
     default_registry().counter(
         "bigdl_breaker_transitions_total",
         "circuit breaker state transitions",
         labels=("to",)).labels(to=to_state).inc()
+    record_change(f"breaker_{to_state}", source="serving.breaker",
+                  replica=owner)
 
 #: acquire() verdicts
 ADMIT = "admit"
@@ -60,6 +65,9 @@ class CircuitBreaker:
         self._probe_in_flight = False
         self.trips = 0        # closed/half-open -> open transitions
         self.recoveries = 0   # half-open probe successes
+        #: the replica this breaker guards (the router stamps it so
+        #: journal events carry a replica scope); None = anonymous
+        self.owner: Optional[str] = None
 
     # ------------------------------------------------------------------
     @property
@@ -79,7 +87,7 @@ class CircuitBreaker:
                     return REJECT
                 self._state = HALF_OPEN
                 self._probe_in_flight = False
-                _count_transition("half_open")
+                _count_transition("half_open", self.owner)
             # half-open: one probe at a time
             if self._probe_in_flight:
                 return REJECT
@@ -90,7 +98,7 @@ class CircuitBreaker:
         with self._lock:
             if self._state == HALF_OPEN:
                 self.recoveries += 1
-                _count_transition("closed")
+                _count_transition("closed", self.owner)
             self._state = CLOSED
             self._consecutive_failures = 0
             self._probe_in_flight = False
@@ -108,7 +116,7 @@ class CircuitBreaker:
                 self._state = OPEN
                 self._opened_at = self._clock()
                 self.trips += 1
-                _count_transition("open")
+                _count_transition("open", self.owner)
 
     # ------------------------------------------------------------------
     def snapshot(self) -> dict:

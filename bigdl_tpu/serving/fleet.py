@@ -45,6 +45,7 @@ from typing import Callable, Dict, Optional
 
 from ..resilience import faults as _faults
 from ..resilience.elastic import ElasticCoordinator, InMemoryKV
+from ..telemetry.events import record_change as _record_change
 from .metrics import ServingMetrics
 from .router import FleetRouter, HEALTH_PREFIX
 from .server import InferenceServer
@@ -468,6 +469,10 @@ class ServingFleet:
         self.router.add_replica(rid, server)
         agent.pump()            # beats with rejoin=True
         self.router.refresh()   # ... and is re-admitted here
+        _record_change("replica_added",
+                       f"role={getattr(server, 'role', 'both')}",
+                       source="serving.fleet", replica=rid,
+                       model=getattr(server, "model_name", None))
         log.info("fleet: added replica %s (role=%s)", rid,
                  getattr(server, "role", "both"))
         return server
@@ -490,6 +495,9 @@ class ServingFleet:
             ok = srv.drain(timeout)
         ok = srv.stop(timeout) and ok
         self.router.remove_replica(rid)
+        _record_change("replica_removed", f"drained={drain}",
+                       source="serving.fleet", replica=rid,
+                       model=getattr(srv, "model_name", None))
         log.info("fleet: removed replica %s (drained=%s)", rid, drain)
         return ok
 
@@ -505,6 +513,9 @@ class ServingFleet:
         agent.killed = False
         agent.pump()
         self.router.refresh()
+        _record_change("replica_restarted", source="serving.fleet",
+                       replica=rid,
+                       model=getattr(srv, "model_name", None))
         log.info("fleet: restarted replica %s", rid)
         return srv
 
@@ -586,6 +597,10 @@ class ServingFleet:
         try:
             if path is not None:
                 params = load_verified_params(path)
+            _record_change(
+                "deploy_started",
+                f"version={version} targets={len(targets)}",
+                source="serving.fleet", model=model)
             quorum = (self.ready_quorum if model is None
                       else len(targets) // 2 + 1)
             done = []  # [(rid, (prior_params, prior_bufs), prior_ver)]
@@ -601,6 +616,10 @@ class ServingFleet:
                 if ready < quorum:
                     self._rollback(done)
                     self.deploy_rollbacks += 1
+                    _record_change(
+                        "deploy_rolled_back",
+                        f"quorum lost before {rid}",
+                        source="serving.fleet", model=model)
                     raise FleetQuorumError(
                         f"deploy halted before {rid}: only {ready} "
                         f"replica(s) ready, quorum is {quorum} — "
@@ -612,6 +631,11 @@ class ServingFleet:
                 except SwapRejected as e:
                     self._rollback(done)
                     self.deploy_rollbacks += 1
+                    _record_change(
+                        "deploy_rolled_back",
+                        f"canary rejected at {rid}",
+                        source="serving.fleet", replica=rid,
+                        model=model)
                     raise SwapRejected(
                         f"rolling deploy halted at {rid}: {e} — "
                         f"{len(done)} already-swapped replica(s) "
@@ -620,6 +644,10 @@ class ServingFleet:
                 log.info("fleet: deployed to %s (%d/%d)", rid,
                          len(done), len(order))
             self.deploys += 1
+            _record_change(
+                "deploy_confirmed",
+                f"version={version} replicas={len(done)}",
+                source="serving.fleet", model=model)
             with self._deploy_table_lock:
                 self._last_deploy[model] = done
             if (model is not None and version is not None
@@ -654,6 +682,10 @@ class ServingFleet:
                 return 0
             self._rollback(done)
             self.deploy_rollbacks += 1
+            _record_change(
+                "deploy_rolled_back",
+                f"alert-driven rollback of {len(done)} replica(s)",
+                source="serving.fleet", model=model)
             if (model is not None
                     and self.router.model_registry is not None
                     and done[0][2] is not None):

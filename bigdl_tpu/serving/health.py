@@ -76,7 +76,9 @@ class FleetHealthMonitor:
 
     def __init__(self, fleet, policy: Optional[ReplicaHealthPolicy]
                  = None, registry=None, mark_degraded: bool = True,
-                 clock: Optional[Callable[[], float]] = None):
+                 clock: Optional[Callable[[], float]] = None,
+                 incidents: bool = False,
+                 incident_policy=None):
         self.fleet = fleet
         self.policy = policy or ReplicaHealthPolicy()
         self.mark_degraded = bool(mark_degraded)
@@ -87,6 +89,16 @@ class FleetHealthMonitor:
             registry=(registry if registry is not None
                       else fleet.router.metrics.registry),
             clock=self._clock)
+        #: optional incident engine (telemetry/incidents.py): built
+        #: over this monitor's recorder + engine so a firing replica
+        #: rule freezes its black box and ranks the change journal
+        self.incidents = None
+        if incidents:
+            from ..telemetry.incidents import IncidentEngine
+            self.incidents = IncidentEngine(
+                self.recorder, engine=self.engine,
+                policy=incident_policy,
+                registry=self.engine.registry, clock=self._clock)
         #: replica -> its rule names (installed lazily on first feed)
         self._replica_rules: Dict[str, List[str]] = {}
         #: replica -> the label set its rules/series were installed
@@ -193,7 +205,12 @@ class FleetHealthMonitor:
                       kind="counter", now=now)
         emitted = self.engine.evaluate(now=now)
         self._actuate()
-        return [a.to_dict() for a in emitted]
+        out = [a.to_dict() for a in emitted]
+        if self.incidents is not None:
+            # chain the incident engine on this round's transitions:
+            # a fresh firing opens + freezes its capture window here
+            self.incidents.observe(out, now=now)
+        return out
 
     def _actuate(self):
         if not self.mark_degraded:
@@ -224,4 +241,6 @@ class FleetHealthMonitor:
     def snapshot(self) -> dict:
         return {"engine": self.engine.snapshot(),
                 "degraded": self.degraded(),
-                "replicas_watched": sorted(self._replica_rules)}
+                "replicas_watched": sorted(self._replica_rules),
+                "incidents": (self.incidents.snapshot()
+                              if self.incidents is not None else None)}

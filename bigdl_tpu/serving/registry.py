@@ -44,6 +44,7 @@ import threading
 from typing import Dict, List, Optional, Tuple
 
 from ..resilience import faults as _faults
+from ..telemetry.events import record_change as _record_change
 
 __all__ = ["ModelRegistry", "AdmissionController"]
 
@@ -66,13 +67,21 @@ class ModelRegistry:
     def register(self, model: str, version: str = "v1") -> str:
         """Register (or re-version) ``model``; returns the version."""
         with self._lock:
+            prior = self._models.get(str(model))
             self._models[str(model)] = str(version)
+        if prior != str(version):
+            _record_change("model_registered", f"version={version}",
+                           source="serving.registry", model=model)
         return str(version)
 
     def unregister(self, model: str) -> bool:
         """Drop ``model``; True when it was registered."""
         with self._lock:
-            return self._models.pop(str(model), None) is not None
+            dropped = self._models.pop(str(model), None) is not None
+        if dropped:
+            _record_change("model_unregistered",
+                           source="serving.registry", model=model)
+        return dropped
 
     def lookup(self, model: str) -> Optional[str]:
         """The registered version of ``model``, or None.

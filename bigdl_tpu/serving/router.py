@@ -44,6 +44,7 @@ import time
 from concurrent.futures import ThreadPoolExecutor
 from typing import Callable, Dict, Optional, Tuple
 
+from ..telemetry.events import record_change as _record_change
 from .breaker import CircuitBreaker, REJECT
 from .metrics import ServingMetrics
 from .status import ServeFuture, ServeResult, Status
@@ -344,6 +345,9 @@ class FleetRouter:
             br = self._breakers.get(replica)
             if br is None:
                 br = self._breakers[replica] = self._breaker_factory()
+                # stamp the guarded replica so journal events from
+                # this breaker's transitions carry a replica scope
+                br.owner = replica
             return br
 
     def _pick(self, exclude=(), phase: Optional[str] = None,
@@ -488,6 +492,12 @@ class FleetRouter:
                 # weighted fair shedding: "tenant_quota" sheds ONLY the
                 # over-quota tenant; "global" is fleet-wide exhaustion
                 self.metrics.record_shed(tenant, decision)
+                # journaled throttled per (tenant, reason): a flood
+                # must not evict the deploy that explains it out of
+                # the bounded ring
+                _record_change("tenant_shed", str(decision),
+                               source="serving.router", tenant=tenant,
+                               throttle_key=f"{tenant}/{decision}")
                 self._resolve(fut, ServeResult(
                     Status.OVERLOADED,
                     error=f"tenant {tenant!r} admission refused "

@@ -30,11 +30,15 @@ import time
 from typing import Optional
 
 from .aggregate import (
-    collect_snapshots, merge_alerts, merge_cluster, merge_metrics,
-    merge_timeline, publish_snapshot, read_snapshot_dir,
-    write_snapshot,
+    collect_snapshots, merge_alerts, merge_cluster, merge_incidents,
+    merge_metrics, merge_timeline, publish_snapshot,
+    read_snapshot_dir, write_snapshot,
 )
 from .device_info import DeviceSpec, device_spec, peak_flops_per_sec
+from .events import (CHANGE_EVENT_KINDS, ChangeEvent, ChangeJournal,
+                     default_journal, record_change,
+                     reset_default_journal)
+from .incidents import Incident, IncidentEngine, IncidentPolicy
 from .goodput import GOODPUT_CATEGORIES, GoodputLedger
 from .metric_names import METRIC_FAMILY_NAMES
 from .perf import PerfAccountant, StepCost, classify_roofline
@@ -55,21 +59,25 @@ from .tracer import CATEGORIES, STEP_CATEGORIES, Span, Tracer
 
 __all__ = [
     "Alert", "BackgroundPublisher", "CATEGORIES",
-    "GOODPUT_CATEGORIES",
-    "Counter", "DeviceSpec",
-    "Gauge", "HealthVerdict", "Histogram", "METRIC_FAMILY_NAMES",
+    "CHANGE_EVENT_KINDS", "GOODPUT_CATEGORIES",
+    "ChangeEvent", "ChangeJournal", "Counter", "DeviceSpec",
+    "Gauge", "HealthVerdict", "Histogram", "Incident",
+    "IncidentEngine", "IncidentPolicy", "METRIC_FAMILY_NAMES",
     "MetricRecorder", "MetricsRegistry", "GoodputLedger",
     "PerfAccountant", "REQUEST_CATEGORIES", "STEP_CATEGORIES",
     "SloEngine", "SloRule",
     "Span", "StepCost", "TRACE_KV_PREFIX", "TailSampler",
     "Telemetry", "TraceContext", "Tracer", "TrainingHealthMonitor",
     "classify_roofline", "collect_snapshots", "configure_logging",
-    "default_buckets", "default_loop_rules", "default_registry",
+    "default_buckets", "default_journal", "default_loop_rules",
+    "default_registry",
     "default_serving_rules", "default_training_rules", "device_spec",
     "get_logger", "ingest_deadman_rule",
-    "merge_alerts", "merge_cluster", "merge_metrics",
+    "merge_alerts", "merge_cluster", "merge_incidents",
+    "merge_metrics",
     "merge_timeline", "peak_flops_per_sec",
-    "publish_snapshot", "read_snapshot_dir", "reset_default_registry",
+    "publish_snapshot", "read_snapshot_dir", "record_change",
+    "reset_default_journal", "reset_default_registry",
     "write_snapshot",
 ]
 
@@ -113,6 +121,10 @@ class Telemetry:
         #: TrainingHealthMonitor built over this bundle registers
         #: itself here so payload() publishes the active-alert view
         self.slo = None
+        #: optional incident engine (telemetry/incidents.py) —
+        #: registered the same way so payload() publishes open/recent
+        #: incident bundles alongside the alerts they explain
+        self.incidents = None
         r = self.registry
         # bind the CONCRETE unlabeled series (family.labels()), not the
         # family wrapper: the per-step hooks below run inside the
@@ -294,6 +306,10 @@ class Telemetry:
             # cluster fold unions these into the run-report alert table
             "alerts": (self.slo.snapshot() if self.slo is not None
                        else None),
+            # open/recent incident bundles (None without an engine) —
+            # merge_incidents folds them cluster-wide like alerts
+            "incidents": (self.incidents.snapshot()
+                          if self.incidents is not None else None),
         }
 
     def write_snapshot(self, directory: Optional[str] = None,

@@ -29,8 +29,8 @@ from .goodput import GoodputLedger
 
 __all__ = [
     "TM_PREFIX", "collect_snapshots", "merge_alerts", "merge_cluster",
-    "merge_metrics", "merge_perf", "merge_timeline",
-    "metrics_to_prometheus", "publish_snapshot",
+    "merge_incidents", "merge_metrics", "merge_perf",
+    "merge_timeline", "metrics_to_prometheus", "publish_snapshot",
     "read_snapshot_dir", "write_snapshot",
 ]
 
@@ -239,8 +239,16 @@ def merge_alerts(payloads: Dict[str, dict]) -> Optional[dict]:
     host's active alerts (host-stamped), recent transitions in time
     order, and per-state totals.  None when no host published an
     engine snapshot."""
-    active = []
-    recent = []
+    # dedupe active by (rule, host): a rule reported twice for one
+    # host (overlapping snapshot collections, a re-published payload)
+    # must union to ONE deterministic entry — the worst one wins
+    # (severity page > ticket, then the newest fired-at time)
+    def _active_rank(a: dict):
+        return (1 if a.get("severity") == "page" else 0,
+                a.get("since") or 0.0)
+
+    active_by_key: Dict[tuple, dict] = {}
+    recent_by_key: Dict[tuple, dict] = {}
     totals: Dict[str, int] = {}
     hosts = []
     for host, p in sorted(payloads.items()):
@@ -249,14 +257,32 @@ def merge_alerts(payloads: Dict[str, dict]) -> Optional[dict]:
             continue
         hosts.append(host)
         for a in snap.get("active", ()):
-            active.append(dict(a, host=host))
+            key = (a.get("rule"), host)
+            cur = active_by_key.get(key)
+            if cur is None or _active_rank(a) > _active_rank(cur):
+                active_by_key[key] = dict(a, host=host)
         for a in snap.get("recent", ()):
-            recent.append(dict(a, host=host))
-            totals[a.get("state", "?")] = \
-                totals.get(a.get("state", "?"), 0) + 1
+            # identical transitions replayed across overlapping
+            # collections dedupe exactly; conflicting states at the
+            # same instant keep the worst (firing beats resolved)
+            key = (a.get("rule"), host, a.get("at"))
+            cur = recent_by_key.get(key)
+            if cur is not None and not (
+                    a.get("state") == "firing"
+                    and cur.get("state") != "firing"):
+                continue
+            recent_by_key[key] = dict(a, host=host)
     if not hosts:
         return None
-    recent.sort(key=lambda a: a.get("at") or 0.0)
+    active = [active_by_key[k] for k in sorted(
+        active_by_key, key=lambda k: (str(k[0]), str(k[1])))]
+    recent = sorted(recent_by_key.values(),
+                    key=lambda a: (a.get("at") or 0.0,
+                                   str(a.get("rule")),
+                                   str(a.get("host"))))
+    for a in recent:
+        state = a.get("state", "?")
+        totals[state] = totals.get(state, 0) + 1
     worst = "ok"
     if any(a.get("severity") == "page" for a in active):
         worst = "critical"
@@ -264,6 +290,42 @@ def merge_alerts(payloads: Dict[str, dict]) -> Optional[dict]:
         worst = "degraded"
     return {"hosts": hosts, "active": active, "recent": recent[-64:],
             "totals": totals, "verdict": worst}
+
+
+def merge_incidents(payloads: Dict[str, dict]) -> Optional[dict]:
+    """Union per-host incident-engine snapshots
+    (``payload["incidents"]``, see ``Telemetry.payload``) into one
+    cluster incident view: every host's open and recent (finalized)
+    incidents, host-stamped, deduped by (id, host), ordered by opened
+    time.  None when no host published an engine snapshot."""
+    open_by_key: Dict[tuple, dict] = {}
+    recent_by_key: Dict[tuple, dict] = {}
+    hosts = []
+    opened = 0
+    for host, p in sorted(payloads.items()):
+        snap = (p or {}).get("incidents")
+        if not snap:
+            continue
+        hosts.append(host)
+        opened += int(snap.get("opened") or 0)
+        for inc in snap.get("open", ()):
+            open_by_key[(inc.get("id"), host)] = dict(inc, host=host)
+        for inc in snap.get("recent", ()):
+            # a finalized re-publish of a previously-open incident
+            # replaces the open entry for the same (id, host)
+            key = (inc.get("id"), host)
+            open_by_key.pop(key, None)
+            recent_by_key[key] = dict(inc, host=host)
+    if not hosts:
+        return None
+    def order(i: dict):
+        return (i.get("opened_at") or 0.0, str(i.get("id")),
+                str(i.get("host")))
+
+    return {"hosts": hosts,
+            "open": sorted(open_by_key.values(), key=order),
+            "recent": sorted(recent_by_key.values(), key=order),
+            "opened": opened}
 
 
 def host_skew(payloads: Dict[str, dict]) -> Dict[str, dict]:
@@ -437,4 +499,7 @@ def merge_cluster(payloads: Dict[str, dict]) -> dict:
         # the cluster alert view (None when no host runs an SLO
         # engine) — tools/run_report.py --alerts renders it
         "alerts": merge_alerts(payloads),
+        # the cluster incident view (None when no host runs an
+        # incident engine) — tools/incident_report.py renders it
+        "incidents": merge_incidents(payloads),
     }

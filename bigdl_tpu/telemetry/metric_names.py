@@ -161,6 +161,15 @@ EMBED_ROWS_SHED_TOTAL = "bigdl_embed_rows_shed_total"
 #: every embedding chaos test pins (labels: table)
 EMBED_BAD_ROWS_TOTAL = "bigdl_embed_bad_rows_total"
 
+# --- incident engine (telemetry/events.py + incidents.py) -----------------
+#: state-change events recorded into the fleet-wide change journal,
+#: labeled {kind} (deploy_started, membership_evict, chaos_inject, ...)
+CHANGE_EVENTS_TOTAL = "bigdl_change_events_total"
+#: incidents opened by the IncidentEngine, labeled {severity}
+INCIDENTS_TOTAL = "bigdl_incidents_total"
+#: incidents currently holding an open capture window
+INCIDENTS_ACTIVE = "bigdl_incidents_active"
+
 #: every bigdl_* metric family name any bigdl_tpu module may register
 #: or reference — the vocabulary the lint enforces
 METRIC_FAMILY_NAMES = frozenset(

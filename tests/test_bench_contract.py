@@ -88,7 +88,8 @@ def test_fallback_merges_persisted_tpu_numbers(tmp_path):
                 "BENCH_LOOP_TIMEOUT": "0",
                 "BENCH_BLOCKSPARSE_TIMEOUT": "0",
                 "BENCH_EMBED_TIMEOUT": "0",
-                "BENCH_TENANT_TIMEOUT": "0"})
+                "BENCH_TENANT_TIMEOUT": "0",
+                "BENCH_INCIDENT_TIMEOUT": "0"})
     # --no-ledger: a test invocation must not append to the repo's
     # judged PERF_LEDGER.jsonl trajectory
     out = subprocess.run(
@@ -669,6 +670,46 @@ def test_tenant_measurements_contract():
         == out["isolation_p99_ratio"]
     assert rec["tenant_victim_shed_rate"] == 0.0
     assert rec["tenant_bad_params_served"] == 0
+    for key in bench.LEDGER_FIELDS:
+        assert key in rec
+
+
+def test_incident_measurements_contract():
+    """The incident leg's measurement dict carries the judged fields:
+    top-1 attribution vs the ground-truth chaos journal across all
+    five fault classes, the must-stay-zero clean-control false-
+    incident count, capture latency, and the amortized per-pump-round
+    observe tax — a small in-process run; the full leg is `--incident`
+    and its one JSON line lands in INCIDENT_r01.json."""
+    bench = _bench()
+    out = bench._incident_measurements(steady_intervals=60)
+    assert out["attribution_total"] == 5
+    assert set(out["scenarios"]) == {
+        "replica_kill", "poisoned_deploy", "tenant_flood",
+        "straggler_delay", "kv_exhaustion"}
+    # every injected fault finalized an incident whose top-1 suspect
+    # is the ground-truth chaos injection (acceptance: >= 4 of 5; the
+    # deterministic harness lands all 5)
+    assert out["all_finalized"] is True
+    assert out["attribution_top1"] >= 4
+    assert out["attribution_top1_frac"] >= 0.8
+    # zero incidents opened over the clean control
+    assert out["false_incidents"] == 0
+    assert out["capture_latency_s"] is not None
+    assert out["capture_latency_s"] < 0.5
+    assert out["overhead_pct"] < 2.0
+    # and the record flattens into the schema-stable ledger fields
+    rec = bench.ledger_record({"incident": {
+        "attribution_top1_frac": out["attribution_top1_frac"],
+        "false_incidents": out["false_incidents"],
+        "capture_latency_s": out["capture_latency_s"],
+        "overhead_pct": out["overhead_pct"]}})
+    assert rec["incident_attribution_top1"] \
+        == out["attribution_top1_frac"]
+    assert rec["incident_false_positives"] == 0
+    assert rec["incident_capture_latency_s"] \
+        == out["capture_latency_s"]
+    assert rec["incident_overhead_pct"] == out["overhead_pct"]
     for key in bench.LEDGER_FIELDS:
         assert key in rec
 

@@ -170,6 +170,18 @@ DEFAULT_TOLERANCES = {
     "tenant_isolation_p99_ratio": ("lower", 1.00, 3.0),
     "tenant_victim_shed_rate": ("lower", 0.0),
     "tenant_bad_params_served": ("lower", 0.0),
+    # incident engine (ISSUE 20): top-1 causal attribution against
+    # the ground-truth chaos journal may only rise (zero tolerance —
+    # the five-fault harness is deterministic); the clean control's
+    # false-incident count must stay ZERO (an incident opened on a
+    # healthy fleet poisons trust in every real one); capture latency
+    # and the amortized per-pump-round observe tax may only fall
+    # (wide tolerance + abs floors absorb shared-CPU perf_counter
+    # jitter on sub-millisecond walls)
+    "incident_attribution_top1": ("higher", 0.0),
+    "incident_false_positives": ("lower", 0.0),
+    "incident_capture_latency_s": ("lower", 1.00, 0.5),
+    "incident_overhead_pct": ("lower", 1.00, 1.0),
 }
 
 

@@ -91,9 +91,10 @@ def analyze(traces: dict) -> dict:
     # noisy-neighbor incident reads straight off kept traces
     tenants = {}
     for r in rows:
-        t = r.get("tenant")
-        if t is None:
-            continue
+        # traces with no tenant stamp (single-model fleets, spans
+        # predating multi-tenancy) land in the "_default" bucket —
+        # attribution must never silently drop wall seconds
+        t = r.get("tenant") or "_default"
         agg = tenants.setdefault(
             t, {"traces": 0, "wall_s": 0.0, "phase_seconds": {}})
         agg["traces"] += 1
